@@ -1,0 +1,33 @@
+"""fluidframework_tpu — a TPU-native real-time collaboration framework.
+
+A ground-up rebuild of the capabilities of Fluid Framework (reference:
+wizmea/FluidFramework; see /root/repo/SURVEY.md for the structural analysis and
+its provenance caveats): operation-based optimistic replication of Distributed
+Data Structures under a total-order sequencing service, with summarization and
+catch-up replay.
+
+The architecture is TPU-first, not a port:
+
+- ``protocol/``  — the op/sequence-number model (seq, clientSeq, refSeq, MSN),
+  the in-process total-order sequencer, and the canonical summary-tree model.
+  Pure Python, zero JAX.  (Reference capability: protocol-definitions,
+  protocol-base, memory-orderer — SURVEY.md §1 layers 2–4.)
+- ``dds/``       — CPU oracle implementations of the merge engines
+  (SharedMap/Directory, merge-tree/SharedString, IntervalCollection,
+  SharedMatrix, SharedTree).  These define the merge semantics, serve as the
+  correctness oracles for the device kernels, and are the 1× CPU baseline.
+  (Reference capability: packages/dds/* — SURVEY.md §2.2.)
+- ``runtime/``   — the ChannelFactory plugin boundary, datastore/container
+  runtime (op routing, batching, summarization).  (SURVEY.md §2.1.)
+- ``ops/``       — the TPU batch-merge path: op streams packed into ragged
+  tensors, JAX-traced op-fold kernels vmapped over thousands of documents.
+  (The BASELINE.json north star.)
+- ``parallel/``  — device mesh / sharding: pjit over a document-sharded Mesh,
+  merged state assembled with XLA collectives over ICI.
+- ``service/``   — ordering-service capabilities (sequencer service, durable op
+  log, summary storage, catch-up service).  (SURVEY.md §2.3.)
+- ``testing/``   — mock runtimes (MockContainerRuntimeFactory pattern) and the
+  seeded fuzz harness with convergence asserts.  (SURVEY.md §4.)
+"""
+
+__version__ = "0.1.0"

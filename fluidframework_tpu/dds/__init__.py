@@ -1,0 +1,26 @@
+"""CPU-oracle Distributed Data Structures.
+
+Clean-room Python implementations of the merge engines (SURVEY.md §2.2;
+reference capability: packages/dds/* — upstream paths UNVERIFIED, empty
+reference mount).  These define the framework's merge semantics (documented in
+SEMANTICS.md), serve as correctness oracles for the TPU kernels in
+``fluidframework_tpu.ops``, and are the 1× CPU baseline the 50× north star is
+measured against.
+"""
+
+from .shared_object import SharedObject
+from .map import SharedMap, SharedDirectory
+from .merge_tree import MergeTreeOracle, Segment
+from .sequence import SharedString
+from .cell_counter import SharedCell, SharedCounter
+
+__all__ = [
+    "SharedObject",
+    "SharedMap",
+    "SharedDirectory",
+    "MergeTreeOracle",
+    "Segment",
+    "SharedString",
+    "SharedCell",
+    "SharedCounter",
+]

@@ -1,0 +1,277 @@
+"""SharedMap / SharedDirectory — the LWW key-value merge engines.
+
+Capability-equivalent of the reference's map package (SURVEY.md §2.2:
+``SharedMap``/``MapKernel``/``SharedDirectory``; upstream paths UNVERIFIED —
+empty reference mount).
+
+Merge semantics (documented in SEMANTICS.md §map):
+
+- Sequenced ops apply in total order; set/delete are last-writer-wins because
+  later ops simply overwrite.
+- Optimistic local reads: a pending local op on a key will be sequenced with a
+  *larger* seq than any op arriving before its ack, so it wins — therefore
+  remote ops on keys with pending local ops are **not** applied to the local
+  view (pending-key tracking, the reference's MapKernel pattern).  The same
+  argument applies to a pending ``clear``.
+- ``clear`` empties sequenced state; pending local sets survive (they will
+  re-populate when sequenced).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .shared_object import SharedObject
+
+
+class MapKernel:
+    """The LWW kernel shared by SharedMap and each SharedDirectory node.
+
+    This is the logic the ``ops.map_kernel`` TPU path replays in bulk: final
+    value per key = the op with the maximum seq for that key, with deletes and
+    clears masking earlier sets.
+    """
+
+    def __init__(self) -> None:
+        self.data: Dict[str, Any] = {}
+        self._pending_keys: Dict[str, int] = {}
+        self._pending_clears = 0
+
+    # -- local (optimistic) ----------------------------------------------------
+
+    def local_set(self, key: str, value: Any, attached: bool) -> None:
+        self.data[key] = value
+        if attached:
+            self._pending_keys[key] = self._pending_keys.get(key, 0) + 1
+
+    def local_delete(self, key: str, attached: bool) -> bool:
+        existed = key in self.data
+        self.data.pop(key, None)
+        if attached:
+            self._pending_keys[key] = self._pending_keys.get(key, 0) + 1
+        return existed
+
+    def local_clear(self, attached: bool) -> None:
+        self.data.clear()
+        if attached:
+            self._pending_clears += 1
+            self._pending_keys.clear()
+
+    # -- sequenced -------------------------------------------------------------
+
+    def process(self, op: dict, local: bool) -> None:
+        kind = op["kind"]
+        if kind == "clear":
+            if local:
+                self._pending_clears -= 1
+                return  # already applied optimistically
+            if self._pending_clears > 0:
+                return  # our pending clear will win (larger seq)
+            # Remote clear: drop sequenced state; keep keys with pending local
+            # ops (those will be re-established when our ops sequence).
+            survivors = {
+                k: v for k, v in self.data.items() if self._pending_keys.get(k, 0) > 0
+            }
+            self.data = survivors
+            return
+
+        key = op["key"]
+        if local:
+            # Ack of our own op: value already applied; release the pending hold.
+            n = self._pending_keys.get(key, 0) - 1
+            if n <= 0:
+                self._pending_keys.pop(key, None)
+            else:
+                self._pending_keys[key] = n
+            return
+        if self._pending_clears > 0 or self._pending_keys.get(key, 0) > 0:
+            return  # a pending local op outranks this remote op
+        if kind == "set":
+            self.data[key] = op["value"]
+        elif kind == "delete":
+            self.data.pop(key, None)
+        else:
+            raise ValueError(f"unknown map op kind {kind!r}")
+
+    # -- summary ---------------------------------------------------------------
+
+    def summary_obj(self) -> dict:
+        return {"data": self.data}
+
+    def load_obj(self, obj: dict) -> None:
+        self.data = dict(obj["data"])
+        self._pending_keys.clear()
+        self._pending_clears = 0
+
+
+class SharedMap(SharedObject):
+    """Flat LWW key-value DDS."""
+
+    TYPE = "map-tpu"
+
+    def __init__(self, object_id: str) -> None:
+        super().__init__(object_id)
+        self._kernel = MapKernel()
+
+    # -- public API ------------------------------------------------------------
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._kernel.data.get(key, default)
+
+    def has(self, key: str) -> bool:
+        return key in self._kernel.data
+
+    def keys(self):
+        return self._kernel.data.keys()
+
+    def __len__(self) -> int:
+        return len(self._kernel.data)
+
+    def set(self, key: str, value: Any) -> None:
+        self._kernel.local_set(key, value, self.is_attached)
+        self._submit_local_op({"kind": "set", "key": key, "value": value})
+
+    def delete(self, key: str) -> bool:
+        existed = self._kernel.local_delete(key, self.is_attached)
+        self._submit_local_op({"kind": "delete", "key": key})
+        return existed
+
+    def clear(self) -> None:
+        self._kernel.local_clear(self.is_attached)
+        self._submit_local_op({"kind": "clear"})
+
+    # -- SharedObject ----------------------------------------------------------
+
+    def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
+        self._kernel.process(msg.contents, local)
+
+    def summarize(self, min_seq: int = 0) -> SummaryTree:
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json(self._kernel.summary_obj()))
+        return tree
+
+    def load(self, summary: SummaryTree) -> None:
+        import json
+
+        self._kernel.load_obj(json.loads(summary.blob_bytes("header")))
+        self.discard_pending()
+
+
+class SubDirectory:
+    """One node of a SharedDirectory: a MapKernel plus named children."""
+
+    def __init__(self) -> None:
+        self.kernel = MapKernel()
+        self.children: Dict[str, "SubDirectory"] = {}
+
+    def resolve(self, path: str, create: bool = False) -> Optional["SubDirectory"]:
+        node = self
+        for part in [p for p in path.split("/") if p]:
+            child = node.children.get(part)
+            if child is None:
+                if not create:
+                    return None
+                child = SubDirectory()
+                node.children[part] = child
+            node = child
+        return node
+
+    def summary_obj(self) -> dict:
+        return {
+            "data": self.kernel.data,
+            "subdirs": {k: v.summary_obj() for k, v in sorted(self.children.items())},
+        }
+
+    def load_obj(self, obj: dict) -> None:
+        self.kernel.load_obj(obj)
+        self.children = {}
+        for name, sub in obj.get("subdirs", {}).items():
+            child = SubDirectory()
+            child.load_obj(sub)
+            self.children[name] = child
+
+
+class SharedDirectory(SharedObject):
+    """Hierarchical LWW key-value DDS: a tree of SubDirectories, each with its
+    own MapKernel.  Ops carry an absolute path."""
+
+    TYPE = "directory-tpu"
+
+    def __init__(self, object_id: str) -> None:
+        super().__init__(object_id)
+        self._root = SubDirectory()
+
+    # -- public API ------------------------------------------------------------
+
+    @property
+    def root(self) -> SubDirectory:
+        return self._root
+
+    def get(self, key: str, path: str = "/", default: Any = None) -> Any:
+        node = self._root.resolve(path)
+        return default if node is None else node.kernel.data.get(key, default)
+
+    def set(self, key: str, value: Any, path: str = "/") -> None:
+        node = self._root.resolve(path, create=True)
+        node.kernel.local_set(key, value, self.is_attached)
+        self._submit_local_op(
+            {"kind": "set", "path": path, "key": key, "value": value}
+        )
+
+    def delete(self, key: str, path: str = "/") -> None:
+        node = self._root.resolve(path, create=True)
+        node.kernel.local_delete(key, self.is_attached)
+        self._submit_local_op({"kind": "delete", "path": path, "key": key})
+
+    def clear(self, path: str = "/") -> None:
+        node = self._root.resolve(path, create=True)
+        node.kernel.local_clear(self.is_attached)
+        self._submit_local_op({"kind": "clear", "path": path})
+
+    def create_subdirectory(self, path: str) -> None:
+        self._root.resolve(path, create=True)
+        self._submit_local_op({"kind": "createSubdir", "path": path})
+
+    def delete_subdirectory(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise ValueError("cannot delete root")
+        parent = self._root.resolve("/".join(parts[:-1]))
+        if parent is not None:
+            parent.children.pop(parts[-1], None)
+        self._submit_local_op({"kind": "deleteSubdir", "path": path})
+
+    # -- SharedObject ----------------------------------------------------------
+
+    def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
+        op = msg.contents
+        kind = op["kind"]
+        if kind == "createSubdir":
+            # Idempotent create; both local and remote paths converge.
+            self._root.resolve(op["path"], create=True)
+            return
+        if kind == "deleteSubdir":
+            # Applied on both the local ack and the remote path (idempotent):
+            # a concurrent createSubdir sequenced before this delete must be
+            # deleted again on the deleting replica for convergence.
+            parts = [p for p in op["path"].split("/") if p]
+            parent = self._root.resolve("/".join(parts[:-1]))
+            if parent is not None:
+                parent.children.pop(parts[-1], None)
+            return
+        node = self._root.resolve(op["path"], create=True)
+        node.kernel.process(op, local)
+
+    def summarize(self, min_seq: int = 0) -> SummaryTree:
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json(self._root.summary_obj()))
+        return tree
+
+    def load(self, summary: SummaryTree) -> None:
+        import json
+
+        self._root = SubDirectory()
+        self._root.load_obj(json.loads(summary.blob_bytes("header")))
+        self.discard_pending()

@@ -1,0 +1,511 @@
+"""The merge-tree — core sequence merge engine (CPU oracle).
+
+Capability-equivalent of the reference's merge-tree package (SURVEY.md §2.2:
+``MergeTree``/``Client``/``PartialSequenceLengths``/zamboni; upstream paths
+UNVERIFIED — empty reference mount).  This oracle defines the framework's
+sequence semantics exactly; the TPU kernel in ``ops.mergetree_kernel`` must
+reproduce them bit-for-bit (asserted by the fuzz harness and golden-summary
+tests).  See SEMANTICS.md §merge-tree for the full rules; in brief:
+
+**State** — an ordered list of segments.  Each segment carries the text run,
+``(insert_seq, insert_client)``, optional ``(removed_seq, removed_client)``
+plus overlap-removers, and LWW properties.  ``UNASSIGNED_SEQ`` (-1) marks
+optimistic local state awaiting ack; it is *newer* than any assigned seq.
+
+**Visibility** — an op resolves positions against its *view*
+``(ref_seq, client)``: a segment contributes its length iff its insert is
+visible (``insert_seq <= ref_seq`` or same client) and its removal is not
+(``removed_seq <= ref_seq`` or removed by this client).
+
+**Insert tie-break (RGA)** — after consuming ``pos`` visible characters, the
+walk sits before a (possibly empty) run of zero-visible segments.  It skips
+past tombstones (insert-visible but removed in the view) and past pending
+local segments (they will sequence later, i.e. newer), and stops in front of
+the first *sequenced concurrent insert* (``insert_seq > ref_seq``, other
+client): since ops apply in total order, the op being applied is the newest,
+and same-position concurrent inserts are kept newest-first.  This is the rule
+that makes optimistic local placement agree with every remote replica's
+placement.
+
+**Remove** — first remove in sequence order wins ``removed_seq``; later
+overlapping removers are recorded in ``overlap_removers`` (their views must
+still see the segment as removed).  A pending local removal loses its claim to
+an earlier-sequenced remote remove.  Concurrent inserts into a concurrently
+removed range survive (no obliterate yet — matches reference default).
+
+**Zamboni** — once the collaboration window floor (``min_seq``) passes a
+tombstone's ``removed_seq``, no future op's view can distinguish it, so it is
+physically collected.  Summaries are emitted in *normalized* form (seqs at or
+below min_seq clamped to the universal epoch, adjacent identical segments
+merged) so replicas and device kernels produce byte-identical bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set
+
+from ..protocol.messages import UNASSIGNED_SEQ
+
+# Client id sentinel that matches no real client (used for the "sequenced
+# state only" summary view).
+NO_CLIENT = "\x00no-client"
+
+
+class Segment:
+    """A run of characters sharing one insert/remove/annotate history."""
+
+    __slots__ = (
+        "text",
+        "insert_seq",
+        "insert_client",
+        "removed_seq",
+        "removed_client",
+        "overlap_removers",
+        "props",
+        "pending_props",
+        "pending_groups",
+        "refs",
+    )
+
+    def __init__(
+        self,
+        text: str,
+        insert_seq: int,
+        insert_client: str,
+        props: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.text = text
+        self.insert_seq = insert_seq
+        self.insert_client = insert_client
+        self.removed_seq: Optional[int] = None
+        self.removed_client: Optional[str] = None
+        self.overlap_removers: Set[str] = set()
+        self.props: Dict[str, Any] = dict(props) if props else {}
+        self.pending_props: Dict[str, int] = {}
+        self.pending_groups: List["SegmentGroup"] = []
+        self.refs: List["LocalReference"] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        r = f" -({self.removed_seq},{self.removed_client})" if self.removed_seq is not None else ""
+        return f"Seg({self.text!r} @{self.insert_seq},{self.insert_client}{r})"
+
+
+class SegmentGroup:
+    """Tracks the segments affected by one pending local op, so the ack can
+    assign sequence numbers / release pending holds.  Segment splits add the
+    new half to every group the original belonged to (reference capability:
+    merge-tree SegmentGroup)."""
+
+    __slots__ = ("kind", "segments", "props")
+
+    def __init__(self, kind: str, props: Optional[Dict[str, Any]] = None) -> None:
+        self.kind = kind
+        self.segments: List[Segment] = []
+        self.props = props or {}
+
+    def add(self, seg: Segment) -> None:
+        self.segments.append(seg)
+        seg.pending_groups.append(self)
+
+
+class LocalReference:
+    """A position anchored to (segment, offset) that survives edits and slides
+    off removed segments (reference capability: LocalReferencePosition).
+    Used by IntervalCollection."""
+
+    __slots__ = ("segment", "offset", "slide")
+
+    def __init__(self, segment: Optional[Segment], offset: int, slide: bool = True):
+        self.segment = segment
+        self.offset = offset
+        self.slide = slide
+
+    def attach(self, segment: Segment, offset: int) -> None:
+        if self.segment is not None and self in self.segment.refs:
+            self.segment.refs.remove(self)
+        self.segment = segment
+        self.offset = offset
+        segment.refs.append(self)
+
+
+class MergeTreeOracle:
+    """The document state + op application walk.
+
+    Performance note: the oracle stores segments in a flat Python list and
+    resolves positions with an O(n) masked walk — the structure the TPU kernel
+    mirrors with masked prefix sums over a segment pool.  (The reference's
+    B-tree + PartialSequenceLengths achieve O(log n); our device path gets its
+    speed from vectorizing the walk instead.)
+    """
+
+    def __init__(self) -> None:
+        self.segments: List[Segment] = []
+        self.current_seq = 0
+        self.min_seq = 0
+
+    # -- visibility ------------------------------------------------------------
+
+    @staticmethod
+    def _insert_visible(seg: Segment, ref_seq: int, client: str) -> bool:
+        return (
+            seg.insert_seq != UNASSIGNED_SEQ and seg.insert_seq <= ref_seq
+        ) or seg.insert_client == client
+
+    @staticmethod
+    def _removed_in_view(seg: Segment, ref_seq: int, client: str) -> bool:
+        if seg.removed_seq is None:
+            return False
+        if seg.removed_seq != UNASSIGNED_SEQ and seg.removed_seq <= ref_seq:
+            return True
+        return client == seg.removed_client or client in seg.overlap_removers
+
+    def _visible_len(self, seg: Segment, ref_seq: int, client: str) -> int:
+        if not self._insert_visible(seg, ref_seq, client):
+            return 0
+        if self._removed_in_view(seg, ref_seq, client):
+            return 0
+        return len(seg.text)
+
+    def length(self, ref_seq: Optional[int] = None, client: str = NO_CLIENT) -> int:
+        """Visible length in a view — the oracle form of partial lengths."""
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        return sum(self._visible_len(s, ref_seq, client) for s in self.segments)
+
+    def get_text(self, ref_seq: Optional[int] = None, client: str = NO_CLIENT) -> str:
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        return "".join(
+            s.text for s in self.segments if self._visible_len(s, ref_seq, client) > 0
+        )
+
+    # -- structural helpers ----------------------------------------------------
+
+    def _split(self, idx: int, offset: int) -> None:
+        """Split segments[idx] at text offset (0 < offset < len)."""
+        seg = self.segments[idx]
+        assert 0 < offset < len(seg.text)
+        right = Segment(seg.text[offset:], seg.insert_seq, seg.insert_client)
+        right.removed_seq = seg.removed_seq
+        right.removed_client = seg.removed_client
+        right.overlap_removers = set(seg.overlap_removers)
+        right.props = dict(seg.props)
+        right.pending_props = dict(seg.pending_props)
+        seg.text = seg.text[:offset]
+        # The split halves both belong to any pending op group the original did.
+        for group in list(seg.pending_groups):
+            group.add(right)
+        # Local references at offsets past the split move to the right half.
+        keep, move = [], []
+        for ref in seg.refs:
+            (move if ref.offset >= offset else keep).append(ref)
+        seg.refs = keep
+        for ref in move:
+            ref.segment = right
+            ref.offset -= offset
+            right.refs.append(ref)
+        self.segments.insert(idx + 1, right)
+
+    @staticmethod
+    def _is_sequenced_concurrent_insert(seg: Segment, ref_seq: int, client: str) -> bool:
+        return (
+            seg.insert_seq != UNASSIGNED_SEQ
+            and seg.insert_seq > ref_seq
+            and seg.insert_client != client
+        )
+
+    def _insert_index(self, pos: int, ref_seq: int, client: str) -> int:
+        """Resolve an insert position to a list index (splitting if needed).
+
+        Phase 1 consumes ``pos`` visible-in-view characters; phase 2 applies
+        the boundary tie-break documented in the module docstring.
+        """
+        idx, c = 0, 0
+        while idx < len(self.segments) and c < pos:
+            seg = self.segments[idx]
+            v = self._visible_len(seg, ref_seq, client)
+            if c + v > pos:
+                self._split(idx, pos - c)
+                return idx + 1
+            c += v
+            idx += 1
+        if c < pos:
+            raise ValueError(f"insert pos {pos} beyond view length {c}")
+        while idx < len(self.segments):
+            seg = self.segments[idx]
+            if self._visible_len(seg, ref_seq, client) > 0:
+                break
+            if self._is_sequenced_concurrent_insert(seg, ref_seq, client):
+                break  # newest-first among same-position concurrent inserts
+            idx += 1  # skip tombstones and pending local segments
+        return idx
+
+    def _walk_range(self, start: int, end: int, ref_seq: int, client: str):
+        """Yield the segments exactly covering visible range [start, end) in
+        the view, splitting at the boundaries.  Used by remove/annotate."""
+        if start >= end:
+            return
+        idx, c = 0, 0
+        while idx < len(self.segments) and c < end:
+            seg = self.segments[idx]
+            v = self._visible_len(seg, ref_seq, client)
+            if v > 0:
+                s0, s1 = c, c + v
+                lo, hi = max(start, s0), min(end, s1)
+                if lo < hi:
+                    if lo > s0:
+                        self._split(idx, lo - s0)
+                        idx += 1
+                        seg = self.segments[idx]
+                        s0 = lo
+                    if hi < s1:
+                        self._split(idx, hi - s0)
+                        seg = self.segments[idx]
+                    yield seg
+                c += v
+            idx += 1
+
+    # -- op application (sequenced or optimistic-local) ------------------------
+
+    def apply_insert(
+        self,
+        pos: int,
+        text: str,
+        seq: int,
+        client: str,
+        ref_seq: int,
+        props: Optional[Dict[str, Any]] = None,
+        group: Optional[SegmentGroup] = None,
+    ) -> Segment:
+        idx = self._insert_index(pos, ref_seq, client)
+        seg = Segment(text, seq, client, props)
+        self.segments.insert(idx, seg)
+        if group is not None:
+            group.add(seg)
+        return seg
+
+    def apply_remove(
+        self,
+        start: int,
+        end: int,
+        seq: int,
+        client: str,
+        ref_seq: int,
+        group: Optional[SegmentGroup] = None,
+    ) -> None:
+        for seg in self._walk_range(start, end, ref_seq, client):
+            if seg.removed_seq is None:
+                seg.removed_seq = seq
+                seg.removed_client = client
+            elif seg.removed_seq == UNASSIGNED_SEQ:
+                # A pending local removal loses to this earlier-sequenced
+                # remove; demote the pending remover to an overlap remover.
+                if seq != UNASSIGNED_SEQ:
+                    seg.overlap_removers.add(seg.removed_client)
+                    seg.removed_seq = seq
+                    seg.removed_client = client
+                # (seq == UNASSIGNED here is impossible: a pending-removed
+                # segment is invisible to the local view.)
+            else:
+                seg.overlap_removers.add(client)
+            if seq != UNASSIGNED_SEQ:
+                self._slide_refs(seg)
+            if group is not None:
+                group.add(seg)
+
+    def apply_annotate(
+        self,
+        start: int,
+        end: int,
+        props: Dict[str, Any],
+        seq: int,
+        client: str,
+        ref_seq: int,
+        group: Optional[SegmentGroup] = None,
+    ) -> None:
+        pending = seq == UNASSIGNED_SEQ
+        for seg in self._walk_range(start, end, ref_seq, client):
+            for key, value in props.items():
+                if pending:
+                    self._set_prop(seg, key, value)
+                    seg.pending_props[key] = seg.pending_props.get(key, 0) + 1
+                else:
+                    if seg.pending_props.get(key, 0) > 0:
+                        continue  # a pending local annotate outranks this op
+                    self._set_prop(seg, key, value)
+            if group is not None:
+                group.add(seg)
+
+    @staticmethod
+    def _set_prop(seg: Segment, key: str, value: Any) -> None:
+        if value is None:
+            seg.props.pop(key, None)  # null deletes the property
+        else:
+            seg.props[key] = value
+
+    # -- ack (own op sequenced) ------------------------------------------------
+
+    def ack_insert(self, group: SegmentGroup, seq: int) -> None:
+        for seg in group.segments:
+            if seg.insert_seq == UNASSIGNED_SEQ:
+                seg.insert_seq = seq
+            seg.pending_groups.remove(group)
+
+    def ack_remove(self, group: SegmentGroup, seq: int, client: str) -> None:
+        for seg in group.segments:
+            if seg.removed_seq == UNASSIGNED_SEQ and seg.removed_client == client:
+                seg.removed_seq = seq
+            self._slide_refs(seg)
+            seg.pending_groups.remove(group)
+
+    def ack_annotate(self, group: SegmentGroup, props: Dict[str, Any]) -> None:
+        for seg in group.segments:
+            for key in props:
+                n = seg.pending_props.get(key, 0) - 1
+                if n <= 0:
+                    seg.pending_props.pop(key, None)
+                else:
+                    seg.pending_props[key] = n
+            seg.pending_groups.remove(group)
+
+    # -- local references (interval anchors) -----------------------------------
+
+    def _slide_refs(self, seg: Segment) -> None:
+        """Slide references off a (sequenced-)removed segment: forward to the
+        next surviving segment's start, else backward to the previous one's
+        end (reference capability: slideOnRemove)."""
+        if not seg.refs:
+            return
+        try:
+            idx = self.segments.index(seg)
+        except ValueError:
+            return
+        target, offset = None, 0
+        for j in range(idx + 1, len(self.segments)):
+            if self.segments[j].removed_seq is None:
+                target, offset = self.segments[j], 0
+                break
+        if target is None:
+            for j in range(idx - 1, -1, -1):
+                if self.segments[j].removed_seq is None:
+                    target, offset = self.segments[j], len(self.segments[j].text)
+                    break
+        # Non-sliding (stay-on-remove) refs remain attached to the tombstone,
+        # which also pins it from zamboni collection.
+        for ref in [r for r in seg.refs if r.slide]:
+            seg.refs.remove(ref)
+            if target is None:
+                ref.segment, ref.offset = None, 0
+            else:
+                ref.attach(target, offset)
+
+    def create_reference(self, pos: int, ref_seq: Optional[int] = None,
+                         client: str = NO_CLIENT, slide: bool = True) -> LocalReference:
+        """Anchor a reference at visible position ``pos`` in the view."""
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        idx, c = 0, 0
+        for seg in self.segments:
+            v = self._visible_len(seg, ref_seq, client)
+            if v > 0 and c + v > pos:
+                ref = LocalReference(None, 0, slide)
+                ref.attach(seg, pos - c)
+                return ref
+            c += v
+        # End of document: anchor to the last visible segment's end.
+        ref = LocalReference(None, 0, slide)
+        for seg in reversed(self.segments):
+            if self._visible_len(seg, ref_seq, client) > 0:
+                ref.attach(seg, len(seg.text))
+                return ref
+        return ref  # empty document: detached reference at 0
+
+    def reference_position(self, ref: LocalReference, ref_seq: Optional[int] = None,
+                           client: str = NO_CLIENT) -> int:
+        if ref.segment is None:
+            return 0
+        if ref_seq is None:
+            ref_seq = self.current_seq
+        pos = 0
+        for seg in self.segments:
+            if seg is ref.segment:
+                if self._visible_len(seg, ref_seq, client) > 0:
+                    return pos + min(ref.offset, len(seg.text))
+                return pos
+            pos += self._visible_len(seg, ref_seq, client)
+        return pos
+
+    # -- zamboni & summaries ---------------------------------------------------
+
+    def zamboni(self, min_seq: Optional[int] = None) -> None:
+        """Collect tombstones the collaboration window can no longer see."""
+        if min_seq is not None:
+            self.min_seq = max(self.min_seq, min_seq)
+        msn = self.min_seq
+        survivors = []
+        for seg in self.segments:
+            dead = (
+                seg.removed_seq is not None
+                and seg.removed_seq != UNASSIGNED_SEQ
+                and seg.removed_seq <= msn
+                and not seg.pending_groups
+                and not seg.refs
+            )
+            if not dead:
+                survivors.append(seg)
+        self.segments = survivors
+
+    def normalized_records(self) -> List[dict]:
+        """Canonical record list for summaries: sequenced state only, seqs at
+        or below min_seq clamped to the universal epoch (0 / no client),
+        window-expired tombstones dropped, adjacent identical runs merged.
+        Both the oracle and the device kernel summary paths emit exactly this,
+        which is what makes byte-identity checkable."""
+        msn = self.min_seq
+        records: List[dict] = []
+        for seg in self.segments:
+            if seg.insert_seq == UNASSIGNED_SEQ:
+                continue  # pending local: not part of the sequenced state
+            rs, rc = seg.removed_seq, seg.removed_client
+            if rs == UNASSIGNED_SEQ:
+                rs, rc = None, None  # pending removal: not sequenced
+            if rs is not None and rs <= msn:
+                continue  # expired tombstone
+            s, c = seg.insert_seq, seg.insert_client
+            if s <= msn:
+                s, c = 0, None
+            rec = {"t": seg.text, "s": s, "c": c}
+            if rs is not None:
+                rec["rs"] = rs
+                rec["rc"] = rc
+            if seg.props:
+                rec["p"] = dict(sorted(seg.props.items()))
+            if records:
+                prev = records[-1]
+                if (
+                    prev["s"] == rec["s"]
+                    and prev["c"] == rec["c"]
+                    and prev.get("rs") == rec.get("rs")
+                    and prev.get("rc") == rec.get("rc")
+                    and prev.get("p") == rec.get("p")
+                ):
+                    prev["t"] += rec["t"]
+                    continue
+            records.append(rec)
+        return records
+
+    def load_records(self, records: List[dict], seq: int, min_seq: int) -> None:
+        self.segments = []
+        for rec in records:
+            seg = Segment(
+                rec["t"],
+                rec["s"],
+                rec["c"] if rec["c"] is not None else NO_CLIENT,
+                rec.get("p"),
+            )
+            if "rs" in rec:
+                seg.removed_seq = rec["rs"]
+                seg.removed_client = rec.get("rc")
+            self.segments.append(seg)
+        self.current_seq = seq
+        self.min_seq = min_seq

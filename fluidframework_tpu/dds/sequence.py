@@ -1,0 +1,170 @@
+"""SharedString — collaborative text over the merge-tree.
+
+Capability-equivalent of the reference's sequence package (SURVEY.md §2.2:
+``SharedString``/``SharedSegmentSequence``; upstream paths UNVERIFIED — empty
+reference mount).  Wire format of an op (the unit the sequencer stamps and the
+TPU replay path packs into ragged tensors):
+
+    {"kind": "insert",   "pos": int, "text": str, "props": {...}?}
+    {"kind": "remove",   "start": int, "end": int}
+    {"kind": "annotate", "start": int, "end": int, "props": {...}}
+
+Positions are always relative to the op's view ``(ref_seq, client)``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Any, Deque, Dict, Optional
+
+from ..protocol.messages import UNASSIGNED_SEQ, SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .merge_tree import MergeTreeOracle, SegmentGroup, NO_CLIENT
+from .shared_object import SharedObject
+
+
+class SharedString(SharedObject):
+    """Collaborative sequence of characters with LWW range annotations."""
+
+    TYPE = "sequence-tpu"
+
+    def __init__(self, object_id: str) -> None:
+        super().__init__(object_id)
+        self.tree = MergeTreeOracle()
+        # FIFO of SegmentGroups for pending local ops (acks arrive in order).
+        self._pending_groups: Deque[SegmentGroup] = collections.deque()
+
+    # -- reads -----------------------------------------------------------------
+
+    @property
+    def text(self) -> str:
+        """The local optimistic view (sequenced state + own pending ops)."""
+        return self.tree.get_text(client=self._local_client())
+
+    def __len__(self) -> int:
+        return self.tree.length(client=self._local_client())
+
+    def _local_client(self) -> str:
+        return self.client_id if self.client_id is not None else NO_CLIENT
+
+    # -- local edits (optimistic apply, then submit) ---------------------------
+
+    def insert_text(self, pos: int, text: str,
+                    props: Optional[Dict[str, Any]] = None) -> None:
+        if not text:
+            return
+        client = self._local_client()
+        group = SegmentGroup("insert")
+        self.tree.apply_insert(
+            pos, text, UNASSIGNED_SEQ, client, self.tree.current_seq,
+            props=props, group=group,
+        )
+        self._pending_groups.append(group)
+        op = {"kind": "insert", "pos": pos, "text": text}
+        if props:
+            op["props"] = props
+        self._submit_local_op(op)
+        if not self.is_attached:
+            self._ack_detached(group, op)
+
+    def remove_range(self, start: int, end: int) -> None:
+        if start >= end:
+            return
+        client = self._local_client()
+        group = SegmentGroup("remove")
+        self.tree.apply_remove(
+            start, end, UNASSIGNED_SEQ, client, self.tree.current_seq, group=group
+        )
+        self._pending_groups.append(group)
+        self._submit_local_op({"kind": "remove", "start": start, "end": end})
+        if not self.is_attached:
+            self._ack_detached(group, {"kind": "remove"})
+
+    def annotate_range(self, start: int, end: int, props: Dict[str, Any]) -> None:
+        if start >= end or not props:
+            return
+        client = self._local_client()
+        group = SegmentGroup("annotate", props=props)
+        self.tree.apply_annotate(
+            start, end, props, UNASSIGNED_SEQ, client, self.tree.current_seq,
+            group=group,
+        )
+        self._pending_groups.append(group)
+        self._submit_local_op(
+            {"kind": "annotate", "start": start, "end": end, "props": props}
+        )
+        if not self.is_attached:
+            self._ack_detached(group, {"kind": "annotate", "props": props})
+
+    def _ack_detached(self, group: SegmentGroup, op: dict) -> None:
+        """Detached (never-connected) DDS: ops are immediately 'sequenced'
+        locally at seq 0 so the state is summary-ready."""
+        self._pending_groups.pop()
+        if group.kind == "insert":
+            self.tree.ack_insert(group, 0)
+        elif group.kind == "remove":
+            self.tree.ack_remove(group, 0, self._local_client())
+        else:
+            self.tree.ack_annotate(group, op.get("props", {}))
+
+    # -- sequenced path --------------------------------------------------------
+
+    def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
+        op = msg.contents
+        kind = op["kind"]
+        if local:
+            group = self._pending_groups.popleft()
+            assert group.kind == kind, f"ack mismatch: {group.kind} vs {kind}"
+            if kind == "insert":
+                self.tree.ack_insert(group, msg.seq)
+            elif kind == "remove":
+                self.tree.ack_remove(group, msg.seq, msg.client_id)
+            elif kind == "annotate":
+                self.tree.ack_annotate(group, op["props"])
+        else:
+            if kind == "insert":
+                self.tree.apply_insert(
+                    op["pos"], op["text"], msg.seq, msg.client_id, msg.ref_seq,
+                    props=op.get("props"),
+                )
+            elif kind == "remove":
+                self.tree.apply_remove(
+                    op["start"], op["end"], msg.seq, msg.client_id, msg.ref_seq
+                )
+            elif kind == "annotate":
+                self.tree.apply_annotate(
+                    op["start"], op["end"], op["props"], msg.seq, msg.client_id,
+                    msg.ref_seq,
+                )
+            else:
+                raise ValueError(f"unknown sequence op kind {kind!r}")
+        self.tree.current_seq = msg.seq
+        if msg.min_seq > self.tree.min_seq:
+            self.tree.zamboni(msg.min_seq)
+
+    def advance(self, seq: int, min_seq: int) -> None:
+        """Window bookkeeping for messages routed elsewhere (e.g. no-ops)."""
+        self.tree.current_seq = max(self.tree.current_seq, seq)
+        if min_seq > self.tree.min_seq:
+            self.tree.zamboni(min_seq)
+
+    # -- summary ---------------------------------------------------------------
+
+    def summarize(self, min_seq: int = 0) -> SummaryTree:
+        header = {
+            "seq": self.tree.current_seq,
+            "minSeq": self.tree.min_seq,
+            "length": self.tree.length(),
+        }
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json(header))
+        tree.add_blob("body", canonical_json(self.tree.normalized_records()))
+        return tree
+
+    def load(self, summary: SummaryTree) -> None:
+        header = json.loads(summary.blob_bytes("header"))
+        records = json.loads(summary.blob_bytes("body"))
+        self.tree.load_records(records, header["seq"], header["minSeq"])
+        self._pending_groups.clear()
+        self.discard_pending()  # in-flight pre-load ops can no longer be acked

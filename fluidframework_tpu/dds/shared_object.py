@@ -1,0 +1,123 @@
+"""SharedObject — the base class every DDS extends.
+
+Capability-equivalent of the reference's shared-object-base (SURVEY.md §2.1:
+``SharedObject``/``SharedObjectCore`` — summary load, op submit/process/
+resubmit; upstream paths UNVERIFIED — empty reference mount).
+
+The contract between a DDS and its runtime:
+
+- the DDS applies local mutations optimistically, then calls
+  :meth:`_submit_local_op` with the op contents and an opaque *local op
+  metadata* record it will need to reconcile the ack;
+- the runtime (or mock) later feeds every sequenced message — including the
+  client's own — to :meth:`process` in strict total order, with
+  ``local=True`` and the matching metadata for the client's own ops;
+- on reconnect the runtime asks the DDS to resubmit pending ops
+  (:meth:`resubmit_pending`);
+- :meth:`summarize` / :meth:`load` round-trip state through the canonical
+  summary-tree model.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Deque, Optional, Tuple
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.summary import SummaryTree
+
+
+class SharedObject:
+    """Base DDS: pending-op bookkeeping + runtime wiring."""
+
+    #: channel type identifier, e.g. "map-tpu"; set by subclasses and used by
+    #: the ChannelFactory registry (the plugin boundary).
+    TYPE: str = "shared-object"
+
+    def __init__(self, object_id: str) -> None:
+        self.id = object_id
+        self.client_id: Optional[str] = None
+        self._delta_connection = None  # set by connect()
+        self._client_seq = 0
+        # FIFO of (client_seq, contents, local_metadata) awaiting ack.
+        self._pending: Deque[Tuple[int, Any, Any]] = collections.deque()
+        # Acks at or below this client_seq are silently dropped: they belong
+        # to ops submitted before a load() reset the channel's state.
+        self._stale_ack_floor = -1
+        self._last_submitted_client_seq = -1
+
+    # -- runtime wiring --------------------------------------------------------
+
+    def connect(self, delta_connection, client_id: str) -> None:
+        """Attach to a delta connection: an object with
+        ``submit(contents) -> client_seq``."""
+        self._delta_connection = delta_connection
+        self.client_id = client_id
+
+    @property
+    def is_attached(self) -> bool:
+        return self._delta_connection is not None
+
+    def _submit_local_op(self, contents: Any, local_metadata: Any = None) -> None:
+        """Send an optimistically-applied local op to the sequencer."""
+        if self._delta_connection is None:
+            return  # detached: local-only state, nothing to send
+        client_seq = self._delta_connection.submit(contents)
+        self._last_submitted_client_seq = client_seq
+        self._pending.append((client_seq, contents, local_metadata))
+
+    def resubmit_pending(self) -> None:
+        """Reconnect path: re-send all unacked ops (same contents, fresh
+        client_seqs).  Capability parity with PendingStateManager resubmit."""
+        if self._delta_connection is None:
+            return
+        pending = list(self._pending)
+        self._pending.clear()
+        for _old_client_seq, contents, metadata in pending:
+            self._resubmit_core(contents, metadata)
+
+    def _resubmit_core(self, contents: Any, metadata: Any) -> None:
+        """Default resubmit: send unchanged.  DDSes whose ops reference
+        positions may need to rewrite contents against the latest state."""
+        self._submit_local_op(contents, metadata)
+
+    # -- inbound ---------------------------------------------------------------
+
+    def process(self, msg: SequencedMessage, local: bool) -> None:
+        """Apply one sequenced message (strict total order)."""
+        if msg.type is not MessageType.OP:
+            return
+        local_metadata = None
+        if local:
+            if msg.client_seq <= self._stale_ack_floor:
+                return  # ack for an op discarded by a load() reset
+            if not self._pending:
+                raise AssertionError(
+                    f"{self.id}: ack for {msg.client_seq} with no pending ops"
+                )
+            client_seq, _contents, local_metadata = self._pending.popleft()
+            if client_seq != msg.client_seq:
+                raise AssertionError(
+                    f"{self.id}: out-of-order ack {msg.client_seq}, "
+                    f"expected {client_seq}"
+                )
+        self._process_core(msg, local, local_metadata)
+
+    # -- subclass surface ------------------------------------------------------
+
+    def discard_pending(self) -> None:
+        """Forget in-flight ops (used by load(): state resets make their acks
+        meaningless; the floor keeps late acks from tripping the FIFO)."""
+        self._pending.clear()
+        self._stale_ack_floor = self._last_submitted_client_seq
+
+    def _process_core(
+        self, msg: SequencedMessage, local: bool, local_metadata: Any
+    ) -> None:
+        raise NotImplementedError
+
+    def summarize(self, min_seq: int = 0) -> SummaryTree:
+        raise NotImplementedError
+
+    def load(self, summary: SummaryTree) -> None:
+        raise NotImplementedError

@@ -1,0 +1,28 @@
+"""Protocol core: op model, sequencing, summary trees.
+
+Pure Python, zero JAX.  This layer is the capability-equivalent of the
+reference's protocol-definitions / protocol-base / memory-orderer packages
+(SURVEY.md §1 layers 2–4; upstream paths UNVERIFIED — empty reference mount).
+"""
+
+from .messages import (
+    UNASSIGNED_SEQ,
+    MessageType,
+    RawOperation,
+    SequencedMessage,
+)
+from .sequencer import ClientConnection, Sequencer
+from .summary import SummaryBlob, SummaryTree, SummaryStorage, canonical_json
+
+__all__ = [
+    "UNASSIGNED_SEQ",
+    "MessageType",
+    "RawOperation",
+    "SequencedMessage",
+    "ClientConnection",
+    "Sequencer",
+    "SummaryBlob",
+    "SummaryTree",
+    "SummaryStorage",
+    "canonical_json",
+]

@@ -1,0 +1,113 @@
+"""The op / sequence-number model.
+
+Capability-equivalent of the reference's ``ISequencedDocumentMessage`` /
+``IDocumentMessage`` contracts (SURVEY.md §2.1 driver-definitions; upstream
+paths UNVERIFIED — empty reference mount).  The five numbers that drive every
+merge decision in the framework:
+
+- ``seq``        — the total-order sequence number stamped by the sequencer.
+- ``client_seq`` — per-client monotonically increasing counter, used for
+                   resubmit dedup and for matching acks to pending local ops.
+- ``ref_seq``    — the latest ``seq`` the submitting client had processed when
+                   it created the op.  Defines the *view* the op's positions
+                   and conflicts are resolved against.
+- ``min_seq``    — the minimum of all connected clients' ``ref_seq`` at stamp
+                   time (the collaboration window floor).  State older than
+                   ``min_seq`` is visible to every client, so tombstones below
+                   it can be compacted (zamboni) and rebase branches below it
+                   evicted.
+- ``UNASSIGNED_SEQ`` (-1) — marks optimistic local state that has not yet been
+  sequenced; it is ordered *after* every assigned seq (it will receive a larger
+  seq than anything currently applied).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+# Sentinel sequence number for optimistic local (pending, un-acked) state.
+# Ordering rule: UNASSIGNED is *newer* than any assigned seq.
+UNASSIGNED_SEQ = -1
+
+# The sequence number that summaries/new documents start from.
+INITIAL_SEQ = 0
+
+
+class MessageType(str, enum.Enum):
+    """Wire-level message types (capability parity with the reference's
+    protocol MessageType: op/join/leave/propose/summarize/summaryAck...)."""
+
+    OP = "op"                    # a DDS/runtime operation (contents is routed)
+    JOIN = "join"                # client joined the quorum
+    LEAVE = "leave"              # client left the quorum
+    PROPOSAL = "propose"         # quorum proposal (e.g. code details)
+    SUMMARIZE = "summarize"      # summarizer announces an uploaded summary
+    SUMMARY_ACK = "summaryAck"   # service accepted a summary
+    SUMMARY_NACK = "summaryNack"  # service rejected a summary
+    NO_OP = "noop"               # heartbeat; advances ref_seq/MSN only
+    SIGNAL = "signal"            # unsequenced ephemeral broadcast (presence)
+
+
+@dataclasses.dataclass
+class RawOperation:
+    """An op as submitted by a client, before sequencing."""
+
+    client_id: str
+    client_seq: int
+    ref_seq: int
+    type: MessageType
+    contents: Any = None
+
+    def to_dict(self) -> dict:
+        return {
+            "clientId": self.client_id,
+            "clientSequenceNumber": self.client_seq,
+            "referenceSequenceNumber": self.ref_seq,
+            "type": self.type.value,
+            "contents": self.contents,
+        }
+
+
+@dataclasses.dataclass
+class SequencedMessage:
+    """An op after the sequencer stamped it — what every client applies.
+
+    This is the unit of the durable op log, of catch-up replay, and of the
+    packed ragged tensors the TPU kernels fold over.
+    """
+
+    seq: int
+    client_id: Optional[str]     # None for server-generated messages
+    client_seq: int
+    ref_seq: int
+    min_seq: int
+    type: MessageType
+    contents: Any = None
+    timestamp: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "sequenceNumber": self.seq,
+            "clientId": self.client_id,
+            "clientSequenceNumber": self.client_seq,
+            "referenceSequenceNumber": self.ref_seq,
+            "minimumSequenceNumber": self.min_seq,
+            "type": self.type.value,
+            "contents": self.contents,
+            "timestamp": self.timestamp,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "SequencedMessage":
+        return SequencedMessage(
+            seq=d["sequenceNumber"],
+            client_id=d.get("clientId"),
+            client_seq=d.get("clientSequenceNumber", -1),
+            ref_seq=d.get("referenceSequenceNumber", 0),
+            min_seq=d.get("minimumSequenceNumber", 0),
+            type=MessageType(d["type"]),
+            contents=d.get("contents"),
+            timestamp=d.get("timestamp", 0.0),
+        )

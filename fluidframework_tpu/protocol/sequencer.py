@@ -1,0 +1,174 @@
+"""In-process total-order sequencer.
+
+Capability-equivalent of the reference's Deli ``ticket()`` sequencing lambda
+wired in-process the way ``memory-orderer``'s ``LocalOrderer`` does
+(SURVEY.md §2.3; upstream paths UNVERIFIED — empty reference mount): one class,
+no Kafka.  Responsibilities:
+
+- stamp each raw op with a monotonically increasing ``seq``;
+- track each connected client's ``ref_seq`` and compute the
+  ``minimumSequenceNumber`` (MSN) — min over connected clients' ref_seq;
+- dedupe resubmitted ops by (client_id, client_seq);
+- broadcast sequenced messages to subscribers in order and append them to the
+  durable op log (the scriptorium-equivalent feed that catch-up replay and the
+  TPU batch-replay path consume).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Dict, List, Optional
+
+from .messages import INITIAL_SEQ, MessageType, RawOperation, SequencedMessage
+
+
+@dataclasses.dataclass
+class ClientConnection:
+    """Sequencer-side record of a connected client."""
+
+    client_id: str
+    ref_seq: int
+    last_client_seq: int = 0  # highest client_seq sequenced (dedup floor)
+
+
+class Sequencer:
+    """Single-document total-order sequencer with MSN tracking.
+
+    Deterministic: sequencing depends only on the submission order, so tests
+    and the fuzz harness can drive interleavings explicitly.
+    """
+
+    def __init__(self, start_seq: int = INITIAL_SEQ) -> None:
+        self._seq = start_seq
+        self._min_seq = start_seq
+        self._clients: Dict[str, ClientConnection] = {}
+        self._subscribers: List[Callable[[SequencedMessage], None]] = []
+        self._log: List[SequencedMessage] = []
+        self._clock = itertools.count()
+
+    # -- connection management -------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    @property
+    def min_seq(self) -> int:
+        return self._min_seq
+
+    @property
+    def log(self) -> List[SequencedMessage]:
+        """The durable op log (scriptorium feed)."""
+        return self._log
+
+    def connect(self, client_id: str) -> ClientConnection:
+        """Join a client to the quorum; emits a JOIN message."""
+        if client_id in self._clients:
+            raise ValueError(f"client {client_id!r} already connected")
+        conn = ClientConnection(client_id=client_id, ref_seq=self._seq)
+        self._clients[client_id] = conn
+        self._stamp(
+            client_id=None,
+            client_seq=-1,
+            ref_seq=self._seq,
+            type_=MessageType.JOIN,
+            contents={"clientId": client_id},
+        )
+        return conn
+
+    def disconnect(self, client_id: str) -> None:
+        """Remove a client from the quorum; emits LEAVE and recomputes MSN."""
+        if client_id not in self._clients:
+            return
+        del self._clients[client_id]
+        self._stamp(
+            client_id=None,
+            client_seq=-1,
+            ref_seq=self._seq,
+            type_=MessageType.LEAVE,
+            contents={"clientId": client_id},
+        )
+
+    # -- sequencing ------------------------------------------------------------
+
+    def submit(self, op: RawOperation) -> Optional[SequencedMessage]:
+        """Sequence one raw op (the Deli ``ticket()`` hot loop).
+
+        Returns the sequenced message, or None if the op was a duplicate
+        (already-sequenced client_seq, e.g. a redundant resubmit after
+        reconnect).
+        """
+        conn = self._clients.get(op.client_id)
+        if conn is None:
+            raise ValueError(f"client {op.client_id!r} is not connected")
+        if op.client_seq <= conn.last_client_seq:
+            return None  # duplicate — dedup by clientSeq
+        conn.last_client_seq = op.client_seq
+        conn.ref_seq = max(conn.ref_seq, op.ref_seq)
+        return self._stamp(
+            client_id=op.client_id,
+            client_seq=op.client_seq,
+            ref_seq=op.ref_seq,
+            type_=op.type,
+            contents=op.contents,
+        )
+
+    def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
+        """Heartbeat path: a client reports processed-up-to without an op."""
+        conn = self._clients.get(client_id)
+        if conn is None:
+            return
+        conn.ref_seq = max(conn.ref_seq, ref_seq)
+        self._recompute_min_seq()
+
+    def tick(self) -> SequencedMessage:
+        """Emit a NO_OP heartbeat: advances seq and propagates the current MSN
+        to clients without carrying an operation."""
+        return self._stamp(
+            client_id=None,
+            client_seq=-1,
+            ref_seq=self._seq,
+            type_=MessageType.NO_OP,
+            contents=None,
+        )
+
+    def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        """Register a delivery callback; called in total order for every
+        sequenced message (the Alfred broadcast capability)."""
+        self._subscribers.append(fn)
+
+    # -- internals -------------------------------------------------------------
+
+    def _recompute_min_seq(self) -> None:
+        if self._clients:
+            msn = min(c.ref_seq for c in self._clients.values())
+        else:
+            msn = self._seq
+        # MSN is monotone.
+        self._min_seq = max(self._min_seq, msn)
+
+    def _stamp(
+        self,
+        client_id: Optional[str],
+        client_seq: int,
+        ref_seq: int,
+        type_: MessageType,
+        contents,
+    ) -> SequencedMessage:
+        self._seq += 1
+        self._recompute_min_seq()
+        msg = SequencedMessage(
+            seq=self._seq,
+            client_id=client_id,
+            client_seq=client_seq,
+            ref_seq=ref_seq,
+            min_seq=self._min_seq,
+            type=type_,
+            contents=contents,
+            timestamp=float(next(self._clock)),
+        )
+        self._log.append(msg)
+        for fn in list(self._subscribers):
+            fn(msg)
+        return msg

@@ -1,0 +1,133 @@
+"""Canonical summary-tree model and content-addressed storage.
+
+Capability-equivalent of the reference's ``ISummaryTree`` + Historian/gitrest
+git-backed summary storage (SURVEY.md §2.1/§2.3; upstream paths UNVERIFIED —
+empty reference mount): summaries are trees of named blobs, stored
+content-addressed (sha256, git-style), so
+
+- unchanged subtrees can be re-referenced by handle (incremental summaries),
+- byte-identity between the CPU-oracle and TPU summary paths is checkable by
+  comparing a single root hash.
+
+Canonicalization is the load-bearing property: every serializer in the
+framework funnels through :func:`canonical_json` (sorted keys, no whitespace,
+explicit utf-8) so that two replicas — or the CPU oracle and the device kernel —
+producing the same logical state produce the *same bytes*.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Union
+
+
+def canonical_json(obj) -> bytes:
+    """Deterministic JSON bytes: sorted keys, minimal separators, utf-8.
+
+    The single canonical serializer used for summary blobs, op contents
+    hashing, and golden-file tests.
+    """
+    return json.dumps(
+        obj, sort_keys=True, separators=(",", ":"), ensure_ascii=False
+    ).encode("utf-8")
+
+
+@dataclass
+class SummaryBlob:
+    """A leaf: raw bytes (git blob equivalent)."""
+
+    content: bytes
+
+    def digest(self) -> str:
+        return hashlib.sha256(b"blob\x00" + self.content).hexdigest()
+
+
+@dataclass
+class SummaryTree:
+    """An ordered-by-name map of children (git tree equivalent)."""
+
+    children: Dict[str, Union["SummaryTree", SummaryBlob]] = field(
+        default_factory=dict
+    )
+
+    def add_blob(self, name: str, content: bytes) -> "SummaryTree":
+        self.children[name] = SummaryBlob(content)
+        return self
+
+    def add_json_blob(self, name: str, obj) -> "SummaryTree":
+        return self.add_blob(name, canonical_json(obj))
+
+    def add_tree(self, name: str) -> "SummaryTree":
+        sub = SummaryTree()
+        self.children[name] = sub
+        return sub
+
+    def digest(self) -> str:
+        """Merkle digest over sorted child names — the summary handle."""
+        h = hashlib.sha256()
+        h.update(b"tree\x00")
+        for name in sorted(self.children):
+            child = self.children[name]
+            h.update(name.encode("utf-8"))
+            h.update(b"\x00")
+            h.update(child.digest().encode("ascii"))
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def get(self, path: str) -> Union["SummaryTree", SummaryBlob]:
+        """Resolve a '/'-separated path to a node."""
+        node: Union[SummaryTree, SummaryBlob] = self
+        for part in path.split("/"):
+            if not part:
+                continue
+            if not isinstance(node, SummaryTree):
+                raise KeyError(path)
+            node = node.children[part]
+        return node
+
+    def blob_bytes(self, path: str) -> bytes:
+        node = self.get(path)
+        if not isinstance(node, SummaryBlob):
+            raise KeyError(f"{path} is not a blob")
+        return node.content
+
+
+class SummaryStorage:
+    """Content-addressed summary store (Historian/gitrest capability).
+
+    Stores summary trees by digest; tracks a linear history of (root handle,
+    reference seq) commits per document, so catch-up = latest summary + op
+    tail from the sequencer log.
+    """
+
+    def __init__(self) -> None:
+        self._objects: Dict[str, Union[SummaryTree, SummaryBlob]] = {}
+        self._commits: Dict[str, list] = {}  # doc_id -> [(handle, ref_seq)]
+
+    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int) -> str:
+        handle = self._store(tree)
+        self._commits.setdefault(doc_id, []).append((handle, ref_seq))
+        return handle
+
+    def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
+        digest = node.digest()
+        self._objects[digest] = node
+        if isinstance(node, SummaryTree):
+            for child in node.children.values():
+                self._store(child)
+        return digest
+
+    def latest(self, doc_id: str):
+        """Returns (tree, ref_seq) of the newest summary, or (None, 0)."""
+        commits = self._commits.get(doc_id)
+        if not commits:
+            return None, 0
+        handle, ref_seq = commits[-1]
+        node = self._objects[handle]
+        assert isinstance(node, SummaryTree)
+        return node, ref_seq
+
+    def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
+        return self._objects[handle]

@@ -1,0 +1,10 @@
+"""Test infrastructure: mock runtimes and the fuzz harness.
+
+Capability-equivalent of the reference's test-runtime-utils +
+test-dds-utils/stochastic-test-utils (SURVEY.md §4; upstream paths UNVERIFIED
+— empty reference mount).
+"""
+
+from .mocks import MockContainerRuntimeFactory, MockClientRuntime
+
+__all__ = ["MockContainerRuntimeFactory", "MockClientRuntime"]

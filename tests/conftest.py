@@ -1,0 +1,16 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is validated
+without TPU hardware; the driver separately dry-runs __graft_entry__).  The
+env vars must be set before the first ``import jax`` anywhere in the test
+process, which conftest guarantees.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

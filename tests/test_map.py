@@ -1,0 +1,102 @@
+"""SharedMap / SharedDirectory: LWW convergence + optimistic local reads."""
+
+from fluidframework_tpu.dds import SharedMap, SharedDirectory
+from fluidframework_tpu.testing import MockContainerRuntimeFactory
+
+
+def make_pair(cls=SharedMap):
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(cls("m"))
+    b = factory.create_client("B").attach(cls("m"))
+    return factory, a, b
+
+
+def test_set_converges():
+    factory, a, b = make_pair()
+    a.set("k", 1)
+    factory.process_all_messages()
+    assert a.get("k") == b.get("k") == 1
+
+
+def test_concurrent_set_last_sequenced_wins():
+    factory, a, b = make_pair()
+    a.set("k", "fromA")
+    b.set("k", "fromB")  # submitted second → sequenced second → wins
+    factory.process_all_messages()
+    assert a.get("k") == b.get("k") == "fromB"
+
+
+def test_pending_local_outranks_incoming_remote():
+    factory, a, b = make_pair()
+    a.set("k", "old")
+    factory.process_all_messages()
+    b.set("k", "fromB")
+    factory.process_all_messages()  # B's op sequenced
+    # A sets while B's value is already sequenced-in: A's op sequences later.
+    a.set("k", "fromA")
+    assert a.get("k") == "fromA"  # optimistic local read
+    factory.process_all_messages()
+    assert a.get("k") == b.get("k") == "fromA"
+
+
+def test_interleaved_delivery_preserves_optimistic_read():
+    factory, a, b = make_pair()
+    b.set("k", "fromB")
+    a.set("k", "fromA")
+    # Deliver only B's op: A must keep its pending value (it sequences later).
+    factory.process_some_messages(1)
+    assert a.get("k") == "fromA"
+    assert b.get("k") == "fromB"
+    factory.process_all_messages()
+    assert a.get("k") == b.get("k") == "fromA"
+
+
+def test_delete_and_clear_converge():
+    factory, a, b = make_pair()
+    a.set("x", 1)
+    a.set("y", 2)
+    factory.process_all_messages()
+    b.delete("x")
+    a.clear()
+    factory.process_all_messages()
+    assert len(a) == len(b) == 0
+
+
+def test_pending_set_survives_remote_clear():
+    factory, a, b = make_pair()
+    a.set("x", 1)
+    factory.process_all_messages()
+    b.clear()
+    a.set("y", 2)  # concurrent with the clear, sequenced after it
+    factory.process_all_messages()
+    assert not a.has("x") and not b.has("x")
+    assert a.get("y") == b.get("y") == 2
+
+
+def test_map_summary_roundtrip_byte_identical():
+    factory, a, b = make_pair()
+    a.set("k1", [1, 2, {"z": 3}])
+    b.set("k2", "v")
+    a.delete("missing")
+    factory.process_all_messages()
+    sa, sb = a.summarize(), b.summarize()
+    assert sa.digest() == sb.digest()  # replicas byte-identical
+    fresh = SharedMap("m")
+    fresh.load(sa)
+    assert fresh.get("k1") == [1, 2, {"z": 3}]
+    assert fresh.summarize().digest() == sa.digest()
+
+
+def test_directory_subdirs_and_convergence():
+    factory, a, b = make_pair(SharedDirectory)
+    a.create_subdirectory("sub/inner")
+    a.set("k", 1, path="sub/inner")
+    b.set("top", True)
+    factory.process_all_messages()
+    assert b.get("k", path="sub/inner") == 1
+    assert a.get("top") == b.get("top") is True
+    assert a.summarize().digest() == b.summarize().digest()
+    b.delete_subdirectory("sub/inner")
+    factory.process_all_messages()
+    assert a.get("k", path="sub/inner") is None
+    assert a.summarize().digest() == b.summarize().digest()

@@ -1,0 +1,200 @@
+"""Merge-tree / SharedString: convergence, tie-breaks, windows, summaries."""
+
+from fluidframework_tpu.dds import SharedString
+from fluidframework_tpu.testing import MockContainerRuntimeFactory
+
+
+def make_clients(n=2):
+    factory = MockContainerRuntimeFactory()
+    strings = [
+        factory.create_client(chr(ord("A") + i)).attach(SharedString("s"))
+        for i in range(n)
+    ]
+    return factory, strings
+
+
+def assert_converged(factory, strings):
+    factory.process_all_messages()
+    texts = {s.text for s in strings}
+    assert len(texts) == 1, f"divergence: {[s.text for s in strings]}"
+    digests = {s.summarize().digest() for s in strings}
+    assert len(digests) == 1, "summary divergence"
+    return strings[0].text
+
+
+def test_basic_insert_remove():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "hello world")
+    factory.process_all_messages()
+    b.remove_range(5, 11)
+    b.insert_text(5, "!")
+    assert_converged(factory, [a, b])
+    assert a.text == "hello!"
+
+
+def test_concurrent_insert_same_position_newest_first():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "AAA")
+    b.insert_text(0, "BBB")  # sequenced second → newer → placed first
+    text = assert_converged(factory, [a, b])
+    assert text == "BBBAAA"
+
+
+def test_concurrent_insert_interior_position():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "0123456789")
+    factory.process_all_messages()
+    a.insert_text(5, "aa")
+    b.insert_text(5, "bb")
+    text = assert_converged(factory, [a, b])
+    assert text == "01234bbaa56789"
+
+
+def test_three_way_concurrent_inserts_stack_newest_first():
+    factory, (a, b, c) = make_clients(3)
+    a.insert_text(0, "A")
+    b.insert_text(0, "B")
+    c.insert_text(0, "C")
+    text = assert_converged(factory, [a, b, c])
+    assert text == "CBA"
+
+
+def test_insert_into_concurrently_removed_range_survives():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "0123456789")
+    factory.process_all_messages()
+    a.remove_range(2, 8)
+    b.insert_text(5, "XYZ")  # inside the range A is removing
+    text = assert_converged(factory, [a, b])
+    assert text == "01XYZ89"
+
+
+def test_overlapping_concurrent_removes():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "0123456789")
+    factory.process_all_messages()
+    a.remove_range(0, 6)
+    b.remove_range(4, 9)
+    text = assert_converged(factory, [a, b])
+    assert text == "9"
+
+
+def test_remote_ops_interleaved_with_pending_local():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "base")
+    factory.process_all_messages()
+    # A edits locally; B's concurrent ops are delivered before A's sequence.
+    a.insert_text(4, "-tail")
+    b.insert_text(0, "head-")
+    b.remove_range(0, 1)  # depends on B's own pending insert
+    factory.process_all_messages()
+    assert a.text == b.text
+    assert a.text == "ead-base-tail"
+
+
+def test_position_resolution_uses_op_view():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "abcdef")
+    factory.process_all_messages()
+    a.remove_range(0, 3)  # A's view: "def"
+    b.insert_text(6, "!")  # B's view: "abcdef", append at end
+    text = assert_converged(factory, [a, b])
+    assert text == "def!"
+
+
+def test_annotate_lww_and_pending_priority():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "styled")
+    factory.process_all_messages()
+    a.annotate_range(0, 6, {"bold": True})
+    factory.process_all_messages()
+    b.annotate_range(0, 6, {"bold": False, "size": 12})
+    a.annotate_range(0, 3, {"size": 14})  # sequenced after b's → wins on [0,3)
+    factory.process_all_messages()
+    assert a.summarize().digest() == b.summarize().digest()
+    recs = a.tree.normalized_records()
+    assert recs[0]["p"] == {"bold": False, "size": 14}
+    assert recs[1]["p"] == {"bold": False, "size": 12}
+
+
+def test_annotate_null_deletes_property():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "xy")
+    a.annotate_range(0, 2, {"k": 1})
+    factory.process_all_messages()
+    b.annotate_range(0, 2, {"k": None})
+    factory.process_all_messages()
+    recs = a.tree.normalized_records()
+    assert "p" not in recs[0]
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_zamboni_collects_tombstones_after_window_advance():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "0123456789")
+    factory.process_all_messages()
+    a.remove_range(2, 8)
+    factory.process_all_messages()
+    assert any(s.removed_seq is not None for s in a.tree.segments)
+    factory.advance_min_seq()
+    factory.process_all_messages()
+    assert all(s.removed_seq is None for s in a.tree.segments)
+    assert a.text == b.text == "0189"
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_summary_roundtrip_through_fresh_client():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "persistent state")
+    b.annotate_range(0, 10, {"mark": 1})
+    b.remove_range(10, 16)
+    factory.process_all_messages()
+    summary = a.summarize()
+    fresh = SharedString("s")
+    fresh.load(summary)
+    assert fresh.text == a.text
+    assert fresh.summarize().digest() == summary.digest()
+
+
+def test_normalization_clamps_old_seqs():
+    factory, (a, b) = make_clients()
+    a.insert_text(0, "one")
+    factory.process_all_messages()
+    b.insert_text(3, "two")
+    factory.process_all_messages()
+    factory.advance_min_seq()
+    recs = a.tree.normalized_records()
+    # Everything below MSN clamps to the universal epoch and merges.
+    assert recs == [{"t": "onetwo", "s": 0, "c": None}]
+
+
+def test_beast_style_random_soak_two_clients():
+    """Randomized interleaved edit soak (the reference's beastTest shape)."""
+    import random
+
+    rng = random.Random(0xF1D)
+    factory, strings = make_clients(3)
+    alphabet = "abcdefghijklmnopqrstuvwxyz"
+    for round_no in range(60):
+        for s in strings:
+            for _ in range(rng.randint(0, 3)):
+                n = len(s)
+                action = rng.random()
+                if action < 0.55 or n == 0:
+                    pos = rng.randint(0, n)
+                    text = "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 5)))
+                    s.insert_text(pos, text)
+                elif action < 0.8:
+                    start = rng.randint(0, n - 1)
+                    end = min(n, start + rng.randint(1, 6))
+                    s.remove_range(start, end)
+                else:
+                    start = rng.randint(0, n - 1)
+                    end = min(n, start + rng.randint(1, 6))
+                    s.annotate_range(start, end, {"k": rng.randint(0, 3)})
+        # Deliver a random prefix of the queue to explore interleavings.
+        factory.process_some_messages(rng.randint(0, factory.pending_count))
+        if round_no % 10 == 9:
+            factory.process_all_messages()
+            factory.advance_min_seq()
+    assert_converged(factory, strings)

@@ -1,0 +1,98 @@
+"""Protocol core: sequencer stamping, MSN tracking, dedup, summary trees."""
+
+from fluidframework_tpu.protocol import (
+    MessageType,
+    RawOperation,
+    Sequencer,
+    SummaryStorage,
+    SummaryTree,
+    canonical_json,
+)
+
+
+def _op(client, client_seq, ref_seq, contents=None):
+    return RawOperation(
+        client_id=client,
+        client_seq=client_seq,
+        ref_seq=ref_seq,
+        type=MessageType.OP,
+        contents=contents,
+    )
+
+
+def test_sequencer_stamps_total_order():
+    seq = Sequencer()
+    seq.connect("A")
+    seq.connect("B")
+    m1 = seq.submit(_op("A", 1, 0, "x"))
+    m2 = seq.submit(_op("B", 1, 0, "y"))
+    m3 = seq.submit(_op("A", 2, m1.seq, "z"))
+    assert [m.seq for m in (m1, m2, m3)] == [3, 4, 5]  # 2 JOINs first
+    assert m3.ref_seq == m1.seq
+
+
+def test_sequencer_min_seq_is_min_of_ref_seqs_and_monotone():
+    seq = Sequencer()
+    seq.connect("A")
+    seq.connect("B")
+    base = seq.seq
+    mA = seq.submit(_op("A", 1, base))
+    assert mA.min_seq <= base
+    # B catches up to head; A still at base → MSN pinned at base.
+    seq.update_ref_seq("B", mA.seq)
+    m2 = seq.submit(_op("B", 1, mA.seq))
+    assert m2.min_seq == base
+    # A catches up → MSN advances.
+    seq.update_ref_seq("A", m2.seq)
+    m3 = seq.submit(_op("B", 2, m2.seq))
+    assert m3.min_seq == m2.seq
+    msns = [m.min_seq for m in seq.log]
+    assert msns == sorted(msns)  # MSN is monotone
+
+
+def test_sequencer_dedups_resubmits_by_client_seq():
+    seq = Sequencer()
+    seq.connect("A")
+    m1 = seq.submit(_op("A", 1, 0))
+    assert m1 is not None
+    assert seq.submit(_op("A", 1, 0)) is None  # duplicate clientSeq dropped
+    m2 = seq.submit(_op("A", 2, 0))
+    assert m2.seq == m1.seq + 1
+
+
+def test_sequencer_disconnect_releases_msn():
+    seq = Sequencer()
+    seq.connect("A")
+    seq.connect("B")
+    base = seq.seq
+    for i in range(3):
+        seq.submit(_op("A", i + 1, base))
+    head = seq.seq
+    seq.update_ref_seq("A", head)
+    # B never advanced; disconnecting B lets MSN move to A's ref_seq.
+    seq.disconnect("B")
+    m = seq.submit(_op("A", 10, head))
+    assert m.min_seq == head
+
+
+def test_summary_tree_digest_is_canonical_and_content_addressed():
+    t1 = SummaryTree()
+    t1.add_json_blob("header", {"b": 2, "a": 1})
+    t2 = SummaryTree()
+    t2.add_blob("header", canonical_json({"a": 1, "b": 2}))
+    assert t1.digest() == t2.digest()  # key order doesn't matter
+    t3 = SummaryTree()
+    t3.add_json_blob("header", {"a": 1, "b": 3})
+    assert t1.digest() != t3.digest()
+
+
+def test_summary_storage_roundtrip_and_latest():
+    store = SummaryStorage()
+    t1 = SummaryTree().add_json_blob("header", {"v": 1})
+    t2 = SummaryTree().add_json_blob("header", {"v": 2})
+    store.upload("doc", t1, ref_seq=10)
+    h2 = store.upload("doc", t2, ref_seq=20)
+    latest, ref_seq = store.latest("doc")
+    assert ref_seq == 20
+    assert latest.digest() == h2 == t2.digest()
+    assert store.read(h2).blob_bytes("header") == canonical_json({"v": 2})

@@ -1,0 +1,107 @@
+"""SharedCell / SharedCounter + regression tests from review findings."""
+
+from fluidframework_tpu.dds import SharedCell, SharedCounter, SharedDirectory, SharedString
+from fluidframework_tpu.testing import MockContainerRuntimeFactory
+
+
+def make_pair(cls):
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(cls("x"))
+    b = factory.create_client("B").attach(cls("x"))
+    return factory, a, b
+
+
+def test_cell_lww_and_pending_priority():
+    factory, a, b = make_pair(SharedCell)
+    a.set(1)
+    factory.process_all_messages()
+    b.set(2)
+    a.set(3)  # sequenced after b's → wins; pending must mask b's op
+    factory.process_all_messages()
+    assert a.get() == b.get() == 3
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_cell_delete():
+    factory, a, b = make_pair(SharedCell)
+    a.set("v")
+    factory.process_all_messages()
+    b.delete()
+    factory.process_all_messages()
+    assert a.is_empty and b.is_empty
+
+
+def test_counter_increments_commute():
+    factory, a, b = make_pair(SharedCounter)
+    a.increment(5)
+    b.increment(-2)
+    a.increment(1)
+    factory.process_all_messages()
+    assert a.value == b.value == 4
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_directory_concurrent_create_then_delete_converges():
+    """Regression: deleteSubdir must re-apply on local ack so a concurrent
+    create sequenced before the delete doesn't resurrect the subdir on the
+    deleting replica only."""
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedDirectory("d"))
+    b = factory.create_client("B").attach(SharedDirectory("d"))
+    b.create_subdirectory("sub")  # sequenced first
+    a.delete_subdirectory("sub")  # concurrent, sequenced second
+    factory.process_all_messages()
+    assert a.summarize().digest() == b.summarize().digest()
+    assert a.root.resolve("sub") is None and b.root.resolve("sub") is None
+    # Opposite order: delete first, create second → subdir exists everywhere.
+    a2 = factory.create_client("A2").attach(SharedDirectory("d2"))
+    b2 = factory.create_client("B2").attach(SharedDirectory("d2"))
+    a2.delete_subdirectory("sub")
+    b2.create_subdirectory("sub")
+    factory.process_all_messages()
+    assert a2.summarize().digest() == b2.summarize().digest()
+    assert a2.root.resolve("sub") is not None
+
+
+def test_string_load_discards_inflight_pending():
+    """Regression: load() must clear the base pending deque too, or acks of
+    pre-load ops crash."""
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    a.insert_text(0, "committed")
+    factory.process_all_messages()
+    summary = a.summarize()
+    a.insert_text(0, "in-flight-")  # submitted but not yet sequenced
+    a.load(summary)
+    factory.process_all_messages()  # the stale ack must not crash or apply
+    assert a.text == "committed"
+
+
+def test_stay_on_remove_reference_pins_tombstone():
+    """Regression: slide=False refs stay attached to the removed segment and
+    keep zamboni from collecting it."""
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    a.insert_text(0, "abcdef")
+    factory.process_all_messages()
+    ref = a.tree.create_reference(2, client="A", slide=False)
+    pinned = ref.segment
+    a.remove_range(0, 6)
+    factory.process_all_messages()
+    factory.advance_min_seq()
+    assert ref.segment is pinned
+    assert pinned in a.tree.segments  # not collected
+    assert a.tree.reference_position(ref) == 0  # at a removed segment
+
+
+def test_sliding_reference_moves_to_survivor():
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    a.insert_text(0, "abcdef")
+    factory.process_all_messages()
+    ref = a.tree.create_reference(1, client="A")  # inside 'abcdef'
+    a.remove_range(0, 3)
+    factory.process_all_messages()
+    # Slid forward to the start of the surviving "def".
+    assert a.tree.reference_position(ref, client="A") == 0
+    assert ref.segment is not None and ref.segment.text == "def"
