@@ -65,9 +65,12 @@ class MapKernel:
         kind = op["kind"]
         if kind == "clear":
             if local:
-                self._pending_clears -= 1
-                return  # already applied optimistically
-            if self._pending_clears > 0:
+                if self._pending_clears > 0:
+                    self._pending_clears -= 1
+                    return  # already applied optimistically
+                # Pending hold lost to a kernel reset (subdir delete/recreate
+                # sequenced under the in-flight clear): apply like a remote op.
+            elif self._pending_clears > 0:
                 return  # our pending clear will win (larger seq)
             # Remote clear: drop sequenced state; keep keys with pending local
             # ops (those will be re-established when our ops sequence).
@@ -79,14 +82,21 @@ class MapKernel:
 
         key = op["key"]
         if local:
-            # Ack of our own op: value already applied; release the pending hold.
-            n = self._pending_keys.get(key, 0) - 1
-            if n <= 0:
-                self._pending_keys.pop(key, None)
-            else:
-                self._pending_keys[key] = n
-            return
-        if self._pending_clears > 0 or self._pending_keys.get(key, 0) > 0:
+            n = self._pending_keys.get(key, 0)
+            if n > 0:
+                # Ack of our own op: value already applied; release the hold.
+                if n == 1:
+                    self._pending_keys.pop(key, None)
+                else:
+                    self._pending_keys[key] = n - 1
+                return
+            if self._pending_clears > 0:
+                return  # our later clear wiped the hold and outranks this op
+            # No pending hold: the kernel was reset underneath the in-flight
+            # op (e.g. its subdirectory was deleted and recreated).  The op is
+            # still the latest writer in sequence order — apply it like a
+            # remote op so every replica converges.
+        elif self._pending_clears > 0 or self._pending_keys.get(key, 0) > 0:
             return  # a pending local op outranks this remote op
         if kind == "set":
             self.data[key] = op["value"]

@@ -18,14 +18,15 @@ visible (``insert_seq <= ref_seq`` or same client) and its removal is not
 (``removed_seq <= ref_seq`` or removed by this client).
 
 **Insert tie-break (RGA)** — after consuming ``pos`` visible characters, the
-walk sits before a (possibly empty) run of zero-visible segments.  It skips
-past tombstones (insert-visible but removed in the view) and past pending
-local segments (they will sequence later, i.e. newer), and stops in front of
-the first *sequenced concurrent insert* (``insert_seq > ref_seq``, other
-client): since ops apply in total order, the op being applied is the newest,
-and same-position concurrent inserts are kept newest-first.  This is the rule
-that makes optimistic local placement agree with every remote replica's
-placement.
+walk skips past *pending* segments of another client (this replica's own
+un-acked ops, which will sequence later, i.e. newer, and stay left) and stops
+before the first *sequenced* segment of any kind — concurrent insert,
+tombstone, or visible text.  Since ops apply in total order, the op being
+applied is the newest, so same-position concurrent inserts stack newest
+first; stopping *before* sequenced tombstones (never sliding past them) keeps
+an op that saw a removal order-consistent with a concurrent op that did not
+(fuzz-found; see SEMANTICS.md).  These rules make optimistic local placement
+agree with every remote replica's placement.
 
 **Remove** — first remove in sequence order wins ``removed_seq``; later
 overlapping removers are recorded in ``overlap_removers`` (their views must
@@ -206,19 +207,17 @@ class MergeTreeOracle:
             right.refs.append(ref)
         self.segments.insert(idx + 1, right)
 
-    @staticmethod
-    def _is_sequenced_concurrent_insert(seg: Segment, ref_seq: int, client: str) -> bool:
-        return (
-            seg.insert_seq != UNASSIGNED_SEQ
-            and seg.insert_seq > ref_seq
-            and seg.insert_client != client
-        )
-
     def _insert_index(self, pos: int, ref_seq: int, client: str) -> int:
         """Resolve an insert position to a list index (splitting if needed).
 
-        Phase 1 consumes ``pos`` visible-in-view characters; phase 2 applies
-        the boundary tie-break documented in the module docstring.
+        Phase 1 consumes ``pos`` visible-in-view characters.  Phase 2 is the
+        boundary tie-break: skip past *pending* segments of another client
+        (i.e. this replica's own un-acked ops — they will sequence later than
+        the op being applied, so newest-first keeps them to the left), then
+        stop before the first sequenced segment of any kind.  Stopping before
+        sequenced tombstones (not after) is what keeps an op that saw the
+        removal order-consistent with a concurrent op that did not — both
+        resolve to the same side of the tombstone.
         """
         idx, c = 0, 0
         while idx < len(self.segments) and c < pos:
@@ -233,11 +232,10 @@ class MergeTreeOracle:
             raise ValueError(f"insert pos {pos} beyond view length {c}")
         while idx < len(self.segments):
             seg = self.segments[idx]
-            if self._visible_len(seg, ref_seq, client) > 0:
-                break
-            if self._is_sequenced_concurrent_insert(seg, ref_seq, client):
-                break  # newest-first among same-position concurrent inserts
-            idx += 1  # skip tombstones and pending local segments
+            if seg.insert_seq == UNASSIGNED_SEQ and seg.insert_client != client:
+                idx += 1  # replica's own pending op: sequences later, stays left
+                continue
+            break
         return idx
 
     def _walk_range(self, start: int, end: int, ref_seq: int, client: str):

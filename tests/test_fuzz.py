@@ -1,0 +1,35 @@
+"""Seeded fuzz: eventual-consistency across many seeds and client counts.
+
+The reference's fuzz-testing strategy (SURVEY.md §4): convergence is the
+oracle — after full delivery, every replica must have byte-identical canonical
+summaries.  Failures print the seed for regression capture.
+"""
+
+import pytest
+
+from fluidframework_tpu.testing.fuzz import (
+    DirectoryFuzzSpec,
+    MapFuzzSpec,
+    StringFuzzSpec,
+    run_fuzz,
+)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_shared_string(seed):
+    run_fuzz(StringFuzzSpec(), seed=seed, n_clients=3, rounds=40)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_shared_string_many_clients(seed):
+    run_fuzz(StringFuzzSpec(), seed=1000 + seed, n_clients=5, rounds=25)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_shared_map(seed):
+    run_fuzz(MapFuzzSpec(), seed=seed, n_clients=4, rounds=30)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_shared_directory(seed):
+    run_fuzz(DirectoryFuzzSpec(), seed=seed, n_clients=3, rounds=30)

@@ -87,6 +87,26 @@ def test_map_summary_roundtrip_byte_identical():
     assert fresh.summarize().digest() == sa.digest()
 
 
+def test_directory_clear_survives_subdir_reset():
+    """Regression: an in-flight clear whose kernel is deleted/recreated
+    underneath it must still apply on its ack (and not underflow the pending
+    counter)."""
+    factory, a, b = make_pair(SharedDirectory)
+    a.create_subdirectory("a")
+    a.set("k", 1, path="a")
+    factory.process_all_messages()
+    b.delete_subdirectory("a")
+    b.set("k", 9, path="a")  # recreates the subdir, sequenced before A's clear
+    a.clear(path="a")        # in-flight while the reset lands
+    factory.process_all_messages()
+    assert a.summarize().digest() == b.summarize().digest()
+    assert a.get("k", path="a") is None and b.get("k", path="a") is None
+    # Counter must not have underflowed: a later remote set applies normally.
+    b.set("k2", 5, path="a")
+    factory.process_all_messages()
+    assert a.get("k2", path="a") == 5
+
+
 def test_directory_subdirs_and_convergence():
     factory, a, b = make_pair(SharedDirectory)
     a.create_subdirectory("sub/inner")
