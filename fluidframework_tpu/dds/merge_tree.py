@@ -62,6 +62,7 @@ class Segment:
         "removed_seq",
         "removed_client",
         "overlap_removers",
+        "pending_overlap",
         "props",
         "pending_props",
         "pending_groups",
@@ -78,9 +79,17 @@ class Segment:
         self.text = text
         self.insert_seq = insert_seq
         self.insert_client = insert_client
+        # Null prop values mean "delete the key" (see _set_prop); on a fresh
+        # segment that is simply absence, so they are dropped here too.
+        if props:
+            props = {k: v for k, v in props.items() if v is not None}
         self.removed_seq: Optional[int] = None
         self.removed_client: Optional[str] = None
+        # Additional removers beyond the winning one.  Sequenced removers are
+        # summary-visible ("ro"); a pending local remover demoted by an
+        # earlier-sequenced remote remove waits here until its ack.
         self.overlap_removers: Set[str] = set()
+        self.pending_overlap: Set[str] = set()
         self.props: Dict[str, Any] = dict(props) if props else {}
         self.pending_props: Dict[str, int] = {}
         self.pending_groups: List["SegmentGroup"] = []
@@ -158,7 +167,11 @@ class MergeTreeOracle:
             return False
         if seg.removed_seq != UNASSIGNED_SEQ and seg.removed_seq <= ref_seq:
             return True
-        return client == seg.removed_client or client in seg.overlap_removers
+        return (
+            client == seg.removed_client
+            or client in seg.overlap_removers
+            or client in seg.pending_overlap
+        )
 
     def _visible_len(self, seg: Segment, ref_seq: int, client: str) -> int:
         if not self._insert_visible(seg, ref_seq, client):
@@ -190,6 +203,7 @@ class MergeTreeOracle:
         right.removed_seq = seg.removed_seq
         right.removed_client = seg.removed_client
         right.overlap_removers = set(seg.overlap_removers)
+        right.pending_overlap = set(seg.pending_overlap)
         right.props = dict(seg.props)
         right.pending_props = dict(seg.pending_props)
         seg.text = seg.text[:offset]
@@ -297,14 +311,17 @@ class MergeTreeOracle:
                 seg.removed_client = client
             elif seg.removed_seq == UNASSIGNED_SEQ:
                 # A pending local removal loses to this earlier-sequenced
-                # remove; demote the pending remover to an overlap remover.
+                # remove; demote the pending remover to a *pending* overlap
+                # remover (not summary-visible until its own op sequences).
                 if seq != UNASSIGNED_SEQ:
-                    seg.overlap_removers.add(seg.removed_client)
+                    seg.pending_overlap.add(seg.removed_client)
                     seg.removed_seq = seq
                     seg.removed_client = client
                 # (seq == UNASSIGNED here is impossible: a pending-removed
                 # segment is invisible to the local view.)
             else:
+                # seq is always assigned here: a locally-pending remove can
+                # only target view-visible (not-yet-removed) segments.
                 seg.overlap_removers.add(client)
             if seq != UNASSIGNED_SEQ:
                 self._slide_refs(seg)
@@ -353,6 +370,10 @@ class MergeTreeOracle:
         for seg in group.segments:
             if seg.removed_seq == UNASSIGNED_SEQ and seg.removed_client == client:
                 seg.removed_seq = seq
+            elif client in seg.pending_overlap:
+                # Our demoted remove is now sequenced: summary-visible.
+                seg.pending_overlap.discard(client)
+                seg.overlap_removers.add(client)
             self._slide_refs(seg)
             seg.pending_groups.remove(group)
 
@@ -476,6 +497,11 @@ class MergeTreeOracle:
             if rs is not None:
                 rec["rs"] = rs
                 rec["rc"] = rc
+            if seg.overlap_removers:
+                # Sequenced overlap removers are part of the replicated state:
+                # their later ops (with old ref_seqs) must still see the
+                # segment as removed after a summary load.
+                rec["ro"] = sorted(seg.overlap_removers)
             if seg.props:
                 rec["p"] = dict(sorted(seg.props.items()))
             if records:
@@ -485,6 +511,7 @@ class MergeTreeOracle:
                     and prev["c"] == rec["c"]
                     and prev.get("rs") == rec.get("rs")
                     and prev.get("rc") == rec.get("rc")
+                    and prev.get("ro") == rec.get("ro")
                     and prev.get("p") == rec.get("p")
                 ):
                     prev["t"] += rec["t"]
@@ -504,6 +531,8 @@ class MergeTreeOracle:
             if "rs" in rec:
                 seg.removed_seq = rec["rs"]
                 seg.removed_client = rec.get("rc")
+            if "ro" in rec:
+                seg.overlap_removers = set(rec["ro"])
             self.segments.append(seg)
         self.current_seq = seq
         self.min_seq = min_seq

@@ -1,0 +1,16 @@
+"""The TPU batch-merge path.
+
+This package is the BASELINE.json north star: DDS op streams packed into
+device-resident tensors and folded by JAX-traced kernels, vmapped/sharded over
+thousands of documents.  Semantics are pinned by SEMANTICS.md and the CPU
+oracles in ``fluidframework_tpu.dds``; every kernel's summary bytes must equal
+the oracle's (asserted by tests replaying fuzz-generated op logs through
+both).
+
+Modules:
+- ``interning``        — host-side vocab building (client ids, keys, values).
+- ``map_kernel``       — SharedMap LWW catch-up replay (segment reductions,
+                         no scan: the whole batch is two segment-maxes).
+- ``mergetree_kernel`` — merge-tree catch-up replay (the centerpiece): a
+                         lax.scan op-fold over an array-pool segment store.
+"""

@@ -1,0 +1,80 @@
+"""Host-side interning: strings/JSON values ↔ dense int32 ids.
+
+The device kernels operate on int32 tensors only; everything symbolic (client
+ids, map keys, property keys, JSON values, text payloads) is interned on the
+host during packing and restored during summary extraction.  Interning order
+is deterministic (first-appearance in op order) so packing itself is
+reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..protocol.summary import canonical_json
+
+
+def next_bucket(n: int, floor: int = 64) -> int:
+    """Round up to a power-of-two bucket so jitted kernels see a small, stable
+    set of shapes instead of recompiling per batch."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+class Interner:
+    """Dense id assignment by first appearance."""
+
+    def __init__(self) -> None:
+        self._by_key: Dict[Any, int] = {}
+        self.values: List[Any] = []
+
+    def intern(self, value: Any) -> int:
+        key = self._hashable(value)
+        idx = self._by_key.get(key)
+        if idx is None:
+            idx = len(self.values)
+            self._by_key[key] = idx
+            self.values.append(value)
+        return idx
+
+    def lookup(self, idx: int) -> Any:
+        return self.values[idx]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @staticmethod
+    def _hashable(value: Any):
+        if isinstance(value, (dict, list)):
+            return canonical_json(value)
+        return value
+
+
+class TextArena:
+    """Append-only byte arena for text payloads; device state references
+    (start, len) spans.  Kept host-side: the device tracks structure, not
+    bytes (SURVEY.md §7 design stance)."""
+
+    def __init__(self) -> None:
+        self._chunks: List[str] = []
+        self._length = 0
+
+    def append(self, text: str) -> int:
+        """Returns the start offset of the appended text (in characters)."""
+        start = self._length
+        self._chunks.append(text)
+        self._length += len(text)
+        return start
+
+    def finalize(self) -> str:
+        joined = "".join(self._chunks)
+        self._chunks = [joined]
+        return joined
+
+    def slice(self, start: int, length: int) -> str:
+        return self.finalize()[start : start + length]
+
+    def __len__(self) -> int:
+        return self._length

@@ -1,0 +1,175 @@
+"""SharedMap LWW catch-up replay on device.
+
+The first TPU kernel (SURVEY.md §7 layer 3): last-writer-wins key-set replay
+expressed as *segment reductions* — no scan, no sequential dependence.  For a
+batch of documents, the entire replay is:
+
+    winner(key)  = the set/delete op with max seq per (doc, key)
+    cleared(doc) = max seq over clear ops per doc
+    present(key) = winner is a set  AND  winner.seq > cleared(doc)
+
+Sequence numbers are unique, so "op with max seq" is exact.  Base state loaded
+from a summary enters as synthetic set ops at seq 0.  The result maps back
+through the interners into the *same canonical summary bytes* the CPU oracle
+produces — byte-identity is asserted by tests.
+
+Scaling note: ops from any number of documents concatenate into one flat
+batch; document parallelism is free (segment ids encode the doc), and the
+arrays shard over a device mesh along the op axis with psum-style segment
+combines.  Shapes are padded to power-of-two buckets to avoid recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .interning import Interner, next_bucket
+
+_NEG = np.int32(np.iinfo(np.int32).min)
+
+
+@dataclass
+class MapDocInput:
+    """One document's catch-up work item."""
+
+    doc_id: str
+    ops: Sequence[SequencedMessage]  # map-kernel op contents, ascending seq
+    base: Optional[Dict[str, Any]] = None  # data loaded from the summary
+
+
+@dataclass
+class _PackedBatch:
+    key_gid: np.ndarray     # [N] global (doc, key) id for set/delete ops
+    op_seq: np.ndarray      # [N]
+    is_set: np.ndarray      # [N] 1=set, 0=delete
+    val_idx: np.ndarray     # [N] interned value id (sets only)
+    key_doc: np.ndarray     # [G] doc index per global key id
+    clear_doc: np.ndarray   # [M] doc index per clear op
+    clear_seq: np.ndarray   # [M]
+    num_keys: int
+    num_docs: int
+    keys: List[tuple] = field(default_factory=list)   # gid -> (doc_idx, key str)
+    values: Interner = field(default_factory=Interner)
+    doc_ids: List[str] = field(default_factory=list)
+
+
+def pack_map_batch(docs: Sequence[MapDocInput]) -> _PackedBatch:
+    """Flatten a multi-document op log into device arrays (host side)."""
+    keys = Interner()
+    values = Interner()
+    key_gid, op_seq, is_set, val_idx = [], [], [], []
+    clear_doc, clear_seq = [], []
+
+    def add_set(doc_idx: int, key: str, seq: int, value: Any) -> None:
+        key_gid.append(keys.intern((doc_idx, key)))
+        op_seq.append(seq)
+        is_set.append(1)
+        val_idx.append(values.intern(value))
+
+    for doc_idx, doc in enumerate(docs):
+        if doc.base:
+            for key, value in doc.base.items():
+                add_set(doc_idx, key, 0, value)
+        for msg in doc.ops:
+            if msg.type is not MessageType.OP:
+                continue
+            op = msg.contents
+            kind = op["kind"]
+            if kind == "set":
+                add_set(doc_idx, op["key"], msg.seq, op["value"])
+            elif kind == "delete":
+                key_gid.append(keys.intern((doc_idx, op["key"])))
+                op_seq.append(msg.seq)
+                is_set.append(0)
+                val_idx.append(-1)
+            elif kind == "clear":
+                clear_doc.append(doc_idx)
+                clear_seq.append(msg.seq)
+            else:
+                raise ValueError(f"unknown map op kind {kind!r}")
+
+    n = next_bucket(max(len(op_seq), 1))
+    m = next_bucket(max(len(clear_seq), 1))
+    g = next_bucket(max(len(keys), 1))
+
+    def pad(lst, size, fill):
+        arr = np.full(size, fill, dtype=np.int32)
+        arr[: len(lst)] = np.asarray(lst, dtype=np.int32) if lst else []
+        return arr
+
+    key_doc = pad([doc for doc, _ in keys.values], g, 0)
+    return _PackedBatch(
+        key_gid=pad(key_gid, n, g - 1 if len(keys) < g else 0),
+        op_seq=pad(op_seq, n, int(_NEG)),
+        is_set=pad(is_set, n, 0),
+        val_idx=pad(val_idx, n, -1),
+        key_doc=key_doc,
+        clear_doc=pad(clear_doc, m, 0),
+        clear_seq=pad(clear_seq, m, int(_NEG)),
+        num_keys=g,
+        num_docs=len(docs),
+        keys=list(keys.values),
+        values=values,
+        doc_ids=[d.doc_id for d in docs],
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_keys", "num_docs"))
+def _map_lww_kernel(
+    key_gid, op_seq, is_set, val_idx, key_doc, clear_doc, clear_seq,
+    *, num_keys: int, num_docs: int,
+):
+    """present[g], winner_val[g] per global key — two segment reductions."""
+    max_seq = jax.ops.segment_max(op_seq, key_gid, num_segments=num_keys)
+    last_clear = jax.ops.segment_max(
+        clear_seq, clear_doc, num_segments=num_docs
+    )
+    winner = op_seq == max_seq[key_gid]  # seqs are unique
+    win_set = jax.ops.segment_max(
+        jnp.where(winner, is_set, -1), key_gid, num_segments=num_keys
+    )
+    win_val = jax.ops.segment_max(
+        jnp.where(winner, val_idx, -1), key_gid, num_segments=num_keys
+    )
+    present = (win_set == 1) & (max_seq > last_clear[key_doc])
+    return present, win_val
+
+
+def replay_map_batch(docs: Sequence[MapDocInput]) -> List[SummaryTree]:
+    """Full pipeline: pack → device LWW reduction → canonical summaries.
+
+    Returns one SummaryTree per input doc whose bytes equal
+    ``SharedMap.summarize()`` after the oracle applies the same ops.
+    """
+    batch = pack_map_batch(docs)
+    present, win_val = _map_lww_kernel(
+        batch.key_gid,
+        batch.op_seq,
+        batch.is_set,
+        batch.val_idx,
+        batch.key_doc,
+        batch.clear_doc,
+        batch.clear_seq,
+        num_keys=batch.num_keys,
+        num_docs=batch.num_docs,
+    )
+    present = np.asarray(present)
+    win_val = np.asarray(win_val)
+    data_per_doc: List[Dict[str, Any]] = [dict() for _ in docs]
+    for gid, (doc_idx, key) in enumerate(batch.keys):
+        if present[gid]:
+            data_per_doc[doc_idx][key] = batch.values.lookup(int(win_val[gid]))
+    out = []
+    for data in data_per_doc:
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json({"data": data}))
+        out.append(tree)
+    return out

@@ -1,0 +1,453 @@
+"""Merge-tree catch-up replay on device — the north-star kernel.
+
+Re-expresses the CPU oracle's pointer-walk (dds/merge_tree.py, semantics
+pinned by SEMANTICS.md) as a pure op-fold over *array-structured state*
+(SURVEY.md §7 design stance): per document, a fixed-capacity segment pool
+kept in sequence order as a struct-of-int32-arrays; each sequenced op is one
+`lax.scan` step of fixed-shape vector work:
+
+1. masked visible lengths for the op's view (ref_seq, client) — the
+   "partial lengths" of the reference, recomputed as a masked prefix sum;
+2. up to two *splits* (range/position boundaries falling inside segments),
+   each a shift-by-one gather over the pool;
+3. the op body as masked updates: insert = shift + write at the tie-break
+   index (first slot whose exclusive prefix ≥ pos — catch-up has no pending
+   segments, so the SEMANTICS.md tie-break degenerates to exactly this);
+   remove = first-wins removal marking + overlap bitmask; annotate = masked
+   property-column writes.
+
+Catch-up is post-sequencing: the fold is sequential per document but
+embarrassingly parallel across documents — `vmap` over the doc axis, then
+pjit over a document-sharded mesh (parallel/).  Zamboni is intentionally
+*absent* on device: tombstone collection never changes the visible order
+(tie-break stops before tombstones; sub-window tombstones are invisible to
+every reachable view), so the kernel keeps tombstones and the host-side
+canonical normalizer (same one the oracle uses) drops them at summary
+extraction.  Text bytes stay host-side in an arena; the device tracks
+(start, len) spans only.
+
+Constraints of the device path (host fallback otherwise):
+- ≤ 31 distinct clients per document (overlap-removers are a bitmask);
+- segment pool capacity = base segments + 2·ops (each op splits ≤ 2).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .interning import Interner, TextArena, next_bucket
+
+NOT_REMOVED = np.int32(np.iinfo(np.int32).max)
+# Property-column sentinels (values are interned ids >= 0).
+PROP_ABSENT = -1      # key not set on the segment
+PROP_NOT_TOUCHED = -2  # annotate op does not touch this key
+
+K_NOOP, K_INSERT, K_REMOVE, K_ANNOTATE = 0, 1, 2, 3
+
+MAX_CLIENTS_PER_DOC = 31
+
+
+class MTState(NamedTuple):
+    """Per-document segment pool, in sequence order (slots [0, n))."""
+
+    tstart: jnp.ndarray      # [S] arena offset
+    tlen: jnp.ndarray        # [S] span length (chars)
+    ins_seq: jnp.ndarray     # [S]
+    ins_client: jnp.ndarray  # [S] per-doc client idx; -1 = universal epoch
+    rem_seq: jnp.ndarray     # [S] NOT_REMOVED if alive
+    rem_client: jnp.ndarray  # [S] -1 if alive
+    overlap: jnp.ndarray     # [S] uint32 bitmask of overlap removers
+    props: jnp.ndarray       # [S, K] interned value ids / PROP_ABSENT
+    n: jnp.ndarray           # [] live slot count
+
+
+class MTOps(NamedTuple):
+    """Packed op stream (scan xs), one row per sequenced op."""
+
+    kind: jnp.ndarray     # [T]
+    seq: jnp.ndarray      # [T]
+    client: jnp.ndarray   # [T] per-doc client idx
+    ref_seq: jnp.ndarray  # [T]
+    a: jnp.ndarray        # [T] pos (insert) / start (remove, annotate)
+    b: jnp.ndarray        # [T] end (remove, annotate)
+    tstart: jnp.ndarray   # [T] arena offset of inserted text
+    tlen: jnp.ndarray     # [T]
+    pvals: jnp.ndarray    # [T, K] per-key values / PROP_NOT_TOUCHED
+
+
+def _visible_len(state: MTState, ref_seq, client) -> jnp.ndarray:
+    slot = jnp.arange(state.tlen.shape[0])
+    active = slot < state.n
+    ins_vis = (state.ins_seq <= ref_seq) | (state.ins_client == client)
+    bit = (state.overlap >> client.astype(jnp.uint32)) & jnp.uint32(1)
+    rem_vis = (
+        (state.rem_seq <= ref_seq) | (state.rem_client == client) | (bit == 1)
+    )
+    return jnp.where(active & ins_vis & ~rem_vis, state.tlen, 0)
+
+
+def _excl_cumsum(v: jnp.ndarray) -> jnp.ndarray:
+    return jnp.cumsum(v) - v
+
+
+def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
+    """Split the segment that ``char_pos`` falls strictly inside of (in the
+    op's view), shifting the pool right by one.  No-op when the position
+    lands on a boundary or ``enable`` is false."""
+    S = state.tlen.shape[0]
+    v = _visible_len(state, ref_seq, client)
+    cum = _excl_cumsum(v)
+    inside = (cum < char_pos) & (char_pos < cum + v)
+    do = enable & inside.any()
+    idx = jnp.argmax(inside)  # unique when present
+    off = char_pos - cum[idx]
+    slot = jnp.arange(S)
+    src = jnp.where(slot <= idx, slot, slot - 1)
+
+    def shift(f):
+        return jnp.take(f, src, axis=0)
+
+    tstart, tlen = shift(state.tstart), shift(state.tlen)
+    is_left = slot == idx
+    is_right = slot == idx + 1
+    new_tlen = jnp.where(is_left, off, jnp.where(is_right, tlen - off, tlen))
+    new_tstart = jnp.where(is_right, tstart + off, tstart)
+    out = MTState(
+        tstart=new_tstart,
+        tlen=new_tlen,
+        ins_seq=shift(state.ins_seq),
+        ins_client=shift(state.ins_client),
+        rem_seq=shift(state.rem_seq),
+        rem_client=shift(state.rem_client),
+        overlap=shift(state.overlap),
+        props=shift(state.props),
+        n=state.n + 1,
+    )
+    return jax.tree.map(lambda new, old: jnp.where(do, new, old), out, state)
+
+
+def _apply_op(state: MTState, op) -> MTState:
+    """One sequenced op — the scan step."""
+    S = state.tlen.shape[0]
+    ref_seq, client = op.ref_seq, op.client
+    is_ins = op.kind == K_INSERT
+    is_rem = op.kind == K_REMOVE
+    is_ann = op.kind == K_ANNOTATE
+
+    # Boundary splits (shared by all op kinds).
+    state = _split_at(state, op.a, ref_seq, client, is_ins | is_rem | is_ann)
+    state = _split_at(state, op.b, ref_seq, client, is_rem | is_ann)
+
+    v = _visible_len(state, ref_seq, client)
+    cum = _excl_cumsum(v)
+    slot = jnp.arange(S)
+    active = slot < state.n
+
+    # --- insert: tie-break index = first slot with cum >= pos (catch-up has
+    # no pending segments; stop before the first sequenced segment).
+    can = (cum >= op.a) & active
+    j = jnp.where(can.any(), jnp.argmax(can), state.n)
+    src = jnp.where(slot <= j, slot, slot - 1)
+
+    def shifted(f, newval):
+        moved = jnp.take(f, src, axis=0)
+        if f.ndim == 1:
+            return jnp.where(slot == j, newval, moved)
+        return jnp.where((slot == j)[:, None], newval, moved)
+
+    ins_state = MTState(
+        tstart=shifted(state.tstart, op.tstart),
+        tlen=shifted(state.tlen, op.tlen),
+        ins_seq=shifted(state.ins_seq, op.seq),
+        ins_client=shifted(state.ins_client, client),
+        rem_seq=shifted(state.rem_seq, NOT_REMOVED),
+        rem_client=shifted(state.rem_client, -1),
+        overlap=shifted(state.overlap, jnp.uint32(0)),
+        props=shifted(
+            state.props,
+            jnp.where(op.pvals == PROP_NOT_TOUCHED, PROP_ABSENT, op.pvals),
+        ),
+        n=state.n + 1,
+    )
+    state = jax.tree.map(
+        lambda new, old: jnp.where(is_ins, new, old), ins_state, state
+    )
+
+    # --- remove / annotate target: segments fully inside [a, b) in the view
+    # (splits above made partial overlaps exact).  Computed on the pre-insert
+    # cum/v, which is correct because the masks are exclusive by kind.
+    covered = (cum >= op.a) & (cum + v <= op.b) & (v > 0) & active
+
+    first_win = covered & (state.rem_seq == NOT_REMOVED) & is_rem
+    again = covered & (state.rem_seq != NOT_REMOVED) & is_rem
+    state = state._replace(
+        rem_seq=jnp.where(first_win, op.seq, state.rem_seq),
+        rem_client=jnp.where(first_win, client, state.rem_client),
+        overlap=jnp.where(
+            again,
+            state.overlap | (jnp.uint32(1) << client.astype(jnp.uint32)),
+            state.overlap,
+        ),
+    )
+
+    touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] & (covered & is_ann)[:, None]
+    state = state._replace(
+        props=jnp.where(touch, jnp.broadcast_to(op.pvals, state.props.shape),
+                        state.props)
+    )
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _replay_scan(state: MTState, ops: MTOps) -> MTState:
+    def step(carry, op):
+        return _apply_op(carry, op), None
+
+    final, _ = jax.lax.scan(step, state, ops)
+    return final
+
+
+_replay_batch = jax.jit(jax.vmap(lambda s, o: _replay_scan(s, o)))
+
+
+# ---------------------------------------------------------------------------
+# Host side: packing and canonical summary extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MergeTreeDocInput:
+    """One document's catch-up work item: optional base summary + op tail."""
+
+    doc_id: str
+    ops: Sequence[SequencedMessage]   # sequence-op contents, ascending seq
+    base_records: Optional[List[dict]] = None  # normalized summary body
+    final_seq: int = 0    # head seq after the tail (for the summary header)
+    final_msn: int = 0    # final minimumSequenceNumber
+
+
+class _DocPack:
+    """Per-document host bookkeeping during packing."""
+
+    def __init__(self) -> None:
+        self.clients = Interner()
+
+    def client_idx(self, client_id) -> int:
+        if client_id is None:
+            return -1
+        idx = self.clients.intern(client_id)
+        if idx >= MAX_CLIENTS_PER_DOC:
+            raise OverflowError(
+                f"device path supports ≤{MAX_CLIENTS_PER_DOC} clients/doc"
+            )
+        return idx
+
+
+def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
+    """Pack documents into uniform-shape device arrays + host metadata.
+
+    Returns (state_arrays, op_arrays, meta) where meta carries everything
+    needed to rebuild canonical summaries from the final device state.
+    """
+    prop_keys = Interner()
+    values = Interner()
+    arena = TextArena()
+    doc_packs = [_DocPack() for _ in docs]
+
+    # Pre-scan for the shared property-key vocabulary K.
+    for doc in docs:
+        if doc.base_records:
+            for rec in doc.base_records:
+                for key in rec.get("p", {}):
+                    prop_keys.intern(key)
+        for msg in doc.ops:
+            op = msg.contents
+            for key in (op.get("props") or {}):
+                prop_keys.intern(key)
+    # Power-of-two buckets: jitted shapes stay stable across batches instead
+    # of recompiling the vmapped scan per (D, S, T, K).
+    K = next_bucket(max(len(prop_keys), 1), floor=1)
+    T = next_bucket(max((len(d.ops) for d in docs), default=1), floor=16)
+    base_counts = [len(d.base_records or []) for d in docs]
+    S = max(
+        (bc + 2 * len(d.ops) for bc, d in zip(base_counts, docs)), default=1
+    )
+    S = next_bucket(max(S, 1), floor=32)
+
+    D = len(docs)
+    st = {
+        "tstart": np.zeros((D, S), np.int32),
+        "tlen": np.zeros((D, S), np.int32),
+        "ins_seq": np.zeros((D, S), np.int32),
+        "ins_client": np.full((D, S), -1, np.int32),
+        "rem_seq": np.full((D, S), NOT_REMOVED, np.int32),
+        "rem_client": np.full((D, S), -1, np.int32),
+        "overlap": np.zeros((D, S), np.uint32),
+        "props": np.full((D, S, K), PROP_ABSENT, np.int32),
+        "n": np.zeros((D,), np.int32),
+    }
+    op = {
+        "kind": np.zeros((D, T), np.int32),
+        "seq": np.zeros((D, T), np.int32),
+        "client": np.zeros((D, T), np.int32),
+        "ref_seq": np.zeros((D, T), np.int32),
+        "a": np.zeros((D, T), np.int32),
+        "b": np.zeros((D, T), np.int32),
+        "tstart": np.zeros((D, T), np.int32),
+        "tlen": np.zeros((D, T), np.int32),
+        "pvals": np.full((D, T, K), PROP_NOT_TOUCHED, np.int32),
+    }
+
+    for d, doc in enumerate(docs):
+        pack = doc_packs[d]
+        for s, rec in enumerate(doc.base_records or []):
+            st["tstart"][d, s] = arena.append(rec["t"])
+            st["tlen"][d, s] = len(rec["t"])
+            st["ins_seq"][d, s] = rec["s"]
+            st["ins_client"][d, s] = pack.client_idx(rec["c"])
+            if "rs" in rec:
+                st["rem_seq"][d, s] = rec["rs"]
+                st["rem_client"][d, s] = pack.client_idx(rec.get("rc"))
+            mask = 0
+            for ro_client in rec.get("ro", []):
+                mask |= 1 << pack.client_idx(ro_client)
+            st["overlap"][d, s] = mask
+            for key, value in rec.get("p", {}).items():
+                st["props"][d, s, prop_keys.intern(key)] = values.intern(value)
+        st["n"][d] = len(doc.base_records or [])
+
+        for t, msg in enumerate(doc.ops):
+            contents = msg.contents
+            kind = contents["kind"]
+            op["seq"][d, t] = msg.seq
+            op["client"][d, t] = pack.client_idx(msg.client_id)
+            op["ref_seq"][d, t] = msg.ref_seq
+            if kind == "insert":
+                op["kind"][d, t] = K_INSERT
+                op["a"][d, t] = contents["pos"]
+                op["tstart"][d, t] = arena.append(contents["text"])
+                op["tlen"][d, t] = len(contents["text"])
+            elif kind == "remove":
+                op["kind"][d, t] = K_REMOVE
+                op["a"][d, t] = contents["start"]
+                op["b"][d, t] = contents["end"]
+            elif kind == "annotate":
+                op["kind"][d, t] = K_ANNOTATE
+                op["a"][d, t] = contents["start"]
+                op["b"][d, t] = contents["end"]
+            else:
+                raise ValueError(f"unknown sequence op kind {kind!r}")
+            for key, value in (contents.get("props") or {}).items():
+                k = prop_keys.intern(key)
+                op["pvals"][d, t, k] = (
+                    PROP_ABSENT if value is None else values.intern(value)
+                )
+
+    meta = {
+        "doc_packs": doc_packs,
+        "prop_keys": list(prop_keys.values),
+        "values": values,
+        "arena": arena,
+        "docs": docs,
+    }
+    return MTState(**st), MTOps(**op), meta
+
+
+def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
+    """Device state → the oracle's normalized record list (host side)."""
+    doc = meta["docs"][d]
+    pack = meta["doc_packs"][d]
+    arena: TextArena = meta["arena"]
+    prop_keys = meta["prop_keys"]
+    values: Interner = meta["values"]
+    msn = doc.final_msn
+    records: List[dict] = []
+    n = int(state_np["n"][d])
+    for s in range(n):
+        rs = int(state_np["rem_seq"][d, s])
+        removed = rs != NOT_REMOVED
+        if removed and rs <= msn:
+            continue  # expired tombstone
+        ins_seq = int(state_np["ins_seq"][d, s])
+        ins_client = int(state_np["ins_client"][d, s])
+        if ins_seq <= msn:
+            seq_out, client_out = 0, None
+        else:
+            seq_out = ins_seq
+            client_out = pack.clients.lookup(ins_client)
+        rec = {
+            "t": arena.slice(
+                int(state_np["tstart"][d, s]), int(state_np["tlen"][d, s])
+            ),
+            "s": seq_out,
+            "c": client_out,
+        }
+        if removed:
+            rec["rs"] = rs
+            rc = int(state_np["rem_client"][d, s])
+            rec["rc"] = pack.clients.lookup(rc) if rc >= 0 else None
+        mask = int(state_np["overlap"][d, s])
+        if mask:
+            rec["ro"] = sorted(
+                pack.clients.lookup(i)
+                for i in range(MAX_CLIENTS_PER_DOC)
+                if mask & (1 << i)
+            )
+        props = {}
+        for k, key in enumerate(prop_keys):
+            vid = int(state_np["props"][d, s, k])
+            if vid != PROP_ABSENT:
+                props[key] = values.lookup(vid)
+        if props:
+            rec["p"] = dict(sorted(props.items()))
+        if records:
+            prev = records[-1]
+            if (
+                prev["s"] == rec["s"]
+                and prev["c"] == rec["c"]
+                and prev.get("rs") == rec.get("rs")
+                and prev.get("rc") == rec.get("rc")
+                and prev.get("ro") == rec.get("ro")
+                and prev.get("p") == rec.get("p")
+            ):
+                prev["t"] += rec["t"]
+                continue
+        records.append(rec)
+    return records
+
+
+def replay_mergetree_batch(
+    docs: Sequence[MergeTreeDocInput],
+) -> List[SummaryTree]:
+    """Full pipeline: pack → vmapped device op-fold → canonical summaries.
+
+    Byte-identical to ``SharedString.summarize()`` after the oracle replays
+    the same log (asserted by tests/test_mergetree_kernel.py).
+    """
+    if not docs:
+        return []
+    state, ops, meta = pack_mergetree_batch(docs)
+    final = _replay_batch(state, ops)
+    state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+    out = []
+    for d, doc in enumerate(docs):
+        records = _extract_records(meta, state_np, d)
+        length = sum(
+            int(state_np["tlen"][d, s])
+            for s in range(int(state_np["n"][d]))
+            if int(state_np["rem_seq"][d, s]) == NOT_REMOVED
+        )
+        header = {"seq": doc.final_seq, "minSeq": doc.final_msn, "length": length}
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json(header))
+        tree.add_blob("body", canonical_json(records))
+        out.append(tree)
+    return out

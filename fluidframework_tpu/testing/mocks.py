@@ -12,6 +12,7 @@ the rest).
 from __future__ import annotations
 
 import collections
+import dataclasses
 from typing import Deque, Dict, List
 
 from ..dds.shared_object import SharedObject
@@ -59,34 +60,31 @@ class MockClientRuntime:
         )
         return self._client_seq
 
+    def _advance_channels(self, msg: SequencedMessage, skip_address=None) -> None:
+        """Every container message advances every channel's window (seq /
+        min_seq for zamboni), whether or not the op was addressed to it."""
+        for address, dds in self.channels.items():
+            if address == skip_address:
+                continue
+            advance = getattr(dds, "advance", None)
+            if advance:
+                advance(msg.seq, msg.min_seq)
+
     def deliver(self, msg: SequencedMessage) -> None:
         self.ref_seq = msg.seq
         if msg.type is not MessageType.OP:
-            for dds in self.channels.values():
-                advance = getattr(dds, "advance", None)
-                if advance:
-                    advance(msg.seq, msg.min_seq)
+            self._advance_channels(msg)
             return
         envelope = msg.contents
         dds = self.channels.get(envelope["address"])
         if dds is None:
+            self._advance_channels(msg)
             return
-        inner = SequencedMessage(
-            seq=msg.seq,
-            client_id=msg.client_id,
-            client_seq=msg.client_seq,
-            ref_seq=msg.ref_seq,
-            min_seq=msg.min_seq,
-            type=msg.type,
-            contents=envelope["contents"],
-            timestamp=msg.timestamp,
+        dds.process(
+            dataclasses.replace(msg, contents=envelope["contents"]),
+            local=(msg.client_id == self.client_id),
         )
-        dds.process(inner, local=(msg.client_id == self.client_id))
-        for cid, other in self.channels.items():
-            if cid != envelope["address"]:
-                advance = getattr(other, "advance", None)
-                if advance:
-                    advance(msg.seq, msg.min_seq)
+        self._advance_channels(msg, skip_address=envelope["address"])
 
 
 class MockContainerRuntimeFactory:
@@ -137,3 +135,19 @@ class MockContainerRuntimeFactory:
             msg = self._delivery_queue.popleft()
             for client in self.clients:
                 client.deliver(msg)
+
+
+def channel_log(factory: MockContainerRuntimeFactory, address: str,
+                min_seq_exclusive: int = 0) -> list:
+    """Extract one channel's sequenced ops from the durable log, unwrapped
+    from their envelopes — the exact stream a catch-up replay (CPU oracle or
+    device kernel) folds over."""
+    out = []
+    for msg in factory.sequencer.log:
+        if msg.type is not MessageType.OP or msg.seq <= min_seq_exclusive:
+            continue
+        envelope = msg.contents
+        if envelope.get("address") != address:
+            continue
+        out.append(dataclasses.replace(msg, contents=envelope["contents"]))
+    return out

@@ -1,0 +1,117 @@
+"""Device merge-tree kernel vs CPU oracle: byte-identical summaries.
+
+The north-star acceptance gate (SURVEY.md §7 layer 4): fuzz-generated
+SharedString op logs replayed through the device op-fold must produce the
+exact canonical summary bytes of the oracle — same walk, same tie-breaks,
+same overlap-removal bookkeeping, same normalization.
+"""
+
+import json
+
+import pytest
+
+from fluidframework_tpu.dds import SharedString
+from fluidframework_tpu.ops.mergetree_kernel import (
+    MergeTreeDocInput,
+    replay_mergetree_batch,
+)
+from fluidframework_tpu.testing.fuzz import StringFuzzSpec, run_fuzz
+from fluidframework_tpu.testing.mocks import channel_log
+
+
+def _kernel_inputs_from_fuzz(factory, doc_id="fuzz", base_records=None,
+                             min_seq_exclusive=0):
+    return MergeTreeDocInput(
+        doc_id=doc_id,
+        ops=channel_log(factory, "fuzz", min_seq_exclusive=min_seq_exclusive),
+        base_records=base_records,
+        final_seq=factory.sequencer.seq,
+        final_msn=factory.sequencer.min_seq,
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_mergetree_kernel_matches_oracle_on_fuzz_logs(seed):
+    replicas, factory = run_fuzz(
+        StringFuzzSpec(), seed=seed, n_clients=3, rounds=20
+    )
+    oracle = replicas[0].summarize()
+    [summary] = replay_mergetree_batch([_kernel_inputs_from_fuzz(factory)])
+    assert summary.digest() == oracle.digest(), (
+        f"seed={seed}: kernel body "
+        f"{summary.blob_bytes('body')!r} != oracle "
+        f"{oracle.blob_bytes('body')!r}"
+    )
+
+
+def test_mergetree_kernel_batches_docs_of_different_sizes():
+    docs, oracle_digests = [], []
+    for seed in (50, 51, 52):
+        replicas, factory = run_fuzz(
+            StringFuzzSpec(), seed=seed, n_clients=2, rounds=6 + 4 * (seed % 3)
+        )
+        docs.append(_kernel_inputs_from_fuzz(factory, doc_id=f"d{seed}"))
+        oracle_digests.append(replicas[0].summarize().digest())
+    summaries = replay_mergetree_batch(docs)
+    assert [s.digest() for s in summaries] == oracle_digests
+
+
+def test_mergetree_kernel_replays_tail_from_base_summary():
+    """The flagship catch-up shape: summary at seq S + op tail."""
+    replicas, factory = run_fuzz(
+        StringFuzzSpec(), seed=9, n_clients=3, rounds=16
+    )
+    full_ops = channel_log(factory, "fuzz")
+    mid_seq = full_ops[len(full_ops) // 2].seq
+    # Build the base summary by oracle catch-up to the midpoint.
+    partial = SharedString("fuzz")
+    for msg in full_ops:
+        if msg.seq <= mid_seq:
+            partial.process(msg, local=False)
+    base_summary = partial.summarize()
+    base_records = json.loads(base_summary.blob_bytes("body"))
+    doc = MergeTreeDocInput(
+        doc_id="fuzz",
+        ops=[m for m in full_ops if m.seq > mid_seq],
+        base_records=base_records,
+        final_seq=factory.sequencer.seq,
+        final_msn=factory.sequencer.min_seq,
+    )
+    [summary] = replay_mergetree_batch([doc])
+    # Oracle continuation from the same summary must agree too.
+    resumed = SharedString("fuzz")
+    resumed.load(base_summary)
+    for msg in full_ops:
+        if msg.seq > mid_seq:
+            resumed.process(msg, local=False)
+    resumed.advance(factory.sequencer.seq, factory.sequencer.min_seq)
+    assert summary.digest() == resumed.summarize().digest()
+
+
+def test_insert_with_none_prop_value_matches_kernel():
+    """Regression: a None prop value on insert means 'absent' on both paths."""
+    from fluidframework_tpu.testing import MockContainerRuntimeFactory
+
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    a.insert_text(0, "hello", props={"k": None, "m": 2})
+    factory.process_all_messages()
+    [dev] = replay_mergetree_batch(
+        [
+            MergeTreeDocInput(
+                "s",
+                channel_log(factory, "s"),
+                final_seq=factory.sequencer.seq,
+                final_msn=factory.sequencer.min_seq,
+            )
+        ]
+    )
+    assert dev.digest() == a.summarize().digest()
+    assert json.loads(a.summarize().blob_bytes("body"))[0]["p"] == {"m": 2}
+
+
+def test_mergetree_kernel_empty_doc_and_noop_padding():
+    doc = MergeTreeDocInput(doc_id="empty", ops=[], final_seq=0, final_msn=0)
+    [summary] = replay_mergetree_batch([doc])
+    fresh = SharedString("empty")
+    assert summary.digest() == fresh.summarize().digest()
