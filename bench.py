@@ -1,0 +1,145 @@
+"""North-star benchmark: bulk SharedString catch-up replay, device vs oracle.
+
+Workload per BASELINE.json: many documents' sequenced op tails folded to
+summaries.  The CPU baseline is the oracle replay harness (BASELINE.md: the
+1× denominator); the device path is the merge-tree kernel vmapped over the
+document axis on whatever backend jax selects (real TPU under the driver).
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": ..., "value": ops/sec, "unit": "ops/sec", "vs_baseline": ratio}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+import jax
+import numpy as np
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.ops.mergetree_kernel import (
+    MergeTreeDocInput,
+    _replay_batch,
+    pack_mergetree_batch,
+    replay_mergetree_batch,
+)
+from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
+
+import os
+
+N_DOCS = int(os.environ.get("BENCH_DOCS", "10240"))
+OPS_PER_DOC = int(os.environ.get("BENCH_OPS", "96"))
+CPU_SAMPLE_DOCS = int(os.environ.get("BENCH_CPU_SAMPLE", "24"))
+ALPHABET = "abcdefghijklmnopqrstuvwxyz "
+
+
+def synth_doc(doc_idx: int, n_ops: int) -> MergeTreeDocInput:
+    """A valid sequenced op stream: 3 clients round-robin, mixed edits."""
+    rng = random.Random(doc_idx * 7919 + 13)
+    ops, length = [], 0
+    for i in range(n_ops):
+        seq = i + 1
+        client = f"client{i % 3}"
+        r = rng.random()
+        if r < 0.62 or length < 4:
+            pos = rng.randint(0, length)
+            text = "".join(
+                rng.choice(ALPHABET) for _ in range(rng.randint(1, 8))
+            )
+            contents = {"kind": "insert", "pos": pos, "text": text}
+            length += len(text)
+        elif r < 0.9:
+            start = rng.randint(0, length - 2)
+            end = min(length, start + rng.randint(1, 8))
+            contents = {"kind": "remove", "start": start, "end": end}
+            length -= end - start
+        else:
+            start = rng.randint(0, length - 2)
+            end = min(length, start + rng.randint(1, 8))
+            contents = {
+                "kind": "annotate", "start": start, "end": end,
+                "props": {"f": rng.randint(0, 3)},
+            }
+        ops.append(
+            SequencedMessage(
+                seq=seq, client_id=client, client_seq=seq, ref_seq=seq - 1,
+                min_seq=0, type=MessageType.OP, contents=contents,
+            )
+        )
+    return MergeTreeDocInput(
+        doc_id=f"doc{doc_idx}", ops=ops, final_seq=n_ops, final_msn=0
+    )
+
+
+def main() -> None:
+    t0 = time.time()
+    docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
+    total_ops = N_DOCS * OPS_PER_DOC
+    print(
+        f"generated {N_DOCS} docs x {OPS_PER_DOC} ops in {time.time()-t0:.1f}s "
+        f"(backend={jax.default_backend()})",
+        file=sys.stderr,
+    )
+
+    # --- CPU oracle baseline (the 1x denominator, BASELINE.md) ---
+    t0 = time.time()
+    for doc in docs[:CPU_SAMPLE_DOCS]:
+        replica = SharedString(doc.doc_id)
+        for msg in doc.ops:
+            replica.process(msg, local=False)
+    cpu_time = time.time() - t0
+    cpu_ops_per_sec = CPU_SAMPLE_DOCS * OPS_PER_DOC / cpu_time
+    print(
+        f"cpu oracle: {CPU_SAMPLE_DOCS * OPS_PER_DOC} ops in {cpu_time:.2f}s "
+        f"= {cpu_ops_per_sec:,.0f} ops/s",
+        file=sys.stderr,
+    )
+
+    # --- device path ---
+    t0 = time.time()
+    state, ops, meta = pack_mergetree_batch(docs)
+    pack_time = time.time() - t0
+    t0 = time.time()
+    final = _replay_batch(state, ops)  # compile + first run
+    jax.block_until_ready(final)
+    warm_time = time.time() - t0
+    t0 = time.time()
+    final = _replay_batch(state, ops)
+    jax.block_until_ready(final)
+    device_time = time.time() - t0
+    device_ops_per_sec = total_ops / device_time
+    print(
+        f"pack {pack_time:.1f}s | compile+first {warm_time:.1f}s | "
+        f"steady replay {device_time:.3f}s = {device_ops_per_sec:,.0f} ops/s",
+        file=sys.stderr,
+    )
+
+    # --- sanity: device bytes == oracle bytes on a couple of docs ---
+    check = replay_mergetree_batch(docs[:2])
+    for doc, dev_summary in zip(docs[:2], check):
+        replica = SharedString(doc.doc_id)
+        for msg in doc.ops:
+            replica.process(msg, local=False)
+        assert dev_summary.digest() == replica.summarize().digest(), (
+            f"bench sanity: {doc.doc_id} device summary != oracle"
+        )
+    print("sanity: device summaries byte-identical to oracle", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "sharedstring_catchup_replay_ops_per_sec",
+                "value": round(device_ops_per_sec, 1),
+                "unit": "ops/sec",
+                "vs_baseline": round(device_ops_per_sec / cpu_ops_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,6 @@ Constraints of the device path (host fallback otherwise):
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
@@ -206,8 +205,9 @@ def _apply_op(state: MTState, op) -> MTState:
     return state
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _replay_scan(state: MTState, ops: MTOps) -> MTState:
+def replay_scan(state: MTState, ops: MTOps) -> MTState:
+    """Pure single-document op-fold (no jit): scan the op stream."""
+
     def step(carry, op):
         return _apply_op(carry, op), None
 
@@ -215,7 +215,10 @@ def _replay_scan(state: MTState, ops: MTOps) -> MTState:
     return final
 
 
-_replay_batch = jax.jit(jax.vmap(lambda s, o: _replay_scan(s, o)))
+#: vmapped over the document axis — the unit the parallel/ package shards.
+replay_vmapped = jax.vmap(replay_scan)
+
+_replay_batch = jax.jit(replay_vmapped)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism: document sharding over TPU chips.
+
+The reference scales by partitioning documents across Kafka partitions and
+service replicas (SURVEY.md §5 'Distributed communication backend'); the
+TPU-native equivalent is a ``jax.sharding.Mesh`` with a ``docs`` axis —
+catch-up replay is embarrassingly document-parallel, so the op-fold shards
+along the doc axis with zero cross-chip traffic during the fold, and merged
+state (summary roots / lengths) is assembled with XLA collectives over ICI at
+the end.  Multi-slice scale-out rides the same shardings over DCN.
+"""
+
+from .shard import (
+    doc_mesh,
+    replay_mergetree_sharded,
+    sharded_replay_step,
+)
+
+__all__ = ["doc_mesh", "replay_mergetree_sharded", "sharded_replay_step"]

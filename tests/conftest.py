@@ -1,16 +1,20 @@
-"""Test configuration.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
-JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is validated
-without TPU hardware; the driver separately dry-runs __graft_entry__).  The
-env vars must be set before the first ``import jax`` anywhere in the test
-process, which conftest guarantees.
+Multi-chip sharding is validated without TPU hardware (the driver separately
+dry-runs __graft_entry__).  Note the axon sitecustomize force-sets
+JAX_PLATFORMS=axon at interpreter startup, so the env var alone is not
+enough — jax.config.update must run before the first backend use, which this
+conftest guarantees (it executes before any test module imports jax).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
