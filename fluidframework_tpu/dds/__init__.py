@@ -12,6 +12,7 @@ from .shared_object import SharedObject
 from .map import SharedMap, SharedDirectory
 from .merge_tree import MergeTreeOracle, Segment
 from .sequence import SharedString
+from .intervals import Interval, IntervalCollection
 from .cell_counter import SharedCell, SharedCounter
 
 __all__ = [
@@ -21,6 +22,8 @@ __all__ = [
     "MergeTreeOracle",
     "Segment",
     "SharedString",
+    "Interval",
+    "IntervalCollection",
     "SharedCell",
     "SharedCounter",
 ]

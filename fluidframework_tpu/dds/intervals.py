@@ -1,0 +1,196 @@
+"""IntervalCollection — sliding intervals anchored in a SharedString.
+
+Capability-equivalent of the reference's sequence-package interval collections
+(SURVEY.md §2.2: ``IntervalCollection``/``SequenceInterval``, anchored via
+local references; upstream paths UNVERIFIED — empty reference mount).
+
+Convergence model (simpler and stronger than per-field pending masking,
+which compounds badly across add/delete/change interleavings — fuzz-found):
+interval state is a **pure fold of sequenced ops** in total order, with
+view-based endpoint resolution.  Every replica applies every remote op when
+it arrives and re-applies its *own* op at its ack (idempotent overwrite
+semantics), so the sequenced fold is identical everywhere.  The optimistic
+local apply at submit time is a provisional overlay for local reads; the ack
+re-apply snaps it to the authoritative sequence position.
+
+Rules of the fold:
+- ``add``    — replace the interval wholesale (endpoints + exact props).
+- ``change`` — update given endpoints; merge props per key (null deletes).
+  No-op if the interval was deleted earlier in the order.
+- ``delete`` — remove the interval.
+- Endpoints carry *positions in the op's view* ``(ref_seq, client)``; each
+  replica resolves them at apply time.  The merge-tree keeps tombstones
+  inside the collab window, so the view walk reconstructs; endpoints that
+  resolve onto a sequenced-removed segment slide immediately (matching the
+  author's earlier slide), and slides only ever target sequenced segments
+  (see MergeTreeOracle._slide_target_ok).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .merge_tree import LocalReference, MergeTreeOracle, NO_CLIENT
+
+
+class Interval:
+    __slots__ = ("id", "start", "end", "props")
+
+    def __init__(self, interval_id: str, start: LocalReference,
+                 end: LocalReference, props: Optional[Dict[str, Any]] = None):
+        self.id = interval_id
+        self.start = start
+        self.end = end
+        self.props: Dict[str, Any] = {
+            k: v for k, v in (props or {}).items() if v is not None
+        }
+
+
+class IntervalCollection:
+    """One named collection of intervals over a SharedString's merge-tree.
+
+    Lifecycle and op routing are owned by the SharedString (ops arrive
+    through the sequence channel with kind "intervalAdd"/"intervalChange"/
+    "intervalDelete"); this class implements resolution and merge rules.
+    """
+
+    def __init__(self, tree: MergeTreeOracle) -> None:
+        self._tree = tree
+        self.intervals: Dict[str, Interval] = {}
+        # Count of in-flight local ops per id: provisional-state marker
+        # (summaries exclude such ids; see summary_obj).
+        self._pending_ids: Dict[str, int] = {}
+
+    # -- queries ---------------------------------------------------------------
+
+    def get(self, interval_id: str) -> Optional[Interval]:
+        return self.intervals.get(interval_id)
+
+    def endpoints(self, interval_id: str, client: str = NO_CLIENT):
+        """Current (start, end) positions, or None if the interval no longer
+        exists (e.g. a concurrent remote delete) — consistent with get()."""
+        iv = self.intervals.get(interval_id)
+        if iv is None:
+            return None
+        return (
+            self._tree.reference_position(iv.start, client=client),
+            self._tree.reference_position(iv.end, client=client),
+        )
+
+    def __len__(self) -> int:
+        return len(self.intervals)
+
+    def items(self):
+        return self.intervals.items()
+
+    # -- resolution ------------------------------------------------------------
+
+    def _resolve(self, pos: int, ref_seq: int, client: str,
+                 up_to_seq=None) -> LocalReference:
+        """Anchor a reference at a view position; slide immediately off
+        sequenced-removed segments so early (author) and late (remote)
+        resolution agree.  ``up_to_seq`` is the fold position for sequenced
+        (re-)application — it excludes the author's own still-pending later
+        edits from the walk (see MergeTreeOracle._insert_visible)."""
+        ref = self._tree.create_reference(
+            pos, ref_seq=ref_seq, client=client, up_to_seq=up_to_seq)
+        seg = ref.segment
+        if seg is not None and self._tree._sequenced_removed(seg):
+            self._tree._slide_refs(seg)
+        return ref
+
+    def _detach(self, iv: Interval) -> None:
+        self._detach_ref(iv, "start")
+        self._detach_ref(iv, "end")
+
+    # -- op application (the fold) ---------------------------------------------
+
+    def apply(self, op: dict, ref_seq: int, client: str, local_ack: bool,
+              pending: bool, seq=None) -> None:
+        """Apply one collection op.
+
+        ``pending``   — optimistic local apply (op not yet sequenced);
+        ``local_ack`` — the sequenced echo of our own op (re-applied so the
+                        fold is identical on every replica).
+        """
+        interval_id = op["id"]
+        if pending:
+            self._pending_ids[interval_id] = (
+                self._pending_ids.get(interval_id, 0) + 1
+            )
+        elif local_ack:
+            n = self._pending_ids.get(interval_id, 0) - 1
+            if n <= 0:
+                self._pending_ids.pop(interval_id, None)
+            else:
+                self._pending_ids[interval_id] = n
+
+        kind = op["kind"]
+        iv = self.intervals.get(interval_id)
+        if kind == "intervalAdd":
+            if iv is not None:
+                self._detach(iv)
+            self.intervals[interval_id] = Interval(
+                interval_id,
+                self._resolve(op["start"], ref_seq, client, seq),
+                self._resolve(op["end"], ref_seq, client, seq),
+                op.get("props"),
+            )
+        elif kind == "intervalChange":
+            if iv is None:
+                return  # deleted earlier in the order: no-op
+            if op.get("start") is not None:
+                self._detach_ref(iv, "start")
+                iv.start = self._resolve(op["start"], ref_seq, client, seq)
+            if op.get("end") is not None:
+                self._detach_ref(iv, "end")
+                iv.end = self._resolve(op["end"], ref_seq, client, seq)
+            for key, value in (op.get("props") or {}).items():
+                if value is None:
+                    iv.props.pop(key, None)
+                else:
+                    iv.props[key] = value
+        elif kind == "intervalDelete":
+            if iv is not None:
+                self._detach(iv)
+                del self.intervals[interval_id]
+        else:
+            raise ValueError(f"unknown interval op kind {kind!r}")
+
+    def _detach_ref(self, iv: Interval, which: str) -> None:
+        ref = getattr(iv, which)
+        if ref.segment is not None and ref in ref.segment.refs:
+            ref.segment.refs.remove(ref)
+
+    # -- summary ---------------------------------------------------------------
+
+    def summary_obj(self) -> dict:
+        """Canonical sequenced-state projection: positions resolved in the
+        all-sequenced view, sorted by id.  Ids with in-flight local ops are
+        excluded (their fold state is provisional; summarizers run from
+        replicas with no pending ops, as in the reference)."""
+        out = {}
+        for interval_id in sorted(self.intervals):
+            if self._pending_ids.get(interval_id, 0) > 0:
+                continue
+            iv = self.intervals[interval_id]
+            rec: Dict[str, Any] = {
+                "start": self._tree.reference_position(iv.start),
+                "end": self._tree.reference_position(iv.end),
+            }
+            if iv.props:
+                rec["props"] = dict(sorted(iv.props.items()))
+            out[interval_id] = rec
+        return out
+
+    def load_obj(self, obj: dict) -> None:
+        for iv in self.intervals.values():
+            self._detach(iv)
+        self.intervals = {}
+        self._pending_ids = {}
+        for interval_id, rec in obj.items():
+            start = self._resolve(rec["start"], self._tree.current_seq, NO_CLIENT)
+            end = self._resolve(rec["end"], self._tree.current_seq, NO_CLIENT)
+            self.intervals[interval_id] = Interval(
+                interval_id, start, end, rec.get("props")
+            )

@@ -156,27 +156,53 @@ class MergeTreeOracle:
     # -- visibility ------------------------------------------------------------
 
     @staticmethod
-    def _insert_visible(seg: Segment, ref_seq: int, client: str) -> bool:
-        return (
-            seg.insert_seq != UNASSIGNED_SEQ and seg.insert_seq <= ref_seq
-        ) or seg.insert_client == client
+    def _insert_visible(seg: Segment, ref_seq: int, client: str,
+                        up_to_seq: Optional[int] = None) -> bool:
+        """Insert visibility in the view (ref_seq, client).
+
+        ``up_to_seq`` bounds the view to the fold position of a *sequenced*
+        op being (re-)applied at seq s: the author's own segments count only
+        if already sequenced before s.  Without the bound (optimistic local
+        apply), all own segments count including pending ones.  The bound is
+        what makes an ack-time re-resolution identical to every remote
+        replica's resolution (fuzz-found)."""
+        if seg.insert_seq != UNASSIGNED_SEQ and seg.insert_seq <= ref_seq:
+            return True
+        if seg.insert_client != client:
+            return False
+        if up_to_seq is None:
+            return True
+        return seg.insert_seq != UNASSIGNED_SEQ and seg.insert_seq < up_to_seq
 
     @staticmethod
-    def _removed_in_view(seg: Segment, ref_seq: int, client: str) -> bool:
+    def _removed_in_view(seg: Segment, ref_seq: int, client: str,
+                         up_to_seq: Optional[int] = None) -> bool:
         if seg.removed_seq is None:
             return False
         if seg.removed_seq != UNASSIGNED_SEQ and seg.removed_seq <= ref_seq:
             return True
-        return (
-            client == seg.removed_client
-            or client in seg.overlap_removers
-            or client in seg.pending_overlap
+        involved = (
+            client == seg.removed_client or client in seg.overlap_removers
         )
+        if up_to_seq is None:
+            # Optimistic view: the client's own pending (unsequenced) overlap
+            # removal also hides the segment from it.
+            involved = involved or client in seg.pending_overlap
+        if not involved:
+            return False
+        if up_to_seq is None:
+            return True
+        # Bounded fold view: involvement counts only if the removal state is
+        # sequenced before the fold position.  (pending_overlap is excluded
+        # above — the bound check uses the *winner's* seq, which says nothing
+        # about when this client's own overlapping remove sequences.)
+        return seg.removed_seq != UNASSIGNED_SEQ and seg.removed_seq < up_to_seq
 
-    def _visible_len(self, seg: Segment, ref_seq: int, client: str) -> int:
-        if not self._insert_visible(seg, ref_seq, client):
+    def _visible_len(self, seg: Segment, ref_seq: int, client: str,
+                     up_to_seq: Optional[int] = None) -> int:
+        if not self._insert_visible(seg, ref_seq, client, up_to_seq):
             return 0
-        if self._removed_in_view(seg, ref_seq, client):
+        if self._removed_in_view(seg, ref_seq, client, up_to_seq):
             return 0
         return len(seg.text)
 
@@ -389,10 +415,22 @@ class MergeTreeOracle:
 
     # -- local references (interval anchors) -----------------------------------
 
+    @staticmethod
+    def _sequenced_removed(seg: Segment) -> bool:
+        return seg.removed_seq is not None and seg.removed_seq != UNASSIGNED_SEQ
+
+    def _slide_target_ok(self, seg: Segment) -> bool:
+        """Valid slide destination: part of the *sequenced* state and not
+        sequenced-removed.  Pending local inserts are excluded (other
+        replicas don't have them at this sequence point) and pending local
+        removals are included (every replica still sees them alive here);
+        both directions of skew diverge otherwise (fuzz-found)."""
+        return seg.insert_seq != UNASSIGNED_SEQ and not self._sequenced_removed(seg)
+
     def _slide_refs(self, seg: Segment) -> None:
-        """Slide references off a (sequenced-)removed segment: forward to the
-        next surviving segment's start, else backward to the previous one's
-        end (reference capability: slideOnRemove)."""
+        """Slide references off a sequenced-removed segment: forward to the
+        next valid segment's start, else backward to the previous one's end
+        (reference capability: slideOnRemove)."""
         if not seg.refs:
             return
         try:
@@ -401,12 +439,12 @@ class MergeTreeOracle:
             return
         target, offset = None, 0
         for j in range(idx + 1, len(self.segments)):
-            if self.segments[j].removed_seq is None:
+            if self._slide_target_ok(self.segments[j]):
                 target, offset = self.segments[j], 0
                 break
         if target is None:
             for j in range(idx - 1, -1, -1):
-                if self.segments[j].removed_seq is None:
+                if self._slide_target_ok(self.segments[j]):
                     target, offset = self.segments[j], len(self.segments[j].text)
                     break
         # Non-sliding (stay-on-remove) refs remain attached to the tombstone,
@@ -419,13 +457,15 @@ class MergeTreeOracle:
                 ref.attach(target, offset)
 
     def create_reference(self, pos: int, ref_seq: Optional[int] = None,
-                         client: str = NO_CLIENT, slide: bool = True) -> LocalReference:
-        """Anchor a reference at visible position ``pos`` in the view."""
+                         client: str = NO_CLIENT, slide: bool = True,
+                         up_to_seq: Optional[int] = None) -> LocalReference:
+        """Anchor a reference at visible position ``pos`` in the view (see
+        _insert_visible for the ``up_to_seq`` fold-position bound)."""
         if ref_seq is None:
             ref_seq = self.current_seq
         idx, c = 0, 0
         for seg in self.segments:
-            v = self._visible_len(seg, ref_seq, client)
+            v = self._visible_len(seg, ref_seq, client, up_to_seq)
             if v > 0 and c + v > pos:
                 ref = LocalReference(None, 0, slide)
                 ref.attach(seg, pos - c)
@@ -434,7 +474,7 @@ class MergeTreeOracle:
         # End of document: anchor to the last visible segment's end.
         ref = LocalReference(None, 0, slide)
         for seg in reversed(self.segments):
-            if self._visible_len(seg, ref_seq, client) > 0:
+            if self._visible_len(seg, ref_seq, client, up_to_seq) > 0:
                 ref.attach(seg, len(seg.text))
                 return ref
         return ref  # empty document: detached reference at 0

@@ -20,6 +20,7 @@ from typing import Any, Deque, Dict, Optional
 
 from ..protocol.messages import UNASSIGNED_SEQ, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
+from .intervals import IntervalCollection
 from .merge_tree import MergeTreeOracle, SegmentGroup, NO_CLIENT
 from .shared_object import SharedObject
 
@@ -34,6 +35,8 @@ class SharedString(SharedObject):
         self.tree = MergeTreeOracle()
         # FIFO of SegmentGroups for pending local ops (acks arrive in order).
         self._pending_groups: Deque[SegmentGroup] = collections.deque()
+        self._interval_collections: Dict[str, IntervalCollection] = {}
+        self._interval_counter = 0
 
     # -- reads -----------------------------------------------------------------
 
@@ -97,6 +100,53 @@ class SharedString(SharedObject):
         if not self.is_attached:
             self._ack_detached(group, {"kind": "annotate", "props": props})
 
+    # -- interval collections (north-star config #3) ---------------------------
+
+    def get_interval_collection(self, label: str = "default") -> IntervalCollection:
+        coll = self._interval_collections.get(label)
+        if coll is None:
+            coll = IntervalCollection(self.tree)
+            self._interval_collections[label] = coll
+        return coll
+
+    def _submit_interval_op(self, label: str, op: dict) -> None:
+        """Optimistic local apply + submit — shared by all interval ops."""
+        self.get_interval_collection(label).apply(
+            op, self.tree.current_seq, self._local_client(),
+            local_ack=False, pending=self.is_attached,
+        )
+        self._submit_local_op(op)
+
+    def add_interval(self, start: int, end: int,
+                     props: Optional[Dict[str, Any]] = None,
+                     label: str = "default",
+                     interval_id: Optional[str] = None) -> str:
+        if interval_id is None:
+            self._interval_counter += 1
+            interval_id = f"{self._local_client()}-{self._interval_counter}"
+        op = {"kind": "intervalAdd", "label": label, "id": interval_id,
+              "start": start, "end": end}
+        if props:
+            op["props"] = props
+        self._submit_interval_op(label, op)
+        return interval_id
+
+    def change_interval(self, interval_id: str,
+                        start: Optional[int] = None,
+                        end: Optional[int] = None,
+                        props: Optional[Dict[str, Any]] = None,
+                        label: str = "default") -> None:
+        op = {"kind": "intervalChange", "label": label, "id": interval_id,
+              "start": start, "end": end}
+        if props:
+            op["props"] = props
+        self._submit_interval_op(label, op)
+
+    def delete_interval(self, interval_id: str, label: str = "default") -> None:
+        self._submit_interval_op(
+            label, {"kind": "intervalDelete", "label": label, "id": interval_id}
+        )
+
     def _ack_detached(self, group: SegmentGroup, op: dict) -> None:
         """Detached (never-connected) DDS: ops are immediately 'sequenced'
         locally at seq 0 so the state is summary-ready."""
@@ -113,6 +163,15 @@ class SharedString(SharedObject):
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
         op = msg.contents
         kind = op["kind"]
+        if kind.startswith("interval"):
+            self.get_interval_collection(op.get("label", "default")).apply(
+                op, msg.ref_seq, msg.client_id, local_ack=local,
+                pending=False, seq=msg.seq,
+            )
+            self.tree.current_seq = msg.seq
+            if msg.min_seq > self.tree.min_seq:
+                self.tree.zamboni(msg.min_seq)
+            return
         if local:
             group = self._pending_groups.popleft()
             assert group.kind == kind, f"ack mismatch: {group.kind} vs {kind}"
@@ -152,6 +211,17 @@ class SharedString(SharedObject):
     # -- summary ---------------------------------------------------------------
 
     def summarize(self, min_seq: int = 0) -> SummaryTree:
+        # Interval state is an optimistic fold overlay (see intervals.py):
+        # with in-flight local interval ops the overlay is provisional, so a
+        # summary taken now would silently drop sequenced interval state.
+        # Summarizers must run from pending-free replicas (as the reference's
+        # do) — enforce rather than diverge.
+        for label, coll in self._interval_collections.items():
+            if coll._pending_ids:
+                raise RuntimeError(
+                    f"{self.id}: cannot summarize with in-flight interval ops "
+                    f"on collection {label!r} (ids {sorted(coll._pending_ids)})"
+                )
         header = {
             "seq": self.tree.current_seq,
             "minSeq": self.tree.min_seq,
@@ -160,6 +230,13 @@ class SharedString(SharedObject):
         tree = SummaryTree()
         tree.add_blob("header", canonical_json(header))
         tree.add_blob("body", canonical_json(self.tree.normalized_records()))
+        intervals = {
+            label: coll.summary_obj()
+            for label, coll in sorted(self._interval_collections.items())
+            if coll.intervals
+        }
+        if intervals:
+            tree.add_blob("intervals", canonical_json(intervals))
         return tree
 
     def load(self, summary: SummaryTree) -> None:
@@ -167,4 +244,11 @@ class SharedString(SharedObject):
         records = json.loads(summary.blob_bytes("body"))
         self.tree.load_records(records, header["seq"], header["minSeq"])
         self._pending_groups.clear()
+        self._interval_collections = {}
+        try:
+            intervals = json.loads(summary.blob_bytes("intervals"))
+        except KeyError:
+            intervals = {}
+        for label, obj in intervals.items():
+            self.get_interval_collection(label).load_obj(obj)
         self.discard_pending()  # in-flight pre-load ops can no longer be acked

@@ -1,0 +1,200 @@
+"""Interval-op folding over final device merge-tree state (host side).
+
+Interval ops (add/change/delete) are rare relative to text ops, so the device
+folds only the text ops; this module folds the interval ops afterwards *over
+the final device state*.  That is possible because the device keeps every
+tombstone: any historical view is reconstructible from the final arrays —
+
+- bounded visibility at fold position ``s`` for client ``c``:
+  insert counts iff ``ins_seq <= ref`` or (own and ``ins_seq < s``); removal
+  counts iff ``rem_seq <= ref`` or the client is a remover whose removal
+  sequenced before ``s`` (the second-remover fields carry exact overlap
+  timing — the reason the kernel tracks (seq, client) pairs, not a bitmask);
+- reference slides replay lazily as a cascade: a ref attached at ``s`` on a
+  segment removed at ``t >= s`` slides at ``t`` to the nearest segment that
+  was sequenced-alive *at t* (``ins_seq < t`` and not removed before ``t``),
+  repeating while the landing segment is itself removed later.  This
+  reproduces the oracle's eager slide-on-remove event order exactly.
+
+The output is the same canonical intervals blob ``SharedString.summarize()``
+emits; byte-identity vs the oracle is asserted by tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..protocol.messages import SequencedMessage
+
+NO_CLIENT_IDX = -2  # matches no per-doc client index
+
+
+class FinalStateView:
+    """Historical-view resolution over one document's final segment arrays."""
+
+    def __init__(self, state_np: dict, d: int, not_removed: int) -> None:
+        n = int(state_np["n"][d])
+        self.n = n
+        self.tlen = np.asarray(state_np["tlen"][d, :n])
+        self.ins_seq = np.asarray(state_np["ins_seq"][d, :n])
+        self.ins_client = np.asarray(state_np["ins_client"][d, :n])
+        self.rem_seq = np.asarray(state_np["rem_seq"][d, :n])
+        self.rem_client = np.asarray(state_np["rem_client"][d, :n])
+        self.rem2_seq = np.asarray(state_np["rem2_seq"][d, :n])
+        self.rem2_client = np.asarray(state_np["rem2_client"][d, :n])
+        self.not_removed = not_removed
+
+    # -- bounded historical views ---------------------------------------------
+
+    def _vis_len(self, s: int, ref: int, client: int, up_to: int) -> int:
+        ins_vis = self.ins_seq[s] <= ref or (
+            self.ins_client[s] == client and self.ins_seq[s] < up_to
+        )
+        if not ins_vis:
+            return 0
+        if self.rem_seq[s] != self.not_removed and self.rem_seq[s] <= ref:
+            return 0
+        if self.rem_client[s] == client and self.rem_seq[s] < up_to:
+            return 0
+        if self.rem2_client[s] == client and self.rem2_seq[s] < up_to:
+            return 0
+        return int(self.tlen[s])
+
+    def resolve(self, pos: int, ref: int, client: int, up_to: int):
+        """View-position → (slot, offset) anchor, or None (empty view).
+        Mirrors MergeTreeOracle.create_reference."""
+        c = 0
+        for s in range(self.n):
+            v = self._vis_len(s, ref, client, up_to)
+            if v > 0 and c + v > pos:
+                return s, pos - c
+            c += v
+        for s in range(self.n - 1, -1, -1):
+            if self._vis_len(s, ref, client, up_to) > 0:
+                return s, int(self.tlen[s])
+        return None
+
+    # -- slide cascade ---------------------------------------------------------
+
+    def _valid_at(self, s: int, t: int) -> bool:
+        if self.ins_seq[s] >= t:
+            return False  # not sequenced-inserted yet at t
+        return self.rem_seq[s] == self.not_removed or self.rem_seq[s] > t
+
+    def anchor_final(self, slot: int, offset: int, attach_seq: int):
+        """Replay the slide cascade for a ref attached at fold position
+        ``attach_seq``; returns the final (slot, offset) or None (detached)."""
+        s = attach_seq
+        while slot is not None and self.rem_seq[slot] != self.not_removed:
+            t = max(s, int(self.rem_seq[slot]))
+            target = None
+            for j in range(slot + 1, self.n):
+                if self._valid_at(j, t):
+                    target, offset = j, 0
+                    break
+            if target is None:
+                for j in range(slot - 1, -1, -1):
+                    if self._valid_at(j, t):
+                        target, offset = j, int(self.tlen[j])
+                        break
+            if target is None:
+                return None
+            slot, s = target, t
+        return slot, offset
+
+    def position(self, anchor) -> int:
+        """Final sequenced-view position of an anchor (None → 0)."""
+        if anchor is None:
+            return 0
+        slot, offset = anchor
+        pos = int(
+            np.sum(
+                np.where(self.rem_seq[:slot] == self.not_removed,
+                         self.tlen[:slot], 0)
+            )
+        )
+        if self.rem_seq[slot] == self.not_removed:
+            pos += min(offset, int(self.tlen[slot]))
+        return pos
+
+
+def replay_intervals(
+    view: FinalStateView,
+    interval_ops: Sequence[SequencedMessage],
+    client_index,  # callable client_id -> per-doc idx
+    base_intervals: Optional[Dict[str, dict]] = None,
+    base_seq: int = 0,
+) -> Dict[str, dict]:
+    """Fold interval ops over the final state; returns {label: summary_obj}
+    byte-compatible with IntervalCollection.summary_obj()."""
+    # label -> id -> (start_ref, end_ref, props) with ref = (slot, off, seq)
+    collections: Dict[str, Dict[str, list]] = {}
+    for label, obj in (base_intervals or {}).items():
+        coll = collections.setdefault(label, {})
+        for interval_id, rec in obj.items():
+            start = view.resolve(rec["start"], base_seq, NO_CLIENT_IDX, base_seq + 1)
+            end = view.resolve(rec["end"], base_seq, NO_CLIENT_IDX, base_seq + 1)
+            coll[interval_id] = [
+                (*start, base_seq) if start else None,
+                (*end, base_seq) if end else None,
+                dict(rec.get("props") or {}),
+            ]
+    for msg in interval_ops:
+        op = msg.contents
+        label = op.get("label", "default")
+        coll = collections.setdefault(label, {})
+        interval_id = op["id"]
+        kind = op["kind"]
+        client = client_index(msg.client_id)
+
+        def res(pos):
+            a = view.resolve(pos, msg.ref_seq, client, msg.seq)
+            return (*a, msg.seq) if a is not None else None
+
+        if kind == "intervalAdd":
+            props = {
+                k: v for k, v in (op.get("props") or {}).items()
+                if v is not None
+            }
+            coll[interval_id] = [res(op["start"]), res(op["end"]), props]
+        elif kind == "intervalChange":
+            iv = coll.get(interval_id)
+            if iv is None:
+                continue
+            if op.get("start") is not None:
+                iv[0] = res(op["start"])
+            if op.get("end") is not None:
+                iv[1] = res(op["end"])
+            for key, value in (op.get("props") or {}).items():
+                if value is None:
+                    iv[2].pop(key, None)
+                else:
+                    iv[2][key] = value
+        elif kind == "intervalDelete":
+            coll.pop(interval_id, None)
+        else:
+            raise ValueError(f"unknown interval op kind {kind!r}")
+
+    out: Dict[str, dict] = {}
+    for label in sorted(collections):
+        if not collections[label]:
+            continue
+        obj = {}
+        for interval_id in sorted(collections[label]):
+            start_ref, end_ref, props = collections[label][interval_id]
+            rec: Dict[str, Any] = {
+                "start": view.position(
+                    view.anchor_final(*start_ref) if start_ref else None
+                ),
+                "end": view.position(
+                    view.anchor_final(*end_ref) if end_ref else None
+                ),
+            }
+            if props:
+                rec["props"] = dict(sorted(props.items()))
+            obj[interval_id] = rec
+        if obj:
+            out[label] = obj
+    return out

@@ -13,8 +13,8 @@ kept in sequence order as a struct-of-int32-arrays; each sequenced op is one
 3. the op body as masked updates: insert = shift + write at the tie-break
    index (first slot whose exclusive prefix ≥ pos — catch-up has no pending
    segments, so the SEMANTICS.md tie-break degenerates to exactly this);
-   remove = first-wins removal marking + overlap bitmask; annotate = masked
-   property-column writes.
+   remove = first-wins removal marking (+ exact-timed second-remover
+   fields for overlap); annotate = masked property-column writes.
 
 Catch-up is post-sequencing: the fold is sequential per document but
 embarrassingly parallel across documents — `vmap` over the doc axis, then
@@ -26,9 +26,13 @@ canonical normalizer (same one the oracle uses) drops them at summary
 extraction.  Text bytes stay host-side in an arena; the device tracks
 (start, len) spans only.
 
-Constraints of the device path (host fallback otherwise):
-- ≤ 31 distinct clients per document (overlap-removers are a bitmask);
-- segment pool capacity = base segments + 2·ops (each op splits ≤ 2).
+Interval ops don't run on device: they are folded host-side over the final
+device state (ops/interval_replay.py), which retains every tombstone and so
+reconstructs any historical view.  Documents where >2 removers overlap one
+segment (device tracks two exactly; flag raised otherwise) or whose base
+summary carries >1 overlap removers fall back to a full oracle replay —
+correctness is never approximated.  Segment pool capacity = base segments +
+2·ops (each op splits ≤ 2).
 """
 
 from __future__ import annotations
@@ -51,8 +55,6 @@ PROP_NOT_TOUCHED = -2  # annotate op does not touch this key
 
 K_NOOP, K_INSERT, K_REMOVE, K_ANNOTATE = 0, 1, 2, 3
 
-MAX_CLIENTS_PER_DOC = 31
-
 
 class MTState(NamedTuple):
     """Per-document segment pool, in sequence order (slots [0, n))."""
@@ -63,9 +65,11 @@ class MTState(NamedTuple):
     ins_client: jnp.ndarray  # [S] per-doc client idx; -1 = universal epoch
     rem_seq: jnp.ndarray     # [S] NOT_REMOVED if alive
     rem_client: jnp.ndarray  # [S] -1 if alive
-    overlap: jnp.ndarray     # [S] uint32 bitmask of overlap removers
+    rem2_seq: jnp.ndarray    # [S] second (overlap) remover seq / NOT_REMOVED
+    rem2_client: jnp.ndarray # [S] second remover client / -1
     props: jnp.ndarray       # [S, K] interned value ids / PROP_ABSENT
     n: jnp.ndarray           # [] live slot count
+    overflow: jnp.ndarray    # [] bool: >2 removers hit one segment
 
 
 class MTOps(NamedTuple):
@@ -86,9 +90,10 @@ def _visible_len(state: MTState, ref_seq, client) -> jnp.ndarray:
     slot = jnp.arange(state.tlen.shape[0])
     active = slot < state.n
     ins_vis = (state.ins_seq <= ref_seq) | (state.ins_client == client)
-    bit = (state.overlap >> client.astype(jnp.uint32)) & jnp.uint32(1)
     rem_vis = (
-        (state.rem_seq <= ref_seq) | (state.rem_client == client) | (bit == 1)
+        (state.rem_seq <= ref_seq)
+        | (state.rem_client == client)
+        | (state.rem2_client == client)
     )
     return jnp.where(active & ins_vis & ~rem_vis, state.tlen, 0)
 
@@ -126,9 +131,11 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
         ins_client=shift(state.ins_client),
         rem_seq=shift(state.rem_seq),
         rem_client=shift(state.rem_client),
-        overlap=shift(state.overlap),
+        rem2_seq=shift(state.rem2_seq),
+        rem2_client=shift(state.rem2_client),
         props=shift(state.props),
         n=state.n + 1,
+        overflow=state.overflow,
     )
     return jax.tree.map(lambda new, old: jnp.where(do, new, old), out, state)
 
@@ -169,12 +176,14 @@ def _apply_op(state: MTState, op) -> MTState:
         ins_client=shifted(state.ins_client, client),
         rem_seq=shifted(state.rem_seq, NOT_REMOVED),
         rem_client=shifted(state.rem_client, -1),
-        overlap=shifted(state.overlap, jnp.uint32(0)),
+        rem2_seq=shifted(state.rem2_seq, NOT_REMOVED),
+        rem2_client=shifted(state.rem2_client, -1),
         props=shifted(
             state.props,
             jnp.where(op.pvals == PROP_NOT_TOUCHED, PROP_ABSENT, op.pvals),
         ),
         n=state.n + 1,
+        overflow=state.overflow,
     )
     state = jax.tree.map(
         lambda new, old: jnp.where(is_ins, new, old), ins_state, state
@@ -187,14 +196,14 @@ def _apply_op(state: MTState, op) -> MTState:
 
     first_win = covered & (state.rem_seq == NOT_REMOVED) & is_rem
     again = covered & (state.rem_seq != NOT_REMOVED) & is_rem
+    second = again & (state.rem2_seq == NOT_REMOVED)
+    third = again & (state.rem2_seq != NOT_REMOVED)
     state = state._replace(
         rem_seq=jnp.where(first_win, op.seq, state.rem_seq),
         rem_client=jnp.where(first_win, client, state.rem_client),
-        overlap=jnp.where(
-            again,
-            state.overlap | (jnp.uint32(1) << client.astype(jnp.uint32)),
-            state.overlap,
-        ),
+        rem2_seq=jnp.where(second, op.seq, state.rem2_seq),
+        rem2_client=jnp.where(second, client, state.rem2_client),
+        overflow=state.overflow | third.any(),
     )
 
     touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] & (covered & is_ann)[:, None]
@@ -235,6 +244,9 @@ class MergeTreeDocInput:
     base_records: Optional[List[dict]] = None  # normalized summary body
     final_seq: int = 0    # head seq after the tail (for the summary header)
     final_msn: int = 0    # final minimumSequenceNumber
+    base_seq: int = 0     # seq of the base summary (for oracle fallback)
+    base_msn: int = 0     # minSeq of the base summary
+    base_intervals: Optional[Dict[str, dict]] = None  # intervals blob content
 
 
 class _DocPack:
@@ -242,16 +254,13 @@ class _DocPack:
 
     def __init__(self) -> None:
         self.clients = Interner()
+        self.interval_ops: List[SequencedMessage] = []
+        self.needs_fallback = False
 
     def client_idx(self, client_id) -> int:
         if client_id is None:
             return -1
-        idx = self.clients.intern(client_id)
-        if idx >= MAX_CLIENTS_PER_DOC:
-            raise OverflowError(
-                f"device path supports ≤{MAX_CLIENTS_PER_DOC} clients/doc"
-            )
-        return idx
+        return self.clients.intern(client_id)
 
 
 def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
@@ -273,15 +282,21 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                     prop_keys.intern(key)
         for msg in doc.ops:
             op = msg.contents
+            if op["kind"].startswith("interval"):
+                continue
             for key in (op.get("props") or {}):
                 prop_keys.intern(key)
     # Power-of-two buckets: jitted shapes stay stable across batches instead
     # of recompiling the vmapped scan per (D, S, T, K).
     K = next_bucket(max(len(prop_keys), 1), floor=1)
-    T = next_bucket(max((len(d.ops) for d in docs), default=1), floor=16)
+    text_op_counts = [
+        sum(1 for m in d.ops if not m.contents["kind"].startswith("interval"))
+        for d in docs
+    ]
+    T = next_bucket(max(text_op_counts, default=1), floor=16)
     base_counts = [len(d.base_records or []) for d in docs]
     S = max(
-        (bc + 2 * len(d.ops) for bc, d in zip(base_counts, docs)), default=1
+        (bc + 2 * t for bc, t in zip(base_counts, text_op_counts)), default=1
     )
     S = next_bucket(max(S, 1), floor=32)
 
@@ -293,9 +308,11 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "ins_client": np.full((D, S), -1, np.int32),
         "rem_seq": np.full((D, S), NOT_REMOVED, np.int32),
         "rem_client": np.full((D, S), -1, np.int32),
-        "overlap": np.zeros((D, S), np.uint32),
+        "rem2_seq": np.full((D, S), NOT_REMOVED, np.int32),
+        "rem2_client": np.full((D, S), -1, np.int32),
         "props": np.full((D, S, K), PROP_ABSENT, np.int32),
         "n": np.zeros((D,), np.int32),
+        "overflow": np.zeros((D,), np.bool_),
     }
     op = {
         "kind": np.zeros((D, T), np.int32),
@@ -319,17 +336,30 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             if "rs" in rec:
                 st["rem_seq"][d, s] = rec["rs"]
                 st["rem_client"][d, s] = pack.client_idx(rec.get("rc"))
-            mask = 0
-            for ro_client in rec.get("ro", []):
-                mask |= 1 << pack.client_idx(ro_client)
-            st["overlap"][d, s] = mask
+            ro = rec.get("ro", [])
+            if ro:
+                # Second-remover slot is exact for one overlap remover; the
+                # base summary doesn't carry overlap seqs, but any value
+                # below the base seq is faithful (it sequenced before every
+                # tail op).  >1 overlap removers → oracle fallback.
+                st["rem2_seq"][d, s] = doc.base_seq
+                st["rem2_client"][d, s] = pack.client_idx(ro[0])
+                if len(ro) > 1:
+                    pack.needs_fallback = True
             for key, value in rec.get("p", {}).items():
                 st["props"][d, s, prop_keys.intern(key)] = values.intern(value)
         st["n"][d] = len(doc.base_records or [])
 
-        for t, msg in enumerate(doc.ops):
+        t = -1
+        for msg in doc.ops:
             contents = msg.contents
             kind = contents["kind"]
+            if kind.startswith("interval"):
+                for cl in ([msg.client_id] if msg.client_id else []):
+                    pack.client_idx(cl)
+                pack.interval_ops.append(msg)
+                continue
+            t += 1
             op["seq"][d, t] = msg.seq
             op["client"][d, t] = pack.client_idx(msg.client_id)
             op["ref_seq"][d, t] = msg.ref_seq
@@ -397,13 +427,9 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
             rec["rs"] = rs
             rc = int(state_np["rem_client"][d, s])
             rec["rc"] = pack.clients.lookup(rc) if rc >= 0 else None
-        mask = int(state_np["overlap"][d, s])
-        if mask:
-            rec["ro"] = sorted(
-                pack.clients.lookup(i)
-                for i in range(MAX_CLIENTS_PER_DOC)
-                if mask & (1 << i)
-            )
+        rc2 = int(state_np["rem2_client"][d, s])
+        if rc2 >= 0:
+            rec["ro"] = [pack.clients.lookup(rc2)]
         props = {}
         for k, key in enumerate(prop_keys):
             vid = int(state_np["props"][d, s, k])
@@ -427,6 +453,58 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
     return records
 
 
+def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:
+    """Full oracle replay of one document — the exactness escape hatch for
+    the rare shapes the device path flags (>2 overlap removers on one
+    segment, or a base summary with >1)."""
+    from ..dds.sequence import SharedString
+
+    replica = SharedString(doc.doc_id)
+    if doc.base_records is not None:
+        replica.tree.load_records(doc.base_records, doc.base_seq, doc.base_msn)
+        for label, obj in (doc.base_intervals or {}).items():
+            replica.get_interval_collection(label).load_obj(obj)
+    for msg in doc.ops:
+        replica.process(msg, local=False)
+    replica.advance(doc.final_seq, doc.final_msn)
+    return replica.summarize()
+
+
+def summary_from_state(meta, state_np: dict, d: int,
+                       length: Optional[int] = None) -> SummaryTree:
+    """Assemble one doc's canonical summary from final device state:
+    normalized body + host-folded intervals blob (see interval_replay)."""
+    from .interval_replay import FinalStateView, replay_intervals
+
+    doc = meta["docs"][d]
+    pack = meta["doc_packs"][d]
+    if pack.needs_fallback or bool(state_np["overflow"][d]):
+        return oracle_fallback_summary(doc)
+    records = _extract_records(meta, state_np, d)
+    if length is None:
+        length = sum(
+            int(state_np["tlen"][d, s])
+            for s in range(int(state_np["n"][d]))
+            if int(state_np["rem_seq"][d, s]) == NOT_REMOVED
+        )
+    header = {"seq": doc.final_seq, "minSeq": doc.final_msn, "length": length}
+    tree = SummaryTree()
+    tree.add_blob("header", canonical_json(header))
+    tree.add_blob("body", canonical_json(records))
+    if pack.interval_ops or doc.base_intervals:
+        view = FinalStateView(state_np, d, int(NOT_REMOVED))
+        intervals = replay_intervals(
+            view,
+            pack.interval_ops,
+            pack.client_idx,
+            base_intervals=doc.base_intervals,
+            base_seq=doc.base_seq,
+        )
+        if intervals:
+            tree.add_blob("intervals", canonical_json(intervals))
+    return tree
+
+
 def replay_mergetree_batch(
     docs: Sequence[MergeTreeDocInput],
 ) -> List[SummaryTree]:
@@ -440,17 +518,4 @@ def replay_mergetree_batch(
     state, ops, meta = pack_mergetree_batch(docs)
     final = _replay_batch(state, ops)
     state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-    out = []
-    for d, doc in enumerate(docs):
-        records = _extract_records(meta, state_np, d)
-        length = sum(
-            int(state_np["tlen"][d, s])
-            for s in range(int(state_np["n"][d]))
-            if int(state_np["rem_seq"][d, s]) == NOT_REMOVED
-        )
-        header = {"seq": doc.final_seq, "minSeq": doc.final_msn, "length": length}
-        tree = SummaryTree()
-        tree.add_blob("header", canonical_json(header))
-        tree.add_blob("body", canonical_json(records))
-        out.append(tree)
-    return out
+    return [summary_from_state(meta, state_np, d) for d in range(len(docs))]

@@ -22,11 +22,11 @@ from ..ops.mergetree_kernel import (
     MTState,
     MergeTreeDocInput,
     NOT_REMOVED,
-    _extract_records,
     pack_mergetree_batch,
     replay_vmapped,
+    summary_from_state,
 )
-from ..protocol.summary import SummaryTree, canonical_json
+from ..protocol.summary import SummaryTree
 
 DOC_AXIS = "docs"
 
@@ -60,7 +60,8 @@ def sharded_replay_step(mesh: Mesh):
 
     state_shardings = MTState(
         tstart=shard, tlen=shard, ins_seq=shard, ins_client=shard,
-        rem_seq=shard, rem_client=shard, overlap=shard, props=shard, n=shard,
+        rem_seq=shard, rem_client=shard, rem2_seq=shard, rem2_client=shard,
+        props=shard, n=shard, overflow=shard,
     )
     ops_shardings = MTOps(
         kind=shard, seq=shard, client=shard, ref_seq=shard, a=shard, b=shard,
@@ -105,17 +106,7 @@ def replay_mergetree_sharded(
     final, lengths = step(state, ops)
     state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
     lengths = np.asarray(lengths)
-    out = []
-    for d in range(n_real):
-        doc = docs[d]
-        records = _extract_records(meta, state_np, d)
-        header = {
-            "seq": doc.final_seq,
-            "minSeq": doc.final_msn,
-            "length": int(lengths[d]),
-        }
-        tree = SummaryTree()
-        tree.add_blob("header", canonical_json(header))
-        tree.add_blob("body", canonical_json(records))
-        out.append(tree)
-    return out
+    return [
+        summary_from_state(meta, state_np, d, length=int(lengths[d]))
+        for d in range(n_real)
+    ]

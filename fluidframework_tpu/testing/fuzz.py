@@ -40,8 +40,9 @@ class FuzzSpec:
 
 
 class StringFuzzSpec(FuzzSpec):
-    def __init__(self, annotate: bool = True) -> None:
+    def __init__(self, annotate: bool = True, intervals: bool = False) -> None:
         self.annotate = annotate
+        self.intervals = intervals
 
     def create(self, object_id: str) -> SharedObject:
         from ..dds.sequence import SharedString
@@ -51,6 +52,9 @@ class StringFuzzSpec(FuzzSpec):
     def random_op(self, rng: random.Random, dds) -> None:
         n = len(dds)
         r = rng.random()
+        if self.intervals and r > 0.82 and n > 0:
+            self._interval_op(rng, dds, n)
+            return
         if r < 0.55 or n == 0:
             pos = rng.randint(0, n)
             text = "".join(rng.choice(ALPHABET) for _ in range(rng.randint(1, 6)))
@@ -63,8 +67,28 @@ class StringFuzzSpec(FuzzSpec):
             end = min(n, start + rng.randint(1, 8))
             dds.annotate_range(start, end, {rng.choice("xyz"): rng.randint(0, 3)})
 
+    def _interval_op(self, rng: random.Random, dds, n: int) -> None:
+        # Small shared id pool so concurrent add/change/delete conflict.
+        interval_id = f"iv{rng.randint(0, 3)}"
+        coll = dds.get_interval_collection()
+        r = rng.random()
+        start = rng.randint(0, n - 1)
+        end = min(n - 1, start + rng.randint(0, 6))
+        if r < 0.5 or coll.get(interval_id) is None:
+            dds.add_interval(start, end, interval_id=interval_id,
+                             props={"tag": rng.randint(0, 3)})
+        elif r < 0.85:
+            dds.change_interval(interval_id, start=start, end=end,
+                                props={"tag": rng.randint(0, 3)})
+        else:
+            dds.delete_interval(interval_id)
+
     def observable(self, dds):
-        return dds.text
+        ivs = {
+            label: coll.summary_obj()
+            for label, coll in dds._interval_collections.items()
+        }
+        return (dds.text, ivs)
 
 
 class MapFuzzSpec(FuzzSpec):

@@ -1,0 +1,107 @@
+"""IntervalCollection: sliding anchors, conflicts, summaries (config #3)."""
+
+import pytest
+
+from fluidframework_tpu.dds import SharedString
+from fluidframework_tpu.testing import MockContainerRuntimeFactory
+from fluidframework_tpu.testing.fuzz import StringFuzzSpec, run_fuzz
+
+
+def make_pair():
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    b = factory.create_client("B").attach(SharedString("s"))
+    return factory, a, b
+
+
+def test_interval_tracks_edits():
+    factory, a, b = make_pair()
+    a.insert_text(0, "hello world")
+    factory.process_all_messages()
+    iv = a.add_interval(6, 11)  # "world"
+    factory.process_all_messages()
+    b.insert_text(0, ">> ")  # shifts everything right
+    factory.process_all_messages()
+    assert a.get_interval_collection().endpoints(iv) == (9, 14)
+    assert b.get_interval_collection().endpoints(iv) == (9, 14)
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_interval_slides_off_removed_range():
+    factory, a, b = make_pair()
+    a.insert_text(0, "abcdefgh")
+    factory.process_all_messages()
+    iv = a.add_interval(2, 5)
+    factory.process_all_messages()
+    b.remove_range(1, 6)  # removes both anchors' segments
+    factory.process_all_messages()
+    assert (
+        a.get_interval_collection().endpoints(iv)
+        == b.get_interval_collection().endpoints(iv)
+    )
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_interval_resolution_uses_op_view():
+    """A remote add created against a pre-removal view must resolve the same
+    as on the author (who resolved early and slid on the removal)."""
+    factory, a, b = make_pair()
+    a.insert_text(0, "0123456789")
+    factory.process_all_messages()
+    iv = b.add_interval(4, 7)    # created against "0123456789"
+    a.remove_range(2, 8)         # sequenced first
+    factory.process_all_messages()
+    assert (
+        a.get_interval_collection().endpoints(iv)
+        == b.get_interval_collection().endpoints(iv)
+    )
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_concurrent_change_last_writer_wins_and_pending_masks():
+    factory, a, b = make_pair()
+    a.insert_text(0, "some interval text")
+    factory.process_all_messages()
+    iv = a.add_interval(0, 4, props={"color": "red"})
+    factory.process_all_messages()
+    b.change_interval(iv, start=5, end=13, props={"color": "blue"})
+    a.change_interval(iv, start=0, end=8)  # sequenced after b's → wins
+    factory.process_all_messages()
+    assert a.get_interval_collection().endpoints(iv) == (0, 8)
+    assert a.summarize().digest() == b.summarize().digest()
+    # Props merged per-key LWW: color from b (a's change had no props).
+    assert a.get_interval_collection().get(iv).props == {"color": "blue"}
+
+
+def test_delete_beats_concurrent_change():
+    factory, a, b = make_pair()
+    a.insert_text(0, "abcdef")
+    factory.process_all_messages()
+    iv = a.add_interval(0, 3)
+    factory.process_all_messages()
+    a.delete_interval(iv)
+    b.change_interval(iv, start=1, end=2)  # sequenced after the delete
+    factory.process_all_messages()
+    assert a.get_interval_collection().get(iv) is None
+    assert b.get_interval_collection().get(iv) is None
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_interval_summary_roundtrip():
+    factory, a, b = make_pair()
+    a.insert_text(0, "persistent text")
+    a.add_interval(0, 4, props={"k": 1}, label="comments")
+    a.add_interval(5, 9, label="default")
+    factory.process_all_messages()
+    summary = a.summarize()
+    fresh = SharedString("s")
+    fresh.load(summary)
+    assert fresh.summarize().digest() == summary.digest()
+    assert len(fresh.get_interval_collection("comments")) == 1
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_string_with_intervals(seed):
+    run_fuzz(
+        StringFuzzSpec(intervals=True), seed=700 + seed, n_clients=3, rounds=30
+    )

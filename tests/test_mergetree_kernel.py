@@ -88,6 +88,72 @@ def test_mergetree_kernel_replays_tail_from_base_summary():
     assert summary.digest() == resumed.summarize().digest()
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_mergetree_kernel_with_interval_ops(seed):
+    """Config #3 parity: logs containing interval ops replay through the
+    device fold + host interval pass to oracle-identical bytes."""
+    replicas, factory = run_fuzz(
+        StringFuzzSpec(intervals=True), seed=900 + seed, n_clients=3, rounds=25
+    )
+    oracle = replicas[0].summarize()
+    [summary] = replay_mergetree_batch([_kernel_inputs_from_fuzz(factory)])
+    assert summary.digest() == oracle.digest(), (
+        f"seed={seed}: kernel {summary.children.keys()} vs oracle "
+        f"{oracle.children.keys()}"
+    )
+
+
+def test_interval_tail_from_base_summary():
+    """Catch-up with a base summary carrying an intervals blob."""
+    replicas, factory = run_fuzz(
+        StringFuzzSpec(intervals=True), seed=42, n_clients=3, rounds=16
+    )
+    full_ops = channel_log(factory, "fuzz")
+    mid_seq = full_ops[len(full_ops) // 2].seq
+    partial = SharedString("fuzz")
+    for msg in full_ops:
+        if msg.seq <= mid_seq:
+            partial.process(msg, local=False)
+    base_summary = partial.summarize()
+    base_records = json.loads(base_summary.blob_bytes("body"))
+    try:
+        base_intervals = json.loads(base_summary.blob_bytes("intervals"))
+    except KeyError:
+        base_intervals = None
+    doc = MergeTreeDocInput(
+        doc_id="fuzz",
+        ops=[m for m in full_ops if m.seq > mid_seq],
+        base_records=base_records,
+        base_intervals=base_intervals,
+        base_seq=partial.tree.current_seq,
+        base_msn=partial.tree.min_seq,
+        final_seq=factory.sequencer.seq,
+        final_msn=factory.sequencer.min_seq,
+    )
+    [summary] = replay_mergetree_batch([doc])
+    resumed = SharedString("fuzz")
+    resumed.load(base_summary)
+    for msg in full_ops:
+        if msg.seq > mid_seq:
+            resumed.process(msg, local=False)
+    resumed.advance(factory.sequencer.seq, factory.sequencer.min_seq)
+    assert summary.digest() == resumed.summarize().digest()
+
+
+def test_summarize_refuses_inflight_interval_ops():
+    from fluidframework_tpu.testing import MockContainerRuntimeFactory
+
+    factory = MockContainerRuntimeFactory()
+    a = factory.create_client("A").attach(SharedString("s"))
+    a.insert_text(0, "text")
+    factory.process_all_messages()
+    a.add_interval(0, 2)
+    with pytest.raises(RuntimeError, match="in-flight interval ops"):
+        a.summarize()
+    factory.process_all_messages()
+    a.summarize()  # fine once sequenced
+
+
 def test_insert_with_none_prop_value_matches_kernel():
     """Regression: a None prop value on insert means 'absent' on both paths."""
     from fluidframework_tpu.testing import MockContainerRuntimeFactory
