@@ -14,6 +14,7 @@ from .merge_tree import MergeTreeOracle, Segment
 from .sequence import SharedString
 from .intervals import Interval, IntervalCollection
 from .cell_counter import SharedCell, SharedCounter
+from .matrix import SharedMatrix, PermutationVector, SparseArray2D
 
 __all__ = [
     "SharedObject",
@@ -26,4 +27,7 @@ __all__ = [
     "IntervalCollection",
     "SharedCell",
     "SharedCounter",
+    "SharedMatrix",
+    "PermutationVector",
+    "SparseArray2D",
 ]

@@ -453,6 +453,15 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
     return records
 
 
+def known_oracle_fallback(doc: MergeTreeDocInput) -> bool:
+    """True when a doc is known *before packing* to need the oracle path
+    (>1 overlap remover on a base record — the device tracks exactly two
+    removers and the base format carries no overlap seqs).  Pack-time's
+    ``needs_fallback`` applies the same rule; filtering first keeps such docs
+    from inflating the shared power-of-two buckets and wasting their fold."""
+    return any(len(r.get("ro", [])) > 1 for r in doc.base_records or [])
+
+
 def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:
     """Full oracle replay of one document — the exactness escape hatch for
     the rare shapes the device path flags (>2 overlap removers on one
@@ -515,7 +524,18 @@ def replay_mergetree_batch(
     """
     if not docs:
         return []
-    state, ops, meta = pack_mergetree_batch(docs)
-    final = _replay_batch(state, ops)
-    state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-    return [summary_from_state(meta, state_np, d) for d in range(len(docs))]
+    out: List[Optional[SummaryTree]] = [None] * len(docs)
+    device_idx = []
+    for i, doc in enumerate(docs):
+        if known_oracle_fallback(doc):
+            out[i] = oracle_fallback_summary(doc)
+        else:
+            device_idx.append(i)
+    if device_idx:
+        batch = [docs[i] for i in device_idx]
+        state, ops, meta = pack_mergetree_batch(batch)
+        final = _replay_batch(state, ops)
+        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+        for d, i in enumerate(device_idx):
+            out[i] = summary_from_state(meta, state_np, d)
+    return out

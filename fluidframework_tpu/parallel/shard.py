@@ -22,6 +22,8 @@ from ..ops.mergetree_kernel import (
     MTState,
     MergeTreeDocInput,
     NOT_REMOVED,
+    known_oracle_fallback,
+    oracle_fallback_summary,
     pack_mergetree_batch,
     replay_vmapped,
     summary_from_state,
@@ -95,6 +97,18 @@ def replay_mergetree_sharded(
         return []
     if mesh is None:
         mesh = doc_mesh()
+    # Known-fallback docs (pre-pack predicate) go straight to the oracle so
+    # they don't inflate the shared buckets or waste their shard's fold.
+    out: List[Optional[SummaryTree]] = [None] * len(docs)
+    device_idx = []
+    for i, doc in enumerate(docs):
+        if known_oracle_fallback(doc):
+            out[i] = oracle_fallback_summary(doc)
+        else:
+            device_idx.append(i)
+    docs = [docs[i] for i in device_idx]
+    if not docs:
+        return out
     n_real = len(docs)
     padded = _pad_docs(docs, mesh.size)
     state, ops, meta = pack_mergetree_batch(padded)
@@ -106,7 +120,8 @@ def replay_mergetree_sharded(
     final, lengths = step(state, ops)
     state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
     lengths = np.asarray(lengths)
-    return [
-        summary_from_state(meta, state_np, d, length=int(lengths[d]))
-        for d in range(n_real)
-    ]
+    for d in range(n_real):
+        out[device_idx[d]] = summary_from_state(
+            meta, state_np, d, length=int(lengths[d])
+        )
+    return out

@@ -138,6 +138,42 @@ class DirectoryFuzzSpec(FuzzSpec):
         return dds._root.summary_obj()
 
 
+class MatrixFuzzSpec(FuzzSpec):
+    """Random row/col structure edits + cell writes; optional FWW switch."""
+
+    def __init__(self, fww: bool = False) -> None:
+        self.fww = fww
+
+    def create(self, object_id: str) -> SharedObject:
+        from ..dds.matrix import SharedMatrix
+
+        return SharedMatrix(object_id)
+
+    def random_op(self, rng: random.Random, dds) -> None:
+        rows, cols = dds.row_count, dds.col_count
+        r = rng.random()
+        if self.fww and dds.policy == "lww" and r > 0.97:
+            dds.switch_policy("fww")
+        elif r < 0.18 or rows == 0:
+            dds.insert_rows(rng.randint(0, rows), rng.randint(1, 3))
+        elif r < 0.3 or cols == 0:
+            dds.insert_cols(rng.randint(0, cols), rng.randint(1, 3))
+        elif r < 0.4 and rows > 1:
+            start = rng.randint(0, rows - 1)
+            dds.remove_rows(start, min(rows - start, rng.randint(1, 2)))
+        elif r < 0.5 and cols > 1:
+            start = rng.randint(0, cols - 1)
+            dds.remove_cols(start, min(cols - start, rng.randint(1, 2)))
+        else:
+            dds.set_cell(
+                rng.randint(0, rows - 1), rng.randint(0, cols - 1),
+                rng.randint(0, 99),
+            )
+
+    def observable(self, dds):
+        return dds.to_list()
+
+
 def run_fuzz(
     spec: FuzzSpec,
     seed: int,

@@ -10,6 +10,7 @@ import pytest
 from fluidframework_tpu.testing.fuzz import (
     DirectoryFuzzSpec,
     MapFuzzSpec,
+    MatrixFuzzSpec,
     StringFuzzSpec,
     run_fuzz,
 )
@@ -33,3 +34,13 @@ def test_fuzz_shared_map(seed):
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_shared_directory(seed):
     run_fuzz(DirectoryFuzzSpec(), seed=seed, n_clients=3, rounds=30)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_matrix(seed):
+    run_fuzz(MatrixFuzzSpec(), seed=seed, n_clients=3, rounds=30)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_matrix_fww(seed):
+    run_fuzz(MatrixFuzzSpec(fww=True), seed=500 + seed, n_clients=3, rounds=30)
