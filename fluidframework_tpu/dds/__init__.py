@@ -15,6 +15,16 @@ from .sequence import SharedString
 from .intervals import Interval, IntervalCollection
 from .cell_counter import SharedCell, SharedCounter
 from .matrix import SharedMatrix, PermutationVector, SparseArray2D
+from .tree import (
+    SharedTree,
+    SchemaFactory,
+    TreeViewConfiguration,
+    FieldSchema,
+    Forest,
+    EditManager,
+    compose,
+    invert,
+)
 
 __all__ = [
     "SharedObject",
@@ -30,4 +40,12 @@ __all__ = [
     "SharedMatrix",
     "PermutationVector",
     "SparseArray2D",
+    "SharedTree",
+    "SchemaFactory",
+    "TreeViewConfiguration",
+    "FieldSchema",
+    "Forest",
+    "EditManager",
+    "compose",
+    "invert",
 ]

@@ -1,0 +1,420 @@
+"""SharedTree oracle tests: convergence, summaries, transactions, schema.
+
+Mirrors the reference's tree test strategy (SURVEY.md §4): multi-client
+mock-runtime scenarios with controlled interleavings, plus a seeded
+mini-fuzz convergence loop.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.tree import (
+    FIELD_START,
+    ROOT_ID,
+    SchemaFactory,
+    SharedTree,
+    TreeViewConfiguration,
+    compose,
+    invert,
+)
+from fluidframework_tpu.testing.mocks import MockContainerRuntimeFactory
+
+
+def make_clients(n, config=None):
+    factory = MockContainerRuntimeFactory()
+    trees = []
+    for i in range(n):
+        rt = factory.create_client(f"client{i}")
+        trees.append(rt.attach(SharedTree("tree", config=config)))
+    return factory, trees
+
+
+def assert_converged(trees):
+    objs = [t.to_obj() for t in trees]
+    digests = [t.summarize().digest() for t in trees]
+    for o in objs[1:]:
+        assert o == objs[0]
+    for d in digests[1:]:
+        assert d == digests[0]
+
+
+# -- basics -----------------------------------------------------------------
+
+
+def test_detached_insert_and_read():
+    t = SharedTree("t")
+    ids = t.insert(ROOT_ID, "items", 0, [t.build("note", value="hello")])
+    assert t.children(ROOT_ID, "items") == ids
+    assert t.value_of(ids[0]) == "hello"
+    assert t.type_of(ids[0]) == "note"
+
+
+def test_nested_content_materializes():
+    t = SharedTree("t")
+    spec = t.build(
+        "list", fields={"rows": [t.build("row", value=1),
+                                 t.build("row", value=2)]}
+    )
+    (lid,) = t.insert(ROOT_ID, "", 0, [spec])
+    rows = t.children(lid, "rows")
+    assert [t.value_of(r) for r in rows] == [1, 2]
+
+
+def test_two_clients_basic_convergence():
+    factory, (a, b) = make_clients(2)
+    a.insert(ROOT_ID, "items", 0, [a.build("n", value="from-a")])
+    b.insert(ROOT_ID, "items", 0, [b.build("n", value="from-b")])
+    factory.process_all_messages()
+    assert_converged([a, b])
+    # Both inserted at index 0 concurrently: newest-first means the
+    # later-sequenced block (b's, submitted second) lands at the start.
+    vals = [a.value_of(c) for c in a.children(ROOT_ID, "items")]
+    assert sorted(vals) == ["from-a", "from-b"]
+
+
+def test_same_anchor_concurrent_inserts_stack_newest_first():
+    factory, (a, b) = make_clients(2)
+    (base,) = a.insert(ROOT_ID, "s", 0, [a.build("n", value="base")])
+    factory.process_all_messages()
+    # Both now insert at index 1 (after base) concurrently.
+    a.insert(ROOT_ID, "s", 1, [a.build("n", value="a1")])
+    b.insert(ROOT_ID, "s", 1, [b.build("n", value="b1")])
+    factory.process_all_messages()
+    assert_converged([a, b])
+    vals = [a.value_of(c) for c in a.children(ROOT_ID, "s")]
+    # b's op sequenced later -> newer -> nearer the anchor.
+    assert vals == ["base", "b1", "a1"]
+
+
+def test_remove_and_tombstone_anchor():
+    factory, (a, b) = make_clients(2)
+    ids = a.insert(ROOT_ID, "s", 0, [
+        a.build("n", value=i) for i in range(3)
+    ])
+    factory.process_all_messages()
+    # a removes the middle node; b concurrently inserts after it.
+    a.remove(ids[1])
+    b.insert(ROOT_ID, "s", 2, [b.build("n", value="x")])
+    factory.process_all_messages()
+    assert_converged([a, b])
+    vals = [a.value_of(c) for c in a.children(ROOT_ID, "s")]
+    # b anchored at the removed node; the tombstone keeps the position.
+    assert vals == [0, "x", 2]
+
+
+def test_insert_under_concurrently_removed_ancestor():
+    factory, (a, b) = make_clients(2)
+    (box,) = a.insert(ROOT_ID, "", 0, [a.build("box")])
+    factory.process_all_messages()
+    a.remove(box)
+    b.insert(box, "items", 0, [b.build("n", value="orphan")])
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert a.children(ROOT_ID, "") == []  # box gone, orphan invisible
+
+
+def test_value_lww_and_pending_hold():
+    factory, (a, b) = make_clients(2)
+    (nid,) = a.insert(ROOT_ID, "", 0, [a.build("n", value=0)])
+    factory.process_all_messages()
+    a.set_value(nid, "from-a")
+    b.set_value(nid, "from-b")
+    # Before sequencing each sees its own pending value.
+    assert a.value_of(nid) == "from-a"
+    assert b.value_of(nid) == "from-b"
+    factory.process_all_messages()
+    assert_converged([a, b])
+    # b submitted second -> sequenced later -> wins LWW.
+    assert a.value_of(nid) == "from-b"
+
+
+def test_concurrent_remove_remove():
+    factory, (a, b) = make_clients(2)
+    (nid,) = a.insert(ROOT_ID, "", 0, [a.build("n")])
+    factory.process_all_messages()
+    a.remove(nid)
+    b.remove(nid)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert not a.contains(nid)
+
+
+# -- move -------------------------------------------------------------------
+
+
+def test_move_basic():
+    factory, (a, b) = make_clients(2)
+    ids = a.insert(ROOT_ID, "s", 0, [a.build("n", value=i) for i in range(3)])
+    factory.process_all_messages()
+    a.move([ids[0]], ROOT_ID, "s", 3)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    vals = [a.value_of(c) for c in a.children(ROOT_ID, "s")]
+    assert vals == [1, 2, 0]
+
+
+def test_move_vs_concurrent_remove_remove_wins():
+    factory, (a, b) = make_clients(2)
+    (box,) = a.insert(ROOT_ID, "", 0, [a.build("box")])
+    (nid,) = a.insert(ROOT_ID, "loose", 0, [a.build("n", value="m")])
+    factory.process_all_messages()
+    a.remove(nid)
+    b.move([nid], box, "kept", 0)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert a.children(box, "kept") == []
+
+
+def test_concurrent_cross_moves_no_cycle():
+    factory, (a, b) = make_clients(2)
+    (x,) = a.insert(ROOT_ID, "", 0, [a.build("x")])
+    (y,) = a.insert(ROOT_ID, "", 1, [a.build("y")])
+    factory.process_all_messages()
+    a.move([x], y, "kids", 0)
+    b.move([y], x, "kids", 0)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    # One move won (the earlier-sequenced), the other was dropped.
+    top = a.children(ROOT_ID, "")
+    assert len(top) == 1
+
+
+# -- transactions & undo ----------------------------------------------------
+
+
+def test_transaction_is_atomic_remotely():
+    factory, (a, b) = make_clients(2)
+    with a.transaction():
+        (lid,) = a.insert(ROOT_ID, "", 0, [a.build("list")])
+        a.insert(lid, "rows", 0, [a.build("row", value=1)])
+        a.insert(lid, "rows", 1, [a.build("row", value=2)])
+    assert factory.pending_count == 1  # one composed op on the wire
+    factory.process_all_messages()
+    assert_converged([a, b])
+    (lid_b,) = b.children(ROOT_ID, "")
+    assert [b.value_of(r) for r in b.children(lid_b, "rows")] == [1, 2]
+
+
+def test_transaction_abort_rolls_back():
+    factory, (a, b) = make_clients(2)
+    (nid,) = a.insert(ROOT_ID, "", 0, [a.build("n", value="keep")])
+    factory.process_all_messages()
+    before = a.to_obj()
+    with pytest.raises(RuntimeError):
+        with a.transaction():
+            a.insert(ROOT_ID, "", 1, [a.build("n", value="bye")])
+            a.set_value(nid, "changed")
+            a.remove(nid)
+            raise RuntimeError("abort")
+    assert a.to_obj() == before
+    assert factory.pending_count == 0
+    factory.process_all_messages()
+    assert_converged([a, b])
+
+
+def test_undo_remove_revives():
+    factory, (a, b) = make_clients(2)
+    (nid,) = a.insert(ROOT_ID, "", 0, [a.build("n", value="v")])
+    factory.process_all_messages()
+    cs = {"edits": [{"kind": "remove", "ids": [nid]}]}
+    a.remove(nid)
+    factory.process_all_messages()
+    a.undo_changeset(cs)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert b.contains(nid)
+    assert b.value_of(nid) == "v"
+
+
+def test_undo_insert_removes():
+    factory, (a, b) = make_clients(2)
+    ids = a.insert(ROOT_ID, "", 0, [a.build("n", value="v")])
+    factory.process_all_messages()
+    # Reconstruct the changeset that inserted (from the trunk tail).
+    seq, client, changeset = a.edit_manager.trunk[-1]
+    a.undo_changeset(changeset)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert not a.contains(ids[0])
+
+
+# -- summaries & catch-up ---------------------------------------------------
+
+
+def test_summary_roundtrip_and_catchup():
+    factory, (a, b) = make_clients(2)
+    ids = a.insert(ROOT_ID, "s", 0, [a.build("n", value=i) for i in range(4)])
+    factory.process_all_messages()
+    summary = a.summarize()
+    # A fresh replica loads the summary, then replays the tail.
+    c_rt = factory.create_client("client2")
+    c = SharedTree("tree2")
+    c.load(summary)
+    assert c.to_obj() == a.to_obj()
+    assert c.summarize().digest() == a.summarize().digest()
+
+
+def test_summary_normalizes_pending_state():
+    factory, (a, b) = make_clients(2)
+    a.insert(ROOT_ID, "", 0, [a.build("n", value="sequenced")])
+    factory.process_all_messages()
+    d0 = a.summarize().digest()
+    a.insert(ROOT_ID, "", 0, [a.build("n", value="pending")])
+    assert a.summarize().digest() == d0  # pending excluded
+    factory.process_all_messages()
+    assert a.summarize().digest() != d0
+
+
+def test_zamboni_purges_expired_tombstones():
+    factory, (a, b) = make_clients(2)
+    ids = a.insert(ROOT_ID, "", 0, [a.build("n", value=i) for i in range(3)])
+    factory.process_all_messages()
+    a.remove(ids[1])
+    factory.process_all_messages()
+    assert a.seq_forest.contains(ids[1])  # tombstone inside the window
+    factory.advance_min_seq()
+    assert not a.seq_forest.contains(ids[1])  # purged
+    assert not b.seq_forest.contains(ids[1])
+    assert_converged([a, b])
+
+
+def test_summary_clamps_below_min_seq():
+    """Replicas whose histories differ only below min_seq emit identical
+    bytes (the merge-tree normalization property, SEMANTICS.md)."""
+    factory, (a, b) = make_clients(2)
+    a.insert(ROOT_ID, "", 0, [a.build("n", value="x")])
+    factory.process_all_messages()
+    factory.advance_min_seq()
+    fresh = SharedTree("f")
+    fresh.load(a.summarize())
+    assert fresh.summarize().digest() == a.summarize().digest()
+
+
+def test_undo_after_purge_keeps_removed_descendants_hidden():
+    """Repair content must not resurrect descendants removed by other edits
+    (review-found): remove child, remove ancestor, purge, undo the ancestor
+    removal — the child stays hidden on every replica."""
+    factory, (a, b) = make_clients(2)
+    (box,) = a.insert(ROOT_ID, "", 0, [a.build("box")])
+    (child,) = a.insert(box, "kids", 0, [a.build("n", value="c")])
+    factory.process_all_messages()
+    a.remove(child)
+    factory.process_all_messages()
+    a.remove(box)
+    factory.process_all_messages()
+    seq, client, remove_box_cs = a.edit_manager.trunk[-1]
+    inverse = invert(remove_box_cs, a.seq_forest)  # capture before purge
+    factory.advance_min_seq()  # purges both tombstones
+    assert not a.seq_forest.contains(box)
+    a._submit_changeset(inverse)
+    factory.process_all_messages()
+    assert_converged([a, b])
+    assert a.contains(box)
+    assert not a.contains(child)
+    assert a.children(box, "kids") == []
+
+
+def test_catchup_tail_overlap_is_idempotent():
+    """A replayed tail that overlaps the loaded summary must not
+    double-apply (review-found): the summary header carries its sequence
+    point and older ops are skipped."""
+    from fluidframework_tpu.testing.mocks import channel_log
+
+    factory, (a, b) = make_clients(2)
+    a.insert(ROOT_ID, "", 0, [a.build("n", value=1)])
+    factory.process_all_messages()
+    summary = a.summarize()
+    b.insert(ROOT_ID, "", 1, [b.build("n", value=2)])
+    factory.process_all_messages()
+    fresh = SharedTree("tree")
+    fresh.load(summary)
+    # Replay the FULL log, including ops already folded into the summary.
+    for msg in channel_log(factory, "tree"):
+        fresh.process(msg, local=False)
+    assert fresh.to_obj() == a.to_obj()
+    assert fresh.summarize().digest() == a.summarize().digest()
+
+
+# -- schema -----------------------------------------------------------------
+
+
+def test_schema_allows_and_rejects():
+    sf = SchemaFactory("app")
+    note = sf.object("note", {"title": sf.value()})
+    board = sf.object("board", {"notes": sf.sequence("app.note")})
+    config = TreeViewConfiguration(schema=sf, root_allowed=("app.board",))
+    t = SharedTree("t", config=config)
+    (bid,) = t.insert(ROOT_ID, "", 0, [t.build("app.board")])
+    t.insert(bid, "notes", 0, [t.build("app.note")])
+    with pytest.raises(ValueError):
+        t.insert(bid, "notes", 0, [t.build("app.board")])
+    with pytest.raises(ValueError):
+        t.insert(bid, "bogus_field", 0, [t.build("app.note")])
+    with pytest.raises(ValueError):
+        t.insert(ROOT_ID, "", 1, [t.build("app.note")])
+
+
+# -- reconnect / resubmit ---------------------------------------------------
+
+
+def test_changeset_algebra_compose_invert():
+    t = SharedTree("t")
+    (nid,) = t.insert(ROOT_ID, "", 0, [t.build("n", value=1)])
+    cs = {"edits": [{"kind": "set", "id": nid, "value": 2, "prev": 1}]}
+    inv = invert(cs, t.seq_forest)
+    assert inv["edits"][0]["value"] == 1
+    both = compose([cs, inv])
+    assert len(both["edits"]) == 2
+
+
+# -- mini-fuzz --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [7, 21, 99, 123, 4242])
+def test_fuzz_convergence(seed):
+    rng = random.Random(seed)
+    factory, trees = make_clients(3)
+    for step in range(120):
+        t = rng.choice(trees)
+        roll = rng.random()
+        try:
+            if roll < 0.45:
+                field = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, field)
+                idx = rng.randint(0, len(kids))
+                t.insert(ROOT_ID, field, idx,
+                         [t.build("n", value=rng.randint(0, 99))])
+            elif roll < 0.6:
+                field = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, field)
+                if kids:
+                    t.remove(rng.choice(kids))
+            elif roll < 0.75:
+                field = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, field)
+                if kids:
+                    t.set_value(rng.choice(kids), rng.randint(0, 99))
+            elif roll < 0.9:
+                src = rng.choice(["a", "b"])
+                dst = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, src)
+                if kids:
+                    nid = rng.choice(kids)
+                    dst_kids = [
+                        k for k in t.children(ROOT_ID, dst) if k != nid
+                    ]
+                    t.move([nid], ROOT_ID, dst,
+                           rng.randint(0, len(dst_kids)))
+            else:
+                factory.process_some_messages(rng.randint(1, 5))
+        except (KeyError, ValueError):
+            pass  # raced against own pending state; fine for fuzz
+    factory.process_all_messages()
+    assert_converged(trees)
+    factory.advance_min_seq()
+    assert_converged(trees)
+    # Summary round-trip equivalence after the run.
+    fresh = SharedTree("f")
+    fresh.load(trees[0].summarize())
+    assert fresh.summarize().digest() == trees[0].summarize().digest()
