@@ -124,67 +124,31 @@ class PermutationVector:
     # -- canonical summary form ------------------------------------------------
 
     def canonical_records(self) -> Tuple[List[dict], Dict[int, int]]:
-        """(records, handle→canonical map): sequenced non-expired segments in
-        document order, seqs at/below min_seq clamped to the epoch, adjacent
-        identical-metadata records merged.  Canonical handle = enumeration
-        order — identical across converged replicas."""
-        msn = self.tree.min_seq
+        """(records, handle→canonical map): the merge-tree's normalized
+        record list with handle runs replaced by run lengths.  Canonical
+        handle = enumeration order over the normalized runs — identical
+        across converged replicas.  All clamp/expire/merge rules live in
+        MergeTreeOracle.normalized_records (one normalizer, one behavior)."""
         records: List[dict] = []
         handle_map: Dict[int, int] = {}
-        for seg in self.tree.segments:
-            if seg.insert_seq == UNASSIGNED_SEQ:
-                continue
-            rs, rc = seg.removed_seq, seg.removed_client
-            if rs == UNASSIGNED_SEQ:
-                rs, rc = None, None
-            if rs is not None and rs <= msn:
-                continue
-            for h in seg.text:
+        for rec in self.tree.normalized_records():
+            handles = rec.pop("t")
+            for h in handles:
                 handle_map[h] = len(handle_map)
-            s, c = seg.insert_seq, seg.insert_client
-            if s <= msn:
-                s, c = 0, None
-            rec: dict = {"n": len(seg.text), "s": s, "c": c}
-            if rs is not None:
-                rec["rs"] = rs
-                rec["rc"] = rc
-            if seg.overlap_removers:
-                rec["ro"] = sorted(seg.overlap_removers)
-            if records:
-                prev = records[-1]
-                if (
-                    prev["s"] == rec["s"]
-                    and prev["c"] == rec["c"]
-                    and prev.get("rs") == rec.get("rs")
-                    and prev.get("rc") == rec.get("rc")
-                    and prev.get("ro") == rec.get("ro")
-                ):
-                    prev["n"] += rec["n"]
-                    continue
+            rec["n"] = len(handles)
             records.append(rec)
         return records, handle_map
 
     def load_records(self, records: List[dict], seq: int, min_seq: int) -> None:
         """Rebuild from canonical records; handles become 0..n-1 in document
         order (i.e. canonical ids)."""
-        from .merge_tree import Segment
-
-        self.tree.segments = []
         self._next_handle = 0
+        expanded = []
         for rec in records:
-            seg = Segment(
-                self.alloc(rec["n"]),
-                rec["s"],
-                rec["c"] if rec["c"] is not None else NO_CLIENT,
-            )
-            if "rs" in rec:
-                seg.removed_seq = rec["rs"]
-                seg.removed_client = rec.get("rc")
-            if "ro" in rec:
-                seg.overlap_removers = set(rec["ro"])
-            self.tree.segments.append(seg)
-        self.tree.current_seq = seq
-        self.tree.min_seq = min_seq
+            rec = dict(rec)
+            rec["t"] = self.alloc(rec.pop("n"))
+            expanded.append(rec)
+        self.tree.load_records(expanded, seq, min_seq)
 
 
 class SharedMatrix(SharedObject):

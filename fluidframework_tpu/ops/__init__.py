@@ -13,4 +13,8 @@ Modules:
                          no scan: the whole batch is two segment-maxes).
 - ``mergetree_kernel`` — merge-tree catch-up replay (the centerpiece): a
                          lax.scan op-fold over an array-pool segment store.
+- ``matrix_kernel``    — SharedMatrix dual-axis fold + host cell fold.
+- ``tree_kernel``      — SharedTree edit-fold over linked sibling arrays
+                         (O(1) scatters per edit — the id-addressed payoff).
+- ``batching``         — shared fallback-partitioning for batch entry points.
 """

@@ -1,0 +1,40 @@
+"""Shared batch-partitioning: known-fallback docs to the oracle, the rest
+through a device batch function, results scattered back in input order.
+
+One implementation of the split/scatter bookkeeping for every kernel's
+``replay_*_batch`` / ``replay_*_sharded`` entry point (the pattern was
+previously hand-rolled per kernel; review-found)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+Doc = TypeVar("Doc")
+Result = TypeVar("Result")
+
+
+def partition_replay(
+    docs: Sequence[Doc],
+    known_fallback: Callable[[Doc], bool],
+    fallback_fn: Callable[[Doc], Result],
+    batch_fn: Callable[[List[Doc]], List[Result]],
+) -> List[Result]:
+    """Route docs matching ``known_fallback`` through ``fallback_fn`` (the
+    oracle), fold the rest as one device batch, and return results in the
+    original order.  Filtering first keeps fallback docs from inflating the
+    shared power-of-two pack buckets and wasting their shard of the fold."""
+    if not docs:
+        return []
+    out: List[Optional[Result]] = [None] * len(docs)
+    device_idx: List[int] = []
+    for i, doc in enumerate(docs):
+        if known_fallback(doc):
+            out[i] = fallback_fn(doc)
+        else:
+            device_idx.append(i)
+    if device_idx:
+        results = batch_fn([docs[i] for i in device_idx])
+        assert len(results) == len(device_idx)
+        for d, i in enumerate(device_idx):
+            out[i] = results[d]
+    return out

@@ -413,21 +413,18 @@ def replay_matrix_batch(docs: Sequence[MatrixDocInput]) -> List[SummaryTree]:
     """Full pipeline: pack → vmapped dual-axis device fold → host cell fold →
     canonical summaries.  Byte-identical to ``SharedMatrix.summarize()``
     (asserted by tests/test_matrix_kernel.py)."""
-    if not docs:
-        return []
-    out: List[Optional[SummaryTree]] = [None] * len(docs)
-    device_idx = []
-    for i, doc in enumerate(docs):
-        if known_matrix_fallback(doc):
-            out[i] = oracle_matrix_fallback(doc)
-        else:
-            device_idx.append(i)
-    if device_idx:
-        batch = [docs[i] for i in device_idx]
+    from .batching import partition_replay
+
+    def fold_batch(batch):
         state, ops, meta = pack_matrix_batch(batch)
         final, resolved = _replay_matrix_batch(state, ops)
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
         resolved_np = np.asarray(resolved)
-        for d, i in enumerate(device_idx):
-            out[i] = summary_from_matrix_state(meta, state_np, resolved_np, d)
-    return out
+        return [
+            summary_from_matrix_state(meta, state_np, resolved_np, d)
+            for d in range(len(batch))
+        ]
+
+    return partition_replay(
+        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch
+    )

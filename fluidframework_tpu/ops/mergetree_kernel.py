@@ -522,20 +522,16 @@ def replay_mergetree_batch(
     Byte-identical to ``SharedString.summarize()`` after the oracle replays
     the same log (asserted by tests/test_mergetree_kernel.py).
     """
-    if not docs:
-        return []
-    out: List[Optional[SummaryTree]] = [None] * len(docs)
-    device_idx = []
-    for i, doc in enumerate(docs):
-        if known_oracle_fallback(doc):
-            out[i] = oracle_fallback_summary(doc)
-        else:
-            device_idx.append(i)
-    if device_idx:
-        batch = [docs[i] for i in device_idx]
+    from .batching import partition_replay
+
+    def fold_batch(batch):
         state, ops, meta = pack_mergetree_batch(batch)
         final = _replay_batch(state, ops)
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-        for d, i in enumerate(device_idx):
-            out[i] = summary_from_state(meta, state_np, d)
-    return out
+        return [
+            summary_from_state(meta, state_np, d) for d in range(len(batch))
+        ]
+
+    return partition_replay(
+        docs, known_oracle_fallback, oracle_fallback_summary, fold_batch
+    )

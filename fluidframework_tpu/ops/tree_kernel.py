@@ -1,0 +1,595 @@
+"""SharedTree catch-up replay on device.
+
+Re-expresses the oracle's sequenced-forest fold (dds/tree.py
+``apply_changeset``, semantics pinned by SEMANTICS.md §tree) as array
+state + an edit-fold.  The id-addressed design pays off here: because edits
+name node ids instead of positions, every scan step is O(1) scatter work —
+no position resolution, no visible-length prefix sums:
+
+- forest structure is a **doubly-linked sibling list per container** (a
+  container = one (parent node, field) pair, interned at pack time):
+  ``head[C]``, ``next[N]``, ``prev[N]``, ``node_container[N]``;
+- **insert** splices a pre-materialized chain after its anchor (content
+  blocks, nested children, their container heads, values, and insert seqs
+  are all known at pack time — the fold only links them in);
+- **remove** is a first-wins scatter into ``removed_seq``;
+- **set** is an LWW scatter into ``value``/``value_seq``;
+- **move** is detach + splice + seq restamp, with the cycle test (is the
+  destination inside the moved subtree?) as a bounded ancestor walk.
+
+Like the merge-tree kernel, zamboni never runs on device: tombstones keep
+their slots (purge only drops state no reachable view distinguishes) and
+the host-side extractor applies the same normalization the oracle's
+summarizer does.  Rare shapes take the oracle path instead of being
+approximated: **revive** edits (undo-of-remove — their purge-timing
+interaction needs the full forest), **multi-id moves** (block-cycle
+semantics), and ancestor walks deeper than ``MAX_DEPTH`` (flagged by the
+device as overflow).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.messages import SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .interning import Interner, next_bucket
+
+NOT_REMOVED = np.int32(np.iinfo(np.int32).max)
+NO_VALUE = -1          # value column sentinel (interned ids are >= 0)
+NIL = -1               # null node / container index
+
+K_NOOP, K_INSERT, K_REMOVE, K_SET, K_MOVE = 0, 1, 2, 3, 4
+
+#: Ancestor-walk budget for the move cycle test; deeper forests overflow
+#: to the oracle path (never silently wrong).
+MAX_DEPTH = 64
+
+
+class TreeState(NamedTuple):
+    """Per-document forest arrays.  ``container_parent`` is static (a
+    container's owning node never changes; *nodes* move between
+    containers)."""
+
+    head: jnp.ndarray              # [C] first node idx of container / NIL
+    next: jnp.ndarray              # [N]
+    prev: jnp.ndarray              # [N]
+    node_container: jnp.ndarray    # [N] current container / NIL (unlinked)
+    container_parent: jnp.ndarray  # [C] owning node idx (0 = root) — static
+    value: jnp.ndarray             # [N] interned value id / NO_VALUE
+    value_seq: jnp.ndarray         # [N]
+    insert_seq: jnp.ndarray        # [N] (restamped by moves)
+    removed_seq: jnp.ndarray       # [N] NOT_REMOVED if alive
+    overflow: jnp.ndarray          # [] bool: ancestor walk exceeded budget
+
+
+class TreeEdits(NamedTuple):
+    """Packed edit stream (scan xs), one row per flattened edit."""
+
+    kind: jnp.ndarray       # [T]
+    seq: jnp.ndarray        # [T]
+    container: jnp.ndarray  # [T] destination container (insert/move)
+    anchor: jnp.ndarray     # [T] anchor node idx / NIL = field start
+    first: jnp.ndarray      # [T] block chain head (insert) / target (others)
+    tail: jnp.ndarray       # [T] block chain tail (insert; == first for move)
+    value: jnp.ndarray      # [T] interned value id (set)
+
+
+def _splice_after(state: TreeState, c, anchor, first, tail) -> TreeState:
+    """Link chain [first..tail] into container ``c`` after ``anchor`` (or at
+    head when the anchor is NIL / not currently in ``c`` — the oracle's
+    deterministic fallback)."""
+    use_anchor = (anchor != NIL) & (state.node_container[anchor] == c)
+    old = jnp.where(use_anchor, state.next[anchor], state.head[c])
+    nxt = state.next.at[tail].set(old)
+    prv = state.prev
+    prv = jnp.where(old != NIL, prv.at[old].set(tail), prv)
+    nxt = jnp.where(use_anchor, nxt.at[anchor].set(first), nxt)
+    prv = prv.at[first].set(jnp.where(use_anchor, anchor, NIL))
+    head = jnp.where(
+        use_anchor, state.head, state.head.at[c].set(first)
+    )
+    return state._replace(head=head, next=nxt, prev=prv)
+
+
+def _detach(state: TreeState, target) -> TreeState:
+    p, nx = state.prev[target], state.next[target]
+    c = state.node_container[target]
+    head = jnp.where(
+        p == NIL, state.head.at[c].set(nx), state.head
+    )
+    nxt = jnp.where(p != NIL, state.next.at[p].set(nx), state.next)
+    prv = jnp.where(nx != NIL, state.prev.at[nx].set(p), state.prev)
+    return state._replace(head=head, next=nxt, prev=prv)
+
+
+def _in_subtree(state: TreeState, dest_container, target):
+    """Does ``dest_container`` live inside ``target``'s subtree?  Walk the
+    ancestor chain container→owner-node→its-container…; root's container is
+    NIL.  Returns (hit, overflowed)."""
+
+    def step(carry, _):
+        cur_node, hit, alive = carry
+        hit = hit | (alive & (cur_node == target))
+        c = jnp.where(alive & (cur_node != NIL),
+                      state.node_container[cur_node], NIL)
+        nxt_node = jnp.where(c != NIL, state.container_parent[c], NIL)
+        alive = alive & (c != NIL)
+        return (nxt_node, hit, alive), None
+
+    start = state.container_parent[dest_container]
+    (last, hit, alive), _ = jax.lax.scan(
+        step, (start, jnp.bool_(False), jnp.bool_(True)), None,
+        length=MAX_DEPTH,
+    )
+    return hit, alive  # still alive after MAX_DEPTH = didn't reach root
+
+
+def _apply_edit(state: TreeState, e) -> TreeState:
+    """One flattened edit — the scan step."""
+    is_ins = e.kind == K_INSERT
+    is_rem = e.kind == K_REMOVE
+    is_set = e.kind == K_SET
+    is_mov = e.kind == K_MOVE
+    target = e.first
+
+    # --- insert: splice the pre-materialized chain.
+    ins = _splice_after(state, e.container, e.anchor, e.first, e.tail)
+    state = jax.tree.map(
+        lambda new, old: jnp.where(is_ins, new, old), ins, state
+    )
+
+    # --- remove: first remover wins the tombstone.
+    state = state._replace(
+        removed_seq=state.removed_seq.at[target].set(
+            jnp.where(
+                is_rem & (state.removed_seq[target] == NOT_REMOVED),
+                e.seq, state.removed_seq[target],
+            )
+        )
+    )
+
+    # --- set: LWW by fold order.
+    state = state._replace(
+        value=state.value.at[target].set(
+            jnp.where(is_set, e.value, state.value[target])
+        ),
+        value_seq=state.value_seq.at[target].set(
+            jnp.where(is_set, e.seq, state.value_seq[target])
+        ),
+    )
+
+    # --- move: cycle test, detach, splice, restamp.
+    hit, deep = _in_subtree(state, e.container, target)
+    do_move = is_mov & ~hit
+    anchor = jnp.where(e.anchor == target, NIL, e.anchor)
+    moved = _detach(state, target)
+    moved = _splice_after(moved, e.container, anchor, target, target)
+    moved = moved._replace(
+        node_container=moved.node_container.at[target].set(e.container),
+        insert_seq=moved.insert_seq.at[target].set(e.seq),
+    )
+    state = jax.tree.map(
+        lambda new, old: jnp.where(do_move, new, old), moved, state
+    )
+    # node_container for inserts: pre-set at pack time (rows are inert until
+    # linked, and nothing references a node before its insert sequences).
+    return state._replace(overflow=state.overflow | (is_mov & deep))
+
+
+def replay_scan(state: TreeState, edits: TreeEdits) -> TreeState:
+    """Pure single-document edit-fold (no jit)."""
+
+    def step(carry, e):
+        return _apply_edit(carry, e), None
+
+    final, _ = jax.lax.scan(step, state, edits)
+    return final
+
+
+#: vmapped over the document axis — the unit the parallel/ package shards.
+replay_vmapped = jax.vmap(replay_scan)
+
+_replay_batch = jax.jit(replay_vmapped)
+
+
+# ---------------------------------------------------------------------------
+# Host side: packing and canonical summary extraction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TreeDocInput:
+    """One document's catch-up work item: optional base summary + op tail."""
+
+    doc_id: str
+    ops: Sequence[SequencedMessage]   # tree changeset messages, ascending seq
+    base_summary: Optional[SummaryTree] = None
+    final_seq: int = 0
+    final_msn: int = 0
+
+
+class _DocPack:
+    """Per-document host bookkeeping: node/container interning plus the
+    static attributes the device never needs (ids, types)."""
+
+    def __init__(self) -> None:
+        self.node_ids = Interner()     # node id str -> node idx
+        self.node_types: List[str] = []
+        self.containers = Interner()   # (node idx, field) -> container idx
+        self.needs_fallback = False
+        self.header_seq = 0            # channel fold position for the header
+        self.base_min_seq = 0
+        self.node_ids.intern("")       # root is node 0
+        self.node_types.append("")
+
+    def node(self, node_id: str) -> int:
+        idx = self.node_ids.intern(node_id)
+        while len(self.node_types) <= idx:
+            self.node_types.append("")
+        return idx
+
+    def container(self, parent_idx: int, field_name: str) -> int:
+        return self.containers.intern((parent_idx, field_name))
+
+
+def _count_nodes_and_edits(doc: TreeDocInput) -> Tuple[int, int]:
+    from ..dds.tree import content_ids
+
+    nodes, edits = 1, 0  # root
+    if doc.base_summary is not None:
+        import json
+
+        obj = json.loads(doc.base_summary.blob_bytes("header"))
+
+        def count(o):
+            return 1 + sum(
+                count(ch)
+                for chs in o.get("fields", {}).values() for ch in chs
+            )
+
+        nodes += sum(
+            count(ch)
+            for chs in obj.get("fields", {}).values() for ch in chs
+        )
+    for msg in doc.ops:
+        for edit in msg.contents["edits"]:
+            kind = edit["kind"]
+            if kind == "insert":
+                nodes += sum(len(content_ids(s)) for s in edit["content"])
+                edits += 1
+            elif kind in ("remove", "move"):
+                edits += len(edit["ids"])
+            elif kind == "revive":
+                nodes += sum(len(content_ids(s)) for s in edit["content"])
+                edits += len(edit["ids"])
+            else:
+                edits += 1
+    return nodes, edits
+
+
+def pack_tree_batch(docs: Sequence[TreeDocInput]):
+    """Pack documents into uniform-shape arrays + host metadata."""
+    import json
+
+    values = Interner()
+    doc_packs = [_DocPack() for _ in docs]
+
+    sizes = [_count_nodes_and_edits(d) for d in docs]
+    # +2·edits slack: anchors/parents naming already-purged ids intern fresh
+    # (inert) rows — the oracle's "missing → field start / drop" fallback
+    # falls out of their NIL containers.
+    N = next_bucket(
+        max((n + 2 * e for n, e in sizes), default=1), floor=16
+    )
+    T = next_bucket(max((e for _, e in sizes), default=1), floor=16)
+    D = len(docs)
+    # Containers ≤ nodes·fields; sized after a packing dry run is overkill —
+    # intern first, then allocate.  Two passes keep the arrays exact.
+
+    packed_docs = []
+    for d, doc in enumerate(docs):
+        pack = doc_packs[d]
+        node_rows: Dict[int, dict] = {}
+        chains: Dict[int, List[int]] = {}  # container -> ordered node idxs
+        edit_rows: List[dict] = []
+
+        def materialize(spec: dict, container: int) -> int:
+            idx = pack.node(spec["id"])
+            pack.node_types[idx] = spec["type"]
+            node_rows[idx] = {
+                "container": container,
+                "value": (
+                    values.intern(spec["value"])
+                    if "value" in spec and spec["value"] is not None
+                    else NO_VALUE
+                ),
+                "value_seq": 0,
+                "insert_seq": 0,
+                "removed_seq": (
+                    spec["removedSeq"] if "removedSeq" in spec
+                    else int(NOT_REMOVED)
+                ),
+            }
+            for f, children in spec.get("fields", {}).items():
+                c = pack.container(idx, f)
+                for ch in children:
+                    chains.setdefault(c, []).append(materialize(ch, c))
+            return idx
+
+        if doc.base_summary is not None:
+            obj = json.loads(doc.base_summary.blob_bytes("header"))
+            pack.header_seq = obj.get("seq", 0)
+            pack.base_min_seq = obj.get("minSeq", 0)
+            for f, children in obj.get("fields", {}).items():
+                c = pack.container(0, f)
+                for ch in children:
+                    idx = materialize(ch, c)
+                    chains.setdefault(c, []).append(idx)
+                    node_rows[idx]["insert_seq"] = ch["insertSeq"]
+            # insert/value seqs for nested nodes come from the summary obj.
+            def fix_seqs(o):
+                idx = pack.node(o["id"])
+                node_rows[idx]["insert_seq"] = o["insertSeq"]
+                node_rows[idx]["value_seq"] = o.get("valueSeq", 0)
+                for chs in o.get("fields", {}).values():
+                    for ch in chs:
+                        fix_seqs(ch)
+            for chs in obj.get("fields", {}).values():
+                for ch in chs:
+                    fix_seqs(ch)
+
+        for msg in doc.ops:
+            pack.header_seq = max(pack.header_seq, msg.seq)
+            pack.base_min_seq = max(pack.base_min_seq, msg.min_seq)
+            for edit in msg.contents["edits"]:
+                kind = edit["kind"]
+                if kind == "insert":
+                    parent_idx = pack.node(edit["parent"])
+                    c = pack.container(parent_idx, edit["field"])
+                    block: List[int] = []
+                    for spec in edit["content"]:
+                        idx = materialize(spec, c)
+                        node_rows[idx]["insert_seq"] = msg.seq
+                        node_rows[idx]["value_seq"] = max(msg.seq, 0)
+                        block.append(idx)
+                    # Nested nodes' seqs:
+                    def stamp(spec):
+                        i = pack.node(spec["id"])
+                        node_rows[i]["insert_seq"] = msg.seq
+                        if node_rows[i]["value"] != NO_VALUE:
+                            node_rows[i]["value_seq"] = msg.seq
+                        for chs in spec.get("fields", {}).values():
+                            for ch in chs:
+                                stamp(ch)
+                    for spec in edit["content"]:
+                        stamp(spec)
+                    anchor = edit["anchor"]
+                    edit_rows.append({
+                        "kind": K_INSERT, "seq": msg.seq, "container": c,
+                        "anchor": (
+                            pack.node(anchor) if anchor is not None else NIL
+                        ),
+                        "first": block[0], "tail": block[-1],
+                        "block": block,
+                    })
+                elif kind == "remove":
+                    for nid in edit["ids"]:
+                        edit_rows.append({
+                            "kind": K_REMOVE, "seq": msg.seq,
+                            "first": pack.node(nid),
+                        })
+                elif kind == "set":
+                    edit_rows.append({
+                        "kind": K_SET, "seq": msg.seq,
+                        "first": pack.node(edit["id"]),
+                        "value": (
+                            values.intern(edit["value"])
+                            if edit["value"] is not None else NO_VALUE
+                        ),
+                    })
+                elif kind == "move":
+                    if len(edit["ids"]) != 1:
+                        pack.needs_fallback = True  # block-cycle semantics
+                        continue
+                    parent_idx = pack.node(edit["parent"])
+                    c = pack.container(parent_idx, edit["field"])
+                    anchor = edit["anchor"]
+                    tgt = pack.node(edit["ids"][0])
+                    edit_rows.append({
+                        "kind": K_MOVE, "seq": msg.seq, "container": c,
+                        "anchor": (
+                            pack.node(anchor) if anchor is not None else NIL
+                        ),
+                        "first": tgt, "tail": tgt,
+                    })
+                elif kind == "revive":
+                    pack.needs_fallback = True  # purge-timing interaction
+                else:
+                    raise ValueError(f"unknown edit kind {kind!r}")
+
+        packed_docs.append((node_rows, chains, edit_rows))
+
+    C = next_bucket(
+        max((len(p.containers) for p in doc_packs), default=1), floor=8
+    )
+
+    st = {
+        "head": np.full((D, C), NIL, np.int32),
+        "next": np.full((D, N), NIL, np.int32),
+        "prev": np.full((D, N), NIL, np.int32),
+        "node_container": np.full((D, N), NIL, np.int32),
+        "container_parent": np.full((D, C), NIL, np.int32),
+        "value": np.full((D, N), NO_VALUE, np.int32),
+        "value_seq": np.zeros((D, N), np.int32),
+        "insert_seq": np.zeros((D, N), np.int32),
+        "removed_seq": np.full((D, N), NOT_REMOVED, np.int32),
+        "overflow": np.zeros((D,), np.bool_),
+    }
+    ed = {
+        "kind": np.zeros((D, T), np.int32),
+        "seq": np.zeros((D, T), np.int32),
+        "container": np.zeros((D, T), np.int32),
+        "anchor": np.full((D, T), NIL, np.int32),
+        "first": np.zeros((D, T), np.int32),
+        "tail": np.zeros((D, T), np.int32),
+        "value": np.full((D, T), NO_VALUE, np.int32),
+    }
+
+    for d, (node_rows, chains, edit_rows) in enumerate(packed_docs):
+        pack = doc_packs[d]
+        for (pidx, _f), c in zip(pack.containers.values,
+                                 range(len(pack.containers))):
+            st["container_parent"][d, c] = pidx
+        for idx, row in node_rows.items():
+            st["node_container"][d, idx] = row["container"]
+            st["value"][d, idx] = row["value"]
+            st["value_seq"][d, idx] = row["value_seq"]
+            st["insert_seq"][d, idx] = row["insert_seq"]
+            st["removed_seq"][d, idx] = row["removed_seq"]
+        # Pre-link chains: base-summary sibling lists fully; insert-block
+        # interiors (head/prev of the block come alive at splice time).
+        base_containers = set()
+        if docs[d].base_summary is not None:
+            # chains collected during base materialization are live lists;
+            # chains from insert blocks must only pre-link interiors.
+            pass
+        for e in edit_rows:
+            if e["kind"] == K_INSERT:
+                block = e.pop("block")
+                for a, b in zip(block, block[1:]):
+                    st["next"][d, a] = b
+                    st["prev"][d, b] = a
+        for c, members in chains.items():
+            # Distinguish base lists (live at t=0) from insert-block nested
+            # chains (live at splice): base lists need head set; nested
+            # chains were added under their materialized parent and are
+            # reachable only through it, so setting head is safe for both —
+            # an unreachable container's head is never read before its
+            # parent links in.
+            st["head"][d, c] = members[0]
+            for a, b in zip(members, members[1:]):
+                st["next"][d, a] = b
+                st["prev"][d, b] = a
+        for t, e in enumerate(edit_rows):
+            ed["kind"][d, t] = e["kind"]
+            ed["seq"][d, t] = e["seq"]
+            ed["container"][d, t] = e.get("container", 0)
+            ed["anchor"][d, t] = e.get("anchor", NIL)
+            ed["first"][d, t] = e["first"]
+            ed["tail"][d, t] = e.get("tail", e["first"])
+            ed["value"][d, t] = e.get("value", NO_VALUE)
+
+    meta = {"doc_packs": doc_packs, "values": values, "docs": docs}
+    return TreeState(**st), TreeEdits(**ed), meta
+
+
+def oracle_fallback_summary(doc: TreeDocInput) -> SummaryTree:
+    """Full oracle replay of one document — the exactness escape hatch."""
+    from ..dds.tree import SharedTree
+
+    replica = SharedTree(doc.doc_id)
+    if doc.base_summary is not None:
+        replica.load(doc.base_summary)
+    for msg in doc.ops:
+        replica.process(msg, local=False)
+    replica.advance(doc.final_seq, doc.final_msn)
+    return replica.summarize()
+
+
+def summary_from_state(meta, state_np: dict, d: int) -> SummaryTree:
+    """Final device state → the oracle's canonical summary bytes."""
+    doc: TreeDocInput = meta["docs"][d]
+    pack: _DocPack = meta["doc_packs"][d]
+    if pack.needs_fallback or bool(state_np["overflow"][d]):
+        return oracle_fallback_summary(doc)
+    values: Interner = meta["values"]
+    msn = max(doc.final_msn, pack.base_min_seq)
+
+    # containers by owning node, in interning order (which preserves field
+    # name order only per first appearance — re-sort by field name to match
+    # the oracle's sorted(fields) serialization).
+    by_node: Dict[int, List[Tuple[str, int]]] = {}
+    for (pidx, fname), c in zip(pack.containers.values,
+                                range(len(pack.containers))):
+        by_node.setdefault(pidx, []).append((fname, c))
+
+    head = state_np["head"][d]
+    nxt = state_np["next"][d]
+    removed = state_np["removed_seq"][d]
+    ins_seq = state_np["insert_seq"][d]
+    val = state_np["value"][d]
+    val_seq = state_np["value_seq"][d]
+    node_container = state_np["node_container"][d]
+
+    def keep(idx: int) -> bool:
+        rs = int(removed[idx])
+        return not (rs != int(NOT_REMOVED) and rs <= msn)
+
+    def chain(c: int) -> List[int]:
+        out = []
+        cur = int(head[c])
+        while cur != NIL:
+            # Only nodes currently linked in this container (a node moved
+            # away leaves no stale link — splice repairs both sides).
+            out.append(cur)
+            cur = int(nxt[cur])
+        return out
+
+    def node_obj(idx: int) -> dict:
+        obj: Dict[str, Any] = {
+            "id": pack.node_ids.values[idx],
+            "type": pack.node_types[idx],
+            "insertSeq": 0 if int(ins_seq[idx]) <= msn else int(ins_seq[idx]),
+        }
+        v = int(val[idx])
+        if v != NO_VALUE:
+            obj["value"] = values.lookup(v)
+            vs = int(val_seq[idx])
+            obj["valueSeq"] = 0 if vs <= msn else vs
+        rs = int(removed[idx])
+        if rs != int(NOT_REMOVED):
+            obj["removedSeq"] = rs
+        fields = fields_obj(idx)
+        if fields:
+            obj["fields"] = fields
+        return obj
+
+    def fields_obj(idx: int) -> dict:
+        out = {}
+        for fname, c in sorted(by_node.get(idx, [])):
+            kids = [node_obj(i) for i in chain(c) if keep(i)]
+            if kids:
+                out[fname] = kids
+        return out
+
+    root_obj = {
+        "fields": fields_obj(0),
+        "minSeq": msn,
+        "seq": pack.header_seq,
+    }
+    tree = SummaryTree()
+    tree.add_blob("header", canonical_json(root_obj))
+    return tree
+
+
+def replay_tree_batch(docs: Sequence[TreeDocInput]) -> List[SummaryTree]:
+    """Full pipeline: pack → vmapped device edit-fold → canonical summaries.
+
+    Byte-identical to ``SharedTree.summarize()`` after the oracle replays
+    the same log (asserted by tests/test_tree_kernel.py).
+    """
+    if not docs:
+        return []
+    out: List[Optional[SummaryTree]] = [None] * len(docs)
+    state, edits, meta = pack_tree_batch(docs)
+    final = _replay_batch(state, edits)
+    state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+    for d in range(len(docs)):
+        out[d] = summary_from_state(meta, state_np, d)
+    return out

@@ -12,7 +12,15 @@ the end.  Multi-slice scale-out rides the same shardings over DCN.
 from .shard import (
     doc_mesh,
     replay_mergetree_sharded,
+    replay_tree_sharded,
+    tree_sharded_replay_step,
     sharded_replay_step,
 )
 
-__all__ = ["doc_mesh", "replay_mergetree_sharded", "sharded_replay_step"]
+__all__ = [
+    "doc_mesh",
+    "replay_mergetree_sharded",
+    "replay_tree_sharded",
+    "sharded_replay_step",
+    "tree_sharded_replay_step",
+]

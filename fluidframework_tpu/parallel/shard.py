@@ -76,13 +76,18 @@ def sharded_replay_step(mesh: Mesh):
     )
 
 
-def _pad_docs(docs: Sequence[MergeTreeDocInput], multiple: int):
+def _pad_docs(docs: Sequence, multiple: int, make_pad):
     """Pad the doc list to a multiple of the mesh size with empty documents
     (noop streams) so the doc axis shards evenly."""
     docs = list(docs)
     while len(docs) % multiple:
-        docs.append(MergeTreeDocInput(doc_id="\x00pad", ops=[]))
+        docs.append(make_pad())
     return docs
+
+
+def _shard_put(mesh: Mesh, tree):
+    shard = NamedSharding(mesh, P(DOC_AXIS))
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), shard), tree)
 
 
 def replay_mergetree_sharded(
@@ -93,35 +98,102 @@ def replay_mergetree_sharded(
     """Multi-chip catch-up replay: pack → shard over the mesh → fold →
     canonical summaries.  Byte-compatible with the single-chip path and the
     CPU oracle."""
-    if not docs:
-        return []
+    from ..ops.batching import partition_replay
+
     if mesh is None:
         mesh = doc_mesh()
-    # Known-fallback docs (pre-pack predicate) go straight to the oracle so
-    # they don't inflate the shared buckets or waste their shard's fold.
-    out: List[Optional[SummaryTree]] = [None] * len(docs)
-    device_idx = []
-    for i, doc in enumerate(docs):
-        if known_oracle_fallback(doc):
-            out[i] = oracle_fallback_summary(doc)
-        else:
-            device_idx.append(i)
-    docs = [docs[i] for i in device_idx]
-    if not docs:
-        return out
-    n_real = len(docs)
-    padded = _pad_docs(docs, mesh.size)
-    state, ops, meta = pack_mergetree_batch(padded)
-    if step is None:
-        step = sharded_replay_step(mesh)
-    shard = NamedSharding(mesh, P(DOC_AXIS))
-    state = jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), shard), state)
-    ops = jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), shard), ops)
-    final, lengths = step(state, ops)
-    state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-    lengths = np.asarray(lengths)
-    for d in range(n_real):
-        out[device_idx[d]] = summary_from_state(
-            meta, state_np, d, length=int(lengths[d])
+    the_step = step if step is not None else (
+        sharded_replay_step(mesh) if docs else None
+    )
+
+    def fold_batch(batch):
+        n_real = len(batch)
+        padded = _pad_docs(
+            batch, mesh.size,
+            lambda: MergeTreeDocInput(doc_id="\x00pad", ops=[]),
         )
-    return out
+        state, ops, meta = pack_mergetree_batch(padded)
+        final, lengths = the_step(_shard_put(mesh, state),
+                                  _shard_put(mesh, ops))
+        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+        lengths = np.asarray(lengths)
+        return [
+            summary_from_state(meta, state_np, d, length=int(lengths[d]))
+            for d in range(n_real)
+        ]
+
+    return partition_replay(
+        docs, known_oracle_fallback, oracle_fallback_summary, fold_batch
+    )
+
+
+def tree_sharded_replay_step(mesh: Mesh):
+    """Jitted, mesh-sharded tree replay step: the edit-fold partitioned
+    along the doc axis; per-doc overflow flags (the host needs every one to
+    route fallbacks) assembled cross-chip — the ICI all-gather."""
+    from ..ops.tree_kernel import TreeEdits, TreeState
+    from ..ops.tree_kernel import replay_vmapped as tree_replay_vmapped
+
+    shard = NamedSharding(mesh, P(DOC_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    def _step(state: TreeState, edits: TreeEdits):
+        final = tree_replay_vmapped(state, edits)
+        overflow = jax.lax.with_sharding_constraint(
+            final.overflow, replicated
+        )
+        return final, overflow
+
+    state_shardings = TreeState(
+        head=shard, next=shard, prev=shard, node_container=shard,
+        container_parent=shard, value=shard, value_seq=shard,
+        insert_seq=shard, removed_seq=shard, overflow=shard,
+    )
+    edit_shardings = TreeEdits(
+        kind=shard, seq=shard, container=shard, anchor=shard,
+        first=shard, tail=shard, value=shard,
+    )
+    return jax.jit(
+        _step,
+        in_shardings=(state_shardings, edit_shardings),
+        out_shardings=(state_shardings, replicated),
+    )
+
+
+def replay_tree_sharded(
+    docs, mesh: Optional[Mesh] = None, step=None,
+) -> List[SummaryTree]:
+    """Multi-chip SharedTree catch-up replay (see replay_mergetree_sharded)."""
+    from ..ops.batching import partition_replay
+    from ..ops.tree_kernel import (
+        TreeDocInput,
+        oracle_fallback_summary as tree_oracle_fallback,
+        pack_tree_batch,
+        summary_from_state as tree_summary_from_state,
+    )
+
+    if mesh is None:
+        mesh = doc_mesh()
+    the_step = step if step is not None else (
+        tree_sharded_replay_step(mesh) if docs else None
+    )
+
+    def fold_batch(batch):
+        n_real = len(batch)
+        padded = _pad_docs(
+            batch, mesh.size, lambda: TreeDocInput(doc_id="\x00pad", ops=[])
+        )
+        state, edits, meta = pack_tree_batch(padded)
+        final, overflow = the_step(_shard_put(mesh, state),
+                                   _shard_put(mesh, edits))
+        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+        state_np["overflow"] = np.asarray(overflow)
+        return [
+            tree_summary_from_state(meta, state_np, d)
+            for d in range(n_real)
+        ]
+
+    # Tree fallbacks (revive edits, multi-id moves) are detected at pack
+    # time inside summary_from_state; no pre-pack predicate exists.
+    return partition_replay(docs, lambda _d: False,
+                            tree_oracle_fallback, fold_batch)

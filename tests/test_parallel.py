@@ -71,3 +71,19 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*example_args)
     assert jax.tree.leaves(out), "entry() produced no outputs"
     mod.dryrun_multichip(8)
+
+
+def test_tree_sharded_matches_oracle():
+    from fluidframework_tpu.ops.tree_kernel import TreeDocInput
+    from fluidframework_tpu.parallel import replay_tree_sharded
+    from tests.test_tree_kernel import run_fuzz_doc
+
+    docs, oracle_digests = [], []
+    for seed in range(5):  # not a multiple of 8: exercises padding
+        _f, trees, log, fs, fm = run_fuzz_doc(600 + seed, steps=30)
+        docs.append(
+            TreeDocInput("tree", ops=log, final_seq=fs, final_msn=fm)
+        )
+        oracle_digests.append(trees[0].summarize().digest())
+    sharded = replay_tree_sharded(docs, mesh=doc_mesh())
+    assert [s.digest() for s in sharded] == oracle_digests

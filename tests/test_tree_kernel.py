@@ -1,0 +1,176 @@
+"""Device tree-kernel parity: byte-identical summaries vs the oracle.
+
+The convergence oracle pattern (SURVEY.md §4): generate sequenced tree op
+logs through the mock runtime's fuzz loop, replay them through both the
+CPU oracle and the vmapped device fold, and compare canonical digests.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.tree import ROOT_ID, SharedTree
+from fluidframework_tpu.ops.tree_kernel import (
+    TreeDocInput,
+    oracle_fallback_summary,
+    replay_tree_batch,
+)
+from fluidframework_tpu.testing.mocks import (
+    MockContainerRuntimeFactory,
+    channel_log,
+)
+
+
+def oracle_summary(doc: TreeDocInput):
+    return oracle_fallback_summary(doc)
+
+
+def run_fuzz_doc(seed, steps=80, n_clients=3, with_moves=True):
+    """Drive a fuzzed multi-client session; return the sequenced log and
+    final window, the exact catch-up work item."""
+    rng = random.Random(seed)
+    factory = MockContainerRuntimeFactory()
+    trees = []
+    for i in range(n_clients):
+        rt = factory.create_client(f"client{i}")
+        trees.append(rt.attach(SharedTree("tree")))
+    for _ in range(steps):
+        t = rng.choice(trees)
+        roll = rng.random()
+        try:
+            if roll < 0.4:
+                field = rng.choice(["a", "b"])
+                parents = [ROOT_ID] + [
+                    c for c in t.children(ROOT_ID, "a")
+                ]
+                parent = rng.choice(parents)
+                kids = t.children(parent, field)
+                nested = (
+                    {"kids": [t.build("leaf", value=rng.randint(0, 9))]}
+                    if rng.random() < 0.3 else None
+                )
+                t.insert(parent, field, rng.randint(0, len(kids)),
+                         [t.build("n", value=rng.randint(0, 99),
+                                  fields=nested)])
+            elif roll < 0.55:
+                field = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, field)
+                if kids:
+                    t.remove(rng.choice(kids))
+            elif roll < 0.7:
+                field = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, field)
+                if kids:
+                    t.set_value(
+                        rng.choice(kids),
+                        rng.choice([rng.randint(0, 99), "s", None]),
+                    )
+            elif roll < 0.85 and with_moves:
+                src = rng.choice(["a", "b"])
+                kids = t.children(ROOT_ID, src)
+                if kids:
+                    nid = rng.choice(kids)
+                    if rng.random() < 0.3 and len(kids) > 1:
+                        dest_parent = rng.choice(
+                            [k for k in kids if k != nid]
+                        )
+                        dest = (dest_parent, "kids")
+                    else:
+                        dest = (ROOT_ID, rng.choice(["a", "b"]))
+                    n_dest = len([
+                        k for k in t.children(*dest) if k != nid
+                    ])
+                    t.move([nid], dest[0], dest[1],
+                           rng.randint(0, n_dest))
+            else:
+                factory.process_some_messages(rng.randint(1, 4))
+        except (KeyError, ValueError):
+            pass
+    factory.process_all_messages()
+    log = channel_log(factory, "tree")
+    final_seq = factory.sequencer.seq
+    final_msn = factory.sequencer.min_seq
+    return factory, trees, log, final_seq, final_msn
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 17, 55, 301])
+def test_device_matches_oracle_fuzz(seed):
+    factory, trees, log, final_seq, final_msn = run_fuzz_doc(seed)
+    doc = TreeDocInput(
+        doc_id="tree", ops=log, final_seq=final_seq, final_msn=final_msn
+    )
+    (device,) = replay_tree_batch([doc])
+    oracle = oracle_summary(doc)
+    assert device.digest() == oracle.digest()
+    # And both equal the live replicas' summaries.
+    assert device.digest() == trees[0].summarize().digest()
+
+
+def test_device_batch_many_docs():
+    docs = []
+    oracles = []
+    for seed in range(8):
+        _f, _t, log, fs, fm = run_fuzz_doc(seed + 1000, steps=40,
+                                           with_moves=(seed % 2 == 0))
+        doc = TreeDocInput("tree", ops=log, final_seq=fs, final_msn=fm)
+        docs.append(doc)
+        oracles.append(oracle_summary(doc))
+    results = replay_tree_batch(docs)
+    for device, oracle in zip(results, oracles):
+        assert device.digest() == oracle.digest()
+
+
+def test_device_from_base_summary():
+    """Catch-up from a mid-stream summary + tail, the north-star shape."""
+    factory, trees, log, final_seq, final_msn = run_fuzz_doc(77, steps=60)
+    # Split: summary at the midpoint op, tail after.
+    mid = len(log) // 2
+    base_replica = SharedTree("tree")
+    for msg in log[:mid]:
+        base_replica.process(msg, local=False)
+    base = base_replica.summarize()
+    doc = TreeDocInput(
+        "tree", ops=log[mid:], base_summary=base,
+        final_seq=final_seq, final_msn=final_msn,
+    )
+    (device,) = replay_tree_batch([doc])
+    oracle = oracle_summary(doc)
+    assert device.digest() == oracle.digest()
+    assert device.digest() == trees[0].summarize().digest()
+
+
+def test_revive_falls_back_to_oracle():
+    factory = MockContainerRuntimeFactory()
+    rt = factory.create_client("c0")
+    t = rt.attach(SharedTree("tree"))
+    (nid,) = t.insert(ROOT_ID, "", 0, [t.build("n", value="v")])
+    factory.process_all_messages()
+    t.remove(nid)
+    factory.process_all_messages()
+    _seq, _c, cs = t.edit_manager.trunk[-1]
+    t.undo_changeset(cs)  # produces a revive edit
+    factory.process_all_messages()
+    log = channel_log(factory, "tree")
+    doc = TreeDocInput("tree", ops=log,
+                       final_seq=factory.sequencer.seq,
+                       final_msn=factory.sequencer.min_seq)
+    (device,) = replay_tree_batch([doc])
+    assert device.digest() == t.summarize().digest()
+
+
+def test_empty_and_noop_docs():
+    doc = TreeDocInput("empty", ops=[])
+    (device,) = replay_tree_batch([doc])
+    oracle = oracle_summary(doc)
+    assert device.digest() == oracle.digest()
+    assert replay_tree_batch([]) == []
+
+
+def test_deterministic_across_runs():
+    """Same batch twice → bitwise-equal results (SURVEY.md §5 race
+    detection equivalent: determinism checks)."""
+    _f, _t, log, fs, fm = run_fuzz_doc(5, steps=50)
+    doc = TreeDocInput("tree", ops=log, final_seq=fs, final_msn=fm)
+    d1 = replay_tree_batch([doc])[0].digest()
+    d2 = replay_tree_batch([doc])[0].digest()
+    assert d1 == d2
