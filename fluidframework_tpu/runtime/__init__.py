@@ -1,0 +1,23 @@
+"""The runtime shell: channel registry, datastores, container runtime,
+summarization (SURVEY.md §2.1 layers 5–6).
+
+The mock runtimes in ``fluidframework_tpu.testing`` remain the lightweight
+harness for DDS-only tests; this package is the production-shaped stack the
+loader/service layers drive."""
+
+from .registry import ChannelFactory, ChannelRegistry, default_registry
+from .datastore import ChannelDeltaConnection, FluidDataStoreRuntime
+from .container import ContainerRuntime, OrderedClientElection
+from .summarizer import SummarizerOptions, SummaryManager
+
+__all__ = [
+    "ChannelFactory",
+    "ChannelRegistry",
+    "default_registry",
+    "ChannelDeltaConnection",
+    "FluidDataStoreRuntime",
+    "ContainerRuntime",
+    "OrderedClientElection",
+    "SummarizerOptions",
+    "SummaryManager",
+]

@@ -1,0 +1,248 @@
+"""Container runtime: datastore hosting, op batching, inbound processing.
+
+Capability-equivalent of the reference's ``ContainerRuntime`` + ``Outbox``
++ ``BatchManager`` (SURVEY.md §2.1 container-runtime, §3.1 hot path;
+upstream paths UNVERIFIED — empty reference mount):
+
+- routes channel ops out through a **grouped-batch outbox**: ops accumulate
+  and flush as ONE sequenced message carrying the batch (atomic delivery,
+  one network round-trip per flush — the reference's grouped batching);
+  each sub-op keeps its own client_seq so channel ack FIFOs match 1:1;
+- processes inbound messages from an explicit queue (``drain()``), keeping
+  optimistic-state windows testable — delivery timing is the caller's
+  (DeltaManager's) concern, total order is the sequencer's;
+- fans the (seq, min_seq) window out to every channel (zamboni plumbing);
+- assembles the container summary tree (per-datastore subtrees + metadata)
+  and loads from it.
+
+The connection surface is deliberately thin — ``connect()`` takes anything
+with ``submit(RawOperation)`` / ``subscribe(fn)`` (the in-proc Sequencer, a
+LocalOrderer, or a driver's delta connection).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+from typing import Deque, Dict, List, Optional
+
+from ..protocol.messages import MessageType, RawOperation, SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .datastore import FluidDataStoreRuntime
+from .registry import ChannelRegistry, default_registry
+
+
+class OrderedClientElection:
+    """Oldest connected client wins (the reference's election rule).
+    Membership is driven by the sequenced JOIN/LEAVE stream, so every
+    client computes the same winner at the same fold position."""
+
+    def __init__(self) -> None:
+        self._order: List[str] = []
+
+    def observe(self, msg: SequencedMessage) -> None:
+        if msg.type is MessageType.JOIN:
+            cid = msg.contents["clientId"]
+            if cid not in self._order:
+                self._order.append(cid)
+        elif msg.type is MessageType.LEAVE:
+            cid = msg.contents["clientId"]
+            if cid in self._order:
+                self._order.remove(cid)
+
+    @property
+    def elected(self) -> Optional[str]:
+        return self._order[0] if self._order else None
+
+    @property
+    def quorum(self) -> List[str]:
+        return list(self._order)
+
+
+class ContainerRuntime:
+    """The per-client runtime instance."""
+
+    def __init__(self, registry: Optional[ChannelRegistry] = None) -> None:
+        self.registry = registry if registry is not None else default_registry()
+        self.datastores: Dict[str, FluidDataStoreRuntime] = {}
+        self.client_id: Optional[str] = None
+        self._service = None
+        self.ref_seq = 0          # last processed seq
+        self.min_seq = 0
+        self._client_seq = 0      # runtime-level op counter (sub-op acks)
+        self._client_ids: set = set()  # all ids this runtime has used
+        self._inbound: Deque[SequencedMessage] = collections.deque()
+        self._outbox: List[dict] = []
+        self._batching = 0
+        self.election = OrderedClientElection()  # quorum, join-ordered
+        self.on_op_processed = None  # hook: fn(msg) after each message
+
+    # -- datastores ------------------------------------------------------------
+
+    def create_datastore(self, datastore_id: str) -> FluidDataStoreRuntime:
+        if datastore_id in self.datastores:
+            raise ValueError(f"datastore {datastore_id!r} already exists")
+        ds = FluidDataStoreRuntime(datastore_id, self.registry)
+        ds._attach(self)
+        self.datastores[datastore_id] = ds
+        return ds
+
+    def get_datastore(self, datastore_id: str) -> FluidDataStoreRuntime:
+        return self.datastores[datastore_id]
+
+    # -- connection ------------------------------------------------------------
+
+    def connect(self, service, client_id: str) -> None:
+        """Attach to an ordering service: anything with
+        ``submit(RawOperation)`` and ``subscribe(fn)``.
+
+        Subscribe-then-join: the live subscription starts first, the
+        service's durable log backfills everything after our current
+        sequence point (catch-up), and only then is the JOIN announced —
+        so this client observes its own JOIN and every quorum event in
+        order.  A runtime that ``load()``ed a summary first backfills just
+        the tail."""
+        self._service = service
+        self.client_id = client_id
+        self._client_ids.add(client_id)
+        service.subscribe(self._inbound.append)
+        log = getattr(service, "log", None)
+        if log is not None:
+            for msg in log:
+                if msg.seq > self.ref_seq:
+                    self._inbound.append(msg)
+        if hasattr(service, "connect"):
+            service.connect(client_id)
+        for ds in self.datastores.values():
+            ds._attach(self)
+
+    @property
+    def is_attached(self) -> bool:
+        return self._service is not None
+
+    # -- outbound: the outbox --------------------------------------------------
+
+    def _submit_op(self, envelope: dict) -> int:
+        """Called by datastores for each channel op; returns the sub-op
+        client_seq the channel records for its ack FIFO."""
+        self._client_seq += 1
+        self._outbox.append(
+            {"clientSeq": self._client_seq, **envelope}
+        )
+        if not self._batching:
+            self.flush()
+        return self._client_seq
+
+    @contextlib.contextmanager
+    def order_sequentially(self):
+        """Batch every op submitted inside into one grouped message —
+        atomic remote delivery (the reference's orderSequentially)."""
+        self._batching += 1
+        try:
+            yield
+        finally:
+            self._batching -= 1
+            if not self._batching:
+                self.flush()
+
+    def flush(self) -> None:
+        if not self._outbox or self._service is None:
+            return
+        batch, self._outbox = self._outbox, []
+        self._service.submit(
+            RawOperation(
+                client_id=self.client_id,
+                client_seq=batch[0]["clientSeq"],
+                ref_seq=self.ref_seq,
+                type=MessageType.OP,
+                contents={"type": "groupedBatch", "ops": batch},
+            )
+        )
+
+    # -- inbound ---------------------------------------------------------------
+
+    @property
+    def inbound_count(self) -> int:
+        return len(self._inbound)
+
+    def drain(self, count: Optional[int] = None) -> int:
+        """Process queued inbound messages in order; returns how many."""
+        n = 0
+        while self._inbound and (count is None or n < count):
+            self.process(self._inbound.popleft())
+            n += 1
+        return n
+
+    def process(self, msg: SequencedMessage) -> None:
+        if msg.seq <= self.ref_seq:
+            return  # tail overlapping a loaded summary / duplicate delivery
+        self.ref_seq = max(self.ref_seq, msg.seq)
+        self.min_seq = max(self.min_seq, msg.min_seq)
+        self.election.observe(msg)
+        if msg.type is MessageType.OP and isinstance(msg.contents, dict) \
+                and msg.contents.get("type") == "groupedBatch":
+            local = msg.client_id in self._client_ids
+            for sub in msg.contents["ops"]:
+                ds = self.datastores.get(sub["ds"])
+                if ds is not None:
+                    ds.process(
+                        dataclasses.replace(msg, client_seq=sub["clientSeq"]),
+                        sub, local,
+                    )
+        self._advance_all(msg.seq, msg.min_seq)
+        if self.on_op_processed is not None:
+            self.on_op_processed(msg)
+
+    def _advance_all(self, seq: int, min_seq: int) -> None:
+        for ds in self.datastores.values():
+            ds.advance(seq, min_seq)
+
+    # -- reconnect -------------------------------------------------------------
+
+    def reconnect(self, service, client_id: str) -> None:
+        """Catch-up-then-resubmit: the caller must first deliver (via the
+        new service subscription or a log replay into ``process``) every
+        message up to the head — acks for previously-sequenced pending ops
+        land during that catch-up — then this resubmits the remainder."""
+        self.connect(service, client_id)
+        self.drain()
+        for ds in self.datastores.values():
+            ds.resubmit_pending()
+        self.flush()
+
+    # -- summaries -------------------------------------------------------------
+
+    def summarize(self) -> SummaryTree:
+        tree = SummaryTree()
+        meta = {"seq": self.ref_seq, "minSeq": self.min_seq}
+        tree.add_blob(".metadata", canonical_json(meta))
+        # Protocol state: the quorum snapshot (new clients can't replay
+        # pre-summary JOINs — the log below the summary is collectible).
+        tree.add_blob(
+            ".protocol", canonical_json({"quorum": self.election.quorum})
+        )
+        ds_tree = tree.add_tree(".datastores")
+        for ds_id in sorted(self.datastores):
+            ds_tree.children[ds_id] = self.datastores[ds_id].summarize(
+                self.min_seq
+            )
+        return tree
+
+    def load(self, summary: SummaryTree) -> int:
+        """Load from a summary; returns the summary's sequence point (the
+        caller replays the op tail after it)."""
+        meta = json.loads(summary.blob_bytes(".metadata"))
+        self.ref_seq = meta["seq"]
+        self.min_seq = meta["minSeq"]
+        protocol = json.loads(summary.blob_bytes(".protocol"))
+        self.election._order = list(protocol["quorum"])
+        self.datastores = {}
+        ds_root = summary.get(".datastores")
+        for ds_id, subtree in sorted(ds_root.children.items()):
+            ds = FluidDataStoreRuntime(ds_id, self.registry)
+            ds._attach(self)
+            ds.load(subtree)
+            self.datastores[ds_id] = ds
+        return meta["seq"]

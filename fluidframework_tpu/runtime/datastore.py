@@ -1,0 +1,121 @@
+"""Datastore runtime: hosts channels (DDS instances) and routes their ops.
+
+Capability-equivalent of the reference's ``FluidDataStoreRuntime`` /
+``ChannelDeltaConnection`` (SURVEY.md §2.1 datastore; upstream paths
+UNVERIFIED — empty reference mount): channel creation through the factory
+registry, attach lifecycle, per-channel op routing, and the per-datastore
+summary subtree (channel subtrees + an attributes blob recording each
+channel's type for load)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..dds.shared_object import SharedObject
+from ..protocol.messages import SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from .registry import ChannelRegistry
+
+
+class ChannelDeltaConnection:
+    """The per-channel submit handle: wraps ops in the channel envelope and
+    forwards them to the datastore's outbound path."""
+
+    def __init__(self, datastore: "FluidDataStoreRuntime",
+                 channel_id: str) -> None:
+        self._datastore = datastore
+        self._channel_id = channel_id
+
+    def submit(self, contents) -> int:
+        return self._datastore._submit_channel_op(self._channel_id, contents)
+
+
+class FluidDataStoreRuntime:
+    """One datastore: a bag of named channels behind one address."""
+
+    def __init__(self, datastore_id: str, registry: ChannelRegistry) -> None:
+        self.id = datastore_id
+        self.registry = registry
+        self.channels: Dict[str, SharedObject] = {}
+        self._container = None  # set by the container runtime on attach
+
+    # -- channel lifecycle -----------------------------------------------------
+
+    def create_channel(self, type_name: str, channel_id: str) -> SharedObject:
+        if channel_id in self.channels:
+            raise ValueError(f"channel {channel_id!r} already exists")
+        channel = self.registry.get(type_name).create(channel_id)
+        self.channels[channel_id] = channel
+        self._connect_channel(channel)
+        return channel
+
+    def get_channel(self, channel_id: str) -> SharedObject:
+        return self.channels[channel_id]
+
+    def _connect_channel(self, channel: SharedObject) -> None:
+        if self._container is not None and self._container.client_id:
+            channel.connect(
+                ChannelDeltaConnection(self, channel.id),
+                self._container.client_id,
+            )
+
+    def _attach(self, container) -> None:
+        self._container = container
+        for channel in self.channels.values():
+            self._connect_channel(channel)
+
+    # -- op routing ------------------------------------------------------------
+
+    def _submit_channel_op(self, channel_id: str, contents) -> int:
+        return self._container._submit_op(
+            {"ds": self.id, "channel": channel_id, "contents": contents}
+        )
+
+    def process(self, msg: SequencedMessage, envelope: dict,
+                local: bool) -> None:
+        channel = self.channels.get(envelope["channel"])
+        if channel is None:
+            raise KeyError(
+                f"datastore {self.id!r}: op for unknown channel "
+                f"{envelope['channel']!r}"
+            )
+        channel.process(
+            dataclasses.replace(msg, contents=envelope["contents"]), local
+        )
+
+    def advance(self, seq: int, min_seq: int,
+                skip_channel: Optional[str] = None) -> None:
+        for channel_id, channel in self.channels.items():
+            if channel_id == skip_channel:
+                continue
+            advance = getattr(channel, "advance", None)
+            if advance:
+                advance(seq, min_seq)
+
+    def resubmit_pending(self) -> None:
+        for channel in self.channels.values():
+            channel.resubmit_pending()
+
+    # -- summaries -------------------------------------------------------------
+
+    def summarize(self, min_seq: int = 0) -> SummaryTree:
+        tree = SummaryTree()
+        attributes = {}
+        for channel_id in sorted(self.channels):
+            channel = self.channels[channel_id]
+            tree.children[channel_id] = channel.summarize(min_seq)
+            attributes[channel_id] = channel.TYPE
+        tree.add_blob(".attributes", canonical_json(attributes))
+        return tree
+
+    def load(self, summary: SummaryTree) -> None:
+        import json
+
+        attributes = json.loads(summary.blob_bytes(".attributes"))
+        self.channels = {}
+        for channel_id, type_name in attributes.items():
+            subtree = summary.children[channel_id]
+            channel = self.registry.get(type_name).load(channel_id, subtree)
+            self.channels[channel_id] = channel
+            self._connect_channel(channel)

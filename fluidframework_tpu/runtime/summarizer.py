@@ -1,0 +1,110 @@
+"""Summarization: election, heuristics, upload, ack tracking.
+
+Capability-equivalent of the reference's summary stack (SURVEY.md §3.3:
+``SummaryManager`` → ``OrderedClientElection`` → ``RunningSummarizer``
+heuristics → ``submitSummary`` → storage upload → "summarize" op → ack;
+upstream paths UNVERIFIED — empty reference mount).  One client — the
+oldest in the quorum — summarizes; everyone else tracks acks so any
+client can take over on re-election."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..protocol.messages import MessageType, RawOperation, SequencedMessage
+from ..protocol.summary import SummaryStorage
+from .container import ContainerRuntime, OrderedClientElection
+
+__all__ = ["SummarizerOptions", "SummaryManager", "OrderedClientElection"]
+
+
+@dataclasses.dataclass
+class SummarizerOptions:
+    """RunningSummarizer heuristics (the reference's ISummaryConfiguration
+    capability: opsSinceLastSummary / maxOps thresholds)."""
+
+    ops_per_summary: int = 50    # summarize every N sequenced ops
+    min_ops: int = 1             # never summarize with fewer new ops
+
+
+class SummaryManager:
+    """Watches the op stream on one client; when that client is elected and
+    the heuristics fire, writes a summary and announces it.
+
+    Wire-in: ``manager = SummaryManager(runtime, storage, doc_id)`` then the
+    runtime's ``on_op_processed`` hook drives it — no polling."""
+
+    def __init__(
+        self,
+        runtime: ContainerRuntime,
+        storage: SummaryStorage,
+        doc_id: str,
+        options: Optional[SummarizerOptions] = None,
+    ) -> None:
+        self.runtime = runtime
+        self.storage = storage
+        self.doc_id = doc_id
+        self.options = options or SummarizerOptions()
+        self.last_summary_seq = 0
+        self.last_ack_handle: Optional[str] = None
+        self.ops_since_summary = 0
+        self.summaries_written = 0
+        runtime.on_op_processed = self._on_message
+
+    # -- the message hook ------------------------------------------------------
+
+    @property
+    def election(self) -> OrderedClientElection:
+        return self.runtime.election
+
+    def _on_message(self, msg: SequencedMessage) -> None:
+        if msg.type is MessageType.OP:
+            self.ops_since_summary += 1
+        elif msg.type is MessageType.SUMMARIZE:
+            # Every client tracks accepted summaries (for takeover): the
+            # reference's summaryAck handling.  In-proc, the sequencer
+            # stamping the summarize op is the acceptance point; a real
+            # service's Scribe validates first (service slice).
+            self.last_summary_seq = msg.contents["seq"]
+            self.last_ack_handle = msg.contents["handle"]
+            self.ops_since_summary = 0
+        if (
+            self._is_summarizer
+            and msg.type is not MessageType.SUMMARIZE
+            and self.ops_since_summary >= self.options.ops_per_summary
+            and self.ops_since_summary >= self.options.min_ops
+        ):
+            self.summarize_now()
+
+    @property
+    def _is_summarizer(self) -> bool:
+        return (
+            self.runtime.is_attached
+            and self.election.elected == self.runtime.client_id
+        )
+
+    # -- the summarize action --------------------------------------------------
+
+    def summarize_now(self) -> Optional[str]:
+        """Write + upload + announce one summary; returns its handle."""
+        tree = self.runtime.summarize()
+        ref_seq = self.runtime.ref_seq
+        handle = self.storage.upload(self.doc_id, tree, ref_seq)
+        self.summaries_written += 1
+        self.runtime._service.submit(
+            RawOperation(
+                client_id=self.runtime.client_id,
+                client_seq=self._next_summary_client_seq(),
+                ref_seq=ref_seq,
+                type=MessageType.SUMMARIZE,
+                contents={"handle": handle, "seq": ref_seq},
+            )
+        )
+        return handle
+
+    def _next_summary_client_seq(self) -> int:
+        # Summary ops ride the same per-client sequence space as channel
+        # ops so the sequencer's dedup floor stays consistent.
+        self.runtime._client_seq += 1
+        return self.runtime._client_seq

@@ -1,0 +1,183 @@
+"""Runtime-shell tests: registry, datastores, batching, summarizer,
+catch-up load — the production-shaped stack over the in-proc sequencer."""
+
+import pytest
+
+from fluidframework_tpu.dds.tree import ROOT_ID
+from fluidframework_tpu.protocol.sequencer import Sequencer
+from fluidframework_tpu.protocol.summary import SummaryStorage
+from fluidframework_tpu.runtime import (
+    ContainerRuntime,
+    SummarizerOptions,
+    SummaryManager,
+    default_registry,
+)
+
+
+def make_runtime(sequencer, client_id, registry=None):
+    rt = ContainerRuntime(registry)
+    rt.connect(sequencer, client_id)  # subscribes, backfills, then joins
+    return rt
+
+
+def drain_all(*runtimes):
+    for rt in runtimes:
+        rt.drain()
+
+
+def test_registry_types():
+    registry = default_registry()
+    assert "map-tpu" in registry.types()
+    assert "tree-tpu" in registry.types()
+    with pytest.raises(KeyError):
+        registry.get("bogus")
+
+
+def test_two_clients_mixed_channels_converge():
+    seq = Sequencer()
+    a = make_runtime(seq, "alice")
+    b = make_runtime(seq, "bob")
+    drain_all(a, b)
+
+    ds_a = a.create_datastore("default")
+    ds_b = b.create_datastore("default")
+    map_a = ds_a.create_channel("map-tpu", "settings")
+    str_a = ds_a.create_channel("sequence-tpu", "text")
+    map_b = ds_b.create_channel("map-tpu", "settings")
+    str_b = ds_b.create_channel("sequence-tpu", "text")
+
+    map_a.set("theme", "dark")
+    str_b.insert_text(0, "hello")
+    str_a.insert_text(0, ">> ")
+    map_b.set("lang", "en")
+    drain_all(a, b)
+
+    assert map_a.get("theme") == "dark" and map_b.get("theme") == "dark"
+    assert str_a.text == str_b.text
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_grouped_batch_is_atomic():
+    seq = Sequencer()
+    a = make_runtime(seq, "alice")
+    b = make_runtime(seq, "bob")
+    drain_all(a, b)
+    ds_a = a.create_datastore("d")
+    ds_b = b.create_datastore("d")
+    m_a = ds_a.create_channel("map-tpu", "m")
+    s_a = ds_a.create_channel("sequence-tpu", "s")
+    ds_b.create_channel("map-tpu", "m")
+    ds_b.create_channel("sequence-tpu", "s")
+
+    with a.order_sequentially():
+        m_a.set("k", 1)
+        s_a.insert_text(0, "x")
+        m_a.set("k2", 2)
+    # One grouped message on the wire for the three ops.
+    op_msgs = [m for m in seq.log if m.type.value == "op"]
+    assert len(op_msgs) == 1
+    assert len(op_msgs[0].contents["ops"]) == 3
+    drain_all(a, b)
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_summary_load_catchup():
+    seq = Sequencer()
+    a = make_runtime(seq, "alice")
+    drain_all(a)
+    ds = a.create_datastore("d")
+    m = ds.create_channel("map-tpu", "m")
+    t = ds.create_channel("tree-tpu", "t")
+    m.set("k", "v")
+    t.insert(ROOT_ID, "", 0, [t.build("n", value=7)])
+    drain_all(a)
+    summary = a.summarize()
+
+    m.set("k", "v2")
+    t.insert(ROOT_ID, "", 1, [t.build("n", value=8)])
+    drain_all(a)
+
+    fresh = ContainerRuntime()
+    base_seq = fresh.load(summary)
+    for msg in seq.log:
+        if msg.seq > base_seq:
+            fresh.process(msg)
+    assert fresh.summarize().digest() == a.summarize().digest()
+    fm = fresh.get_datastore("d").get_channel("m")
+    assert fm.get("k") == "v2"
+
+
+def test_summarizer_election_and_heuristics():
+    seq = Sequencer()
+    storage = SummaryStorage()
+    a = make_runtime(seq, "alice")
+    b = make_runtime(seq, "bob")
+    mgr_a = SummaryManager(a, storage, "doc",
+                           SummarizerOptions(ops_per_summary=5))
+    mgr_b = SummaryManager(b, storage, "doc",
+                           SummarizerOptions(ops_per_summary=5))
+    drain_all(a, b)
+    assert mgr_a.election.elected == "alice"  # oldest joins first
+
+    ds_a = a.create_datastore("d")
+    ds_b = b.create_datastore("d")
+    m_a = ds_a.create_channel("map-tpu", "m")
+    ds_b.create_channel("map-tpu", "m")
+    for i in range(12):
+        m_a.set(f"k{i}", i)
+        drain_all(a, b)
+    # Alice (elected) summarized at least twice; bob wrote none.
+    assert mgr_a.summaries_written >= 2
+    assert mgr_b.summaries_written == 0
+    # Every client tracked the accepted summary.
+    assert mgr_b.last_ack_handle == mgr_a.last_ack_handle
+    tree, ref_seq = storage.latest("doc")
+    assert tree is not None and ref_seq == mgr_a.last_summary_seq
+
+    # Takeover: alice leaves; bob becomes the summarizer.
+    seq.disconnect("alice")
+    drain_all(a, b)
+    assert mgr_b.election.elected == "bob"
+    for i in range(6):
+        m_b = b.get_datastore("d").get_channel("m")
+        m_b.set(f"x{i}", i)
+        drain_all(a, b)
+    assert mgr_b.summaries_written >= 1
+
+
+def test_catchup_from_latest_summary_via_storage():
+    """The full catch-up shape: latest summary + op tail from the log."""
+    seq = Sequencer()
+    storage = SummaryStorage()
+    a = make_runtime(seq, "alice")
+    SummaryManager(a, storage, "doc", SummarizerOptions(ops_per_summary=4))
+    drain_all(a)
+    ds = a.create_datastore("d")
+    s = ds.create_channel("sequence-tpu", "s")
+    for i in range(10):
+        s.insert_text(0, f"{i} ")
+        drain_all(a)
+    tree, base_seq = storage.latest("doc")
+    assert tree is not None and base_seq > 0
+    fresh = ContainerRuntime()
+    loaded_seq = fresh.load(tree)
+    assert loaded_seq == base_seq
+    for msg in seq.log:
+        if msg.seq > loaded_seq:
+            fresh.process(msg)
+    assert fresh.summarize().digest() == a.summarize().digest()
+    assert fresh.get_datastore("d").get_channel("s").text == s.text
+
+
+def test_unknown_channel_op_raises():
+    seq = Sequencer()
+    a = make_runtime(seq, "alice")
+    b = make_runtime(seq, "bob")
+    drain_all(a, b)
+    ds_a = a.create_datastore("d")
+    ds_a.create_channel("map-tpu", "m")
+    b.create_datastore("d")  # bob never creates the channel
+    ds_a.get_channel("m").set("k", 1)
+    a.drain()
+    with pytest.raises(KeyError):
+        b.drain()
