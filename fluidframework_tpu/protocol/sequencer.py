@@ -17,7 +17,6 @@ no Kafka.  Responsibilities:
 from __future__ import annotations
 
 import dataclasses
-import itertools
 from typing import Callable, Dict, List, Optional
 
 from .messages import INITIAL_SEQ, MessageType, RawOperation, SequencedMessage
@@ -45,7 +44,13 @@ class Sequencer:
         self._clients: Dict[str, ClientConnection] = {}
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._log: List[SequencedMessage] = []
-        self._clock = itertools.count()
+        self._clock = 0
+        # Delivery queue: stamping is allowed *during* a broadcast (e.g. the
+        # scribe acks a summary from inside its subscription callback), but
+        # delivery must stay in total order — re-entrant stamps are queued
+        # and drained by the outermost broadcast.
+        self._delivery: List[SequencedMessage] = []
+        self._delivering = False
 
     # -- connection management -------------------------------------------------
 
@@ -63,9 +68,15 @@ class Sequencer:
         return self._log
 
     def connect(self, client_id: str) -> ClientConnection:
-        """Join a client to the quorum; emits a JOIN message."""
-        if client_id in self._clients:
-            raise ValueError(f"client {client_id!r} already connected")
+        """Join a client to the quorum; emits a JOIN message.
+
+        Idempotent for an already-connected id (the crash-resume reconnect:
+        a restored sequencer still carries the client's record, and keeping
+        it preserves the resubmit-dedup floor) — no duplicate JOIN is
+        stamped."""
+        existing = self._clients.get(client_id)
+        if existing is not None:
+            return existing
         conn = ClientConnection(client_id=client_id, ref_seq=self._seq)
         self._clients[client_id] = conn
         self._stamp(
@@ -138,6 +149,82 @@ class Sequencer:
         sequenced message (the Alfred broadcast capability)."""
         self._subscribers.append(fn)
 
+    def unsubscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        if fn in self._subscribers:
+            self._subscribers.remove(fn)
+
+    def server_message(self, type_: MessageType, contents) -> SequencedMessage:
+        """Stamp a server-originated message (scribe summaryAck/Nack — the
+        reference's service-generated ops carry clientId null)."""
+        return self._stamp(
+            client_id=None,
+            client_seq=-1,
+            ref_seq=self._seq,
+            type_=type_,
+            contents=contents,
+        )
+
+    def replay(self, msg: SequencedMessage) -> None:
+        """Advance sequencing state from an already-durable message without
+        re-stamping or re-broadcasting — crash-resume when the log is ahead
+        of the checkpoint (Deli resuming from its Kafka offset).  The
+        message is appended to the in-memory log so late joiners backfill
+        the full history."""
+        if msg.seq <= self._seq:
+            return  # already reflected in the checkpoint
+        self._log.append(msg)
+        self._seq = msg.seq
+        self._min_seq = max(self._min_seq, msg.min_seq)
+        self._clock = max(self._clock, int(msg.timestamp) + 1)
+        if msg.type is MessageType.JOIN:
+            cid = msg.contents["clientId"]
+            self._clients.setdefault(
+                cid, ClientConnection(client_id=cid, ref_seq=msg.ref_seq)
+            )
+        elif msg.type is MessageType.LEAVE:
+            self._clients.pop(msg.contents["clientId"], None)
+        elif msg.client_id is not None:
+            conn = self._clients.get(msg.client_id)
+            if conn is not None:
+                conn.last_client_seq = max(conn.last_client_seq,
+                                           msg.client_seq)
+                conn.ref_seq = max(conn.ref_seq, msg.ref_seq)
+
+    # -- checkpointing (Deli CheckpointManager capability) ---------------------
+
+    def checkpoint(self) -> dict:
+        """Serializable sequencing state: enough to resume stamping
+        exactly-once after a crash (the durable log holds the messages;
+        this holds the counters and per-client dedup floors)."""
+        return {
+            "seq": self._seq,
+            "minSeq": self._min_seq,
+            "clock": self._clock,
+            "clients": {
+                cid: {"refSeq": c.ref_seq, "lastClientSeq": c.last_client_seq}
+                for cid, c in sorted(self._clients.items())
+            },
+        }
+
+    @staticmethod
+    def restore(
+        state: dict, log: Optional[List[SequencedMessage]] = None
+    ) -> "Sequencer":
+        """Rebuild from a checkpoint; pass the durable messages at or below
+        the checkpoint as ``log`` so the in-memory catch-up feed stays
+        complete (``replay`` appends everything after it)."""
+        seq = Sequencer(start_seq=state["seq"])
+        seq._min_seq = state["minSeq"]
+        seq._clock = state["clock"]
+        seq._log = list(log) if log is not None else []
+        for cid, c in state["clients"].items():
+            seq._clients[cid] = ClientConnection(
+                client_id=cid,
+                ref_seq=c["refSeq"],
+                last_client_seq=c["lastClientSeq"],
+            )
+        return seq
+
     # -- internals -------------------------------------------------------------
 
     def _recompute_min_seq(self) -> None:
@@ -166,9 +253,18 @@ class Sequencer:
             min_seq=self._min_seq,
             type=type_,
             contents=contents,
-            timestamp=float(next(self._clock)),
+            timestamp=float(self._clock),
         )
+        self._clock += 1
         self._log.append(msg)
-        for fn in list(self._subscribers):
-            fn(msg)
+        self._delivery.append(msg)
+        if not self._delivering:
+            self._delivering = True
+            try:
+                while self._delivery:
+                    queued = self._delivery.pop(0)
+                    for fn in list(self._subscribers):
+                        fn(queued)
+            finally:
+                self._delivering = False
         return msg

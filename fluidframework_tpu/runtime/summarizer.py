@@ -48,6 +48,11 @@ class SummaryManager:
         self.options = options or SummarizerOptions()
         self.last_summary_seq = 0
         self.last_ack_handle: Optional[str] = None
+        # Scribe-confirmed state (set only when a Scribe is in the loop and
+        # stamps summaryAck/summaryNack back into the stream).
+        self.last_acked_handle: Optional[str] = None
+        self.last_acked_seq = 0
+        self.nacks_received = 0
         self.ops_since_summary = 0
         self.summaries_written = 0
         runtime.on_op_processed = self._on_message
@@ -62,13 +67,24 @@ class SummaryManager:
         if msg.type is MessageType.OP:
             self.ops_since_summary += 1
         elif msg.type is MessageType.SUMMARIZE:
-            # Every client tracks accepted summaries (for takeover): the
-            # reference's summaryAck handling.  In-proc, the sequencer
-            # stamping the summarize op is the acceptance point; a real
-            # service's Scribe validates first (service slice).
+            # Every client tracks announced summaries (for takeover).  With
+            # no Scribe in the loop, sequencing the summarize op is the
+            # acceptance point; with one, summaryAck below confirms it.
             self.last_summary_seq = msg.contents["seq"]
             self.last_ack_handle = msg.contents["handle"]
             self.ops_since_summary = 0
+        elif msg.type is MessageType.SUMMARY_ACK:
+            self.last_acked_handle = msg.contents["handle"]
+            self.last_acked_seq = msg.contents["seq"]
+        elif msg.type is MessageType.SUMMARY_NACK:
+            # No immediate retry (a persistent nack reason would loop);
+            # the next ops_per_summary window naturally re-attempts — the
+            # deterministic in-proc analogue of the reference's backoff.
+            # Roll the takeover baseline back to the last *accepted* summary
+            # so a re-elected summarizer never builds on the rejected one.
+            self.nacks_received += 1
+            self.last_summary_seq = self.last_acked_seq
+            self.last_ack_handle = self.last_acked_handle
         if (
             self._is_summarizer
             and msg.type is not MessageType.SUMMARIZE

@@ -1,0 +1,18 @@
+"""The ordering-service slice: capability-equivalent of the reference's
+Routerlicious release group (SURVEY.md §2.3; upstream paths UNVERIFIED —
+empty reference mount), re-shaped for an in-process / single-host TPU
+deployment:
+
+- :mod:`oplog`    — Scriptorium capability: durable per-document op log.
+- :mod:`scribe`   — Scribe capability: summary validation + ack/nack.
+- :mod:`orderer`  — Deli + LocalOrderer + Alfred capability: per-document
+  sequencing with checkpoints, multi-document front door, signal fan-out.
+- :mod:`catchup`  — the scriptorium-fed bulk catch-up service that routes
+  replay through the TPU backend (the north-star service path).
+"""
+
+from .oplog import OpLog
+from .orderer import DocumentOrderer, LocalOrderingService
+from .scribe import Scribe
+
+__all__ = ["OpLog", "DocumentOrderer", "LocalOrderingService", "Scribe"]

@@ -1,0 +1,222 @@
+"""Bulk catch-up: fold many documents' op tails into fresh summaries.
+
+The north-star service path (BASELINE.json; SURVEY.md §3.2): the reference
+serves catch-up by handing the client a summary plus the scriptorium op
+tail, and *every client* replays that tail itself.  Here the service does
+the replay centrally, in bulk, on the device: op tails for thousands of
+documents are packed into ragged tensors and folded by the merge-tree
+kernel in one vmapped scan, producing summaries byte-identical to the CPU
+oracle — so loading clients start from a fresh summary and replay nothing.
+
+Device routing today covers the flagship document shape (string channels
+whose prior summary is empty, i.e. whole history in the log); everything
+else folds through the CPU container-runtime path.  The split/scatter is
+the shared :func:`partition_replay` bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..ops.batching import partition_replay
+from ..ops.mergetree_kernel import MergeTreeDocInput, replay_mergetree_batch
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.summary import SummaryTree, canonical_json
+from ..runtime.container import ContainerRuntime
+from ..runtime.registry import ChannelRegistry, default_registry
+from .orderer import LocalOrderingService
+
+STRING_TYPE = "sequence-tpu"
+
+
+@dataclasses.dataclass
+class _DocWork:
+    doc_id: str
+    summary: SummaryTree
+    ref_seq: int
+    tail: List[SequencedMessage]
+    # device plan: [(ds_id, channel_id), ...] or None (CPU fallback);
+    # computed once at partition time.
+    plan: Optional[List[Tuple[str, str]]] = None
+
+
+def flatten_channel_ops(
+    tail: Sequence[SequencedMessage], ds_id: str, channel_id: str
+) -> List[SequencedMessage]:
+    """Unwrap grouped-batch envelopes into the flat per-channel op stream a
+    replay kernel folds over.  Sub-ops keep the batch's sequence number —
+    the same view the oracle applies them under."""
+    out = []
+    for msg in tail:
+        if msg.type is not MessageType.OP:
+            continue
+        contents = msg.contents
+        if not isinstance(contents, dict) \
+                or contents.get("type") != "groupedBatch":
+            continue
+        for sub in contents["ops"]:
+            if sub["ds"] == ds_id and sub["channel"] == channel_id:
+                out.append(
+                    dataclasses.replace(msg, contents=sub["contents"])
+                )
+    return out
+
+
+class CatchupService:
+    """Scriptorium-fed bulk summarizer over (storage, oplog)."""
+
+    def __init__(
+        self,
+        service: LocalOrderingService,
+        registry: Optional[ChannelRegistry] = None,
+    ) -> None:
+        self.service = service
+        self.registry = registry if registry is not None else default_registry()
+        self.device_docs = 0
+        self.cpu_docs = 0
+
+    # -- public API ------------------------------------------------------------
+
+    def catch_up(
+        self,
+        doc_ids: Optional[Sequence[str]] = None,
+        upload: bool = True,
+    ) -> Dict[str, Tuple[str, int]]:
+        """Fold each document's tail; returns {doc_id: (handle, seq)}.
+        Documents with no new ops keep their current summary handle."""
+        works: List[_DocWork] = []
+        results: Dict[str, Tuple[str, int]] = {}
+        for doc_id in (doc_ids if doc_ids is not None
+                       else self.service.doc_ids()):
+            summary, ref_seq = self.service.storage.latest(doc_id)
+            if summary is None:
+                continue  # never attached: nothing to summarize from
+            tail = self.service.oplog.get(doc_id, from_seq=ref_seq)
+            if not tail:
+                results[doc_id] = (summary.digest(), ref_seq)
+                continue
+            work = _DocWork(doc_id, summary, ref_seq, tail)
+            work.plan = self._device_plan(work)
+            works.append(work)
+
+        trees = partition_replay(
+            works,
+            known_fallback=lambda w: w.plan is None,
+            fallback_fn=self._cpu_fold,
+            batch_fn=self._device_fold,
+        )
+        for work, tree in zip(works, trees):
+            seq = work.tail[-1].seq
+            if upload:
+                handle = self.service.storage.upload(work.doc_id, tree, seq)
+            else:
+                handle = tree.digest()
+            results[work.doc_id] = (handle, seq)
+        return results
+
+    # -- CPU path --------------------------------------------------------------
+
+    def _cpu_fold(self, work: _DocWork) -> SummaryTree:
+        self.cpu_docs += 1
+        runtime = ContainerRuntime(self.registry)
+        runtime.load(work.summary)
+        for msg in work.tail:
+            runtime.process(msg)
+        return runtime.summarize()
+
+    # -- device path -----------------------------------------------------------
+
+    def _device_plan(self, work: _DocWork):
+        """Device-eligible shape: every channel is a string channel with an
+        *empty* prior summary (whole history lives in the tail), so the
+        kernel can cold-fold each channel.  Returns the plan
+        [(ds_id, channel_id), ...] or None."""
+        try:
+            ds_root = work.summary.get(".datastores")
+        except KeyError:
+            return None
+        if work.ref_seq != 0:
+            return None  # warm-start state packing: CPU path for now
+        plan = []
+        for ds_id, subtree in ds_root.children.items():
+            if not isinstance(subtree, SummaryTree):
+                return None
+            try:
+                attrs = json.loads(subtree.blob_bytes(".attributes"))
+            except KeyError:
+                return None
+            for channel_id, type_name in attrs.items():
+                if type_name != STRING_TYPE:
+                    return None
+                plan.append((ds_id, channel_id))
+        return plan or None
+
+    def _device_fold(self, works: List[_DocWork]) -> List[SummaryTree]:
+        """Batch every (doc, channel) pair as one kernel input; reassemble
+        full container summary trees host-side, byte-identical to
+        ``ContainerRuntime.summarize()``."""
+        inputs: List[MergeTreeDocInput] = []
+        for work in works:
+            self.device_docs += 1
+            final_seq = work.tail[-1].seq
+            final_msn = max(m.min_seq for m in work.tail)
+            for ds_id, channel_id in work.plan:
+                inputs.append(
+                    MergeTreeDocInput(
+                        doc_id=f"{work.doc_id}/{ds_id}/{channel_id}",
+                        ops=flatten_channel_ops(work.tail, ds_id, channel_id),
+                        final_seq=final_seq,
+                        final_msn=final_msn,
+                    )
+                )
+        channel_trees = replay_mergetree_batch(inputs)
+
+        out: List[SummaryTree] = []
+        i = 0
+        for work in works:
+            final_seq = work.tail[-1].seq
+            final_msn = max(m.min_seq for m in work.tail)
+            quorum = self._fold_quorum(work)
+            tree = SummaryTree()
+            tree.add_blob(
+                ".metadata",
+                canonical_json({"seq": final_seq, "minSeq": final_msn}),
+            )
+            tree.add_blob(".protocol", canonical_json({"quorum": quorum}))
+            ds_tree = tree.add_tree(".datastores")
+            channel_by_pair = {
+                pair: channel_trees[i + k]
+                for k, pair in enumerate(work.plan)
+            }
+            by_ds: Dict[str, List[str]] = {}
+            for ds_id, channel_id in work.plan:
+                by_ds.setdefault(ds_id, []).append(channel_id)
+            for ds_id in sorted(by_ds):
+                sub = SummaryTree()
+                attrs = {}
+                for channel_id in sorted(by_ds[ds_id]):
+                    sub.children[channel_id] = channel_by_pair[
+                        (ds_id, channel_id)
+                    ]
+                    attrs[channel_id] = STRING_TYPE
+                sub.add_blob(".attributes", canonical_json(attrs))
+                ds_tree.children[ds_id] = sub
+            i += len(work.plan)
+            out.append(tree)
+        return out
+
+    def _fold_quorum(self, work: _DocWork) -> List[str]:
+        protocol = json.loads(work.summary.blob_bytes(".protocol"))
+        order: List[str] = list(protocol["quorum"])
+        for msg in work.tail:
+            if msg.type is MessageType.JOIN:
+                cid = msg.contents["clientId"]
+                if cid not in order:
+                    order.append(cid)
+            elif msg.type is MessageType.LEAVE:
+                cid = msg.contents["clientId"]
+                if cid in order:
+                    order.remove(cid)
+        return order

@@ -1,0 +1,230 @@
+"""Per-document orderer and the multi-document front door.
+
+Capability-equivalent of the reference's ``LocalOrderer`` (memory-orderer:
+deli + scribe + scriptorium lambdas wired in one process) plus the Alfred
+front door (document creation, per-client delta connections, signal fan-out)
+— SURVEY.md §2.3/§3.5; upstream paths UNVERIFIED, empty reference mount.
+
+The shape differs from Routerlicious deliberately: there is no Kafka hop —
+the sequencer broadcast *is* the bus, and the durable :class:`OpLog` append
+happens inside the broadcast (first subscriber), so the log is always at or
+ahead of any client's view and strictly ahead of the checkpoint.  Crash
+resume = restore checkpoint + replay the log tail into the sequencer/scribe
+state (exactly-once: ``replay`` never re-stamps).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.sequencer import Sequencer
+from ..protocol.summary import SummaryStorage
+from .oplog import OpLog
+from .scribe import Scribe
+
+SignalListener = Callable[[dict], None]
+
+
+class DocumentOrderer:
+    """One document's service state: sequencer + scribe + durable log."""
+
+    def __init__(
+        self,
+        doc_id: str,
+        oplog: OpLog,
+        storage: SummaryStorage,
+        sequencer: Optional[Sequencer] = None,
+    ) -> None:
+        self.doc_id = doc_id
+        self.oplog = oplog
+        self.storage = storage
+        self.sequencer = sequencer or Sequencer()
+        # Durable append rides first in the broadcast chain: by the time any
+        # client sees a message it is already in the log (scriptorium-before-
+        # broadcast, collapsing the reference's Kafka fan-out).
+        self.sequencer.subscribe(lambda msg: oplog.append(doc_id, msg))
+        self.scribe = Scribe(doc_id, self.sequencer, storage)
+        self._signal_listeners: List[SignalListener] = []
+
+    # -- signals (unsequenced ephemeral broadcast — presence rides this) -------
+
+    def submit_signal(self, client_id: str, content,
+                      target_client_id: Optional[str] = None) -> None:
+        signal = {
+            "clientId": client_id,
+            "content": content,
+            "targetClientId": target_client_id,
+        }
+        for fn in list(self._signal_listeners):
+            fn(signal)
+
+    def subscribe_signals(self, fn: SignalListener) -> None:
+        self._signal_listeners.append(fn)
+
+    def unsubscribe_signals(self, fn: SignalListener) -> None:
+        if fn in self._signal_listeners:
+            self._signal_listeners.remove(fn)
+
+    # -- checkpoint / crash-resume ---------------------------------------------
+
+    def checkpoint(self) -> dict:
+        return {
+            "sequencer": self.sequencer.checkpoint(),
+            "scribe": self.scribe.checkpoint(),
+        }
+
+    @staticmethod
+    def restore(
+        doc_id: str,
+        oplog: OpLog,
+        storage: SummaryStorage,
+        checkpoint: dict,
+    ) -> "DocumentOrderer":
+        """Resume after a crash: the checkpoint may lag the durable log;
+        the tail is replayed into sequencer + scribe state exactly-once.
+
+        Clients that died with the process remain in the quorum (their
+        dedup floors must survive for reconnect); the host is responsible
+        for ``disconnect``-ing ones that never return, or the MSN stays
+        pinned at their last ref_seq."""
+        checkpoint_seq = checkpoint["sequencer"]["seq"]
+        sequencer = Sequencer.restore(
+            checkpoint["sequencer"],
+            log=oplog.get(doc_id, to_seq=checkpoint_seq),
+        )
+        orderer = DocumentOrderer(doc_id, oplog, storage, sequencer=sequencer)
+        orderer.scribe.restore(checkpoint["scribe"])
+        for msg in oplog.get(doc_id, from_seq=checkpoint_seq):
+            sequencer.replay(msg)
+            orderer.scribe.replay(msg)
+        return orderer
+
+    @staticmethod
+    def recover(
+        doc_id: str, oplog: OpLog, storage: SummaryStorage
+    ) -> "DocumentOrderer":
+        """No checkpoint at all: rebuild everything from the durable log."""
+        orderer = DocumentOrderer(doc_id, oplog, storage)
+        for msg in oplog.get(doc_id):
+            orderer.sequencer.replay(msg)
+            orderer.scribe.replay(msg)
+        return orderer
+
+
+class DocumentEndpoint:
+    """A per-document connection facade handed to clients/drivers.
+
+    Satisfies the ``ContainerRuntime.connect`` contract — ``submit``,
+    ``subscribe``, ``connect``, ``log`` — plus signals and ranged delta
+    reads, so the same runtime code runs against the in-proc sequencer,
+    this service, or a remote driver.
+    """
+
+    def __init__(self, orderer: DocumentOrderer) -> None:
+        self._orderer = orderer
+
+    @property
+    def doc_id(self) -> str:
+        return self._orderer.doc_id
+
+    @property
+    def log(self) -> List[SequencedMessage]:
+        return self._orderer.oplog.get(self._orderer.doc_id)
+
+    @property
+    def head_seq(self) -> int:
+        return self._orderer.sequencer.seq
+
+    def connect(self, client_id: str) -> None:
+        self._orderer.sequencer.connect(client_id)
+
+    def disconnect(self, client_id: str) -> None:
+        self._orderer.sequencer.disconnect(client_id)
+
+    def submit(self, op: RawOperation) -> Optional[SequencedMessage]:
+        return self._orderer.sequencer.submit(op)
+
+    def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        self._orderer.sequencer.subscribe(fn)
+
+    def unsubscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        self._orderer.sequencer.unsubscribe(fn)
+
+    def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
+        self._orderer.sequencer.update_ref_seq(client_id, ref_seq)
+
+    def deltas(self, from_seq: int = 0,
+               to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        return self._orderer.oplog.get(self._orderer.doc_id, from_seq, to_seq)
+
+    def submit_signal(self, client_id: str, content,
+                      target_client_id: Optional[str] = None) -> None:
+        self._orderer.submit_signal(client_id, content, target_client_id)
+
+    def subscribe_signals(self, fn: SignalListener) -> None:
+        self._orderer.subscribe_signals(fn)
+
+    def unsubscribe_signals(self, fn: SignalListener) -> None:
+        self._orderer.unsubscribe_signals(fn)
+
+
+class LocalOrderingService:
+    """Multi-document ordering service in one process — the Tinylicious
+    capability point: create/load documents, connect clients, store
+    summaries, serve catch-up deltas."""
+
+    def __init__(
+        self,
+        oplog: Optional[OpLog] = None,
+        storage: Optional[SummaryStorage] = None,
+    ) -> None:
+        self.oplog = oplog if oplog is not None else OpLog()
+        self.storage = storage if storage is not None else SummaryStorage()
+        self._orderers: Dict[str, DocumentOrderer] = {}
+
+    def create_document(self, doc_id: str) -> DocumentEndpoint:
+        if doc_id in self._orderers:
+            raise ValueError(f"document {doc_id!r} already exists")
+        self._orderers[doc_id] = DocumentOrderer(
+            doc_id, self.oplog, self.storage
+        )
+        return DocumentEndpoint(self._orderers[doc_id])
+
+    def has_document(self, doc_id: str) -> bool:
+        return doc_id in self._orderers or self.oplog.head(doc_id) > 0
+
+    def endpoint(self, doc_id: str) -> DocumentEndpoint:
+        """Connect-or-recover: an existing orderer is reused; a document
+        present only in the durable log (service restart) is recovered by
+        replaying the log into a fresh orderer."""
+        orderer = self._orderers.get(doc_id)
+        if orderer is None:
+            if self.oplog.head(doc_id) == 0:
+                raise KeyError(f"document {doc_id!r} does not exist")
+            orderer = DocumentOrderer.recover(
+                doc_id, self.oplog, self.storage
+            )
+            self._orderers[doc_id] = orderer
+        return DocumentEndpoint(orderer)
+
+    def doc_ids(self) -> List[str]:
+        ids = set(self._orderers) | set(self.oplog.doc_ids())
+        return sorted(ids)
+
+    def checkpoint(self) -> dict:
+        return {
+            doc_id: orderer.checkpoint()
+            for doc_id, orderer in sorted(self._orderers.items())
+        }
+
+    @staticmethod
+    def restore(
+        oplog: OpLog, storage: SummaryStorage, checkpoint: dict
+    ) -> "LocalOrderingService":
+        service = LocalOrderingService(oplog, storage)
+        for doc_id, doc_checkpoint in checkpoint.items():
+            service._orderers[doc_id] = DocumentOrderer.restore(
+                doc_id, oplog, storage, doc_checkpoint
+            )
+        return service

@@ -1,0 +1,364 @@
+"""Service slice: durable op log, scribe ack/nack, checkpoints/crash-resume,
+multi-document ordering service, bulk catch-up (CPU + device paths)."""
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    RawOperation,
+)
+from fluidframework_tpu.protocol.sequencer import Sequencer
+from fluidframework_tpu.protocol.summary import SummaryStorage
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.runtime.summarizer import (
+    SummarizerOptions,
+    SummaryManager,
+)
+from fluidframework_tpu.service import (
+    LocalOrderingService,
+    OpLog,
+)
+from fluidframework_tpu.service.catchup import CatchupService
+
+
+def op(client, client_seq, ref_seq=0, contents=None):
+    return RawOperation(
+        client_id=client, client_seq=client_seq, ref_seq=ref_seq,
+        type=MessageType.OP, contents=contents or {"k": client_seq},
+    )
+
+
+# --- OpLog -------------------------------------------------------------------
+
+
+def test_oplog_ranged_reads():
+    service = LocalOrderingService()
+    ep = service.create_document("d1")
+    ep.connect("a")
+    for i in range(1, 6):
+        ep.submit(op("a", i))
+    # seq 1 is the JOIN; ops are seqs 2..6
+    assert service.oplog.head("d1") == 6
+    tail = ep.deltas(from_seq=3)
+    assert [m.seq for m in tail] == [4, 5, 6]
+    window = ep.deltas(from_seq=1, to_seq=4)
+    assert [m.seq for m in window] == [2, 3, 4]
+
+
+def test_oplog_file_persistence(tmp_path):
+    path = str(tmp_path / "ops.jsonl")
+    log = OpLog(path)
+    service = LocalOrderingService(oplog=log)
+    ep = service.create_document("doc")
+    ep.connect("a")
+    for i in range(1, 4):
+        ep.submit(op("a", i, contents={"text": f"op{i}"}))
+    log.close()
+
+    reopened = OpLog(path)
+    assert reopened.head("doc") == 4
+    msgs = reopened.get("doc")
+    assert [m.seq for m in msgs] == [1, 2, 3, 4]
+    assert msgs[0].type is MessageType.JOIN
+    assert msgs[1].contents == {"text": "op1"}
+
+
+# --- Scribe ------------------------------------------------------------------
+
+
+def _connected_runtime_with_string(service, doc_id, client_id):
+    ep = service.create_document(doc_id) if not service.has_document(doc_id) \
+        else service.endpoint(doc_id)
+    runtime = ContainerRuntime()
+    ds = runtime.create_datastore("ds")
+    text = ds.create_channel("sequence-tpu", "text")
+    runtime.connect(ep, client_id)
+    runtime.drain()
+    return runtime, ds, text, ep
+
+
+def test_scribe_acks_valid_summary():
+    service = LocalOrderingService()
+    runtime, _ds, text, ep = _connected_runtime_with_string(
+        service, "doc", "a"
+    )
+    mgr = SummaryManager(runtime, service.storage, "doc",
+                         SummarizerOptions(ops_per_summary=1000))
+    text.insert_text(0, "hello")
+    runtime.drain()
+    mgr.summarize_now()
+    runtime.drain()
+    orderer = service._orderers["doc"]
+    assert orderer.scribe.acks == 1
+    assert orderer.scribe.nacks == 0
+    assert mgr.last_acked_handle == orderer.scribe.last_acked_handle
+    # ack is a durable, sequenced message
+    types = [m.type for m in ep.log]
+    assert MessageType.SUMMARY_ACK in types
+
+
+def test_scribe_nacks_unknown_handle():
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect("a")
+    ep.submit(
+        RawOperation(
+            client_id="a", client_seq=1, ref_seq=0,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": "deadbeef", "seq": 0},
+        )
+    )
+    orderer = service._orderers["doc"]
+    assert orderer.scribe.nacks == 1
+    nacks = [m for m in ep.log if m.type is MessageType.SUMMARY_NACK]
+    assert len(nacks) == 1
+    assert "unknown" in nacks[0].contents["reason"]
+
+
+def test_scribe_nacks_stale_summary():
+    service = LocalOrderingService()
+    runtime, _ds, text, ep = _connected_runtime_with_string(
+        service, "doc", "a"
+    )
+    mgr = SummaryManager(runtime, service.storage, "doc",
+                         SummarizerOptions(ops_per_summary=1000))
+    text.insert_text(0, "hello")
+    runtime.drain()
+    first = mgr.summarize_now()
+    runtime.drain()
+    # Re-announce an older summary point than the accepted one.
+    stale_seq = runtime.ref_seq
+    text.insert_text(5, " world")
+    runtime.drain()
+    second = mgr.summarize_now()
+    runtime.drain()
+    assert second != first
+    # Now replay the *first* (older ref_seq) announcement again.
+    orderer = service._orderers["doc"]
+    before_nacks = orderer.scribe.nacks
+    ep.submit(
+        RawOperation(
+            client_id="a", client_seq=999, ref_seq=runtime.ref_seq,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": first, "seq": 1},
+        )
+    )
+    assert orderer.scribe.nacks == before_nacks + 1
+
+
+# --- checkpoints / crash-resume ----------------------------------------------
+
+
+def test_sequencer_checkpoint_roundtrip():
+    seq = Sequencer()
+    seq.connect("a")
+    seq.connect("b")
+    seq.submit(op("a", 1, ref_seq=1))
+    seq.submit(op("b", 1, ref_seq=2))
+    state = seq.checkpoint()
+    restored = Sequencer.restore(state)
+    assert restored.seq == seq.seq
+    assert restored.min_seq == seq.min_seq
+    # dedup floors survive: an old client_seq is still rejected
+    assert restored.submit(op("a", 1, ref_seq=2)) is None
+    assert restored.submit(op("a", 2, ref_seq=2)) is not None
+
+
+def test_crash_resume_from_stale_checkpoint(tmp_path):
+    """Checkpoint taken early; more ops land; service crashes.  The restored
+    orderer must resume from the durable log exactly-once: no re-stamping,
+    dedup floors reconstructed from the tail."""
+    path = str(tmp_path / "ops.jsonl")
+    log = OpLog(path)
+    service = LocalOrderingService(oplog=log)
+    ep = service.create_document("doc")
+    ep.connect("a")
+    ep.submit(op("a", 1))
+    checkpoint = service.checkpoint()  # taken at seq 2
+    ep.submit(op("a", 2))
+    ep.submit(op("a", 3, ref_seq=3))
+    log.close()  # "crash"
+
+    log2 = OpLog(path)
+    restored = LocalOrderingService.restore(
+        log2, SummaryStorage(), checkpoint
+    )
+    ep2 = restored.endpoint("doc")
+    assert ep2.head_seq == 4  # JOIN + 3 ops, none re-stamped
+    # dedup floor covers ops sequenced after the checkpoint
+    assert ep2.submit(op("a", 3, ref_seq=3)) is None
+    msg = ep2.submit(op("a", 4, ref_seq=4))
+    assert msg is not None and msg.seq == 5
+    assert log2.head("doc") == 5
+
+
+def test_endpoint_recovers_doc_from_log_only(tmp_path):
+    """Service restarted with no checkpoint at all: a document that exists
+    only in the durable log is recovered by full log replay."""
+    path = str(tmp_path / "ops.jsonl")
+    log = OpLog(path)
+    service = LocalOrderingService(oplog=log)
+    ep = service.create_document("doc")
+    ep.connect("a")
+    ep.submit(op("a", 1))
+    ep.submit(op("a", 2))
+    log.close()
+
+    service2 = LocalOrderingService(oplog=OpLog(path))
+    assert service2.has_document("doc")
+    ep2 = service2.endpoint("doc")
+    assert ep2.head_seq == 3
+    assert ep2.submit(op("a", 2)) is None  # dedup floor recovered
+    assert ep2.submit(op("a", 3)) is not None
+
+
+def test_reconnect_same_client_after_crash_resume(tmp_path):
+    """A surviving client reconnects with its old id after the service
+    restores: connect is idempotent (no duplicate JOIN), the dedup floor
+    survives, and disconnecting the truly-dead client unpins the MSN."""
+    path = str(tmp_path / "ops.jsonl")
+    service = LocalOrderingService(oplog=OpLog(path))
+    ep = service.create_document("doc")
+    ep.connect("alive")
+    ep.connect("dead")
+    ep.submit(op("alive", 1, ref_seq=2))
+    ep.submit(op("dead", 1, ref_seq=2))
+    checkpoint = service.checkpoint()
+    service.oplog.close()
+
+    restored = LocalOrderingService.restore(
+        OpLog(path), SummaryStorage(), checkpoint
+    )
+    ep2 = restored.endpoint("doc")
+    joins_before = sum(1 for m in ep2.log if m.type is MessageType.JOIN)
+    ep2.connect("alive")  # reconnect: no error, no duplicate JOIN
+    assert sum(1 for m in ep2.log if m.type is MessageType.JOIN) \
+        == joins_before
+    assert ep2.submit(op("alive", 1, ref_seq=2)) is None  # floor survived
+    # the dead client pins the MSN until the host disconnects it
+    ep2.disconnect("dead")
+    msg = ep2.submit(op("alive", 2, ref_seq=ep2.head_seq))
+    assert msg.min_seq == msg.ref_seq
+
+
+def test_signals_are_unsequenced():
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect("a")
+    seen = []
+    ep.subscribe_signals(seen.append)
+    head_before = ep.head_seq
+    ep.submit_signal("a", {"cursor": 7})
+    ep.submit_signal("a", {"cursor": 8}, target_client_id="b")
+    assert [s["content"]["cursor"] for s in seen] == [7, 8]
+    assert seen[1]["targetClientId"] == "b"
+    assert ep.head_seq == head_before  # nothing sequenced, nothing logged
+
+
+# --- bulk catch-up -----------------------------------------------------------
+
+
+def _seed_string_doc(service, doc_id, edits, n_clients=2):
+    """Attach a single-string-channel doc (initial summary at seq 0), then
+    drive `edits` ops through connected runtimes."""
+    ep = service.create_document(doc_id)
+    seeded = ContainerRuntime()
+    ds = seeded.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+    service.storage.upload(doc_id, seeded.summarize(), 0)
+
+    runtimes = []
+    for c in range(n_clients):
+        rt = ContainerRuntime()
+        rt.load(service.storage.latest(doc_id)[0])
+        rt.connect(ep, f"client{c}")
+        rt.drain()
+        runtimes.append(rt)
+
+    import random
+    rng = random.Random(doc_id)
+    for i in range(edits):
+        rt = runtimes[i % n_clients]
+        text = rt.get_datastore("ds").get_channel("text")
+        length = len(text.text)
+        if length < 4 or rng.random() < 0.7:
+            text.insert_text(rng.randint(0, length), "ab"[i % 2] * 3)
+        else:
+            start = rng.randint(0, length - 2)
+            text.remove_range(start, min(length, start + 2))
+        for r in runtimes:
+            r.drain()
+    return runtimes
+
+
+def test_catchup_cpu_vs_device_byte_identical():
+    service = LocalOrderingService()
+    for d in range(3):
+        _seed_string_doc(service, f"doc{d}", edits=12)
+
+    cpu = CatchupService(service)
+    # force CPU by making the device plan fail
+    cpu._device_plan = lambda w: None
+    cpu_results = cpu.catch_up(upload=False)
+
+    dev = CatchupService(service)
+    dev_results = dev.catch_up(upload=False)
+    assert dev.device_docs == 3
+    assert cpu_results == dev_results
+
+
+def test_catchup_uploads_and_is_incremental():
+    service = LocalOrderingService()
+    runtimes = _seed_string_doc(service, "doc", edits=8)
+    svc = CatchupService(service)
+    first = svc.catch_up()
+    handle, seq = first["doc"]
+    latest_tree, latest_seq = service.storage.latest("doc")
+    assert latest_tree.digest() == handle and latest_seq == seq
+
+    # no new ops: same handle, no re-upload of a new commit
+    again = svc.catch_up()
+    assert again["doc"] == (handle, seq)
+
+    # a loading client starts from the fresh summary with an empty tail
+    loader_rt = ContainerRuntime()
+    loaded_seq = loader_rt.load(latest_tree)
+    tail = service.oplog.get("doc", from_seq=loaded_seq)
+    assert tail == []
+    live_text = runtimes[0].get_datastore("ds").get_channel("text")
+    assert (
+        loader_rt.get_datastore("ds").get_channel("text").text
+        == live_text.text
+    )
+
+
+def test_catchup_mixed_eligibility():
+    """String docs go to the device; a map doc folds on CPU; results land
+    for both."""
+    service = LocalOrderingService()
+    _seed_string_doc(service, "strdoc", edits=6)
+
+    ep = service.create_document("mapdoc")
+    seeded = ContainerRuntime()
+    ds = seeded.create_datastore("ds")
+    ds.create_channel("map-tpu", "kv")
+    service.storage.upload("mapdoc", seeded.summarize(), 0)
+    rt = ContainerRuntime()
+    rt.load(service.storage.latest("mapdoc")[0])
+    rt.connect(ep, "m0")
+    rt.drain()
+    kv = rt.get_datastore("ds").get_channel("kv")
+    kv.set("x", 1)
+    kv.set("y", 2)
+    rt.drain()
+
+    svc = CatchupService(service)
+    results = svc.catch_up()
+    assert svc.device_docs == 1 and svc.cpu_docs == 1
+    assert set(results) == {"strdoc", "mapdoc"}
+
+    tree, _seq = service.storage.latest("mapdoc")
+    check = ContainerRuntime()
+    check.load(tree)
+    loaded_kv = check.get_datastore("ds").get_channel("kv")
+    assert loaded_kv.get("x") == 1 and loaded_kv.get("y") == 2
