@@ -45,6 +45,15 @@ class SharedCell(SharedObject):
             self._pending_writes += 1
         self._submit_local_op({"kind": "delete"})
 
+    def apply_stashed_op(self, contents) -> None:
+        kind = contents["kind"]
+        if kind == "set":
+            self.set(contents["value"])
+        elif kind == "delete":
+            self.delete()
+        else:
+            raise ValueError(f"unknown stashed cell op {kind!r}")
+
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
         if local:
             self._pending_writes -= 1
@@ -87,6 +96,9 @@ class SharedCounter(SharedObject):
             raise TypeError("counter delta must be an integer")
         self._value += delta  # optimistic; increments commute
         self._submit_local_op({"kind": "increment", "delta": delta})
+
+    def apply_stashed_op(self, contents) -> None:
+        self.increment(contents["delta"])
 
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
         if local:

@@ -152,6 +152,17 @@ class SharedMap(SharedObject):
         self._kernel.local_clear(self.is_attached)
         self._submit_local_op({"kind": "clear"})
 
+    def apply_stashed_op(self, contents) -> None:
+        kind = contents["kind"]
+        if kind == "set":
+            self.set(contents["key"], contents["value"])
+        elif kind == "delete":
+            self.delete(contents["key"])
+        elif kind == "clear":
+            self.clear()
+        else:
+            raise ValueError(f"unknown stashed map op {kind!r}")
+
     # -- SharedObject ----------------------------------------------------------
 
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
@@ -254,6 +265,21 @@ class SharedDirectory(SharedObject):
         self._submit_local_op({"kind": "deleteSubdir", "path": path})
 
     # -- SharedObject ----------------------------------------------------------
+
+    def apply_stashed_op(self, contents) -> None:
+        kind = contents["kind"]
+        if kind == "set":
+            self.set(contents["key"], contents["value"], contents["path"])
+        elif kind == "delete":
+            self.delete(contents["key"], contents["path"])
+        elif kind == "clear":
+            self.clear(contents["path"])
+        elif kind == "createSubdir":
+            self.create_subdirectory(contents["path"])
+        elif kind == "deleteSubdir":
+            self.delete_subdirectory(contents["path"])
+        else:
+            raise ValueError(f"unknown stashed directory op {kind!r}")
 
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
         op = msg.contents

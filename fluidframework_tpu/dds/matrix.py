@@ -279,6 +279,22 @@ class SharedMatrix(SharedObject):
         if not self.is_attached:
             self._policy = "fww"
 
+    def apply_stashed_op(self, contents) -> None:
+        kind = contents["kind"]
+        if kind in ("insertRows", "insertCols"):
+            self._insert_axis(self._axis_for(kind), kind,
+                              contents["pos"], contents["count"])
+        elif kind in ("removeRows", "removeCols"):
+            self._remove_axis(self._axis_for(kind), kind, contents["start"],
+                              contents["end"] - contents["start"])
+        elif kind == "setCell":
+            self.set_cell(contents["row"], contents["col"],
+                          contents["value"])
+        elif kind == "setPolicy":
+            self.switch_policy(contents["policy"])
+        else:
+            raise ValueError(f"unknown stashed matrix op {kind!r}")
+
     # -- sequenced path --------------------------------------------------------
 
     def _axis_for(self, kind: str) -> PermutationVector:

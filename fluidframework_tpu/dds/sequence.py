@@ -147,6 +147,21 @@ class SharedString(SharedObject):
             label, {"kind": "intervalDelete", "label": label, "id": interval_id}
         )
 
+    def apply_stashed_op(self, contents) -> None:
+        kind = contents["kind"]
+        if kind == "insert":
+            self.insert_text(contents["pos"], contents["text"],
+                             contents.get("props"))
+        elif kind == "remove":
+            self.remove_range(contents["start"], contents["end"])
+        elif kind == "annotate":
+            self.annotate_range(contents["start"], contents["end"],
+                                contents["props"])
+        elif kind.startswith("interval"):
+            self._submit_interval_op(contents["label"], contents)
+        else:
+            raise ValueError(f"unknown stashed sequence op {kind!r}")
+
     def _ack_detached(self, group: SegmentGroup, op: dict) -> None:
         """Detached (never-connected) DDS: ops are immediately 'sequenced'
         locally at seq 0 so the state is summary-ready."""

@@ -105,6 +105,17 @@ class SharedObject:
 
     # -- subclass surface ------------------------------------------------------
 
+    def apply_stashed_op(self, contents: Any) -> None:
+        """Re-apply a stashed (crashed-session pending) op as a fresh local
+        mutation: optimistic apply + submit.  Called by the loader's
+        rehydrate path with the channel's state positioned exactly where it
+        was when the op was created (summary + tail to the stash's ref_seq),
+        so position-carrying contents resolve identically.  Capability
+        parity with the reference's per-DDS ``applyStashedOp``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support stashed-op rehydration"
+        )
+
     def discard_pending(self) -> None:
         """Forget in-flight ops (used by load(): state resets make their acks
         meaningless; the floor keeps late acks from tripping the FIFO)."""

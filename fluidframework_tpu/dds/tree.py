@@ -702,6 +702,11 @@ class SharedTree(SharedObject):
             apply_changeset(self.seq_forest, cs, seq=0)
         self._invalidate()
 
+    def apply_stashed_op(self, contents) -> None:
+        # Changesets are id-addressed: no positional rebase needed — re-apply
+        # as a fresh local edit on the rehydrated state.
+        self._submit_changeset(contents)
+
     # -- sequenced apply (SharedObject) ----------------------------------------
 
     def _process_core(self, msg: SequencedMessage, local: bool, meta) -> None:

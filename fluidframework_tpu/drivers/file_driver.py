@@ -1,0 +1,115 @@
+"""File driver: a durable single-host deployment of the whole service.
+
+Capability parity with the reference's file-driver + Historian/gitrest
+storage (SURVEY.md §2.3/§2.4: summaries stored as content-addressed
+objects — literally git's blob/tree model — plus per-document commit
+history).  Everything lives under one directory:
+
+    <root>/ops.jsonl          — the durable op log (OpLog format)
+    <root>/objects/<digest>   — content-addressed summary nodes (JSON)
+    <root>/commits.jsonl      — (doc_id, handle, ref_seq) commit records
+
+Reopening the directory restores the full service: documents recover from
+the op log, summaries from the object store."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Optional, Union
+
+from ..protocol.summary import SummaryBlob, SummaryStorage, SummaryTree
+from ..service.oplog import OpLog
+from ..service.orderer import LocalOrderingService
+from .local_driver import LocalDocumentServiceFactory
+
+
+def _serialize_node(node: Union[SummaryTree, SummaryBlob]) -> bytes:
+    if isinstance(node, SummaryBlob):
+        obj = {"kind": "blob",
+               "content": base64.b64encode(node.content).decode("ascii")}
+    else:
+        obj = {"kind": "tree",
+               "children": {name: child.digest()
+                            for name, child in sorted(node.children.items())}}
+    return json.dumps(obj, sort_keys=True).encode("utf-8")
+
+
+class FileSummaryStorage(SummaryStorage):
+    """Content-addressed summary store persisted to a directory."""
+
+    def __init__(self, root: str) -> None:
+        super().__init__()
+        self.root = root
+        self._objects_dir = os.path.join(root, "objects")
+        self._commits_path = os.path.join(root, "commits.jsonl")
+        os.makedirs(self._objects_dir, exist_ok=True)
+        if os.path.exists(self._commits_path):
+            with open(self._commits_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    self._commits.setdefault(rec["doc"], []).append(
+                        (rec["handle"], rec["refSeq"])
+                    )
+
+    # -- persistence hooks -----------------------------------------------------
+
+    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int) -> str:
+        handle = super().upload(doc_id, tree, ref_seq)
+        with open(self._commits_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"doc": doc_id, "handle": handle, "refSeq": ref_seq},
+                sort_keys=True,
+            ) + "\n")
+        return handle
+
+    def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
+        digest = super()._store(node)
+        path = os.path.join(self._objects_dir, digest)
+        if not os.path.exists(path):  # content-addressed: write-once
+            with open(path, "wb") as f:
+                f.write(_serialize_node(node))
+        return digest
+
+    # -- lazy reads from disk (latest() inherits these via read()) -------------
+
+    def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
+        cached = self._objects.get(handle)
+        if cached is not None:
+            return cached
+        node = self._load_from_disk(handle)
+        self._objects[handle] = node
+        return node
+
+    def _load_from_disk(self, digest: str) -> Union[SummaryTree, SummaryBlob]:
+        path = os.path.join(self._objects_dir, digest)
+        if not os.path.exists(path):
+            raise KeyError(digest)
+        with open(path, "rb") as f:
+            obj = json.loads(f.read())
+        if obj["kind"] == "blob":
+            return SummaryBlob(base64.b64decode(obj["content"]))
+        tree = SummaryTree()
+        for name, child_digest in obj["children"].items():
+            tree.children[name] = self.read(child_digest)
+        return tree
+
+
+class FileDocumentServiceFactory(LocalDocumentServiceFactory):
+    """The whole service stack rooted in one directory; reopen to resume."""
+
+    def __init__(self, root: str) -> None:
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        service = LocalOrderingService(
+            oplog=OpLog(os.path.join(root, "ops.jsonl")),
+            storage=FileSummaryStorage(root),
+        )
+        super().__init__(service)
+
+    def close(self) -> None:
+        self.service.oplog.close()

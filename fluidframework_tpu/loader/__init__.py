@@ -1,0 +1,13 @@
+"""Loader layer: container lifecycle, delta manager, audience, pending
+state (SURVEY.md §1 layer 4 — the reference's container-loader package)."""
+
+from .delta_manager import ConnectionState, DeltaManager
+from .loader import Audience, Container, Loader
+
+__all__ = [
+    "Audience",
+    "Container",
+    "ConnectionState",
+    "DeltaManager",
+    "Loader",
+]

@@ -1,0 +1,164 @@
+"""DeltaManager — the loader's op pipeline and connection state machine.
+
+Capability-equivalent of the reference's ``DeltaManager`` +
+``ConnectionManager`` + ``ConnectionStateHandler`` (SURVEY.md §2.1
+container-loader; upstream paths UNVERIFIED — empty reference mount):
+
+- presents the ordering-service surface the container runtime expects
+  (``submit`` / ``subscribe`` / ``connect`` / ``log``) while owning the
+  *transport* concerns beneath it;
+- delivers strictly **gap-free, in-order** messages: a live message that
+  skips ahead parks in a buffer while the missing range is fetched from
+  delta storage (the reference's fetchMissingDeltas path);
+- tracks connection state (disconnected → connecting → catching_up →
+  connected) and supports explicit disconnect/reconnect against the same
+  or a new document service;
+- read-only mode rejects local submits at the edge (the reference's
+  forced-readonly capability).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from typing import Callable, List, Optional
+
+from ..protocol.messages import RawOperation, SequencedMessage
+
+_session_counter = itertools.count(1)
+
+
+class ConnectionState(enum.Enum):
+    DISCONNECTED = "disconnected"
+    CONNECTING = "connecting"
+    CATCHING_UP = "catching_up"
+    CONNECTED = "connected"
+    CLOSED = "closed"
+
+
+class DeltaManager:
+    """Gap-free ordered delivery + connection lifecycle over a driver."""
+
+    def __init__(self, document_service) -> None:
+        self._service = document_service
+        self.state = ConnectionState.DISCONNECTED
+        self.client_id: Optional[str] = None
+        self.read_only = False
+        self.last_delivered_seq = 0
+        self.gaps_repaired = 0
+        self._subscribers: List[Callable[[SequencedMessage], None]] = []
+        self._ahead: dict = {}  # seq -> parked out-of-order message
+        self._live_fn = None
+        # Connection epoch: reconnects from THIS manager resume the same
+        # sequencer-side record (dedup floor preserved); a different
+        # manager reusing the client id gets a fresh record.
+        self._session = f"dm-{id(self)}-{next(_session_counter)}"
+
+    # -- the service surface handed to ContainerRuntime ------------------------
+
+    @property
+    def log(self) -> List[SequencedMessage]:
+        """Durable backfill feed (runtime.connect catch-up reads this) —
+        only the tail this manager has not already delivered/accounted."""
+        return self._service.delta_storage.get(
+            from_seq=self.last_delivered_seq
+        )
+
+    def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        self._subscribers.append(fn)
+
+    def connect(self, client_id: str) -> None:
+        if self.state is ConnectionState.CLOSED:
+            raise RuntimeError("delta manager is closed")
+        self.state = ConnectionState.CONNECTING
+        self.client_id = client_id
+        conn = self._service.connection()
+        self._live_fn = self._on_live
+        conn.subscribe(self._live_fn)
+        conn.connect(client_id, self._session)
+        self.state = ConnectionState.CONNECTED
+
+    @property
+    def can_send(self) -> bool:
+        """Offline holds ops in the runtime outbox; read-only stays True so
+        the submit path raises loudly at mutation time instead."""
+        return self.state is ConnectionState.CONNECTED
+
+    def submit(self, op: RawOperation):
+        if self.read_only:
+            raise PermissionError("container is in read-only mode")
+        if self.state is not ConnectionState.CONNECTED:
+            raise ConnectionError(f"not connected (state={self.state.value})")
+        return self._service.connection().submit(op)
+
+    # -- signals ---------------------------------------------------------------
+
+    def submit_signal(self, content, target_client_id: Optional[str] = None):
+        self._service.connection().submit_signal(
+            self.client_id, content, target_client_id
+        )
+
+    def subscribe_signals(self, fn) -> None:
+        self._service.connection().subscribe_signals(fn)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def disconnect(self) -> None:
+        if self.state in (ConnectionState.DISCONNECTED, ConnectionState.CLOSED):
+            return
+        conn = self._service.connection()
+        if self._live_fn is not None:
+            conn.unsubscribe(self._live_fn)
+            self._live_fn = None
+        if self.client_id is not None:
+            conn.disconnect(self.client_id)
+        self.state = ConnectionState.DISCONNECTED
+
+    def reconnect(self, client_id: Optional[str] = None,
+                  document_service=None) -> None:
+        """Drop the old connection (if any) and establish a fresh one,
+        optionally against a new resolved service (new endpoint after a
+        service restart)."""
+        self.disconnect()
+        if document_service is not None:
+            self._service = document_service
+        self.connect(client_id if client_id is not None else self.client_id)
+
+    def close(self) -> None:
+        self.disconnect()
+        self.state = ConnectionState.CLOSED
+
+    # -- ordered, gap-free delivery --------------------------------------------
+
+    def note_delivered(self, seq: int) -> None:
+        """The container loaded a summary / replayed storage up to ``seq``
+        outside the live path; future live delivery resumes after it."""
+        self.last_delivered_seq = max(self.last_delivered_seq, seq)
+
+    def _on_live(self, msg: SequencedMessage) -> None:
+        if msg.seq <= self.last_delivered_seq:
+            return  # duplicate of something storage already served
+        if msg.seq > self.last_delivered_seq + 1:
+            # A gap: park this message, repair from durable storage.
+            self._ahead[msg.seq] = msg
+            self.state = ConnectionState.CATCHING_UP
+            missing = self._service.delta_storage.get(
+                from_seq=self.last_delivered_seq, to_seq=msg.seq - 1
+            )
+            self.gaps_repaired += 1
+            for m in missing:
+                self._deliver(m)
+        else:
+            self._deliver(msg)
+        # Drain any parked messages that are now contiguous.
+        while self.last_delivered_seq + 1 in self._ahead:
+            self._deliver(self._ahead.pop(self.last_delivered_seq + 1))
+        if self.state is ConnectionState.CATCHING_UP and not self._ahead:
+            self.state = ConnectionState.CONNECTED
+
+    def _deliver(self, msg: SequencedMessage) -> None:
+        if msg.seq <= self.last_delivered_seq:
+            return
+        self.last_delivered_seq = msg.seq
+        for fn in list(self._subscribers):
+            fn(msg)

@@ -1,0 +1,262 @@
+"""Loader and Container — document lifecycle above the driver layer.
+
+Capability-equivalent of the reference's ``Loader.resolve()`` /
+``Container`` (SURVEY.md §2.1 container-loader, §3.2 load+catch-up path;
+upstream paths UNVERIFIED — empty reference mount):
+
+- **create**: build initial state, upload the attach summary, connect;
+- **load**: latest summary → catch-up replay of the op tail from delta
+  storage → live connection → connected (THE north-star client path);
+- **audience**: who is in the collaboration, folded from join/leave;
+- **pending state**: ``close_and_get_pending_state()`` captures unacked
+  local ops; ``Loader.resolve(..., pending_state=...)`` rehydrates them
+  (the reference's stashed-ops offline/crash-resume flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..runtime.container import ContainerRuntime
+from ..runtime.registry import ChannelRegistry
+from .delta_manager import ConnectionState, DeltaManager
+
+
+class Audience:
+    """Connected-client roster, folded from the sequenced join/leave stream
+    (the reference's IAudience)."""
+
+    def __init__(self) -> None:
+        self._members: Dict[str, dict] = {}
+
+    def observe(self, msg: SequencedMessage) -> None:
+        if msg.type is MessageType.JOIN:
+            cid = msg.contents["clientId"]
+            self._members[cid] = {"clientId": cid, "joinedSeq": msg.seq}
+        elif msg.type is MessageType.LEAVE:
+            self._members.pop(msg.contents["clientId"], None)
+
+    @property
+    def members(self) -> List[str]:
+        return sorted(self._members)
+
+    def get(self, client_id: str) -> Optional[dict]:
+        return self._members.get(client_id)
+
+
+class Container:
+    """One loaded document: runtime + delta manager + audience."""
+
+    def __init__(
+        self,
+        doc_id: str,
+        runtime: ContainerRuntime,
+        delta_manager: DeltaManager,
+    ) -> None:
+        self.doc_id = doc_id
+        self.runtime = runtime
+        self.delta_manager = delta_manager
+        self.audience = Audience()
+        # Observe through the runtime so every processed message — backfill
+        # and live alike — folds into the audience.
+        runtime.message_observers.append(self.audience.observe)
+        self.closed = False
+
+    # -- state -----------------------------------------------------------------
+
+    @property
+    def connection_state(self) -> ConnectionState:
+        return self.delta_manager.state
+
+    @property
+    def connected(self) -> bool:
+        return self.delta_manager.state is ConnectionState.CONNECTED
+
+    @property
+    def client_id(self) -> Optional[str]:
+        return self.delta_manager.client_id
+
+    # -- op pumping ------------------------------------------------------------
+
+    def drain(self) -> int:
+        """Process everything queued inbound (tests/hosts drive delivery
+        explicitly; a live host would pump this from its event loop)."""
+        return self.runtime.drain()
+
+    # -- connection lifecycle --------------------------------------------------
+
+    def disconnect(self) -> None:
+        self.delta_manager.disconnect()
+
+    def reconnect(self, client_id: Optional[str] = None,
+                  document_service=None) -> None:
+        """Reconnect and resubmit pending ops (catch-up first so acks for
+        already-sequenced pending ops land, then resubmit the rest)."""
+        self.delta_manager.reconnect(client_id, document_service)
+        self.runtime.client_id = self.delta_manager.client_id
+        self.runtime._client_ids.add(self.delta_manager.client_id)
+        self.drain()
+        # Drop the offline-held outbox: resubmit_pending re-issues every
+        # unacked op with fresh client_seqs (keeping both would double-send).
+        self.runtime._outbox.clear()
+        for ds in self.runtime.datastores.values():
+            ds.resubmit_pending()
+        self.runtime.flush()
+
+    def close(self) -> None:
+        self.delta_manager.close()
+        self.closed = True
+
+    # -- pending local state (stashed ops) -------------------------------------
+
+    def get_pending_ops(self) -> List[dict]:
+        """Unacked local channel ops in submission order."""
+        pending = []
+        for ds_id, ds in self.runtime.datastores.items():
+            for channel_id, channel in ds.channels.items():
+                for client_seq, contents, _meta in channel._pending:
+                    pending.append({
+                        "clientSeq": client_seq,
+                        "ds": ds_id,
+                        "channel": channel_id,
+                        "contents": contents,
+                    })
+        pending.sort(key=lambda p: p["clientSeq"])
+        return pending
+
+    def close_and_get_pending_state(self) -> dict:
+        """Capture everything needed to resume this session offline: the
+        processed sequence point, unacked local ops, and the client ids
+        they were submitted under (rehydrate uses those to drop stashed
+        ops that *did* get sequenced — we just never saw the ack).
+        Summary and op tail are re-fetched from the (durable) service at
+        rehydrate time."""
+        state = {
+            "docId": self.doc_id,
+            "refSeq": self.runtime.ref_seq,
+            "clientIds": sorted(self.runtime._client_ids),
+            "pending": self.get_pending_ops(),
+        }
+        self.close()
+        return state
+
+
+class Loader:
+    """Resolves documents through a driver factory into Containers."""
+
+    def __init__(self, factory,
+                 registry: Optional[ChannelRegistry] = None) -> None:
+        self.factory = factory
+        self.registry = registry
+
+    def _new_runtime(self) -> ContainerRuntime:
+        return ContainerRuntime(self.registry)
+
+    # -- create (attach flow) --------------------------------------------------
+
+    def create(
+        self,
+        doc_id: str,
+        client_id: str,
+        build: Callable[[ContainerRuntime], Any],
+    ) -> Container:
+        """Create a new document: ``build(runtime)`` seeds datastores and
+        channels detached; their state rides the initial (attach) summary."""
+        runtime = self._new_runtime()
+        build(runtime)
+        service = self.factory.create_document(
+            doc_id, runtime.summarize(), ref_seq=0
+        )
+        return self._wire(doc_id, runtime, service, client_id)
+
+    # -- load (catch-up flow) --------------------------------------------------
+
+    def resolve(
+        self,
+        doc_id: str,
+        client_id: Optional[str] = None,
+        pending_state: Optional[dict] = None,
+    ) -> Container:
+        """Load a document: summary + catch-up replay + live connection.
+        ``client_id=None`` loads read-only-detached (e.g. replay driver).
+        ``pending_state`` rehydrates a previous session's unacked ops."""
+        if pending_state is not None and client_id is None:
+            raise ValueError("rehydrating pending state requires a live "
+                             "client_id (stashed ops must be resubmitted)")
+        service = self.factory.resolve(doc_id)
+        runtime = self._new_runtime()
+
+        # Rehydrating: the summary must not be newer than the stash point,
+        # or stashed position-carrying ops would re-apply against a state
+        # they were never created on.
+        stash_ref = pending_state["refSeq"] if pending_state else None
+        summary, summary_seq = service.storage.latest(at_or_below=stash_ref)
+        if summary is None:
+            raise KeyError(f"document {doc_id!r} has no summary (never "
+                           f"attached)")
+        runtime.load(summary)
+
+        container = Container(doc_id, runtime, DeltaManager(service))
+
+        # Catch-up replay: one fetch of the whole tail, split at the stash
+        # point.  THE hot loop the TPU catch-up service obsoletes when it
+        # keeps summaries fresh.
+        tail = service.delta_storage.get(from_seq=summary_seq)
+        pre_stash = [m for m in tail
+                     if stash_ref is None or m.seq <= stash_ref]
+        post_stash = tail[len(pre_stash):]
+        for msg in pre_stash:
+            runtime.process(msg)
+        container.delta_manager.note_delivered(runtime.ref_seq)
+
+        if client_id is not None:
+            # Connect first (channels need a live submit path), then re-apply
+            # stashed ops while the runtime is still positioned at the stash
+            # point — the remote tail beyond it is queued but undrained, so
+            # position-carrying contents resolve against the original view.
+            container.runtime.connect(container.delta_manager, client_id)
+            if pending_state is not None:
+                self._apply_stashed(runtime, pending_state, post_stash)
+            container.drain()
+            container.runtime.flush()
+        return container
+
+    # -- internals -------------------------------------------------------------
+
+    def _apply_stashed(self, runtime: ContainerRuntime, pending_state: dict,
+                       post_stash_tail: List[SequencedMessage]) -> None:
+        """Re-apply stashed pending ops as fresh local mutations (optimistic
+        apply + submit) on exactly the state they were created against.
+
+        An op the old session submitted may already have been *sequenced* —
+        the session just crashed before processing its ack.  Those arrive
+        in the post-stash tail as ordinary remote ops (the new client id
+        makes them non-local), so re-applying their stashed copies would
+        double-apply: drop any stashed op whose (old client id, clientSeq)
+        appears in the durable tail (the reference's PendingStateManager
+        dedup)."""
+        old_ids = set(pending_state.get("clientIds", []))
+        already_sequenced = set()
+        for msg in post_stash_tail:
+            if msg.client_id in old_ids and msg.type is MessageType.OP \
+                    and isinstance(msg.contents, dict) \
+                    and msg.contents.get("type") == "groupedBatch":
+                for sub in msg.contents["ops"]:
+                    already_sequenced.add((msg.client_id, sub["clientSeq"]))
+        for p in pending_state["pending"]:
+            if any((cid, p["clientSeq"]) in already_sequenced
+                   for cid in old_ids):
+                continue  # it made it to the log; the tail will apply it
+            ds = runtime.datastores[p["ds"]]
+            ds.channels[p["channel"]].apply_stashed_op(p["contents"])
+
+    def _wire(self, doc_id: str, runtime: ContainerRuntime, service,
+              client_id: str) -> Container:
+        container = Container(doc_id, runtime, DeltaManager(service))
+        container.delta_manager.note_delivered(runtime.ref_seq)
+        container.runtime.connect(container.delta_manager, client_id)
+        container.drain()
+        container.runtime.flush()
+        return container

@@ -29,6 +29,7 @@ class ClientConnection:
     client_id: str
     ref_seq: int
     last_client_seq: int = 0  # highest client_seq sequenced (dedup floor)
+    session: Optional[str] = None  # connection epoch (crash-resume identity)
 
 
 class Sequencer:
@@ -67,17 +68,24 @@ class Sequencer:
         """The durable op log (scriptorium feed)."""
         return self._log
 
-    def connect(self, client_id: str) -> ClientConnection:
+    def connect(self, client_id: str,
+                session: Optional[str] = None) -> ClientConnection:
         """Join a client to the quorum; emits a JOIN message.
 
-        Idempotent for an already-connected id (the crash-resume reconnect:
-        a restored sequencer still carries the client's record, and keeping
-        it preserves the resubmit-dedup floor) — no duplicate JOIN is
-        stamped."""
+        ``session`` disambiguates reuse of a client id.  A reconnect that
+        presents the *same* session token resumes the existing record —
+        no duplicate JOIN, dedup floor preserved (crash-resume of a
+        surviving runtime whose client_seq counter continues).  A different
+        (or absent) session is a *fresh* runtime whose counter restarts:
+        the stale record is dropped (LEAVE+JOIN) so its dedup floor cannot
+        silently swallow the new session's ops."""
         existing = self._clients.get(client_id)
         if existing is not None:
-            return existing
-        conn = ClientConnection(client_id=client_id, ref_seq=self._seq)
+            if session is not None and existing.session == session:
+                return existing
+            self.disconnect(client_id)
+        conn = ClientConnection(client_id=client_id, ref_seq=self._seq,
+                                session=session)
         self._clients[client_id] = conn
         self._stamp(
             client_id=None,
@@ -201,7 +209,8 @@ class Sequencer:
             "minSeq": self._min_seq,
             "clock": self._clock,
             "clients": {
-                cid: {"refSeq": c.ref_seq, "lastClientSeq": c.last_client_seq}
+                cid: {"refSeq": c.ref_seq, "lastClientSeq": c.last_client_seq,
+                      "session": c.session}
                 for cid, c in sorted(self._clients.items())
             },
         }
@@ -222,6 +231,7 @@ class Sequencer:
                 client_id=cid,
                 ref_seq=c["refSeq"],
                 last_client_seq=c["lastClientSeq"],
+                session=c.get("session"),
             )
         return seq
 

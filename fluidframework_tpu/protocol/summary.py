@@ -119,13 +119,19 @@ class SummaryStorage:
                 self._store(child)
         return digest
 
-    def latest(self, doc_id: str):
-        """Returns (tree, ref_seq) of the newest summary, or (None, 0)."""
+    def latest(self, doc_id: str, at_or_below: int = None):
+        """Returns (tree, ref_seq) of the newest summary, or (None, 0).
+        With ``at_or_below``, the newest summary whose ref_seq does not
+        exceed it (historical reconstruction / replay driver)."""
         commits = self._commits.get(doc_id)
         if not commits:
             return None, 0
+        if at_or_below is not None:
+            commits = [c for c in commits if c[1] <= at_or_below]
+            if not commits:
+                return None, 0
         handle, ref_seq = commits[-1]
-        node = self._objects[handle]
+        node = self.read(handle)  # read() so disk-backed stores lazy-load
         assert isinstance(node, SummaryTree)
         return node, ref_seq
 

@@ -78,6 +78,7 @@ class ContainerRuntime:
         self._batching = 0
         self.election = OrderedClientElection()  # quorum, join-ordered
         self.on_op_processed = None  # hook: fn(msg) after each message
+        self.message_observers: List = []  # additional fn(msg) observers
 
     # -- datastores ------------------------------------------------------------
 
@@ -150,6 +151,10 @@ class ContainerRuntime:
     def flush(self) -> None:
         if not self._outbox or self._service is None:
             return
+        # A connection-aware service (DeltaManager) holds the outbox while
+        # offline; ops ride out on the post-reconnect flush instead.
+        if not getattr(self._service, "can_send", True):
+            return
         batch, self._outbox = self._outbox, []
         self._service.submit(
             RawOperation(
@@ -194,6 +199,8 @@ class ContainerRuntime:
         self._advance_all(msg.seq, msg.min_seq)
         if self.on_op_processed is not None:
             self.on_op_processed(msg)
+        for fn in self.message_observers:
+            fn(msg)
 
     def _advance_all(self, seq: int, min_seq: int) -> None:
         for ds in self.datastores.values():

@@ -30,6 +30,18 @@ from .orderer import LocalOrderingService
 
 STRING_TYPE = "sequence-tpu"
 
+_EMPTY_STRING_DIGEST: Optional[str] = None
+
+
+def _empty_string_digest() -> str:
+    """Digest of a fresh, empty string-channel summary (id-independent)."""
+    global _EMPTY_STRING_DIGEST
+    if _EMPTY_STRING_DIGEST is None:
+        from ..dds.sequence import SharedString
+
+        _EMPTY_STRING_DIGEST = SharedString("-").summarize(0).digest()
+    return _EMPTY_STRING_DIGEST
+
 
 @dataclasses.dataclass
 class _DocWork:
@@ -129,9 +141,10 @@ class CatchupService:
     # -- device path -----------------------------------------------------------
 
     def _device_plan(self, work: _DocWork):
-        """Device-eligible shape: every channel is a string channel with an
-        *empty* prior summary (whole history lives in the tail), so the
-        kernel can cold-fold each channel.  Returns the plan
+        """Device-eligible shape: every channel is a string channel whose
+        prior summary is *empty* (whole history lives in the tail — a
+        seeded attach summary would be silently dropped by a cold fold), so
+        the kernel can cold-fold each channel.  Returns the plan
         [(ds_id, channel_id), ...] or None."""
         try:
             ds_root = work.summary.get(".datastores")
@@ -150,6 +163,9 @@ class CatchupService:
             for channel_id, type_name in attrs.items():
                 if type_name != STRING_TYPE:
                     return None
+                if subtree.children[channel_id].digest() \
+                        != _empty_string_digest():
+                    return None  # attach-seeded content: CPU path
                 plan.append((ds_id, channel_id))
         return plan or None
 

@@ -136,8 +136,8 @@ class DocumentEndpoint:
     def head_seq(self) -> int:
         return self._orderer.sequencer.seq
 
-    def connect(self, client_id: str) -> None:
-        self._orderer.sequencer.connect(client_id)
+    def connect(self, client_id: str, session: Optional[str] = None) -> None:
+        self._orderer.sequencer.connect(client_id, session)
 
     def disconnect(self, client_id: str) -> None:
         self._orderer.sequencer.disconnect(client_id)

@@ -1,0 +1,329 @@
+"""Loader + drivers: create/load/catch-up, delta-manager gap repair,
+disconnect/reconnect with resubmit, stashed pending state, replay driver,
+file driver durability."""
+
+import pytest
+
+from fluidframework_tpu.drivers import (
+    FileDocumentServiceFactory,
+    LocalDocumentServiceFactory,
+    ReplayDocumentService,
+)
+from fluidframework_tpu.loader import ConnectionState, Loader
+from fluidframework_tpu.service import LocalOrderingService
+
+
+def make_stack():
+    service = LocalOrderingService()
+    factory = LocalDocumentServiceFactory(service)
+    return service, factory, Loader(factory)
+
+
+def build_text_doc(runtime):
+    ds = runtime.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+    ds.create_channel("map-tpu", "meta")
+
+
+def text_of(container):
+    return container.runtime.get_datastore("ds").get_channel("text").text
+
+
+def text_channel(container):
+    return container.runtime.get_datastore("ds").get_channel("text")
+
+
+def map_channel(container):
+    return container.runtime.get_datastore("ds").get_channel("meta")
+
+
+# --- create / load / catch-up ------------------------------------------------
+
+
+def test_create_then_load_and_collaborate():
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    assert a.connected
+    text_channel(a).insert_text(0, "hello")
+    a.drain()
+
+    b = loader.resolve("doc", "bob")
+    assert text_of(b) == "hello"
+    text_channel(b).insert_text(5, " world")
+    a.drain()
+    b.drain()
+    assert text_of(a) == text_of(b) == "hello world"
+    assert a.audience.members == ["alice", "bob"]
+    assert b.audience.members == ["alice", "bob"]
+
+
+def test_load_detached_read_only():
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "content")
+    a.drain()
+
+    ro = loader.resolve("doc", client_id=None)
+    assert not ro.connected
+    assert text_of(ro) == "content"
+
+
+def test_catchup_replay_from_summary_and_tail():
+    """A late joiner loads the uploaded summary and replays only the tail."""
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "0123456789")
+    a.drain()
+    # Central summary point
+    from fluidframework_tpu.service.catchup import CatchupService
+    CatchupService(service).catch_up()
+    # More edits after the summary
+    text_channel(a).insert_text(10, "-tail")
+    a.drain()
+
+    c = loader.resolve("doc", "carol")
+    assert text_of(c) == "0123456789-tail"
+    a.drain()  # alice must fold carol's JOIN before states can match
+    sa = a.runtime.summarize().digest()
+    sc = c.runtime.summarize().digest()
+    assert sa == sc
+
+
+# --- delta manager: gaps, disconnect/reconnect -------------------------------
+
+
+class LossyConnection:
+    """Wraps a document-service connection, dropping selected live
+    broadcasts (transport fault injection)."""
+
+    def __init__(self, inner, drop_seqs):
+        self._inner = inner
+        self._drop = set(drop_seqs)
+        self._subs = []
+        inner.subscribe(self._relay)
+
+    def _relay(self, msg):
+        if msg.seq in self._drop:
+            return
+        for fn in list(self._subs):
+            fn(msg)
+
+    def subscribe(self, fn):
+        self._subs.append(fn)
+
+    def unsubscribe(self, fn):
+        if fn in self._subs:
+            self._subs.remove(fn)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_delta_manager_repairs_gaps_from_storage():
+    service, factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    doc_service = factory.resolve("doc")
+    head = service.oplog.head("doc")
+    # bob's transport drops the next two sequenced messages
+    lossy = LossyConnection(doc_service.connection(),
+                            drop_seqs={head + 2, head + 3})
+    doc_service._connection = lossy
+
+    b = Loader(factory).resolve("doc")  # detached first
+    b.delta_manager._service = doc_service
+    b.runtime.connect(b.delta_manager, "bob")
+    b.drain()
+
+    text_channel(a).insert_text(0, "abc")   # dropped for bob
+    text_channel(a).insert_text(3, "def")   # dropped for bob
+    text_channel(a).insert_text(6, "ghi")   # delivered -> gap detected
+    a.drain()
+    b.drain()
+    assert b.delta_manager.gaps_repaired >= 1
+    assert text_of(b) == text_of(a) == "abcdefghi"
+
+
+def test_disconnect_reconnect_resubmits_pending():
+    """Offline edits are held locally and ride out on reconnect; concurrent
+    remote edits merge."""
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    b = loader.resolve("doc", "bob")
+
+    b.disconnect()
+    assert b.connection_state is ConnectionState.DISCONNECTED
+    with pytest.raises(ConnectionError):
+        b.delta_manager.submit(None)
+    # channel-level edits while offline: applied optimistically, held
+    text_channel(b).insert_text(0, "offline-edit ")
+    map_channel(b).set("who", "bob")
+    assert text_of(b) == "offline-edit "
+    # concurrent remote edit
+    text_channel(a).insert_text(0, "alice-edit ")
+    a.drain()
+
+    b.reconnect()
+    a.drain()
+    b.drain()
+    assert text_of(a) == text_of(b)
+    assert "offline-edit" in text_of(a) and "alice-edit" in text_of(a)
+    assert map_channel(a).get("who") == "bob"
+
+
+def test_read_only_mode_rejects_submit():
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    a.delta_manager.read_only = True
+    with pytest.raises(PermissionError):
+        text_channel(a).insert_text(0, "nope")
+
+
+# --- stashed pending state ---------------------------------------------------
+
+
+def test_pending_state_stash_and_rehydrate():
+    """Close with unacked ops; rehydrate into a new session; converge."""
+    service, factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "base")
+    a.drain()
+
+    b = loader.resolve("doc", "bob")
+    # bob goes offline-ish: edits whose acks he never processes
+    b.disconnect()
+    b.reconnect()
+    text_channel(b).insert_text(4, " pending")
+    map_channel(b).set("k", "v")
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 2
+
+    # meanwhile alice keeps editing
+    text_channel(a).insert_text(0, ">> ")
+    a.drain()
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    assert text_of(a) == text_of(b2)
+    assert " pending" in text_of(a)
+    assert ">> " in text_of(a)
+    assert map_channel(a).get("k") == "v"
+
+
+def test_stashed_op_already_sequenced_not_double_applied():
+    """The crashed session's op made it into the durable log (the ack was
+    just never processed): rehydrate must NOT re-apply the stashed copy."""
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    b = loader.resolve("doc", "bob")
+    # sequenced synchronously in-proc, but bob never drains the ack
+    text_channel(b).insert_text(0, "once ")
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 1
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    assert text_of(a) == text_of(b2)
+    assert text_of(a).count("once ") == 1
+
+
+def test_stashed_never_sequenced_op_is_applied():
+    """An op that never reached the sequencer (offline at close) must be
+    re-applied and resubmitted by rehydrate."""
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    b = loader.resolve("doc", "bob")
+    b.disconnect()
+    text_channel(b).insert_text(0, "ghost ")  # held: never sequenced
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 1
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    assert text_of(a) == text_of(b2)
+    assert text_of(a).count("ghost ") == 1
+
+
+def test_pending_state_empty_rehydrate():
+    _service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "x")
+    a.drain()
+    stash = a.close_and_get_pending_state()
+    assert stash["pending"] == []
+    a2 = loader.resolve("doc", "alice2", pending_state=stash)
+    assert text_of(a2) == "x"
+
+
+# --- replay driver -----------------------------------------------------------
+
+
+def test_replay_driver_reconstructs_history():
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    lengths = {}
+    for i in range(5):
+        text_channel(a).insert_text(0, f"[{i}]")
+        a.drain()
+        lengths[service.oplog.head("doc")] = len(text_of(a))
+
+    class ReplayFactory:
+        def __init__(self, to_seq):
+            self.to_seq = to_seq
+
+        def resolve(self, doc_id):
+            return ReplayDocumentService(
+                doc_id, service.oplog, service.storage, to_seq=self.to_seq
+            )
+
+    for seq, expect_len in lengths.items():
+        replayed = Loader(ReplayFactory(seq)).resolve("doc")
+        assert len(text_of(replayed)) == expect_len
+    # full replay matches the live document byte-for-byte
+    full = Loader(ReplayFactory(None)).resolve("doc")
+    assert full.runtime.summarize().digest() == \
+        a.runtime.summarize().digest()
+
+
+def test_replay_driver_rejects_writes():
+    service, _factory, loader = make_stack()
+    loader.create("doc", "alice", build_text_doc)
+
+    class ReplayFactory:
+        def resolve(self, doc_id):
+            return ReplayDocumentService(
+                doc_id, service.oplog, service.storage
+            )
+
+    ro = Loader(ReplayFactory()).resolve("doc")
+    with pytest.raises(PermissionError):
+        ro.delta_manager._service.connection().submit(None)
+
+
+# --- file driver -------------------------------------------------------------
+
+
+def test_file_driver_durable_across_reopen(tmp_path):
+    root = str(tmp_path / "store")
+    factory = FileDocumentServiceFactory(root)
+    loader = Loader(factory)
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "durable")
+    map_channel(a).set("version", 3)
+    a.drain()
+    digest = a.runtime.summarize().digest()
+    factory.close()
+
+    factory2 = FileDocumentServiceFactory(root)
+    ro = Loader(factory2).resolve("doc")  # detached: byte-compare state
+    assert ro.runtime.summarize().digest() == digest
+    b = Loader(factory2).resolve("doc", "bob")
+    assert text_of(b) == "durable"
+    assert map_channel(b).get("version") == 3
+    # still writable after reopen
+    text_channel(b).insert_text(0, "still-")
+    b.drain()
+    assert text_of(b) == "still-durable"
+    factory2.close()

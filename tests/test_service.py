@@ -213,14 +213,15 @@ def test_endpoint_recovers_doc_from_log_only(tmp_path):
 
 
 def test_reconnect_same_client_after_crash_resume(tmp_path):
-    """A surviving client reconnects with its old id after the service
-    restores: connect is idempotent (no duplicate JOIN), the dedup floor
-    survives, and disconnecting the truly-dead client unpins the MSN."""
+    """A surviving client reconnects with its old id + session after the
+    service restores: the record resumes (no duplicate JOIN), the dedup
+    floor survives, and disconnecting the truly-dead client unpins the
+    MSN."""
     path = str(tmp_path / "ops.jsonl")
     service = LocalOrderingService(oplog=OpLog(path))
     ep = service.create_document("doc")
-    ep.connect("alive")
-    ep.connect("dead")
+    ep.connect("alive", session="sess-alive")
+    ep.connect("dead", session="sess-dead")
     ep.submit(op("alive", 1, ref_seq=2))
     ep.submit(op("dead", 1, ref_seq=2))
     checkpoint = service.checkpoint()
@@ -231,7 +232,7 @@ def test_reconnect_same_client_after_crash_resume(tmp_path):
     )
     ep2 = restored.endpoint("doc")
     joins_before = sum(1 for m in ep2.log if m.type is MessageType.JOIN)
-    ep2.connect("alive")  # reconnect: no error, no duplicate JOIN
+    ep2.connect("alive", session="sess-alive")  # resume: no duplicate JOIN
     assert sum(1 for m in ep2.log if m.type is MessageType.JOIN) \
         == joins_before
     assert ep2.submit(op("alive", 1, ref_seq=2)) is None  # floor survived
@@ -239,6 +240,25 @@ def test_reconnect_same_client_after_crash_resume(tmp_path):
     ep2.disconnect("dead")
     msg = ep2.submit(op("alive", 2, ref_seq=ep2.head_seq))
     assert msg.min_seq == msg.ref_seq
+
+
+def test_fresh_session_reusing_client_id_gets_fresh_floor():
+    """A NEW session (different/no session token) reusing a client id must
+    not inherit the old dedup floor — its restarted client_seqs would be
+    silently swallowed."""
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect("bob", session="one")
+    ep.submit(op("bob", 1))
+    ep.submit(op("bob", 2))
+    # fresh session, same id
+    ep.connect("bob", session="two")
+    msg = ep.submit(op("bob", 1))  # client_seq restarts
+    assert msg is not None
+    # the swap is visible in the stream as LEAVE + JOIN
+    types = [m.type for m in ep.log]
+    assert types.count(MessageType.JOIN) == 2
+    assert types.count(MessageType.LEAVE) == 1
 
 
 def test_signals_are_unsequenced():
@@ -330,6 +350,33 @@ def test_catchup_uploads_and_is_incremental():
         loader_rt.get_datastore("ds").get_channel("text").text
         == live_text.text
     )
+
+
+def test_catchup_preserves_seeded_attach_content():
+    """A doc whose attach summary carries seeded (detached-created) content
+    must NOT cold-fold on the device — that would drop the seed."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        text = ds.create_channel("sequence-tpu", "text")
+        text.insert_text(0, "SEEDED-")
+
+    a = loader.create("doc", "alice", build)
+    a.runtime.get_datastore("ds").get_channel("text").insert_text(7, "tail")
+    a.drain()
+
+    svc = CatchupService(service)
+    svc.catch_up()
+    assert svc.device_docs == 0 and svc.cpu_docs == 1
+
+    fresh = loader.resolve("doc")
+    text = fresh.runtime.get_datastore("ds").get_channel("text").text
+    assert text == "SEEDED-tail"
 
 
 def test_catchup_mixed_eligibility():
