@@ -58,11 +58,18 @@ class DeltaManager:
 
     @property
     def log(self) -> List[SequencedMessage]:
-        """Durable backfill feed (runtime.connect catch-up reads this) —
-        only the tail this manager has not already delivered/accounted."""
-        return self._service.delta_storage.get(
+        """Durable backfill feed — the tail this manager has not already
+        delivered/accounted.  Reading it *consumes* the tail: its one
+        consumer (``ContainerRuntime.connect``) enqueues everything
+        returned, so delivery accounting advances here — otherwise the
+        next live message would misread the backfilled span as a gap and
+        re-fetch it all."""
+        tail = self._service.delta_storage.get(
             from_seq=self.last_delivered_seq
         )
+        if tail:
+            self.last_delivered_seq = tail[-1].seq
+        return tail
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         self._subscribers.append(fn)
@@ -80,9 +87,13 @@ class DeltaManager:
 
     @property
     def can_send(self) -> bool:
-        """Offline holds ops in the runtime outbox; read-only stays True so
-        the submit path raises loudly at mutation time instead."""
-        return self.state is ConnectionState.CONNECTED
+        """False holds ops in the runtime outbox (optimistic local state
+        stays intact, everything rides out on the next writable flush) —
+        both offline and read-only work this way, because rejecting at
+        submit time would fire *after* the DDS's optimistic apply and
+        strand a diverged replica."""
+        return (self.state is ConnectionState.CONNECTED
+                and not self.read_only)
 
     def submit(self, op: RawOperation):
         if self.read_only:

@@ -15,7 +15,6 @@ upstream paths UNVERIFIED — empty reference mount):
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, Dict, List, Optional
 
 from ..protocol.messages import MessageType, SequencedMessage
@@ -59,6 +58,11 @@ class Container:
         self.runtime = runtime
         self.delta_manager = delta_manager
         self.audience = Audience()
+        # Members whose JOIN predates the loaded summary are only visible
+        # in the summary's quorum — seed from it (joinedSeq unknowable).
+        for cid in runtime.election.quorum:
+            self.audience._members[cid] = {"clientId": cid,
+                                           "joinedSeq": None}
         # Observe through the runtime so every processed message — backfill
         # and live alike — folds into the audience.
         runtime.message_observers.append(self.audience.observe)

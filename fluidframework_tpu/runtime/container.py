@@ -31,6 +31,7 @@ from typing import Deque, Dict, List, Optional
 from ..protocol.messages import MessageType, RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from .datastore import FluidDataStoreRuntime
+from .id_compressor import IdCompressor
 from .registry import ChannelRegistry, default_registry
 
 
@@ -77,8 +78,10 @@ class ContainerRuntime:
         self._outbox: List[dict] = []
         self._batching = 0
         self.election = OrderedClientElection()  # quorum, join-ordered
-        self.on_op_processed = None  # hook: fn(msg) after each message
-        self.message_observers: List = []  # additional fn(msg) observers
+        self.message_observers: List = []  # fn(msg) after each message
+        # Distributed id compression: locals mint free; creation ranges
+        # ride outbound batches and finalize identically on every client.
+        self.id_compressor = IdCompressor()
 
     # -- datastores ------------------------------------------------------------
 
@@ -156,15 +159,25 @@ class ContainerRuntime:
         if not getattr(self._service, "can_send", True):
             return
         batch, self._outbox = self._outbox, []
-        self._service.submit(
-            RawOperation(
-                client_id=self.client_id,
-                client_seq=batch[0]["clientSeq"],
-                ref_seq=self.ref_seq,
-                type=MessageType.OP,
-                contents={"type": "groupedBatch", "ops": batch},
+        contents = {"type": "groupedBatch", "ops": batch}
+        id_range = self.id_compressor.take_next_creation_range()
+        if id_range is not None:
+            contents["idRange"] = id_range
+        try:
+            self._service.submit(
+                RawOperation(
+                    client_id=self.client_id,
+                    client_seq=batch[0]["clientSeq"],
+                    ref_seq=self.ref_seq,
+                    type=MessageType.OP,
+                    contents=contents,
+                )
             )
-        )
+        except BaseException:
+            # A failed send must not lose the batch: the ops are still
+            # optimistically applied locally and must resubmit eventually.
+            self._outbox = batch + self._outbox
+            raise
 
     # -- inbound ---------------------------------------------------------------
 
@@ -188,6 +201,8 @@ class ContainerRuntime:
         self.election.observe(msg)
         if msg.type is MessageType.OP and isinstance(msg.contents, dict) \
                 and msg.contents.get("type") == "groupedBatch":
+            if "idRange" in msg.contents:
+                self.id_compressor.finalize_range(msg.contents["idRange"])
             local = msg.client_id in self._client_ids
             for sub in msg.contents["ops"]:
                 ds = self.datastores.get(sub["ds"])
@@ -196,10 +211,16 @@ class ContainerRuntime:
                         dataclasses.replace(msg, client_seq=sub["clientSeq"]),
                         sub, local,
                     )
+        elif msg.type in (MessageType.JOIN, MessageType.LEAVE):
+            # Consensus-style channels react to quorum membership (held
+            # items / task assignments of a departed client re-queue).
+            for ds in self.datastores.values():
+                for channel in ds.channels.values():
+                    observe = getattr(channel, "observe_protocol", None)
+                    if observe is not None:
+                        observe(msg)
         self._advance_all(msg.seq, msg.min_seq)
-        if self.on_op_processed is not None:
-            self.on_op_processed(msg)
-        for fn in self.message_observers:
+        for fn in list(self.message_observers):
             fn(msg)
 
     def _advance_all(self, seq: int, min_seq: int) -> None:
@@ -230,6 +251,9 @@ class ContainerRuntime:
         tree.add_blob(
             ".protocol", canonical_json({"quorum": self.election.quorum})
         )
+        tree.add_blob(
+            ".idCompressor", canonical_json(self.id_compressor.serialize())
+        )
         ds_tree = tree.add_tree(".datastores")
         for ds_id in sorted(self.datastores):
             ds_tree.children[ds_id] = self.datastores[ds_id].summarize(
@@ -245,6 +269,10 @@ class ContainerRuntime:
         self.min_seq = meta["minSeq"]
         protocol = json.loads(summary.blob_bytes(".protocol"))
         self.election._order = list(protocol["quorum"])
+        if ".idCompressor" in summary.children:
+            self.id_compressor = IdCompressor.deserialize(
+                json.loads(summary.blob_bytes(".idCompressor"))
+            )
         self.datastores = {}
         ds_root = summary.get(".datastores")
         for ds_id, subtree in sorted(ds_root.children.items()):

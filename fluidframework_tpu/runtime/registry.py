@@ -60,6 +60,11 @@ class ChannelRegistry:
 def default_registry() -> ChannelRegistry:
     """All built-in ``*-tpu`` channel types."""
     from ..dds.cell_counter import SharedCell, SharedCounter
+    from ..dds.consensus import (
+        ConsensusQueue,
+        ConsensusRegisterCollection,
+        TaskManager,
+    )
     from ..dds.map import SharedDirectory, SharedMap
     from ..dds.matrix import SharedMatrix
     from ..dds.sequence import SharedString
@@ -67,6 +72,7 @@ def default_registry() -> ChannelRegistry:
 
     registry = ChannelRegistry()
     for cls in (SharedMap, SharedDirectory, SharedString, SharedMatrix,
-                SharedTree, SharedCell, SharedCounter):
+                SharedTree, SharedCell, SharedCounter, ConsensusQueue,
+                ConsensusRegisterCollection, TaskManager):
         registry.register_type(cls)
     return registry

@@ -33,7 +33,7 @@ class SummaryManager:
     the heuristics fire, writes a summary and announces it.
 
     Wire-in: ``manager = SummaryManager(runtime, storage, doc_id)`` then the
-    runtime's ``on_op_processed`` hook drives it — no polling."""
+    runtime's ``message_observers`` hook drives it — no polling."""
 
     def __init__(
         self,
@@ -55,7 +55,7 @@ class SummaryManager:
         self.nacks_received = 0
         self.ops_since_summary = 0
         self.summaries_written = 0
-        runtime.on_op_processed = self._on_message
+        runtime.message_observers.append(self._on_message)
 
     # -- the message hook ------------------------------------------------------
 

@@ -201,6 +201,10 @@ class CatchupService:
                 canonical_json({"seq": final_seq, "minSeq": final_msn}),
             )
             tree.add_blob(".protocol", canonical_json({"quorum": quorum}))
+            tree.add_blob(
+                ".idCompressor",
+                canonical_json(self._fold_id_compressor(work)),
+            )
             ds_tree = tree.add_tree(".datastores")
             channel_by_pair = {
                 pair: channel_trees[i + k]
@@ -222,6 +226,22 @@ class CatchupService:
             i += len(work.plan)
             out.append(tree)
         return out
+
+    def _fold_id_compressor(self, work: _DocWork) -> dict:
+        """Replicate the runtime's sequenced id-range finalization for the
+        host-composed summary (byte-parity with the CPU fold)."""
+        from ..runtime.id_compressor import IdCompressor
+
+        try:
+            prior = json.loads(work.summary.blob_bytes(".idCompressor"))
+            comp = IdCompressor.deserialize(prior)
+        except KeyError:
+            comp = IdCompressor()
+        for msg in work.tail:
+            if msg.type is MessageType.OP and isinstance(msg.contents, dict) \
+                    and "idRange" in msg.contents:
+                comp.finalize_range(msg.contents["idRange"])
+        return comp.serialize()
 
     def _fold_quorum(self, work: _DocWork) -> List[str]:
         protocol = json.loads(work.summary.blob_bytes(".protocol"))

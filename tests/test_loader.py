@@ -89,6 +89,20 @@ def test_catchup_replay_from_summary_and_tail():
     assert sa == sc
 
 
+def test_audience_includes_pre_summary_members():
+    """Members whose JOIN is folded into the loaded summary (not in the
+    replayed tail) must still appear in a late joiner's audience."""
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "x")
+    a.drain()
+    from fluidframework_tpu.service.catchup import CatchupService
+    CatchupService(service).catch_up()  # summary now covers alice's JOIN
+
+    c = loader.resolve("doc", "carol")
+    assert c.audience.members == ["alice", "carol"]
+
+
 # --- delta manager: gaps, disconnect/reconnect -------------------------------
 
 
@@ -170,12 +184,26 @@ def test_disconnect_reconnect_resubmits_pending():
     assert map_channel(a).get("who") == "bob"
 
 
-def test_read_only_mode_rejects_submit():
+def test_read_only_mode_holds_ops_until_writable():
+    """Read-only must not strand a diverged replica: local edits apply
+    optimistically, are held unsent, and ride out when writability
+    returns."""
     _service, _factory, loader = make_stack()
     a = loader.create("doc", "alice", build_text_doc)
+    b = loader.resolve("doc", "bob")
     a.delta_manager.read_only = True
+    text_channel(a).insert_text(0, "held ")
+    b.drain()
+    assert text_of(a) == "held "   # local optimistic apply
+    assert text_of(b) == ""        # nothing sequenced
+    # direct submit is still rejected loudly
     with pytest.raises(PermissionError):
-        text_channel(a).insert_text(0, "nope")
+        a.delta_manager.submit(None)
+    a.delta_manager.read_only = False
+    a.runtime.flush()
+    a.drain()
+    b.drain()
+    assert text_of(b) == "held "
 
 
 # --- stashed pending state ---------------------------------------------------
