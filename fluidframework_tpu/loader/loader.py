@@ -102,9 +102,12 @@ class Container:
         self.runtime.client_id = self.delta_manager.client_id
         self.runtime._client_ids.add(self.delta_manager.client_id)
         self.drain()
-        # Drop the offline-held outbox: resubmit_pending re-issues every
-        # unacked op with fresh client_seqs (keeping both would double-send).
+        # Drop the offline-held outbox and any half-sent wire messages:
+        # resubmit_pending re-issues every unacked op with fresh client_seqs
+        # under the new connection (keeping both would double-send; the old
+        # connection's partial chunk trains die with its LEAVE).
         self.runtime._outbox.clear()
+        self.runtime._pending_wire.clear()
         for ds in self.runtime.datastores.values():
             ds.resubmit_pending()
         self.runtime.flush()

@@ -28,11 +28,28 @@ import dataclasses
 import json
 from typing import Deque, Dict, List, Optional
 
+import base64
+
 from ..protocol.messages import MessageType, RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
+from .blobs import BlobManager
 from .datastore import FluidDataStoreRuntime
+from .gc import GarbageCollector, GCOptions
 from .id_compressor import IdCompressor
+from .op_pipeline import ChunkReassembler, encode_batch, maybe_decompress
 from .registry import ChannelRegistry, default_registry
+
+
+@dataclasses.dataclass
+class ContainerRuntimeOptions:
+    """Typed runtime options (the reference's IContainerRuntimeOptions
+    capability: compression, chunking, GC switches)."""
+
+    #: compress batches whose canonical encoding reaches this many bytes
+    compression_threshold: int = 64 * 1024
+    #: split encoded batches into chunks below this many bytes
+    chunk_size: int = 768 * 1024
+    gc: GCOptions = dataclasses.field(default_factory=GCOptions)
 
 
 class OrderedClientElection:
@@ -65,8 +82,10 @@ class OrderedClientElection:
 class ContainerRuntime:
     """The per-client runtime instance."""
 
-    def __init__(self, registry: Optional[ChannelRegistry] = None) -> None:
+    def __init__(self, registry: Optional[ChannelRegistry] = None,
+                 options: Optional[ContainerRuntimeOptions] = None) -> None:
         self.registry = registry if registry is not None else default_registry()
+        self.options = options or ContainerRuntimeOptions()
         self.datastores: Dict[str, FluidDataStoreRuntime] = {}
         self.client_id: Optional[str] = None
         self._service = None
@@ -82,13 +101,24 @@ class ContainerRuntime:
         # Distributed id compression: locals mint free; creation ranges
         # ride outbound batches and finalize identically on every client.
         self.id_compressor = IdCompressor()
+        self.blob_manager = BlobManager(self)
+        self.gc = GarbageCollector(self, self.options.gc)
+        self._chunks = ChunkReassembler()
+        # Encoded wire messages not yet accepted by the service: a failed
+        # send resumes HERE (same bytes, same client_seqs) so partially-
+        # delivered chunk trains and consumed idRanges are never re-encoded.
+        self._pending_wire: List[RawOperation] = []
 
     # -- datastores ------------------------------------------------------------
 
-    def create_datastore(self, datastore_id: str) -> FluidDataStoreRuntime:
+    def create_datastore(self, datastore_id: str,
+                         rooted: bool = True) -> FluidDataStoreRuntime:
+        """``rooted=False`` datastores survive only while some rooted
+        datastore's channels hold a ``fluidHandle`` to them (GC sweeps the
+        rest)."""
         if datastore_id in self.datastores:
             raise ValueError(f"datastore {datastore_id!r} already exists")
-        ds = FluidDataStoreRuntime(datastore_id, self.registry)
+        ds = FluidDataStoreRuntime(datastore_id, self.registry, rooted=rooted)
         ds._attach(self)
         self.datastores[datastore_id] = ds
         return ds
@@ -132,12 +162,13 @@ class ContainerRuntime:
         """Called by datastores for each channel op; returns the sub-op
         client_seq the channel records for its ack FIFO."""
         self._client_seq += 1
+        client_seq = self._client_seq  # flush below may advance the counter
         self._outbox.append(
-            {"clientSeq": self._client_seq, **envelope}
+            {"clientSeq": client_seq, **envelope}
         )
         if not self._batching:
             self.flush()
-        return self._client_seq
+        return client_seq
 
     @contextlib.contextmanager
     def order_sequentially(self):
@@ -152,32 +183,79 @@ class ContainerRuntime:
                 self.flush()
 
     def flush(self) -> None:
-        if not self._outbox or self._service is None:
+        if self._service is None:
             return
         # A connection-aware service (DeltaManager) holds the outbox while
         # offline; ops ride out on the post-reconnect flush instead.
         if not getattr(self._service, "can_send", True):
+            return
+        # Resume any wire messages a previous failed flush left behind —
+        # identical bytes, so receivers' chunk reassembly stays coherent
+        # and already-taken idRanges are preserved.
+        self._drain_wire()
+        if not self._outbox:
             return
         batch, self._outbox = self._outbox, []
         contents = {"type": "groupedBatch", "ops": batch}
         id_range = self.id_compressor.take_next_creation_range()
         if id_range is not None:
             contents["idRange"] = id_range
-        try:
-            self._service.submit(
+        for i, wire_contents in enumerate(
+                encode_batch(contents, self.options.compression_threshold,
+                             self.options.chunk_size)):
+            if i == 0:
+                client_seq = batch[0]["clientSeq"]
+            else:
+                # Extra chunk messages ride fresh runtime client_seqs
+                # (the sequencer dedups per message).
+                self._client_seq += 1
+                client_seq = self._client_seq
+            self._pending_wire.append(
                 RawOperation(
                     client_id=self.client_id,
-                    client_seq=batch[0]["clientSeq"],
+                    client_seq=client_seq,
                     ref_seq=self.ref_seq,
                     type=MessageType.OP,
-                    contents=contents,
+                    contents=wire_contents,
                 )
             )
-        except BaseException:
-            # A failed send must not lose the batch: the ops are still
-            # optimistically applied locally and must resubmit eventually.
-            self._outbox = batch + self._outbox
-            raise
+        self._drain_wire()
+
+    def _drain_wire(self) -> None:
+        while self._pending_wire:
+            self._service.submit(self._pending_wire[0])
+            self._pending_wire.pop(0)  # only after the send was accepted
+
+    def perform_gc_sweep(self) -> List[str]:
+        """Submit a sequenced sweep for datastores whose unreferenced grace
+        has expired.  Deletion happens when the op folds — at the same
+        position on every replica (summarize() itself never mutates).
+        Returns the ids proposed for sweeping."""
+        ready = self.gc.sweep_ready(self.ref_seq)
+        if ready and self._service is not None:
+            self._client_seq += 1
+            self._outbox.append({
+                "clientSeq": self._client_seq,
+                "runtime": "gcSweep",
+                "ids": ready,
+            })
+            if not self._batching:
+                self.flush()
+        return ready
+
+    def _submit_blob_attach(self, sha: str, content: bytes) -> None:
+        """Replicate an attachment blob (BlobManager upload path)."""
+        if self._service is None:
+            return  # detached: the blob rides the attach summary
+        self._client_seq += 1
+        self._outbox.append({
+            "clientSeq": self._client_seq,
+            "runtime": "blobAttach",
+            "sha": sha,
+            "data": base64.b64encode(content).decode("ascii"),
+        })
+        if not self._batching:
+            self.flush()
 
     # -- inbound ---------------------------------------------------------------
 
@@ -199,12 +277,26 @@ class ContainerRuntime:
         self.ref_seq = max(self.ref_seq, msg.seq)
         self.min_seq = max(self.min_seq, msg.min_seq)
         self.election.observe(msg)
-        if msg.type is MessageType.OP and isinstance(msg.contents, dict) \
-                and msg.contents.get("type") == "groupedBatch":
-            if "idRange" in msg.contents:
-                self.id_compressor.finalize_range(msg.contents["idRange"])
+        contents = msg.contents
+        if msg.type is MessageType.OP and isinstance(contents, dict):
+            if contents.get("type") == "chunk":
+                # Partial chunks still advance the window; the batch
+                # processes at the FINAL chunk's sequence number.
+                contents = self._chunks.feed(msg.client_id, contents)
+            else:
+                contents = maybe_decompress(contents)
+        if msg.type is MessageType.OP and isinstance(contents, dict) \
+                and contents.get("type") == "groupedBatch":
+            if "idRange" in contents:
+                self.id_compressor.finalize_range(contents["idRange"])
             local = msg.client_id in self._client_ids
-            for sub in msg.contents["ops"]:
+            for sub in contents["ops"]:
+                if sub.get("runtime") == "blobAttach":
+                    self.blob_manager.process_attach(sub["sha"], sub["data"])
+                    continue
+                if sub.get("runtime") == "gcSweep":
+                    self.gc.apply_sweep(sub["ids"])
+                    continue
                 ds = self.datastores.get(sub["ds"])
                 if ds is not None:
                     ds.process(
@@ -214,6 +306,8 @@ class ContainerRuntime:
         elif msg.type in (MessageType.JOIN, MessageType.LEAVE):
             # Consensus-style channels react to quorum membership (held
             # items / task assignments of a departed client re-queue).
+            if msg.type is MessageType.LEAVE:
+                self._chunks.drop(msg.contents["clientId"])
             for ds in self.datastores.values():
                 for channel in ds.channels.values():
                     observe = getattr(channel, "observe_protocol", None)
@@ -254,11 +348,22 @@ class ContainerRuntime:
         tree.add_blob(
             ".idCompressor", canonical_json(self.id_compressor.serialize())
         )
+        ds_summaries = {
+            ds_id: self.datastores[ds_id].summarize(self.min_seq)
+            for ds_id in sorted(self.datastores)
+        }
+        # GC stamping over sequenced state at the summary point: identical
+        # for any replica summarizing at the same seq with the same
+        # inherited gc state (single-writer summarizer model).  Sweeping is
+        # NOT done here — see perform_gc_sweep().
+        gc_state = self.gc.run(ds_summaries, self.ref_seq)
+        tree.add_blob(".gc", canonical_json(gc_state))
+        tree.children[".blobs"] = self.blob_manager.summarize(
+            self.gc.surviving_blob_shas(self.ref_seq)
+        )
         ds_tree = tree.add_tree(".datastores")
-        for ds_id in sorted(self.datastores):
-            ds_tree.children[ds_id] = self.datastores[ds_id].summarize(
-                self.min_seq
-            )
+        for ds_id in sorted(ds_summaries):
+            ds_tree.children[ds_id] = ds_summaries[ds_id]
         return tree
 
     def load(self, summary: SummaryTree) -> int:
@@ -273,6 +378,10 @@ class ContainerRuntime:
             self.id_compressor = IdCompressor.deserialize(
                 json.loads(summary.blob_bytes(".idCompressor"))
             )
+        if ".gc" in summary.children:
+            self.gc.load_state(json.loads(summary.blob_bytes(".gc")))
+        if ".blobs" in summary.children:
+            self.blob_manager.load(summary.get(".blobs"))
         self.datastores = {}
         ds_root = summary.get(".datastores")
         for ds_id, subtree in sorted(ds_root.children.items()):

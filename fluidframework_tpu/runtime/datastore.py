@@ -34,9 +34,11 @@ class ChannelDeltaConnection:
 class FluidDataStoreRuntime:
     """One datastore: a bag of named channels behind one address."""
 
-    def __init__(self, datastore_id: str, registry: ChannelRegistry) -> None:
+    def __init__(self, datastore_id: str, registry: ChannelRegistry,
+                 rooted: bool = True) -> None:
         self.id = datastore_id
         self.registry = registry
+        self.rooted = rooted  # GC root-set membership
         self.channels: Dict[str, SharedObject] = {}
         self._container = None  # set by the container runtime on attach
 
@@ -101,20 +103,23 @@ class FluidDataStoreRuntime:
 
     def summarize(self, min_seq: int = 0) -> SummaryTree:
         tree = SummaryTree()
-        attributes = {}
+        channel_types = {}
         for channel_id in sorted(self.channels):
             channel = self.channels[channel_id]
             tree.children[channel_id] = channel.summarize(min_seq)
-            attributes[channel_id] = channel.TYPE
-        tree.add_blob(".attributes", canonical_json(attributes))
+            channel_types[channel_id] = channel.TYPE
+        tree.add_blob(".attributes", canonical_json(
+            {"channels": channel_types, "rooted": self.rooted}
+        ))
         return tree
 
     def load(self, summary: SummaryTree) -> None:
         import json
 
         attributes = json.loads(summary.blob_bytes(".attributes"))
+        self.rooted = attributes.get("rooted", True)
         self.channels = {}
-        for channel_id, type_name in attributes.items():
+        for channel_id, type_name in attributes["channels"].items():
             subtree = summary.children[channel_id]
             channel = self.registry.get(type_name).load(channel_id, subtree)
             self.channels[channel_id] = channel

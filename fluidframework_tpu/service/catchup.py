@@ -25,12 +25,31 @@ from ..ops.mergetree_kernel import MergeTreeDocInput, replay_mergetree_batch
 from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from ..runtime.container import ContainerRuntime
+from ..runtime.op_pipeline import decode_stream
 from ..runtime.registry import ChannelRegistry, default_registry
 from .orderer import LocalOrderingService
 
 STRING_TYPE = "sequence-tpu"
 
 _EMPTY_STRING_DIGEST: Optional[str] = None
+
+
+def _gc_state_empty(summary: SummaryTree) -> bool:
+    """Prior summary carries no gc stamps/sweeps and no blobs."""
+    try:
+        gc = json.loads(summary.blob_bytes(".gc"))
+        if gc.get("unreferenced") or gc.get("swept") \
+                or gc.get("unreferencedBlobs"):
+            return False
+    except KeyError:
+        pass
+    try:
+        blobs = summary.get(".blobs")
+        if isinstance(blobs, SummaryTree) and blobs.children:
+            return False
+    except KeyError:
+        pass
+    return True
 
 
 def _empty_string_digest() -> str:
@@ -52,24 +71,22 @@ class _DocWork:
     # device plan: [(ds_id, channel_id), ...] or None (CPU fallback);
     # computed once at partition time.
     plan: Optional[List[Tuple[str, str]]] = None
+    # decoded (msg, batch) pairs — chunk/compression resolved once
+    decoded: Optional[list] = None
 
 
 def flatten_channel_ops(
-    tail: Sequence[SequencedMessage], ds_id: str, channel_id: str
+    decoded: Sequence, ds_id: str, channel_id: str
 ) -> List[SequencedMessage]:
-    """Unwrap grouped-batch envelopes into the flat per-channel op stream a
+    """Unwrap decoded grouped batches into the flat per-channel op stream a
     replay kernel folds over.  Sub-ops keep the batch's sequence number —
-    the same view the oracle applies them under."""
+    the same view the oracle applies them under.  ``decoded`` is the
+    (msg, batch) stream from :func:`decode_stream` (chunked/compressed
+    batches already resolved)."""
     out = []
-    for msg in tail:
-        if msg.type is not MessageType.OP:
-            continue
-        contents = msg.contents
-        if not isinstance(contents, dict) \
-                or contents.get("type") != "groupedBatch":
-            continue
-        for sub in contents["ops"]:
-            if sub["ds"] == ds_id and sub["channel"] == channel_id:
+    for msg, batch in decoded:
+        for sub in batch["ops"]:
+            if sub.get("ds") == ds_id and sub.get("channel") == channel_id:
                 out.append(
                     dataclasses.replace(msg, contents=sub["contents"])
                 )
@@ -110,6 +127,7 @@ class CatchupService:
                 results[doc_id] = (summary.digest(), ref_seq)
                 continue
             work = _DocWork(doc_id, summary, ref_seq, tail)
+            work.decoded = list(decode_stream(tail))
             work.plan = self._device_plan(work)
             works.append(work)
 
@@ -152,6 +170,12 @@ class CatchupService:
             return None
         if work.ref_seq != 0:
             return None  # warm-start state packing: CPU path for now
+        # GC/blob state must be trivially foldable host-side.
+        if not _gc_state_empty(work.summary):
+            return None
+        for _msg, batch in work.decoded:
+            if any("runtime" in sub for sub in batch["ops"]):
+                return None  # blob attaches: CPU path
         plan = []
         for ds_id, subtree in ds_root.children.items():
             if not isinstance(subtree, SummaryTree):
@@ -160,7 +184,12 @@ class CatchupService:
                 attrs = json.loads(subtree.blob_bytes(".attributes"))
             except KeyError:
                 return None
-            for channel_id, type_name in attrs.items():
+            if not attrs.get("rooted", True):
+                return None  # GC-collectible datastore: CPU path
+            channels = attrs.get("channels")
+            if channels is None:
+                return None  # unrecognized attributes shape: CPU path
+            for channel_id, type_name in channels.items():
                 if type_name != STRING_TYPE:
                     return None
                 if subtree.children[channel_id].digest() \
@@ -182,7 +211,8 @@ class CatchupService:
                 inputs.append(
                     MergeTreeDocInput(
                         doc_id=f"{work.doc_id}/{ds_id}/{channel_id}",
-                        ops=flatten_channel_ops(work.tail, ds_id, channel_id),
+                        ops=flatten_channel_ops(work.decoded, ds_id,
+                                                channel_id),
                         final_seq=final_seq,
                         final_msn=final_msn,
                     )
@@ -205,6 +235,13 @@ class CatchupService:
                 ".idCompressor",
                 canonical_json(self._fold_id_compressor(work)),
             )
+            # Eligibility guaranteed nothing becomes unreferenced and no
+            # blobs exist: the folded gc/blob state is the empty state.
+            from ..runtime.gc import GarbageCollector
+
+            tree.add_blob(".gc",
+                          canonical_json(GarbageCollector.empty_state()))
+            tree.add_tree(".blobs")
             ds_tree = tree.add_tree(".datastores")
             channel_by_pair = {
                 pair: channel_trees[i + k]
@@ -215,13 +252,15 @@ class CatchupService:
                 by_ds.setdefault(ds_id, []).append(channel_id)
             for ds_id in sorted(by_ds):
                 sub = SummaryTree()
-                attrs = {}
+                channel_types = {}
                 for channel_id in sorted(by_ds[ds_id]):
                     sub.children[channel_id] = channel_by_pair[
                         (ds_id, channel_id)
                     ]
-                    attrs[channel_id] = STRING_TYPE
-                sub.add_blob(".attributes", canonical_json(attrs))
+                    channel_types[channel_id] = STRING_TYPE
+                sub.add_blob(".attributes", canonical_json(
+                    {"channels": channel_types, "rooted": True}
+                ))
                 ds_tree.children[ds_id] = sub
             i += len(work.plan)
             out.append(tree)
@@ -237,10 +276,9 @@ class CatchupService:
             comp = IdCompressor.deserialize(prior)
         except KeyError:
             comp = IdCompressor()
-        for msg in work.tail:
-            if msg.type is MessageType.OP and isinstance(msg.contents, dict) \
-                    and "idRange" in msg.contents:
-                comp.finalize_range(msg.contents["idRange"])
+        for _msg, batch in work.decoded:
+            if "idRange" in batch:
+                comp.finalize_range(batch["idRange"])
         return comp.serialize()
 
     def _fold_quorum(self, work: _DocWork) -> List[str]:

@@ -1,0 +1,229 @@
+"""Container-runtime op pipeline (compression, chunking), garbage
+collection, and attachment blobs."""
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime.container import (
+    ContainerRuntime,
+    ContainerRuntimeOptions,
+)
+from fluidframework_tpu.runtime.gc import GCOptions
+from fluidframework_tpu.runtime.handles import channel_handle
+from fluidframework_tpu.service import LocalOrderingService
+from fluidframework_tpu.service.catchup import CatchupService
+
+
+def make_stack(registry=None, options=None):
+    service = LocalOrderingService()
+    factory = LocalDocumentServiceFactory(service)
+
+    class OptLoader(Loader):
+        def _new_runtime(self):
+            return ContainerRuntime(self.registry, options)
+
+    return service, OptLoader(factory, registry)
+
+
+def build_doc(rt):
+    ds = rt.create_datastore("ds")
+    ds.create_channel("map-tpu", "kv")
+    ds.create_channel("sequence-tpu", "text")
+
+
+def kv(c):
+    return c.runtime.get_datastore("ds").get_channel("kv")
+
+
+def text(c):
+    return c.runtime.get_datastore("ds").get_channel("text")
+
+
+# --- compression / chunking --------------------------------------------------
+
+
+def test_large_batch_is_compressed_on_wire():
+    opts = ContainerRuntimeOptions(compression_threshold=256)
+    service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    b = loader.resolve("doc", "bob")
+    kv(a).set("big", "x" * 2000)
+    a.drain()
+    b.drain()
+    wire = [m for m in service.oplog.get("doc")
+            if isinstance(m.contents, dict)
+            and m.contents.get("type") == "compressedBatch"]
+    assert wire, "batch should have been compressed on the wire"
+    assert kv(b).get("big") == "x" * 2000
+    assert (a.runtime.summarize().digest()
+            == b.runtime.summarize().digest())
+
+
+def test_huge_batch_is_chunked_and_reassembled():
+    opts = ContainerRuntimeOptions(compression_threshold=10**9,  # no compress
+                                   chunk_size=512)
+    service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    b = loader.resolve("doc", "bob")
+    payload = "".join(chr(ord("a") + i % 26) for i in range(4000))
+    kv(a).set("huge", payload)
+    a.drain()
+    b.drain()
+    chunks = [m for m in service.oplog.get("doc")
+              if isinstance(m.contents, dict)
+              and m.contents.get("type") == "chunk"]
+    assert len(chunks) >= 2
+    assert kv(b).get("huge") == payload
+    # a late joiner replays the chunked log correctly too
+    c = loader.resolve("doc", "carl")
+    assert kv(c).get("huge") == payload
+
+
+def test_compressed_and_chunked_together_with_device_catchup():
+    """Chunk+compress the wire, then let the bulk catch-up service decode
+    the same stream — string doc stays device-eligible."""
+    opts = ContainerRuntimeOptions(compression_threshold=128, chunk_size=256)
+    service, loader = make_stack(options=opts)
+
+    def build(rt):
+        rt.create_datastore("ds").create_channel("sequence-tpu", "text")
+
+    a = loader.create("doc", "alice", build)
+    t = a.runtime.get_datastore("ds").get_channel("text")
+    with a.runtime.order_sequentially():
+        for i in range(40):
+            t.insert_text(len(t.text), f"chunk-me-{i:03d} ")
+    a.drain()
+
+    svc = CatchupService(service)
+    svc.catch_up()
+    assert svc.device_docs == 1
+    fresh = loader.resolve("doc")
+    assert fresh.runtime.get_datastore("ds").get_channel("text").text \
+        == t.text
+
+
+# --- garbage collection ------------------------------------------------------
+
+
+def test_gc_sweeps_unreferenced_datastore():
+    opts = ContainerRuntimeOptions(gc=GCOptions(sweep_grace_ops=3))
+    service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    # a non-rooted datastore referenced from the rooted one
+    side = a.runtime.create_datastore("side", rooted=False)
+    side.create_channel("map-tpu", "data")
+    kv(a).set("ref", channel_handle("side", "data"))
+    a.drain()
+    state = a.runtime.summarize()
+    assert "side" in state.get(".datastores").children
+
+    # drop the reference; after grace ops, sweep
+    kv(a).delete("ref")
+    a.drain()
+    s1 = a.runtime.summarize()
+    import json
+    gc1 = json.loads(s1.blob_bytes(".gc"))
+    assert "side" in gc1["unreferenced"]
+    for i in range(4):
+        kv(a).set(f"pad{i}", i)
+        a.drain()
+    # sweeping is a sequenced op: EVERY replica deletes at the same fold
+    # position — and a replica that merely summarizes never mutates.
+    assert a.runtime.perform_gc_sweep() == ["side"]
+    a.drain()
+    s2 = a.runtime.summarize()
+    gc2 = json.loads(s2.blob_bytes(".gc"))
+    assert "side" in gc2["swept"]
+    assert "side" not in s2.get(".datastores").children
+    assert "side" not in a.runtime.datastores
+
+
+def test_gc_revival_clears_stamp():
+    opts = ContainerRuntimeOptions(gc=GCOptions(sweep_grace_ops=100))
+    _service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    side = a.runtime.create_datastore("side", rooted=False)
+    side.create_channel("map-tpu", "data")
+    a.drain()
+    s1 = a.runtime.summarize()
+    import json
+    assert "side" in json.loads(s1.blob_bytes(".gc"))["unreferenced"]
+    kv(a).set("ref", channel_handle("side", "data"))  # revive
+    a.drain()
+    s2 = a.runtime.summarize()
+    assert json.loads(s2.blob_bytes(".gc"))["unreferenced"] == {}
+
+
+def test_gc_state_rides_summary_to_loader():
+    opts = ContainerRuntimeOptions(gc=GCOptions(sweep_grace_ops=100))
+    service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    a.runtime.create_datastore("orphan", rooted=False) \
+        .create_channel("map-tpu", "x")
+    a.drain()
+    service.storage.upload("doc", a.runtime.summarize(),
+                           a.runtime.ref_seq)  # stamps orphan
+    b = loader.resolve("doc", "bob")
+    # bob inherits the stamp through his loaded summary
+    assert "orphan" in b.runtime.gc.unreferenced_at
+
+
+# --- attachment blobs --------------------------------------------------------
+
+
+def test_blob_roundtrip_and_replication():
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build_doc)
+    b = loader.resolve("doc", "bob")
+    payload = bytes(range(256)) * 10
+    handle = a.runtime.blob_manager.create_blob(payload)
+    kv(a).set("attachment", handle)
+    a.drain()
+    b.drain()
+    assert b.runtime.blob_manager.get_blob(kv(b).get("attachment")) \
+        == payload
+    # referenced blob rides the summary to a late joiner
+    c = loader.resolve("doc", "carl")
+    assert c.runtime.blob_manager.get_blob(kv(c).get("attachment")) \
+        == payload
+    assert (a.runtime.summarize().digest()
+            == b.runtime.summarize().digest())
+
+
+def test_unreferenced_blob_kept_through_grace_then_dropped():
+    """Blob bytes must survive the grace window (a handle written in the
+    post-summary tail still needs them), then drop."""
+    opts = ContainerRuntimeOptions(gc=GCOptions(sweep_grace_ops=3))
+    _service, loader = make_stack(options=opts)
+    a = loader.create("doc", "alice", build_doc)
+    handle = a.runtime.blob_manager.create_blob(b"ephemeral")
+    kv(a).set("att", handle)
+    a.drain()
+    sha = handle["fluidBlob"]
+    s1 = a.runtime.summarize()
+    assert sha in s1.get(".blobs").children
+    kv(a).delete("att")
+    a.drain()
+    s2 = a.runtime.summarize()  # stamps the blob, still within grace
+    assert sha in s2.get(".blobs").children
+    for i in range(4):
+        kv(a).set(f"pad{i}", i)
+        a.drain()
+    s3 = a.runtime.summarize()  # grace expired
+    assert sha not in s3.get(".blobs").children
+
+
+def test_blob_referenced_after_summary_point_survives():
+    """Regression (review-found): blob attached at seq N, handle written at
+    seq N+1; a loader of summary@N + tail must still resolve the blob."""
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build_doc)
+    handle = a.runtime.blob_manager.create_blob(b"late-referenced")
+    a.drain()
+    # summarize + upload BEFORE any handle references the blob
+    service.storage.upload("doc", a.runtime.summarize(), a.runtime.ref_seq)
+    kv(a).set("att", handle)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    assert b.runtime.blob_manager.get_blob(kv(b).get("att")) \
+        == b"late-referenced"
