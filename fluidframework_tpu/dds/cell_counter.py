@@ -34,16 +34,19 @@ class SharedCell(SharedObject):
         return self._empty
 
     def set(self, value: Any) -> None:
+        prev = self._value
         self._value, self._empty = value, False
         if self.is_attached:
             self._pending_writes += 1
         self._submit_local_op({"kind": "set", "value": value})
+        self._emit("valueChanged", {"previousValue": prev}, local=True)
 
     def delete(self) -> None:
         self._value, self._empty = None, True
         if self.is_attached:
             self._pending_writes += 1
         self._submit_local_op({"kind": "delete"})
+        self._emit("delete", local=True)
 
     def apply_stashed_op(self, contents) -> None:
         kind = contents["kind"]
@@ -62,9 +65,15 @@ class SharedCell(SharedObject):
             return  # pending local write sequences later → wins
         op = msg.contents
         if op["kind"] == "set":
+            prev = self._value
             self._value, self._empty = op["value"], False
+            if not local:
+                self._emit("valueChanged", {"previousValue": prev},
+                           local=False)
         else:
             self._value, self._empty = None, True
+            if not local:
+                self._emit("delete", local=False)
 
     def summarize(self, min_seq: int = 0) -> SummaryTree:
         tree = SummaryTree()
@@ -96,6 +105,8 @@ class SharedCounter(SharedObject):
             raise TypeError("counter delta must be an integer")
         self._value += delta  # optimistic; increments commute
         self._submit_local_op({"kind": "increment", "delta": delta})
+        self._emit("incremented", {"incrementAmount": delta,
+                                   "newValue": self.value}, local=True)
 
     def apply_stashed_op(self, contents) -> None:
         self.increment(contents["delta"])
@@ -104,6 +115,9 @@ class SharedCounter(SharedObject):
         if local:
             return  # already counted optimistically
         self._value += msg.contents["delta"]
+        self._emit("incremented",
+                   {"incrementAmount": msg.contents["delta"],
+                    "newValue": self._value}, local=False)
 
     def summarize(self, min_seq: int = 0) -> SummaryTree:
         tree = SummaryTree()

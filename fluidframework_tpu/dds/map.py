@@ -61,24 +61,27 @@ class MapKernel:
 
     # -- sequenced -------------------------------------------------------------
 
-    def process(self, op: dict, local: bool) -> None:
+    def process(self, op: dict, local: bool) -> bool:
+        """Apply one sequenced op; returns True when the op changed the
+        *visible* state (False for local acks and for remote ops masked by
+        pending local ops) — the event-emission signal."""
         kind = op["kind"]
         if kind == "clear":
             if local:
                 if self._pending_clears > 0:
                     self._pending_clears -= 1
-                    return  # already applied optimistically
+                    return False  # already applied optimistically
                 # Pending hold lost to a kernel reset (subdir delete/recreate
                 # sequenced under the in-flight clear): apply like a remote op.
             elif self._pending_clears > 0:
-                return  # our pending clear will win (larger seq)
+                return False  # our pending clear will win (larger seq)
             # Remote clear: drop sequenced state; keep keys with pending local
             # ops (those will be re-established when our ops sequence).
             survivors = {
                 k: v for k, v in self.data.items() if self._pending_keys.get(k, 0) > 0
             }
             self.data = survivors
-            return
+            return True
 
         key = op["key"]
         if local:
@@ -89,21 +92,22 @@ class MapKernel:
                     self._pending_keys.pop(key, None)
                 else:
                     self._pending_keys[key] = n - 1
-                return
+                return False
             if self._pending_clears > 0:
-                return  # our later clear wiped the hold and outranks this op
+                return False  # our later clear wiped the hold; it outranks
             # No pending hold: the kernel was reset underneath the in-flight
             # op (e.g. its subdirectory was deleted and recreated).  The op is
             # still the latest writer in sequence order — apply it like a
             # remote op so every replica converges.
         elif self._pending_clears > 0 or self._pending_keys.get(key, 0) > 0:
-            return  # a pending local op outranks this remote op
+            return False  # a pending local op outranks this remote op
         if kind == "set":
             self.data[key] = op["value"]
         elif kind == "delete":
             self.data.pop(key, None)
         else:
             raise ValueError(f"unknown map op kind {kind!r}")
+        return True
 
     # -- summary ---------------------------------------------------------------
 
@@ -140,17 +144,29 @@ class SharedMap(SharedObject):
         return len(self._kernel.data)
 
     def set(self, key: str, value: Any) -> None:
+        existed = key in self._kernel.data
+        prev = self._kernel.data.get(key)
         self._kernel.local_set(key, value, self.is_attached)
         self._submit_local_op({"kind": "set", "key": key, "value": value})
+        self._emit("valueChanged",
+                   {"key": key, "previousValue": prev,
+                    "previousExisted": existed}, local=True)
 
     def delete(self, key: str) -> bool:
-        existed = self._kernel.local_delete(key, self.is_attached)
+        existed = key in self._kernel.data
+        prev = self._kernel.data.get(key)
+        self._kernel.local_delete(key, self.is_attached)
         self._submit_local_op({"kind": "delete", "key": key})
+        if existed:
+            self._emit("valueChanged",
+                       {"key": key, "previousValue": prev,
+                        "previousExisted": True}, local=True)
         return existed
 
     def clear(self) -> None:
         self._kernel.local_clear(self.is_attached)
         self._submit_local_op({"kind": "clear"})
+        self._emit("clear", local=True)
 
     def apply_stashed_op(self, contents) -> None:
         kind = contents["kind"]
@@ -166,7 +182,19 @@ class SharedMap(SharedObject):
     # -- SharedObject ----------------------------------------------------------
 
     def _process_core(self, msg: SequencedMessage, local: bool, _meta) -> None:
-        self._kernel.process(msg.contents, local)
+        op = msg.contents
+        key = op.get("key")
+        existed = key in self._kernel.data if key is not None else False
+        prev = self._kernel.data.get(key) if key is not None else None
+        applied = self._kernel.process(op, local)
+        if local or not applied:
+            return  # optimistic apply already emitted / masked by pending
+        if op["kind"] == "clear":
+            self._emit("clear", local=False)
+        else:
+            self._emit("valueChanged",
+                       {"key": key, "previousValue": prev,
+                        "previousExisted": existed}, local=False)
 
     def summarize(self, min_seq: int = 0) -> SummaryTree:
         tree = SummaryTree()

@@ -70,11 +70,15 @@ class SharedString(SharedObject):
         self._submit_local_op(op)
         if not self.is_attached:
             self._ack_detached(group, op)
+        self._emit("sequenceDelta",
+                   {"kind": "insert", "pos": pos, "text": text,
+                    "props": props}, local=True)
 
     def remove_range(self, start: int, end: int) -> None:
         if start >= end:
             return
         client = self._local_client()
+        removed = self.text[start:end]
         group = SegmentGroup("remove")
         self.tree.apply_remove(
             start, end, UNASSIGNED_SEQ, client, self.tree.current_seq, group=group
@@ -83,6 +87,9 @@ class SharedString(SharedObject):
         self._submit_local_op({"kind": "remove", "start": start, "end": end})
         if not self.is_attached:
             self._ack_detached(group, {"kind": "remove"})
+        self._emit("sequenceDelta",
+                   {"kind": "remove", "start": start, "end": end,
+                    "removedText": removed}, local=True)
 
     def annotate_range(self, start: int, end: int, props: Dict[str, Any]) -> None:
         if start >= end or not props:
@@ -99,6 +106,9 @@ class SharedString(SharedObject):
         )
         if not self.is_attached:
             self._ack_detached(group, {"kind": "annotate", "props": props})
+        self._emit("sequenceDelta",
+                   {"kind": "annotate", "start": start, "end": end,
+                    "props": props}, local=True)
 
     # -- interval collections (north-star config #3) ---------------------------
 
@@ -213,6 +223,10 @@ class SharedString(SharedObject):
                 )
             else:
                 raise ValueError(f"unknown sequence op kind {kind!r}")
+            # Remote delta event.  Positions are the submitting client's
+            # view (op coordinates), mirroring the wire op — a documented
+            # deviation from the reference's resolved-range delta events.
+            self._emit("sequenceDelta", dict(op), local=False)
         self.tree.current_seq = msg.seq
         if msg.min_seq > self.tree.min_seq:
             self.tree.zamboni(msg.min_seq)

@@ -25,10 +25,11 @@ from typing import Any, Deque, Optional, Tuple
 
 from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.summary import SummaryTree
+from ..utils.events import EventEmitter
 
 
 class SharedObject:
-    """Base DDS: pending-op bookkeeping + runtime wiring."""
+    """Base DDS: pending-op bookkeeping + runtime wiring + change events."""
 
     #: channel type identifier, e.g. "map-tpu"; set by subclasses and used by
     #: the ChannelFactory registry (the plugin boundary).
@@ -45,6 +46,8 @@ class SharedObject:
         # to ops submitted before a load() reset the channel's state.
         self._stale_ack_floor = -1
         self._last_submitted_client_seq = -1
+        self.events = EventEmitter()
+        self._in_event = 0  # op-reentrancy guard depth
 
     # -- runtime wiring --------------------------------------------------------
 
@@ -58,8 +61,24 @@ class SharedObject:
     def is_attached(self) -> bool:
         return self._delta_connection is not None
 
+    def _emit(self, event: str, *args, **kwargs) -> None:
+        """Emit a change event with op-reentrancy detection: mutating a DDS
+        from inside its own change event diverges optimistic state across
+        clients, so it errors (the reference's op-reentrancy guard —
+        SURVEY.md §5 race-detection equivalents)."""
+        self._in_event += 1
+        try:
+            self.events.emit(event, *args, **kwargs)
+        finally:
+            self._in_event -= 1
+
     def _submit_local_op(self, contents: Any, local_metadata: Any = None) -> None:
         """Send an optimistically-applied local op to the sequencer."""
+        if self._in_event:
+            raise RuntimeError(
+                f"{self.id}: op submitted from inside a change-event "
+                f"listener (op re-entrancy is not allowed)"
+            )
         if self._delta_connection is None:
             return  # detached: local-only state, nothing to send
         client_seq = self._delta_connection.submit(contents)

@@ -695,6 +695,7 @@ class SharedTree(SharedObject):
     def _submit_changeset(self, cs: dict) -> None:
         if self.is_attached:
             self._submit_local_op(cs, local_metadata=cs)
+            self._emit("changed", {"changeset": cs}, local=True)
         else:
             # Detached: the edit is immediately "sequenced" locally — the
             # attach summary will carry it (reference: attach serializes
@@ -721,6 +722,8 @@ class SharedTree(SharedObject):
         apply_changeset(self.seq_forest, cs, msg.seq)
         self._invalidate()
         self.advance(msg.seq, msg.min_seq)
+        if not local:
+            self._emit("changed", {"changeset": cs}, local=False)
 
     # -- window / zamboni ------------------------------------------------------
 

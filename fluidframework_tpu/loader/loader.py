@@ -108,6 +108,9 @@ class Container:
         # connection's partial chunk trains die with its LEAVE).
         self.runtime._outbox.clear()
         self.runtime._pending_wire.clear()
+        # Meta-ops (ds/channel/blob attaches) first: their channels' ops
+        # must land on materialized targets.
+        self.runtime.resubmit_pending_runtime_ops()
         for ds in self.runtime.datastores.values():
             ds.resubmit_pending()
         self.runtime.flush()

@@ -108,6 +108,10 @@ class ContainerRuntime:
         # send resumes HERE (same bytes, same client_seqs) so partially-
         # delivered chunk trains and consumed idRanges are never re-encoded.
         self._pending_wire: List[RawOperation] = []
+        # Runtime meta-ops (dsAttach/channelAttach/blobAttach/gcSweep)
+        # awaiting their sequenced echo — resubmitted on reconnect like
+        # channel ops (they'd otherwise be lost with the cleared outbox).
+        self._pending_runtime: Dict[int, dict] = {}
 
     # -- datastores ------------------------------------------------------------
 
@@ -121,6 +125,13 @@ class ContainerRuntime:
         ds = FluidDataStoreRuntime(datastore_id, self.registry, rooted=rooted)
         ds._attach(self)
         self.datastores[datastore_id] = ds
+        if self.is_attached and self.client_id is not None:
+            # Live creation: announce so every replica materializes it.
+            self._submit_runtime_op({
+                "runtime": "dsAttach",
+                "ds": datastore_id,
+                "rooted": rooted,
+            })
         return ds
 
     def get_datastore(self, datastore_id: str) -> FluidDataStoreRuntime:
@@ -233,29 +244,46 @@ class ContainerRuntime:
         Returns the ids proposed for sweeping."""
         ready = self.gc.sweep_ready(self.ref_seq)
         if ready and self._service is not None:
-            self._client_seq += 1
-            self._outbox.append({
-                "clientSeq": self._client_seq,
-                "runtime": "gcSweep",
-                "ids": ready,
-            })
-            if not self._batching:
-                self.flush()
+            self._submit_runtime_op({"runtime": "gcSweep", "ids": ready})
         return ready
+
+    def _submit_runtime_op(self, envelope: dict) -> None:
+        """Runtime meta-op: rides the outbox like channel ops, tracked for
+        resubmit-on-reconnect until its sequenced echo arrives."""
+        self._client_seq += 1
+        self._outbox.append({"clientSeq": self._client_seq, **envelope})
+        self._pending_runtime[self._client_seq] = envelope
+        if not self._batching:
+            self.flush()
+
+    def resubmit_pending_runtime_ops(self) -> None:
+        """Reconnect: re-issue unacked meta-ops in original order (before
+        channel resubmits — attaches must precede their channels' ops).
+        Receivers treat every meta-op idempotently, so a duplicate from a
+        sequenced-but-unacked original is harmless."""
+        pending = sorted(self._pending_runtime.items())
+        self._pending_runtime.clear()
+        for _old_seq, envelope in pending:
+            self._submit_runtime_op(envelope)
+
+    def _submit_channel_attach(self, ds_id: str, channel_id: str,
+                               type_name: str) -> None:
+        self._submit_runtime_op({
+            "runtime": "channelAttach",
+            "ds": ds_id,
+            "channel": channel_id,
+            "channelType": type_name,
+        })
 
     def _submit_blob_attach(self, sha: str, content: bytes) -> None:
         """Replicate an attachment blob (BlobManager upload path)."""
         if self._service is None:
             return  # detached: the blob rides the attach summary
-        self._client_seq += 1
-        self._outbox.append({
-            "clientSeq": self._client_seq,
+        self._submit_runtime_op({
             "runtime": "blobAttach",
             "sha": sha,
             "data": base64.b64encode(content).decode("ascii"),
         })
-        if not self._batching:
-            self.flush()
 
     # -- inbound ---------------------------------------------------------------
 
@@ -291,11 +319,39 @@ class ContainerRuntime:
                 self.id_compressor.finalize_range(contents["idRange"])
             local = msg.client_id in self._client_ids
             for sub in contents["ops"]:
+                if local and "runtime" in sub:
+                    self._pending_runtime.pop(sub["clientSeq"], None)
                 if sub.get("runtime") == "blobAttach":
                     self.blob_manager.process_attach(sub["sha"], sub["data"])
                     continue
                 if sub.get("runtime") == "gcSweep":
                     self.gc.apply_sweep(sub["ids"])
+                    continue
+                if sub.get("runtime") == "dsAttach":
+                    existing = self.datastores.get(sub["ds"])
+                    if existing is None:
+                        ds = FluidDataStoreRuntime(
+                            sub["ds"], self.registry,
+                            rooted=sub.get("rooted", True),
+                        )
+                        ds._attach(self)
+                        self.datastores[sub["ds"]] = ds
+                    elif existing.rooted != sub.get("rooted", True):
+                        # Two clients created the same id with different
+                        # GC rootedness: an app-level id collision — fail
+                        # loudly rather than letting GC diverge.
+                        raise RuntimeError(
+                            f"conflicting dsAttach for {sub['ds']!r}: "
+                            f"rooted={existing.rooted} vs "
+                            f"{sub.get('rooted', True)}"
+                        )
+                    continue
+                if sub.get("runtime") == "channelAttach":
+                    ds = self.datastores.get(sub["ds"])
+                    if ds is not None:
+                        ds._materialize_remote_channel(
+                            sub["channelType"], sub["channel"]
+                        )
                     continue
                 ds = self.datastores.get(sub["ds"])
                 if ds is not None:

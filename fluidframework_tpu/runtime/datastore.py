@@ -49,8 +49,36 @@ class FluidDataStoreRuntime:
             raise ValueError(f"channel {channel_id!r} already exists")
         channel = self.registry.get(type_name).create(channel_id)
         self.channels[channel_id] = channel
+        # Dynamically created channels (container already live) announce
+        # themselves with a sequenced attach op so every remote replica
+        # materializes the channel before its first op arrives; channels
+        # created while detached ride the attach summary instead.
+        if self._container is not None and self._container.is_attached:
+            self._container._submit_channel_attach(
+                self.id, channel_id, type_name
+            )
         self._connect_channel(channel)
         return channel
+
+    def _materialize_remote_channel(self, type_name: str,
+                                    channel_id: str) -> None:
+        """A peer's channelAttach op: create the (empty) channel."""
+        existing = self.channels.get(channel_id)
+        if existing is not None:
+            if existing.TYPE != type_name:
+                # Two clients concurrently created the same channel id with
+                # different types — an app-level id collision that cannot
+                # merge.  Fail loudly (the reference asserts) instead of
+                # silently routing one type's ops into the other.
+                raise RuntimeError(
+                    f"conflicting channelAttach for "
+                    f"{self.id!r}/{channel_id!r}: {existing.TYPE} vs "
+                    f"{type_name}"
+                )
+            return  # our own attach echo (or identical concurrent create)
+        channel = self.registry.get(type_name).create(channel_id)
+        self.channels[channel_id] = channel
+        self._connect_channel(channel)
 
     def get_channel(self, channel_id: str) -> SharedObject:
         return self.channels[channel_id]

@@ -1,0 +1,5 @@
+"""Shared utilities: events, telemetry, configuration."""
+
+from .events import EventEmitter
+
+__all__ = ["EventEmitter"]

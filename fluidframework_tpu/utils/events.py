@@ -1,0 +1,44 @@
+"""Typed event emission — the capability of the reference's
+``TypedEventEmitter`` (core-utils; SURVEY.md §2.1; upstream paths
+UNVERIFIED — empty reference mount).
+
+DDSes emit change events ("valueChanged", "sequenceDelta", …) that app
+code and the undo-redo stack subscribe to.  Listener errors propagate —
+the reference's op-reentrancy rule applies: mutating a DDS from inside its
+own change event is a programming error the runtime guards against
+(see ``SharedObject._emit``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+
+class EventEmitter:
+    """Minimal ordered event emitter."""
+
+    def __init__(self) -> None:
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    def on(self, event: str, fn: Callable) -> Callable:
+        """Subscribe; returns ``fn`` for easy unsubscription."""
+        self._listeners.setdefault(event, []).append(fn)
+        return fn
+
+    def off(self, event: str, fn: Callable) -> None:
+        listeners = self._listeners.get(event)
+        if listeners and fn in listeners:
+            listeners.remove(fn)
+
+    def once(self, event: str, fn: Callable) -> Callable:
+        def wrapper(*args: Any, **kwargs: Any):
+            self.off(event, wrapper)
+            return fn(*args, **kwargs)
+
+        return self.on(event, wrapper)
+
+    def emit(self, event: str, *args: Any, **kwargs: Any) -> None:
+        for fn in list(self._listeners.get(event, [])):
+            fn(*args, **kwargs)
+
+    def listener_count(self, event: str) -> int:
+        return len(self._listeners.get(event, []))

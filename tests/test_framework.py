@@ -1,0 +1,276 @@
+"""Framework layer: FluidClient/FluidContainer, DataObject, DDS events,
+presence (signals), undo-redo."""
+
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.framework import (
+    ContainerSchema,
+    DataObject,
+    DataObjectFactory,
+    FluidClient,
+    Presence,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.service import LocalOrderingService
+
+
+SCHEMA = ContainerSchema(initial_objects={
+    "notes": "sequence-tpu",
+    "votes": "map-tpu",
+    "tally": "counter-tpu",
+})
+
+
+def make_clients(n=2, doc_id="doc"):
+    service = LocalOrderingService()
+    client = FluidClient(LocalDocumentServiceFactory(service))
+    first = client.create_container(doc_id, SCHEMA)
+    rest = [client.get_container(doc_id, SCHEMA) for _ in range(n - 1)]
+    return service, [first] + rest
+
+
+def sync(containers):
+    for c in containers:
+        c.sync()
+
+
+# --- FluidClient / FluidContainer --------------------------------------------
+
+
+def test_create_and_get_container_with_initial_objects():
+    _service, (a, b) = make_clients()
+    assert set(a.initial_objects) == {"notes", "votes", "tally"}
+    a.initial_objects["notes"].insert_text(0, "hello")
+    b.initial_objects["votes"].set("q1", "yes")
+    b.initial_objects["tally"].increment(3)
+    sync([a, b])
+    assert b.initial_objects["notes"].text == "hello"
+    assert a.initial_objects["votes"].get("q1") == "yes"
+    assert a.initial_objects["tally"].value == 3
+    assert a.connected and b.connected
+
+
+def test_dynamic_channel_creation():
+    _service, (a, b) = make_clients()
+    extra = a.create_channel("map-tpu", "extra")
+    extra.set("k", 1)
+    sync([a, b])
+    b_extra = b._container.runtime.get_datastore(
+        "initial-objects").get_channel("extra")
+    assert b_extra.get("k") == 1
+
+
+# --- DataObject ---------------------------------------------------------------
+
+
+class TodoList(DataObject):
+    CHANNELS = {"items": "map-tpu", "title": "cell-tpu"}
+
+    def initialize_first_time(self):
+        self.title.set("untitled")
+
+
+def test_data_object_create_and_load():
+    service = LocalOrderingService()
+    client = FluidClient(LocalDocumentServiceFactory(service))
+    a = client.create_container("doc", SCHEMA)
+    factory = DataObjectFactory(TodoList)
+    todo = factory.create(a._container.runtime, "todo")
+    assert todo.title.get() == "untitled"
+    todo.items.set("buy-milk", {"done": False})
+    a.sync()
+
+    b = client.get_container("doc", SCHEMA)
+    todo_b = factory.load(b._container.runtime, "todo")
+    assert todo_b.items.get("buy-milk") == {"done": False}
+    assert todo_b.title.get() == "untitled"
+
+
+def test_offline_dynamic_creation_survives_reconnect():
+    """Datastore/channel/blob attaches made while offline must replicate
+    after reconnect (review-found: they were dropped with the outbox)."""
+    _service, (a, b) = make_clients()
+    a._container.disconnect()
+    rt = a._container.runtime
+    ds = rt.create_datastore("offline-ds")
+    ch = ds.create_channel("map-tpu", "data")
+    ch.set("k", 42)
+    blob_handle = rt.blob_manager.create_blob(b"offline-blob")
+    ch.set("file", blob_handle)
+    a._container.reconnect()
+    sync([a, b])
+    b_rt = b._container.runtime
+    assert "offline-ds" in b_rt.datastores
+    b_ch = b_rt.get_datastore("offline-ds").get_channel("data")
+    assert b_ch.get("k") == 42
+    assert b_rt.blob_manager.get_blob(b_ch.get("file")) == b"offline-blob"
+    assert (rt.summarize().digest() == b_rt.summarize().digest())
+
+
+def test_conflicting_channel_attach_fails_loudly():
+    _service, (a, b) = make_clients()
+    a._container.runtime.get_datastore("initial-objects") \
+        .create_channel("map-tpu", "clash")
+    b._container.runtime.get_datastore("initial-objects") \
+        .create_channel("counter-tpu", "clash")
+    # each side trips on the OTHER side's conflicting attach
+    with pytest.raises(RuntimeError, match="conflicting channelAttach"):
+        a.sync()
+    with pytest.raises(RuntimeError, match="conflicting channelAttach"):
+        b.sync()
+
+
+# --- DDS events ---------------------------------------------------------------
+
+
+def test_map_value_changed_events_local_and_remote():
+    _service, (a, b) = make_clients()
+    seen = []
+    b.initial_objects["votes"].events.on(
+        "valueChanged", lambda ev, local: seen.append((ev["key"], local)))
+    a.initial_objects["votes"].set("x", 1)
+    sync([a, b])
+    b.initial_objects["votes"].set("y", 2)
+    assert ("x", False) in seen
+    assert ("y", True) in seen
+
+
+def test_op_reentrancy_guard():
+    _service, (a, b) = make_clients()
+    votes = a.initial_objects["votes"]
+    votes.events.on("valueChanged",
+                    lambda ev, local: votes.set("echo", 1))
+    with pytest.raises(RuntimeError, match="re-entrancy"):
+        votes.set("trigger", 0)
+
+
+def test_sequence_delta_events():
+    _service, (a, b) = make_clients()
+    deltas = []
+    a.initial_objects["notes"].events.on(
+        "sequenceDelta", lambda ev, local: deltas.append((ev["kind"], local)))
+    a.initial_objects["notes"].insert_text(0, "abc")
+    b.initial_objects["notes"].insert_text(0, "xyz")
+    sync([a, b])
+    assert ("insert", True) in deltas
+    assert ("insert", False) in deltas
+
+
+# --- presence -----------------------------------------------------------------
+
+
+def test_presence_broadcast_and_late_joiner():
+    service, (a, b) = make_clients()
+    pa = Presence(a)
+    pb = Presence(b)
+    pa.workspace("cursors").set_local("pos", 17)
+    assert pb.workspace("cursors").get(a.client_id, "pos") == 17
+    # nothing was sequenced
+    ops_before = service.oplog.head("doc")
+    pa.workspace("cursors").set_local("pos", 18)
+    assert service.oplog.head("doc") == ops_before
+    # a late joiner requests current presence and receives it
+    client = FluidClient(LocalDocumentServiceFactory(service))
+    c = client.get_container("doc", SCHEMA)
+    pc = Presence(c)
+    assert pc.workspace("cursors").get(a.client_id, "pos") == 18
+
+
+def test_presence_targeted_signal():
+    _service, (a, b) = make_clients()
+    got = []
+    b.on_signal(lambda s: got.append(s))
+    a.submit_signal({"ping": 1}, target_client_id=b.client_id)
+    a.submit_signal({"ping": 2}, target_client_id="someone-else")
+    pings = [s["content"]["ping"] for s in got
+             if s.get("targetClientId") in (b.client_id, None)
+             and "ping" in (s.get("content") or {})]
+    assert 1 in pings and 2 not in pings
+
+
+# --- undo-redo ----------------------------------------------------------------
+
+
+def test_undo_redo_map_and_counter():
+    _service, (a, b) = make_clients()
+    mgr = UndoRedoStackManager()
+    votes, tally = a.initial_objects["votes"], a.initial_objects["tally"]
+    mgr.attach(votes)
+    mgr.attach(tally)
+
+    votes.set("k", "v1")
+    votes.set("k", "v2")
+    tally.increment(5)
+    sync([a, b])
+
+    assert mgr.undo()  # undo increment
+    sync([a, b])
+    assert tally.value == 0
+    assert b.initial_objects["tally"].value == 0
+
+    assert mgr.undo()  # undo k=v2
+    sync([a, b])
+    assert votes.get("k") == "v1"
+
+    assert mgr.redo()
+    sync([a, b])
+    assert votes.get("k") == "v2"
+    assert b.initial_objects["votes"].get("k") == "v2"
+
+
+def test_undo_string_insert_and_remove():
+    _service, (a, b) = make_clients()
+    mgr = UndoRedoStackManager()
+    notes = a.initial_objects["notes"]
+    mgr.attach(notes)
+
+    notes.insert_text(0, "hello world")
+    notes.remove_range(5, 11)  # "hello"
+    sync([a, b])
+    assert notes.text == "hello"
+
+    assert mgr.undo()  # restore " world"
+    sync([a, b])
+    assert notes.text == "hello world"
+    assert b.initial_objects["notes"].text == "hello world"
+
+    assert mgr.undo()  # remove the original insert
+    sync([a, b])
+    assert notes.text == ""
+
+    assert mgr.redo()
+    sync([a, b])
+    assert notes.text == "hello world"
+
+
+def test_undo_grouped_operation():
+    _service, (a, b) = make_clients()
+    mgr = UndoRedoStackManager()
+    votes = a.initial_objects["votes"]
+    mgr.attach(votes)
+    with mgr.operation():
+        votes.set("a", 1)
+        votes.set("b", 2)
+        votes.set("c", 3)
+    sync([a, b])
+    assert mgr.undo()  # one step reverts all three
+    sync([a, b])
+    assert votes.get("a") is None and votes.get("c") is None
+    assert not mgr.can_undo
+
+
+def test_undo_merges_with_concurrent_remote_edit():
+    _service, (a, b) = make_clients()
+    mgr = UndoRedoStackManager()
+    notes_a = a.initial_objects["notes"]
+    notes_b = b.initial_objects["notes"]
+    mgr.attach(notes_a)
+    notes_a.insert_text(0, "AAA ")
+    sync([a, b])
+    notes_b.insert_text(4, "BBB ")
+    sync([a, b])
+    assert notes_a.text == "AAA BBB "
+    mgr.undo()  # removes "AAA " — BBB survives
+    sync([a, b])
+    assert notes_a.text == notes_b.text == "BBB "

@@ -69,12 +69,14 @@ def test_grouped_batch_is_atomic():
     ds_b.create_channel("map-tpu", "m")
     ds_b.create_channel("sequence-tpu", "s")
 
+    head_before = seq.seq  # attach ops for the channels are already out
     with a.order_sequentially():
         m_a.set("k", 1)
         s_a.insert_text(0, "x")
         m_a.set("k2", 2)
     # One grouped message on the wire for the three ops.
-    op_msgs = [m for m in seq.log if m.type.value == "op"]
+    op_msgs = [m for m in seq.log if m.type.value == "op"
+               and m.seq > head_before]
     assert len(op_msgs) == 1
     assert len(op_msgs[0].contents["ops"]) == 3
     drain_all(a, b)
@@ -169,15 +171,40 @@ def test_catchup_from_latest_summary_via_storage():
     assert fresh.get_datastore("d").get_channel("s").text == s.text
 
 
-def test_unknown_channel_op_raises():
+def test_channel_attach_materializes_on_remote():
+    """A dynamically created channel announces itself: peers that never
+    created it locally materialize it from the sequenced attach op."""
     seq = Sequencer()
     a = make_runtime(seq, "alice")
     b = make_runtime(seq, "bob")
     drain_all(a, b)
     ds_a = a.create_datastore("d")
     ds_a.create_channel("map-tpu", "m")
-    b.create_datastore("d")  # bob never creates the channel
     ds_a.get_channel("m").set("k", 1)
-    a.drain()
+    drain_all(a, b)
+    assert b.get_datastore("d").get_channel("m").get("k") == 1
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_unknown_channel_op_raises():
+    """A genuinely unknown channel (no attach op, not in any summary) is a
+    corruption signal: routing raises rather than dropping silently."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType as MT,
+        SequencedMessage as SM,
+    )
+
+    seq = Sequencer()
+    b = make_runtime(seq, "bob")
+    drain_all(b)
+    b.create_datastore("d")
+    b.drain()
+    rogue = SM(
+        seq=seq.seq + 1, client_id="ghost", client_seq=1,
+        ref_seq=seq.seq, min_seq=0, type=MT.OP,
+        contents={"type": "groupedBatch", "ops": [
+            {"clientSeq": 1, "ds": "d", "channel": "nope", "contents": {}}
+        ]},
+    )
     with pytest.raises(KeyError):
-        b.drain()
+        b.process(rogue)
