@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..protocol.messages import MessageType, SequencedMessage
 from ..runtime.container import ContainerRuntime
 from ..runtime.registry import ChannelRegistry
+from ..utils.telemetry import MonitoringContext, PerformanceEvent
 from .delta_manager import ConnectionState, DeltaManager
 
 
@@ -58,6 +59,7 @@ class Container:
         self.runtime = runtime
         self.delta_manager = delta_manager
         self.audience = Audience()
+        self.catchup_ops = 0  # ops replayed from delta storage at load
         # Members whose JOIN predates the loaded summary are only visible
         # in the summary's quorum — seed from it (joinedSeq unknowable).
         for cid in runtime.election.quorum:
@@ -157,9 +159,11 @@ class Loader:
     """Resolves documents through a driver factory into Containers."""
 
     def __init__(self, factory,
-                 registry: Optional[ChannelRegistry] = None) -> None:
+                 registry: Optional[ChannelRegistry] = None,
+                 mc: Optional[MonitoringContext] = None) -> None:
         self.factory = factory
         self.registry = registry
+        self.mc = (mc or MonitoringContext()).child("loader")
 
     def _new_runtime(self) -> ContainerRuntime:
         return ContainerRuntime(self.registry)
@@ -195,6 +199,18 @@ class Loader:
         if pending_state is not None and client_id is None:
             raise ValueError("rehydrating pending state requires a live "
                              "client_id (stashed ops must be resubmitted)")
+        with PerformanceEvent.timed_exec(
+                self.mc.logger, "containerLoad", docId=doc_id) as perf:
+            container = self._resolve(doc_id, client_id, pending_state)
+            perf["extra"]["catchupOps"] = container.catchup_ops
+        return container
+
+    def _resolve(
+        self,
+        doc_id: str,
+        client_id: Optional[str],
+        pending_state: Optional[dict],
+    ) -> Container:
         service = self.factory.resolve(doc_id)
         runtime = self._new_runtime()
 
@@ -219,6 +235,7 @@ class Loader:
         post_stash = tail[len(pre_stash):]
         for msg in pre_stash:
             runtime.process(msg)
+        container.catchup_ops = len(pre_stash)
         container.delta_manager.note_delivered(runtime.ref_seq)
 
         if client_id is not None:

@@ -37,6 +37,7 @@ correctness is never approximated.  Segment pool capacity = base segments +
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
@@ -47,6 +48,7 @@ import numpy as np
 from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from .interning import Interner, TextArena, next_bucket
+from .native_pack import count_stream
 
 NOT_REMOVED = np.int32(np.iinfo(np.int32).max)
 # Property-column sentinels (values are interned ids >= 0).
@@ -230,6 +232,31 @@ replay_vmapped = jax.vmap(replay_scan)
 _replay_batch = jax.jit(replay_vmapped)
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
+    """Cold-start fold: documents with no base summary start from the empty
+    state, which is all zeros/sentinels — building it IN-GRAPH instead of
+    transferring (D, S) arrays of zeros through the host↔device link cuts
+    the per-chunk upload to the op arrays alone (the link, not the fold, is
+    the bottleneck on a tunneled chip)."""
+    D = ops.kind.shape[0]
+    K = ops.pvals.shape[2]
+    state = MTState(
+        tstart=jnp.zeros((D, S), jnp.int32),
+        tlen=jnp.zeros((D, S), jnp.int32),
+        ins_seq=jnp.zeros((D, S), jnp.int32),
+        ins_client=jnp.full((D, S), -1, jnp.int32),
+        rem_seq=jnp.full((D, S), NOT_REMOVED, jnp.int32),
+        rem_client=jnp.full((D, S), -1, jnp.int32),
+        rem2_seq=jnp.full((D, S), NOT_REMOVED, jnp.int32),
+        rem2_client=jnp.full((D, S), -1, jnp.int32),
+        props=jnp.full((D, S, K), PROP_ABSENT, jnp.int32),
+        n=jnp.zeros((D,), jnp.int32),
+        overflow=jnp.zeros((D,), jnp.bool_),
+    )
+    return replay_vmapped(state, ops)
+
+
 # ---------------------------------------------------------------------------
 # Host side: packing and canonical summary extraction
 # ---------------------------------------------------------------------------
@@ -247,6 +274,13 @@ class MergeTreeDocInput:
     base_seq: int = 0     # seq of the base summary (for oracle fallback)
     base_msn: int = 0     # minSeq of the base summary
     base_intervals: Optional[Dict[str, dict]] = None  # intervals blob content
+    # Native fast path: the ops pre-encoded as the liboppack binary record
+    # stream (ops/native_pack.py) + the client-id intern order the encoder
+    # used.  Only valid for prop-free insert/remove streams with no
+    # interval ops; when set, ``ops`` may be empty (the stream is
+    # authoritative) — C++ fills this doc's arrays.
+    binary_ops: Optional[bytes] = None
+    binary_clients: Optional[Sequence[str]] = None
 
 
 class _DocPack:
@@ -274,12 +308,15 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     arena = TextArena()
     doc_packs = [_DocPack() for _ in docs]
 
-    # Pre-scan for the shared property-key vocabulary K.
+    # Pre-scan for the shared property-key vocabulary K.  Binary-stream
+    # docs are prop-free by contract and skip it.
     for doc in docs:
         if doc.base_records:
             for rec in doc.base_records:
                 for key in rec.get("p", {}):
                     prop_keys.intern(key)
+        if doc.binary_ops is not None:
+            continue
         for msg in doc.ops:
             op = msg.contents
             if op["kind"].startswith("interval"):
@@ -289,9 +326,22 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     # Power-of-two buckets: jitted shapes stay stable across batches instead
     # of recompiling the vmapped scan per (D, S, T, K).
     K = next_bucket(max(len(prop_keys), 1), floor=1)
+    binary_counts = {}
+    for i, d in enumerate(docs):
+        if d.binary_ops is not None:
+            if d.base_records:
+                # Base-record clients would shift the encoder's dense client
+                # ids — a silent misattribution, so refuse (warm-start docs
+                # take the message-list path).
+                raise ValueError(
+                    f"{d.doc_id}: binary_ops cannot be combined with "
+                    f"base_records"
+                )
+            binary_counts[i] = count_stream(d.binary_ops)
     text_op_counts = [
+        binary_counts[i][0] if i in binary_counts else
         sum(1 for m in d.ops if not m.contents["kind"].startswith("interval"))
-        for d in docs
+        for i, d in enumerate(docs)
     ]
     T = next_bucket(max(text_op_counts, default=1), floor=16)
     base_counts = [len(d.base_records or []) for d in docs]
@@ -349,6 +399,21 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             for key, value in rec.get("p", {}).items():
                 st["props"][d, s, prop_keys.intern(key)] = values.intern(value)
         st["n"][d] = len(doc.base_records or [])
+
+        if doc.binary_ops is not None:
+            # Native fast path: C++ fills this doc's rows in one pass.
+            from .native_pack import pack_doc_row
+
+            for client in (doc.binary_clients or []):
+                pack.client_idx(client)
+            row = {key: op[key][d]
+                   for key in ("kind", "seq", "client", "ref_seq",
+                               "a", "b", "tstart", "tlen", "pvals")}
+            doc_bytes = bytearray()
+            pack_doc_row(doc.binary_ops, row, K, len(arena), doc_bytes,
+                         text_bytes=binary_counts[d][1])
+            arena.append(doc_bytes.decode("utf-8"))
+            continue
 
         t = -1
         for msg in doc.ops:
@@ -473,7 +538,13 @@ def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:
         replica.tree.load_records(doc.base_records, doc.base_seq, doc.base_msn)
         for label, obj in (doc.base_intervals or {}).items():
             replica.get_interval_collection(label).load_obj(obj)
-    for msg in doc.ops:
+    ops = doc.ops
+    if doc.binary_ops is not None and not ops:
+        from .native_pack import decode_string_ops
+
+        ops = decode_string_ops(doc.binary_ops,
+                                list(doc.binary_clients or []))
+    for msg in ops:
         replica.process(msg, local=False)
     replica.advance(doc.final_seq, doc.final_msn)
     return replica.summarize()
@@ -526,7 +597,12 @@ def replay_mergetree_batch(
 
     def fold_batch(batch):
         state, ops, meta = pack_mergetree_batch(batch)
-        final = _replay_batch(state, ops)
+        if not any(d.base_records for d in batch):
+            # all-cold chunk: initial state is built in-graph (no zero
+            # upload; the host link is the bottleneck, not the fold)
+            final = _replay_batch_cold(ops, state.tstart.shape[1])
+        else:
+            final = _replay_batch(state, ops)
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
         return [
             summary_from_state(meta, state_np, d) for d in range(len(batch))

@@ -1,0 +1,274 @@
+"""Native op packing: binary record streams + the liboppack C++ packer.
+
+The ingestion side (sequencer/scriptorium, bench synthesis, or the catch-up
+service's flatten step) encodes each string-channel op stream ONCE into the
+flat binary record format documented in ``native/oppack.cpp``; packing a
+10k-document batch for the device then runs entirely in C++ — one pass per
+document filling the padded (D, T) arrays and the shared text arena, no
+Python objects in the loop.
+
+Build: ``liboppack.so`` compiles on demand from ``native/oppack.cpp`` with
+g++ (cached next to the source, rebuilt when the source is newer).  If no
+toolchain is available the pure-Python encoder/packer pair keeps everything
+working — the native path is a strictly optional accelerator with
+bit-identical output (asserted by tests/test_native_pack.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..protocol.messages import MessageType, SequencedMessage
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "oppack.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "liboppack.so")
+
+_KINDS = {"insert": 1, "remove": 2, "annotate": 3}
+_HEADER = struct.Struct("<B7i")
+_PAIR = struct.Struct("<2i")
+
+
+# -- encoder (ingestion side; pure Python by design — runs once per op) -------
+
+
+def encode_string_ops(
+    ops: Sequence[SequencedMessage],
+    client_intern,
+    prop_key_intern=None,
+    value_intern=None,
+) -> bytes:
+    """Sequence-channel ops → the flat binary record stream.
+
+    ``client_intern`` / ``prop_key_intern`` / ``value_intern`` are
+    ``Interner``-likes (callables via ``.intern``); symbol interning stays
+    host-side so records carry dense ids only."""
+    out = bytearray()
+    for msg in ops:
+        if msg.type is not MessageType.OP:
+            continue
+        op = msg.contents
+        kind = _KINDS[op["kind"]]
+        client = client_intern.intern(msg.client_id) \
+            if msg.client_id is not None else -1
+        if kind == 1:
+            text = op["text"].encode("utf-8")
+            a, b = op["pos"], 0
+        else:
+            text = b""
+            a, b = op["start"], op["end"]
+        props = op.get("props") or {}
+        pairs = []
+        for key, value in props.items():
+            if prop_key_intern is None:
+                raise ValueError("props present but no prop interner given")
+            k = prop_key_intern.intern(key)
+            v = -1 if value is None else value_intern.intern(value)
+            pairs.append((k, v))
+        out += _HEADER.pack(kind, msg.seq, msg.ref_seq, client, a, b,
+                            len(pairs), len(text))
+        for pair in pairs:
+            out += _PAIR.pack(*pair)
+        out += text
+    return bytes(out)
+
+
+def decode_string_ops(
+    blob: bytes, clients: Sequence[str],
+    prop_keys: Optional[Sequence[str]] = None,
+    values: Optional[Sequence] = None,
+) -> List[SequencedMessage]:
+    """Inverse of :func:`encode_string_ops` — the oracle-fallback escape
+    hatch for binary-only documents (rare; correctness over speed)."""
+    out: List[SequencedMessage] = []
+    off = 0
+    kinds = {v: k for k, v in _KINDS.items()}
+    while off < len(blob):
+        kind, seq, ref, client, a, b, n_props, text_len = \
+            _HEADER.unpack_from(blob, off)
+        off += _HEADER.size
+        props = {}
+        for _ in range(n_props):
+            k, v = _PAIR.unpack_from(blob, off)
+            off += 8
+            props[prop_keys[k]] = None if v == -1 else values[v]
+        text = blob[off:off + text_len].decode("utf-8")
+        off += text_len
+        name = kinds[kind]
+        if name == "insert":
+            contents = {"kind": "insert", "pos": a, "text": text}
+            if props:
+                contents["props"] = props
+        else:
+            contents = {"kind": name, "start": a, "end": b}
+            if props:
+                contents["props"] = props
+        out.append(SequencedMessage(
+            seq=seq, client_id=clients[client] if client >= 0 else None,
+            client_seq=seq, ref_seq=ref, min_seq=0,
+            type=MessageType.OP, contents=contents,
+        ))
+    return out
+
+
+# -- the native library --------------------------------------------------------
+
+
+_lib_handle: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build_library() -> Optional[str]:
+    if not os.path.exists(_SRC):
+        return None
+    if os.path.exists(_LIB) and \
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The compiled packer, or None (pure-Python fallback)."""
+    global _lib_handle, _lib_tried
+    if _lib_tried:
+        return _lib_handle
+    _lib_tried = True
+    path = _build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.oppack_count.restype = ctypes.c_int
+    lib.oppack_count.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.oppack_pack.restype = ctypes.c_int32
+    lib.oppack_pack.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+    ] + [np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")] * 9 + [
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib_handle = lib
+    return lib
+
+
+def count_stream(blob: bytes) -> Tuple[int, int, int]:
+    """(n_ops, text_bytes, text_chars) for one binary stream."""
+    lib = load_library()
+    if lib is not None:
+        n_ops = ctypes.c_int32()
+        text_bytes = ctypes.c_int64()
+        text_chars = ctypes.c_int64()
+        rc = lib.oppack_count(blob, len(blob), ctypes.byref(n_ops),
+                              ctypes.byref(text_bytes),
+                              ctypes.byref(text_chars))
+        if rc != 0:
+            raise ValueError("malformed binary op stream")
+        return n_ops.value, text_bytes.value, text_chars.value
+    return _count_py(blob)
+
+
+def _count_py(blob: bytes) -> Tuple[int, int, int]:
+    off, n, tb, tc = 0, 0, 0, 0
+    while off < len(blob):
+        _kind, _seq, _ref, _cl, _a, _b, n_props, text_len = \
+            _HEADER.unpack_from(blob, off)
+        off += _HEADER.size + 8 * n_props
+        text = blob[off:off + text_len]
+        if len(text) != text_len:
+            raise ValueError("malformed binary op stream")
+        off += text_len
+        tb += text_len
+        tc += len(text.decode("utf-8"))
+        n += 1
+    return n, tb, tc
+
+
+def pack_doc_row(
+    blob: bytes,
+    row: Dict[str, np.ndarray],
+    K: int,
+    arena_base_chars: int,
+    arena: bytearray,
+    text_bytes: Optional[int] = None,
+) -> int:
+    """Fill one document's row of the batch arrays from its binary stream;
+    appends text to ``arena`` (utf-8 bytes) and returns ops packed.
+
+    ``row`` maps field name → the 1-D row views (``op['kind'][d]`` etc.,
+    C-contiguous); ``pvals`` is the (T, K) row."""
+    T = row["kind"].shape[0]
+    lib = load_library()
+    if lib is not None:
+        if text_bytes is None:
+            _n, text_bytes, _tc = count_stream(blob)
+        scratch = np.zeros(max(text_bytes, 1), np.uint8)
+        arena_bytes = ctypes.c_int64()
+        arena_chars = ctypes.c_int64()
+        packed = lib.oppack_pack(
+            blob, len(blob), T, K, arena_base_chars,
+            row["kind"], row["seq"], row["client"], row["ref_seq"],
+            row["a"], row["b"], row["tstart"], row["tlen"],
+            row["pvals"].reshape(-1),
+            scratch, len(scratch),
+            ctypes.byref(arena_bytes), ctypes.byref(arena_chars),
+        )
+        if packed < 0:
+            raise ValueError("malformed binary op stream")
+        arena += scratch[:arena_bytes.value].tobytes()
+        return packed
+    return _pack_py(blob, row, K, arena_base_chars, arena)
+
+
+def _pack_py(blob: bytes, row: Dict[str, np.ndarray], K: int,
+             arena_base_chars: int, arena: bytearray) -> int:
+    off, t, chars = 0, 0, 0
+    while off < len(blob):
+        kind, seq, ref, client, a, b, n_props, text_len = \
+            _HEADER.unpack_from(blob, off)
+        off += _HEADER.size
+        row["kind"][t] = kind
+        row["seq"][t] = seq
+        row["ref_seq"][t] = ref
+        row["client"][t] = client
+        row["a"][t] = a
+        row["b"][t] = b
+        for _ in range(n_props):
+            k, v = _PAIR.unpack_from(blob, off)
+            off += 8
+            row["pvals"][t, k] = v
+        if text_len:
+            text = blob[off:off + text_len]
+            off += text_len
+            n_chars = len(text.decode("utf-8"))
+            row["tstart"][t] = arena_base_chars + chars
+            row["tlen"][t] = n_chars
+            arena += text
+            chars += n_chars
+        else:
+            row["tstart"][t] = 0
+            row["tlen"][t] = 0
+        t += 1
+    return t

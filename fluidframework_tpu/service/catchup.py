@@ -100,9 +100,13 @@ class CatchupService:
         self,
         service: LocalOrderingService,
         registry: Optional[ChannelRegistry] = None,
+        mc=None,
     ) -> None:
+        from ..utils.telemetry import MonitoringContext
+
         self.service = service
         self.registry = registry if registry is not None else default_registry()
+        self.mc = (mc or MonitoringContext()).child("catchup")
         self.device_docs = 0
         self.cpu_docs = 0
 
@@ -115,6 +119,23 @@ class CatchupService:
     ) -> Dict[str, Tuple[str, int]]:
         """Fold each document's tail; returns {doc_id: (handle, seq)}.
         Documents with no new ops keep their current summary handle."""
+        from ..utils.telemetry import PerformanceEvent
+
+        device_before, cpu_before = self.device_docs, self.cpu_docs
+        with PerformanceEvent.timed_exec(
+                self.mc.logger, "bulkCatchup") as perf:
+            results = self._catch_up(doc_ids, upload)
+            perf["extra"].update(
+                deviceDocs=self.device_docs - device_before,
+                cpuDocs=self.cpu_docs - cpu_before,
+                docs=len(results))
+        return results
+
+    def _catch_up(
+        self,
+        doc_ids: Optional[Sequence[str]] = None,
+        upload: bool = True,
+    ) -> Dict[str, Tuple[str, int]]:
         works: List[_DocWork] = []
         results: Dict[str, Tuple[str, int]] = {}
         for doc_id in (doc_ids if doc_ids is not None

@@ -1,0 +1,154 @@
+"""Telemetry: logger tree, performance events, monitoring context.
+
+Capability-equivalent of the reference's ``telemetry-utils`` (SURVEY.md
+§2.4/§5: ``createChildLogger``, ``PerformanceEvent.timedExec``,
+``MonitoringContext``/``IConfigProvider`` feature gates; upstream paths
+UNVERIFIED — empty reference mount).
+
+The logger contract is one duck-typed method — ``send(event: dict)`` —
+so hosts plug in anything (stdout, a file, a metrics pipe).  Loggers
+compose into a tree: children prefix a namespace and merge inherited
+properties, exactly the host-injected shape the reference uses."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class NullLogger:
+    """Swallow everything (the default when hosts inject nothing)."""
+
+    def send(self, event: dict) -> None:
+        pass
+
+
+class CollectingLogger:
+    """Keep events in memory (tests, devtools)."""
+
+    def __init__(self) -> None:
+        self.events: List[dict] = []
+
+    def send(self, event: dict) -> None:
+        self.events.append(event)
+
+
+class StreamLogger:
+    """One JSON line per event (winston/Lumberjack-style sink)."""
+
+    def __init__(self, stream=None) -> None:
+        self._stream = stream if stream is not None else sys.stderr
+
+    def send(self, event: dict) -> None:
+        self._stream.write(json.dumps(event, sort_keys=True,
+                                      default=str) + "\n")
+
+
+class ChildLogger:
+    """Namespace prefix + inherited properties over a base logger."""
+
+    def __init__(self, base, namespace: str,
+                 properties: Optional[Dict[str, Any]] = None) -> None:
+        self._base = base
+        self.namespace = namespace
+        self.properties = properties or {}
+
+    def send(self, event: dict) -> None:
+        out = dict(self.properties)
+        out.update(event)
+        name = event.get("eventName", "")
+        out["eventName"] = f"{self.namespace}:{name}" if name \
+            else self.namespace
+        self._base.send(out)
+
+
+def create_child_logger(base=None, namespace: str = "",
+                        properties: Optional[Dict[str, Any]] = None):
+    return ChildLogger(base if base is not None else NullLogger(),
+                       namespace, properties)
+
+
+class PerformanceEvent:
+    """Duration-measuring event: emits <name>_start / <name>_end (or
+    <name>_cancel with the error) around a phase — the reference's
+    ``PerformanceEvent.timedExec``."""
+
+    @staticmethod
+    @contextlib.contextmanager
+    def timed_exec(logger, event_name: str, **properties):
+        start = time.perf_counter()
+        logger.send({"eventName": f"{event_name}_start", **properties})
+        holder = {"extra": {}}
+        try:
+            yield holder
+        except BaseException as err:
+            logger.send({
+                "eventName": f"{event_name}_cancel",
+                "durationMs": round((time.perf_counter() - start) * 1000, 3),
+                "error": repr(err),
+                **properties,
+            })
+            raise
+        logger.send({
+            "eventName": f"{event_name}_end",
+            "durationMs": round((time.perf_counter() - start) * 1000, 3),
+            **properties,
+            **holder["extra"],
+        })
+
+
+class ConfigProvider:
+    """Layered feature gates: explicit dict over environment variables
+    (``FLUID_TPU_<KEY>``), read through typed getters — the reference's
+    IConfigProvider resolved via MonitoringContext."""
+
+    ENV_PREFIX = "FLUID_TPU_"
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None) -> None:
+        self._settings = dict(settings or {})
+
+    def raw(self, key: str) -> Optional[Any]:
+        if key in self._settings:
+            return self._settings[key]
+        env_key = self.ENV_PREFIX + key.replace(".", "_").upper()
+        return os.environ.get(env_key)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        value = self.raw(key)
+        if value is None:
+            return default
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        value = self.raw(key)
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return default
+
+    def get_str(self, key: str, default: str = "") -> str:
+        value = self.raw(key)
+        return default if value is None else str(value)
+
+
+class MonitoringContext:
+    """logger + config bundle threaded through subsystems."""
+
+    def __init__(self, logger=None,
+                 config: Optional[ConfigProvider] = None) -> None:
+        self.logger = logger if logger is not None else NullLogger()
+        self.config = config if config is not None else ConfigProvider()
+
+    def child(self, namespace: str,
+              properties: Optional[Dict[str, Any]] = None
+              ) -> "MonitoringContext":
+        return MonitoringContext(
+            create_child_logger(self.logger, namespace, properties),
+            self.config,
+        )

@@ -1,0 +1,134 @@
+// liboppack — native op-log packing for the TPU replay path.
+//
+// The host-side hot loop of bulk catch-up is turning op streams into the
+// padded (D, T) int32 arrays the merge-tree kernel folds (see
+// fluidframework_tpu/ops/mergetree_kernel.py::pack_mergetree_batch).  The
+// ingestion side encodes string-channel ops once into a flat binary record
+// stream (ops/native_pack.py::encode_string_ops); this library consumes
+// that stream and fills the arrays in one pass — no Python objects, no
+// per-op dict lookups.
+//
+// Record layout (little-endian, packed):
+//   u8  kind        (1=insert, 2=remove, 3=annotate)
+//   i32 seq
+//   i32 ref_seq
+//   i32 client_idx  (interned by the encoder)
+//   i32 a           (pos | start)
+//   i32 b           (end; 0 for insert)
+//   i32 n_props     (annotate property pairs)
+//   i32 text_len    (insert only, BYTES of utf-8; 0 otherwise)
+//   { i32 key_idx, i32 val_idx } * n_props   (val -1 == PROP_ABSENT)
+//   u8  text[text_len]
+//
+// Text offsets in the arrays are in CHARACTERS (the Python arena is a str);
+// the packer counts code points while copying utf-8 bytes, so the caller
+// can decode the byte arena once and every (tstart, tlen) span aligns.
+//
+// API (C ABI, ctypes-consumed):
+//   oppack_count(...)  — sizing pre-pass
+//   oppack_pack(...)   — fill one document's row of the batch arrays
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+constexpr int64_t kHeader = 1 + 4 * 7;  // kind byte + 7 i32 fields
+
+inline int64_t count_codepoints(const uint8_t* p, int64_t n) {
+    int64_t chars = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        chars += (p[i] & 0xC0) != 0x80;
+    }
+    return chars;
+}
+}  // namespace
+
+extern "C" {
+
+// Sizing pre-pass.  Returns 0 on success; -1 on truncated/malformed input.
+int oppack_count(const uint8_t* buf, int64_t len,
+                 int32_t* n_ops, int64_t* text_bytes, int64_t* text_chars) {
+    int64_t off = 0;
+    int32_t ops = 0;
+    int64_t bytes = 0, chars = 0;
+    while (off < len) {
+        if (off + kHeader > len) return -1;
+        int32_t fields[7];
+        std::memcpy(fields, buf + off + 1, 4 * 7);
+        const int32_t n_props = fields[5];
+        const int32_t text_len = fields[6];
+        off += kHeader;
+        if (n_props < 0 || text_len < 0) return -1;
+        if (off + 8 * static_cast<int64_t>(n_props) + text_len > len)
+            return -1;
+        off += 8 * static_cast<int64_t>(n_props);
+        chars += count_codepoints(buf + off, text_len);
+        bytes += text_len;
+        off += text_len;
+        ops += 1;
+    }
+    *n_ops = ops;
+    *text_bytes = bytes;
+    *text_chars = chars;
+    return 0;
+}
+
+// Packs one document's record stream into row-slices of the batch arrays.
+// `pvals` is the (T, K) row in C order, pre-filled with PROP_NOT_TOUCHED.
+// Returns ops packed, or -1 on malformed input / capacity overflow.
+int32_t oppack_pack(const uint8_t* buf, int64_t len,
+                    int32_t T, int32_t K, int64_t arena_base_chars,
+                    int32_t* kind, int32_t* seq, int32_t* client,
+                    int32_t* ref_seq, int32_t* a, int32_t* b,
+                    int32_t* tstart, int32_t* tlen, int32_t* pvals,
+                    uint8_t* arena_out, int64_t arena_capacity,
+                    int64_t* arena_bytes, int64_t* arena_chars) {
+    int64_t off = 0;
+    int32_t t = 0;
+    int64_t out_bytes = 0, out_chars = 0;
+    while (off < len) {
+        if (off + kHeader > len) return -1;
+        if (t >= T) return -1;
+        const uint8_t k = buf[off];
+        int32_t fields[7];
+        std::memcpy(fields, buf + off + 1, 4 * 7);
+        off += kHeader;
+        const int32_t n_props = fields[5];
+        const int32_t text_len = fields[6];
+        if (n_props < 0 || text_len < 0) return -1;
+        if (off + 8 * static_cast<int64_t>(n_props) + text_len > len)
+            return -1;
+        kind[t] = static_cast<int32_t>(k);
+        seq[t] = fields[0];
+        ref_seq[t] = fields[1];
+        client[t] = fields[2];
+        a[t] = fields[3];
+        b[t] = fields[4];
+        for (int32_t i = 0; i < n_props; ++i) {
+            int32_t pair[2];
+            std::memcpy(pair, buf + off, 8);
+            off += 8;
+            if (pair[0] < 0 || pair[0] >= K) return -1;
+            pvals[static_cast<int64_t>(t) * K + pair[0]] = pair[1];
+        }
+        if (text_len > 0) {
+            if (out_bytes + text_len > arena_capacity) return -1;
+            std::memcpy(arena_out + out_bytes, buf + off, text_len);
+            const int64_t chars = count_codepoints(buf + off, text_len);
+            tstart[t] = static_cast<int32_t>(arena_base_chars + out_chars);
+            tlen[t] = static_cast<int32_t>(chars);
+            out_bytes += text_len;
+            out_chars += chars;
+            off += text_len;
+        } else {
+            tstart[t] = 0;
+            tlen[t] = 0;
+        }
+        t += 1;
+    }
+    *arena_bytes = out_bytes;
+    *arena_chars = out_chars;
+    return t;
+}
+
+}  // extern "C"

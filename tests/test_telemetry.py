@@ -1,0 +1,86 @@
+"""Telemetry: logger tree, performance events, config gates, and the
+loader/catchup integration points."""
+
+import io
+
+import pytest
+
+from fluidframework_tpu.utils.telemetry import (
+    CollectingLogger,
+    ConfigProvider,
+    MonitoringContext,
+    PerformanceEvent,
+    StreamLogger,
+    create_child_logger,
+)
+
+
+def test_child_logger_namespaces_and_properties():
+    sink = CollectingLogger()
+    child = create_child_logger(sink, "loader", {"docId": "d1"})
+    grandchild = create_child_logger(child, "deltaManager")
+    grandchild.send({"eventName": "connect", "attempt": 1})
+    [ev] = sink.events
+    assert ev["eventName"] == "loader:deltaManager:connect"
+    assert ev["docId"] == "d1" and ev["attempt"] == 1
+
+
+def test_performance_event_end_and_cancel():
+    sink = CollectingLogger()
+    with PerformanceEvent.timed_exec(sink, "phase", k="v") as perf:
+        perf["extra"]["items"] = 3
+    names = [e["eventName"] for e in sink.events]
+    assert names == ["phase_start", "phase_end"]
+    assert sink.events[1]["items"] == 3
+    assert sink.events[1]["durationMs"] >= 0
+
+    with pytest.raises(ValueError):
+        with PerformanceEvent.timed_exec(sink, "bad"):
+            raise ValueError("boom")
+    assert sink.events[-1]["eventName"] == "bad_cancel"
+    assert "boom" in sink.events[-1]["error"]
+
+
+def test_stream_logger_writes_json_lines():
+    buf = io.StringIO()
+    StreamLogger(buf).send({"eventName": "x", "n": 1})
+    assert '"eventName": "x"' in buf.getvalue()
+
+
+def test_config_provider_layers_and_types(monkeypatch):
+    monkeypatch.setenv("FLUID_TPU_FLUID_GC_ENABLED", "false")
+    cfg = ConfigProvider({"Fluid.Chunk.Size": "1024"})
+    assert cfg.get_int("Fluid.Chunk.Size") == 1024
+    assert cfg.get_bool("Fluid.Gc.Enabled", default=True) is False
+    assert cfg.get_str("Fluid.Missing", "fallback") == "fallback"
+    assert cfg.get_bool("Fluid.Missing", default=True) is True
+
+
+def test_monitoring_context_threads_through_loader():
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    sink = CollectingLogger()
+    mc = MonitoringContext(sink)
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service), mc=mc)
+
+    def build(rt):
+        rt.create_datastore("ds").create_channel("sequence-tpu", "t")
+
+    a = loader.create("doc", "alice", build)
+    a.runtime.get_datastore("ds").get_channel("t").insert_text(0, "x")
+    a.drain()
+    loader.resolve("doc", "bob")
+    names = [e["eventName"] for e in sink.events]
+    assert "loader:containerLoad_start" in names
+    assert "loader:containerLoad_end" in names
+
+    CatchupService(service, mc=mc).catch_up()
+    names = [e["eventName"] for e in sink.events]
+    assert "catchup:bulkCatchup_end" in names
+    end = [e for e in sink.events
+           if e["eventName"] == "catchup:bulkCatchup_end"][-1]
+    assert end["docs"] == 1
