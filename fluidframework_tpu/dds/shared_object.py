@@ -28,6 +28,12 @@ from ..protocol.summary import SummaryTree
 from ..utils.events import EventEmitter
 
 
+class StaleOpError(RuntimeError):
+    """A pending op's view fell below the collaboration window; the
+    container must be stashed and rehydrated (reference: container close
+    on too-old pending state)."""
+
+
 class SharedObject:
     """Base DDS: pending-op bookkeeping + runtime wiring + change events."""
 
@@ -40,8 +46,10 @@ class SharedObject:
         self.client_id: Optional[str] = None
         self._delta_connection = None  # set by connect()
         self._client_seq = 0
-        # FIFO of (client_seq, contents, local_metadata) awaiting ack.
-        self._pending: Deque[Tuple[int, Any, Any]] = collections.deque()
+        # FIFO of (client_seq, contents, local_metadata, ref_seq) awaiting
+        # ack; ref_seq is the view the op was created against (resubmits
+        # preserve it so position-carrying contents stay correct).
+        self._pending: Deque[Tuple[int, Any, Any, Any]] = collections.deque()
         # Acks at or below this client_seq are silently dropped: they belong
         # to ops submitted before a load() reset the channel's state.
         self._stale_ack_floor = -1
@@ -72,8 +80,11 @@ class SharedObject:
         finally:
             self._in_event -= 1
 
-    def _submit_local_op(self, contents: Any, local_metadata: Any = None) -> None:
-        """Send an optimistically-applied local op to the sequencer."""
+    def _submit_local_op(self, contents: Any, local_metadata: Any = None,
+                         ref_seq: Any = None) -> None:
+        """Send an optimistically-applied local op to the sequencer.
+        ``ref_seq`` pins the view the op resolves against (resubmit path);
+        None = the current view."""
         if self._in_event:
             raise RuntimeError(
                 f"{self.id}: op submitted from inside a change-event "
@@ -81,9 +92,11 @@ class SharedObject:
             )
         if self._delta_connection is None:
             return  # detached: local-only state, nothing to send
-        client_seq = self._delta_connection.submit(contents)
+        if ref_seq is None:
+            ref_seq = getattr(self._delta_connection, "ref_seq", None)
+        client_seq = self._delta_connection.submit(contents, ref_seq)
         self._last_submitted_client_seq = client_seq
-        self._pending.append((client_seq, contents, local_metadata))
+        self._pending.append((client_seq, contents, local_metadata, ref_seq))
 
     def resubmit_pending(self) -> None:
         """Reconnect path: re-send all unacked ops (same contents, fresh
@@ -92,13 +105,26 @@ class SharedObject:
             return
         pending = list(self._pending)
         self._pending.clear()
-        for _old_client_seq, contents, metadata in pending:
-            self._resubmit_core(contents, metadata)
+        min_seq = getattr(self._delta_connection, "min_seq", None)
+        for _old_client_seq, contents, metadata, ref_seq in pending:
+            if ref_seq is not None and min_seq is not None \
+                    and ref_seq < min_seq:
+                # The collaboration window moved past the op's view while
+                # we were away: its positions can no longer be resolved
+                # (zamboni may have compacted state the view needs).  The
+                # reference closes the container; the host stashes pending
+                # state and rehydrates (which re-resolves positions).
+                raise StaleOpError(
+                    f"{self.id}: pending op ref_seq {ref_seq} is below the "
+                    f"collaboration window ({min_seq}); stash and rehydrate"
+                )
+            self._resubmit_core(contents, metadata, ref_seq)
 
-    def _resubmit_core(self, contents: Any, metadata: Any) -> None:
-        """Default resubmit: send unchanged.  DDSes whose ops reference
-        positions may need to rewrite contents against the latest state."""
-        self._submit_local_op(contents, metadata)
+    def _resubmit_core(self, contents: Any, metadata: Any,
+                       ref_seq: Any = None) -> None:
+        """Default resubmit: send unchanged, pinned to the op's original
+        view — position-carrying contents resolve exactly as authored."""
+        self._submit_local_op(contents, metadata, ref_seq=ref_seq)
 
     # -- inbound ---------------------------------------------------------------
 
@@ -114,7 +140,8 @@ class SharedObject:
                 raise AssertionError(
                     f"{self.id}: ack for {msg.client_seq} with no pending ops"
                 )
-            client_seq, _contents, local_metadata = self._pending.popleft()
+            client_seq, _contents, local_metadata, _ref = \
+                self._pending.popleft()
             if client_seq != msg.client_seq:
                 raise AssertionError(
                     f"{self.id}: out-of-order ack {msg.client_seq}, "

@@ -484,7 +484,7 @@ class SharedTree(SharedObject):
         Detached (never-connected) trees edit the sequenced forest directly
         through the same path: pending is always empty there because
         _submit_local_op drops ops pre-attach, so prediction == state."""
-        pending = [contents for _cs, contents, _m in self._pending]
+        pending = [entry[1] for entry in self._pending]
         if self._txn_edits:
             pending = pending + [{"edits": self._txn_edits}]
         if not pending:

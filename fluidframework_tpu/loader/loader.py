@@ -128,7 +128,7 @@ class Container:
         pending = []
         for ds_id, ds in self.runtime.datastores.items():
             for channel_id, channel in ds.channels.items():
-                for client_seq, contents, _meta in channel._pending:
+                for client_seq, contents, _meta, _ref in channel._pending:
                     pending.append({
                         "clientSeq": client_seq,
                         "ds": ds_id,
@@ -192,16 +192,22 @@ class Loader:
         doc_id: str,
         client_id: Optional[str] = None,
         pending_state: Optional[dict] = None,
+        stale_pending: str = "raise",
     ) -> Container:
         """Load a document: summary + catch-up replay + live connection.
         ``client_id=None`` loads read-only-detached (e.g. replay driver).
-        ``pending_state`` rehydrates a previous session's unacked ops."""
+        ``pending_state`` rehydrates a previous session's unacked ops.
+        ``stale_pending``: when the stash's view has fallen below the
+        collaboration window its position ops can no longer merge exactly —
+        ``"raise"`` surfaces StaleOpError (host decides), ``"drop"``
+        discards the stashed ops and loads clean."""
         if pending_state is not None and client_id is None:
             raise ValueError("rehydrating pending state requires a live "
                              "client_id (stashed ops must be resubmitted)")
         with PerformanceEvent.timed_exec(
                 self.mc.logger, "containerLoad", docId=doc_id) as perf:
-            container = self._resolve(doc_id, client_id, pending_state)
+            container = self._resolve(doc_id, client_id, pending_state,
+                                      stale_pending)
             perf["extra"]["catchupOps"] = container.catchup_ops
         return container
 
@@ -210,6 +216,7 @@ class Loader:
         doc_id: str,
         client_id: Optional[str],
         pending_state: Optional[dict],
+        stale_pending: str = "raise",
     ) -> Container:
         service = self.factory.resolve(doc_id)
         runtime = self._new_runtime()
@@ -237,6 +244,24 @@ class Loader:
             runtime.process(msg)
         container.catchup_ops = len(pre_stash)
         container.delta_manager.note_delivered(runtime.ref_seq)
+
+        if pending_state is not None and pending_state["pending"]:
+            # Stash staleness: its ops' views must still be inside the
+            # collaboration window or their positions can't merge exactly.
+            head_msn = max((m.min_seq for m in post_stash),
+                           default=runtime.min_seq)
+            if pending_state["refSeq"] < head_msn:
+                from ..dds.shared_object import StaleOpError
+
+                if stale_pending == "drop":
+                    pending_state = None
+                else:
+                    raise StaleOpError(
+                        f"{doc_id}: stashed pending state (refSeq "
+                        f"{pending_state['refSeq']}) is below the "
+                        f"collaboration window ({head_msn}); pass "
+                        f"stale_pending='drop' to load without it"
+                    )
 
         if client_id is not None:
             # Connect first (channels need a live submit path), then re-apply

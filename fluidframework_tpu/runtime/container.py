@@ -169,13 +169,17 @@ class ContainerRuntime:
 
     # -- outbound: the outbox --------------------------------------------------
 
-    def _submit_op(self, envelope: dict) -> int:
+    def _submit_op(self, envelope: dict, ref_seq=None) -> int:
         """Called by datastores for each channel op; returns the sub-op
-        client_seq the channel records for its ack FIFO."""
+        client_seq the channel records for its ack FIFO.  Each sub-op
+        carries the view (refSeq) it was authored against — resubmitted
+        ops pin their original view so position contents stay correct."""
         self._client_seq += 1
         client_seq = self._client_seq  # flush below may advance the counter
         self._outbox.append(
-            {"clientSeq": client_seq, **envelope}
+            {"clientSeq": client_seq,
+             "refSeq": self.ref_seq if ref_seq is None else ref_seq,
+             **envelope}
         )
         if not self._batching:
             self.flush()
@@ -356,7 +360,11 @@ class ContainerRuntime:
                 ds = self.datastores.get(sub["ds"])
                 if ds is not None:
                     ds.process(
-                        dataclasses.replace(msg, client_seq=sub["clientSeq"]),
+                        dataclasses.replace(
+                            msg,
+                            client_seq=sub["clientSeq"],
+                            ref_seq=sub.get("refSeq", msg.ref_seq),
+                        ),
                         sub, local,
                     )
         elif msg.type in (MessageType.JOIN, MessageType.LEAVE):

@@ -27,8 +27,17 @@ class ChannelDeltaConnection:
         self._datastore = datastore
         self._channel_id = channel_id
 
-    def submit(self, contents) -> int:
-        return self._datastore._submit_channel_op(self._channel_id, contents)
+    def submit(self, contents, ref_seq=None) -> int:
+        return self._datastore._submit_channel_op(self._channel_id, contents,
+                                                  ref_seq)
+
+    @property
+    def ref_seq(self):
+        return self._datastore._container.ref_seq
+
+    @property
+    def min_seq(self):
+        return self._datastore._container.min_seq
 
 
 class FluidDataStoreRuntime:
@@ -97,9 +106,11 @@ class FluidDataStoreRuntime:
 
     # -- op routing ------------------------------------------------------------
 
-    def _submit_channel_op(self, channel_id: str, contents) -> int:
+    def _submit_channel_op(self, channel_id: str, contents,
+                           ref_seq=None) -> int:
         return self._container._submit_op(
-            {"ds": self.id, "channel": channel_id, "contents": contents}
+            {"ds": self.id, "channel": channel_id, "contents": contents},
+            ref_seq=ref_seq,
         )
 
     def process(self, msg: SequencedMessage, envelope: dict,

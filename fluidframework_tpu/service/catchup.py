@@ -180,23 +180,21 @@ class CatchupService:
     # -- device path -----------------------------------------------------------
 
     def _device_plan(self, work: _DocWork):
-        """Device-eligible shape: every channel is a string channel whose
-        prior summary is *empty* (whole history lives in the tail — a
-        seeded attach summary would be silently dropped by a cold fold), so
-        the kernel can cold-fold each channel.  Returns the plan
-        [(ds_id, channel_id), ...] or None."""
+        """Device-eligible shape: every channel is a string channel.  Cold
+        (empty prior summary) AND warm starts both fold on device — a warm
+        channel's summary body re-enters the kernel as base_records.
+        Returns [(ds_id, channel_id, base)] where ``base`` is None (cold)
+        or (records, base_seq, base_msn, intervals); None = CPU path."""
         try:
             ds_root = work.summary.get(".datastores")
         except KeyError:
             return None
-        if work.ref_seq != 0:
-            return None  # warm-start state packing: CPU path for now
         # GC/blob state must be trivially foldable host-side.
         if not _gc_state_empty(work.summary):
             return None
         for _msg, batch in work.decoded:
             if any("runtime" in sub for sub in batch["ops"]):
-                return None  # blob attaches: CPU path
+                return None  # blob/ds/channel attaches, sweeps: CPU path
         plan = []
         for ds_id, subtree in ds_root.children.items():
             if not isinstance(subtree, SummaryTree):
@@ -213,10 +211,20 @@ class CatchupService:
             for channel_id, type_name in channels.items():
                 if type_name != STRING_TYPE:
                     return None
-                if subtree.children[channel_id].digest() \
-                        != _empty_string_digest():
-                    return None  # attach-seeded content: CPU path
-                plan.append((ds_id, channel_id))
+                channel_tree = subtree.children[channel_id]
+                if channel_tree.digest() == _empty_string_digest():
+                    base = None  # cold fold
+                else:
+                    header = json.loads(channel_tree.blob_bytes("header"))
+                    records = json.loads(channel_tree.blob_bytes("body"))
+                    try:
+                        intervals = json.loads(
+                            channel_tree.blob_bytes("intervals"))
+                    except KeyError:
+                        intervals = None
+                    base = (records, header["seq"], header["minSeq"],
+                            intervals)
+                plan.append((ds_id, channel_id, base))
         return plan or None
 
     def _device_fold(self, works: List[_DocWork]) -> List[SummaryTree]:
@@ -228,7 +236,17 @@ class CatchupService:
             self.device_docs += 1
             final_seq = work.tail[-1].seq
             final_msn = max(m.min_seq for m in work.tail)
-            for ds_id, channel_id in work.plan:
+            for ds_id, channel_id, base in work.plan:
+                if base is None:
+                    base_kwargs = {}
+                else:
+                    records, base_seq, base_msn, intervals = base
+                    base_kwargs = {
+                        "base_records": records,
+                        "base_seq": base_seq,
+                        "base_msn": base_msn,
+                        "base_intervals": intervals,
+                    }
                 inputs.append(
                     MergeTreeDocInput(
                         doc_id=f"{work.doc_id}/{ds_id}/{channel_id}",
@@ -236,6 +254,7 @@ class CatchupService:
                                                 channel_id),
                         final_seq=final_seq,
                         final_msn=final_msn,
+                        **base_kwargs,
                     )
                 )
         channel_trees = replay_mergetree_batch(inputs)
@@ -265,11 +284,11 @@ class CatchupService:
             tree.add_tree(".blobs")
             ds_tree = tree.add_tree(".datastores")
             channel_by_pair = {
-                pair: channel_trees[i + k]
-                for k, pair in enumerate(work.plan)
+                (entry[0], entry[1]): channel_trees[i + k]
+                for k, entry in enumerate(work.plan)
             }
             by_ds: Dict[str, List[str]] = {}
-            for ds_id, channel_id in work.plan:
+            for ds_id, channel_id, _base in work.plan:
                 by_ds.setdefault(ds_id, []).append(channel_id)
             for ds_id in sorted(by_ds):
                 sub = SummaryTree()

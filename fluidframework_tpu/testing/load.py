@@ -1,0 +1,154 @@
+"""Load/stress harness: many puppet clients with fault injection.
+
+Capability-equivalent of the reference's ``test-service-load`` (SURVEY.md
+§4: many puppet clients against a real service, configurable op rates,
+random disconnects; upstream paths UNVERIFIED — empty reference mount).
+
+Drives the REAL stack (Loader → driver → ordering service), not the mocks:
+each puppet runs a seeded random schedule of edits, syncs, disconnects/
+reconnects, stash/rehydrate cycles, and late joins; at the end everything
+synchronizes and the harness asserts byte-identical summaries across every
+surviving client — the convergence oracle under load."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional
+
+from ..drivers import LocalDocumentServiceFactory
+from ..loader import Loader
+from ..service import LocalOrderingService
+
+
+@dataclasses.dataclass
+class LoadSpec:
+    seed: int = 0
+    clients: int = 4
+    steps: int = 200               # total scheduled actions
+    edit_weight: float = 0.70
+    sync_weight: float = 0.15
+    disconnect_weight: float = 0.05
+    stash_weight: float = 0.03     # crash + rehydrate as a new session
+    late_join_weight: float = 0.02
+    max_clients: int = 8
+
+
+@dataclasses.dataclass
+class LoadResult:
+    steps: int
+    edits: int
+    disconnects: int
+    rehydrates: int
+    late_joins: int
+    final_clients: int
+    sequenced_ops: int
+    summary_digest: str
+
+
+def run_load(spec: LoadSpec) -> LoadResult:
+    rng = random.Random(spec.seed)
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+        ds.create_channel("map-tpu", "kv")
+        ds.create_channel("counter-tpu", "count")
+
+    containers: Dict[str, object] = {}
+    offline: Dict[str, bool] = {}
+    next_id = 0
+
+    def new_client(pending_state=None):
+        nonlocal next_id
+        next_id += 1
+        cid = f"load-{spec.seed}-{next_id}"
+        if not containers and pending_state is None:
+            c = loader.create("load-doc", cid, build)
+        else:
+            c = loader.resolve("load-doc", cid, pending_state=pending_state)
+        containers[cid] = c
+        offline[cid] = False
+        return cid
+
+    for _ in range(spec.clients):
+        new_client()
+
+    edits = disconnects = rehydrates = late_joins = 0
+
+    def do_edit(container):
+        nonlocal edits
+        ds = container.runtime.get_datastore("ds")
+        choice = rng.random()
+        if choice < 0.6:
+            text = ds.get_channel("text")
+            length = len(text.text)
+            if length < 4 or rng.random() < 0.7:
+                text.insert_text(rng.randint(0, length),
+                                 rng.choice("abcdef") * rng.randint(1, 4))
+            else:
+                start = rng.randint(0, length - 2)
+                text.remove_range(start, min(length, start + 3))
+        elif choice < 0.9:
+            ds.get_channel("kv").set(f"k{rng.randint(0, 20)}",
+                                     rng.randint(0, 999))
+        else:
+            ds.get_channel("count").increment(rng.choice([1, -1, 5]))
+        edits += 1
+
+    for _step in range(spec.steps):
+        cid = rng.choice(sorted(containers))
+        container = containers[cid]
+        r = rng.random()
+        if r < spec.edit_weight:
+            do_edit(container)
+        elif r < spec.edit_weight + spec.sync_weight:
+            for c in containers.values():
+                c.drain()
+        elif r < spec.edit_weight + spec.sync_weight \
+                + spec.disconnect_weight:
+            if offline[cid]:
+                container.reconnect()
+                offline[cid] = False
+            else:
+                container.disconnect()
+                offline[cid] = True
+            disconnects += 1
+        elif r < spec.edit_weight + spec.sync_weight \
+                + spec.disconnect_weight + spec.stash_weight:
+            if len(containers) > 1:
+                stash = container.close_and_get_pending_state()
+                del containers[cid]
+                del offline[cid]
+                new_client(pending_state=stash)
+                rehydrates += 1
+        else:
+            if len(containers) < spec.max_clients:
+                new_client()
+                late_joins += 1
+
+    # Final convergence: reconnect everyone, drain to quiescence.
+    for cid, container in containers.items():
+        if offline[cid]:
+            container.reconnect()
+            offline[cid] = False
+    for _ in range(4):  # a few rounds: reconnect resubmits need re-drains
+        for container in containers.values():
+            container.drain()
+
+    digests = {c.runtime.summarize().digest() for c in containers.values()}
+    assert len(digests) == 1, (
+        f"load run diverged: {len(digests)} distinct summaries"
+    )
+    return LoadResult(
+        steps=spec.steps,
+        edits=edits,
+        disconnects=disconnects,
+        rehydrates=rehydrates,
+        late_joins=late_joins,
+        final_clients=len(containers),
+        sequenced_ops=service.oplog.head("load-doc"),
+        summary_digest=next(iter(digests)),
+    )

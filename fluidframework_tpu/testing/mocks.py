@@ -27,8 +27,17 @@ class _MockDeltaConnection:
         self._runtime = runtime
         self._channel_id = channel_id
 
-    def submit(self, contents) -> int:
-        return self._runtime.submit_channel_op(self._channel_id, contents)
+    def submit(self, contents, ref_seq=None) -> int:
+        return self._runtime.submit_channel_op(self._channel_id, contents,
+                                               ref_seq)
+
+    @property
+    def ref_seq(self):
+        return self._runtime.ref_seq
+
+    @property
+    def min_seq(self):
+        return getattr(self._runtime, "min_seq", 0)
 
 
 class MockClientRuntime:
@@ -47,13 +56,14 @@ class MockClientRuntime:
         dds.connect(_MockDeltaConnection(self, dds.id), self.client_id)
         return dds
 
-    def submit_channel_op(self, channel_id: str, contents) -> int:
+    def submit_channel_op(self, channel_id: str, contents,
+                          ref_seq=None) -> int:
         self._client_seq += 1
         self.factory.enqueue(
             RawOperation(
                 client_id=self.client_id,
                 client_seq=self._client_seq,
-                ref_seq=self.ref_seq,
+                ref_seq=self.ref_seq if ref_seq is None else ref_seq,
                 type=MessageType.OP,
                 contents={"address": channel_id, "contents": contents},
             )

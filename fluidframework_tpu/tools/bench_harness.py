@@ -1,0 +1,118 @@
+"""Statistical micro-benchmark harness.
+
+Capability-equivalent of the reference's ``@fluid-tools/benchmark``
+(SURVEY.md §2.4/§4: execution-time + memory modes with statistical
+reporting, the ``.perf.spec`` convention; upstream paths UNVERIFIED —
+empty reference mount).
+
+    result = benchmark(lambda: replica.process(msg), min_runs=20)
+    print(result.report())          # mean/p50/p95/stddev
+    mem = benchmark_memory(build_big_state)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+import tracemalloc
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    name: str
+    runs: int
+    #: per-run durations, seconds
+    samples: List[float]
+
+    @property
+    def mean(self) -> float:
+        return sum(self.samples) / len(self.samples)
+
+    @property
+    def stddev(self) -> float:
+        mean = self.mean
+        if len(self.samples) < 2:
+            return 0.0
+        var = sum((s - mean) ** 2 for s in self.samples) \
+            / (len(self.samples) - 1)
+        return math.sqrt(var)
+
+    def percentile(self, p: float) -> float:
+        ordered = sorted(self.samples)
+        idx = min(len(ordered) - 1, max(0, round(p / 100 * (len(ordered) - 1))))
+        return ordered[idx]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(95)
+
+    @property
+    def ops_per_sec(self) -> float:
+        return 1.0 / self.mean if self.mean > 0 else float("inf")
+
+    def report(self) -> str:
+        return (
+            f"{self.name}: {self.runs} runs | mean "
+            f"{self.mean * 1e3:.3f}ms | p50 {self.p50 * 1e3:.3f}ms | "
+            f"p95 {self.p95 * 1e3:.3f}ms | stddev {self.stddev * 1e3:.3f}ms"
+        )
+
+
+def benchmark(
+    fn: Callable[[], object],
+    name: str = "benchmark",
+    min_runs: int = 10,
+    max_runs: int = 1000,
+    min_time_s: float = 0.5,
+    warmup_runs: int = 2,
+    setup: Optional[Callable[[], object]] = None,
+) -> BenchmarkResult:
+    """Timed mode: run until both min_runs and min_time_s are satisfied
+    (or max_runs), measuring each run.  ``setup`` runs untimed before each
+    measured run (fresh state per run)."""
+    for _ in range(warmup_runs):
+        arg = setup() if setup else None
+        fn() if arg is None else fn(arg)  # type: ignore[call-arg]
+    samples: List[float] = []
+    total = 0.0
+    while (len(samples) < min_runs or total < min_time_s) \
+            and len(samples) < max_runs:
+        arg = setup() if setup else None
+        t0 = time.perf_counter()
+        fn() if arg is None else fn(arg)  # type: ignore[call-arg]
+        dt = time.perf_counter() - t0
+        samples.append(dt)
+        total += dt
+    return BenchmarkResult(name=name, runs=len(samples), samples=samples)
+
+
+@dataclasses.dataclass
+class MemoryResult:
+    name: str
+    peak_bytes: int
+    retained_bytes: int
+
+    def report(self) -> str:
+        return (f"{self.name}: peak {self.peak_bytes / 1e6:.2f}MB | "
+                f"retained {self.retained_bytes / 1e6:.2f}MB")
+
+
+def benchmark_memory(fn: Callable[[], object],
+                     name: str = "memory") -> MemoryResult:
+    """Memory mode: peak allocation during fn and bytes retained by its
+    return value's lifetime (tracemalloc)."""
+    tracemalloc.start()
+    tracemalloc.reset_peak()
+    before, _ = tracemalloc.get_traced_memory()
+    result = fn()
+    after, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    del result
+    return MemoryResult(name=name, peak_bytes=peak - before,
+                        retained_bytes=max(0, after - before))

@@ -354,7 +354,8 @@ def test_catchup_uploads_and_is_incremental():
 
 def test_catchup_preserves_seeded_attach_content():
     """A doc whose attach summary carries seeded (detached-created) content
-    must NOT cold-fold on the device — that would drop the seed."""
+    warm-folds on the device: the summary body re-enters the kernel as
+    base_records and the seed survives byte-for-byte."""
     from fluidframework_tpu.drivers import LocalDocumentServiceFactory
     from fluidframework_tpu.loader import Loader
 
@@ -372,11 +373,53 @@ def test_catchup_preserves_seeded_attach_content():
 
     svc = CatchupService(service)
     svc.catch_up()
-    assert svc.device_docs == 0 and svc.cpu_docs == 1
+    assert svc.device_docs == 1 and svc.cpu_docs == 0
 
     fresh = loader.resolve("doc")
     text = fresh.runtime.get_datastore("ds").get_channel("text").text
     assert text == "SEEDED-tail"
+
+
+def test_catchup_warm_start_from_prior_summary_on_device():
+    """THE north-star shape: catch-up = prior summary + op tail, folded on
+    device repeatedly, byte-identical to the CPU fold every round."""
+    service = LocalOrderingService()
+    runtimes = _seed_string_doc(service, "doc", edits=10)
+    svc = CatchupService(service)
+    first = svc.catch_up()
+    assert svc.device_docs == 1  # cold round
+
+    import random
+    rng = random.Random("warm")
+    for round_idx in range(3):
+        for i in range(8):
+            rt = runtimes[i % len(runtimes)]
+            t = rt.get_datastore("ds").get_channel("text")
+            L = len(t.text)
+            if L < 4 or rng.random() < 0.7:
+                t.insert_text(rng.randint(0, L), f"w{round_idx}")
+            else:
+                s = rng.randint(0, L - 2)
+                t.remove_range(s, min(L, s + 2))
+            for r in runtimes:
+                r.drain()
+        before_dev = svc.device_docs
+        # device fold vs a forced-CPU fold of the same (summary, tail)
+        cpu = CatchupService(service)
+        cpu._device_plan = lambda w: None
+        cpu_result = cpu.catch_up(upload=False)
+        result = svc.catch_up()
+        assert svc.device_docs == before_dev + 1  # warm round on device
+        handle, seq = result["doc"]
+        assert cpu_result["doc"] == (handle, seq)
+
+    # the final summary loads clean with an empty tail
+    tree, seq = service.storage.latest("doc")
+    assert service.oplog.get("doc", from_seq=seq) == []
+    check = ContainerRuntime()
+    check.load(tree)
+    live = runtimes[0].get_datastore("ds").get_channel("text").text
+    assert check.get_datastore("ds").get_channel("text").text == live
 
 
 def test_catchup_mixed_eligibility():

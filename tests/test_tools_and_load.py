@@ -1,0 +1,121 @@
+"""Tooling (replay CLI, bench harness) and the load/stress harness."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from fluidframework_tpu.drivers import FileDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.testing.load import LoadSpec, run_load
+from fluidframework_tpu.tools.bench_harness import (
+    benchmark,
+    benchmark_memory,
+)
+from fluidframework_tpu.tools.replay import replay
+
+
+# --- replay tool -------------------------------------------------------------
+
+
+def _make_store(tmp_path):
+    root = str(tmp_path / "store")
+    factory = FileDocumentServiceFactory(root)
+    loader = Loader(factory)
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+
+    a = loader.create("doc", "alice", build)
+    text = a.runtime.get_datastore("ds").get_channel("text")
+    seqs = []
+    for i in range(5):
+        text.insert_text(0, f"[{i}]")
+        a.drain()
+        seqs.append((a.runtime.ref_seq, text.text))
+    factory.close()
+    return root, seqs
+
+
+def test_replay_tool_reconstructs_history(tmp_path):
+    root, seqs = _make_store(tmp_path)
+    for seq, expected_text in seqs:
+        report = replay(root, "doc", to_seq=seq)
+        runtime = report.pop("_runtime")
+        assert report["seq"] == seq
+        channel = runtime.get_datastore("ds").get_channel("text")
+        assert channel.text == expected_text
+    head = replay(root, "doc")
+    assert head["seq"] == seqs[-1][0]
+    assert head["datastores"] == {"ds": {"text": "sequence-tpu"}}
+
+
+def test_replay_cli(tmp_path):
+    root, seqs = _make_store(tmp_path)
+    out = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.tools.replay",
+         root, "doc", "--json"],
+        capture_output=True, text=True, check=True, cwd="/root/repo",
+    )
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["seq"] == seqs[-1][0]
+    assert report["summaryDigest"]
+
+    shown = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.tools.replay",
+         root, "doc", "--show", "ds/text"],
+        capture_output=True, text=True, check=True, cwd="/root/repo",
+    )
+    assert seqs[-1][1] in shown.stdout
+
+
+# --- bench harness -----------------------------------------------------------
+
+
+def test_benchmark_statistics():
+    calls = []
+    result = benchmark(lambda: calls.append(1), name="noop",
+                       min_runs=5, min_time_s=0.0, warmup_runs=1)
+    assert result.runs >= 5
+    assert len(calls) == result.runs + 1  # warmup included
+    assert result.mean >= 0
+    assert result.p50 <= result.p95 or result.runs < 3
+    assert "noop" in result.report()
+
+
+def test_benchmark_setup_untimed():
+    def setup():
+        return list(range(1000))
+
+    timed = benchmark(lambda data: sum(data), min_runs=3, min_time_s=0,
+                      warmup_runs=0, setup=setup)
+    assert timed.runs == 3
+
+
+def test_benchmark_memory():
+    result = benchmark_memory(lambda: bytearray(5_000_000), name="alloc")
+    assert result.peak_bytes > 4_000_000
+    assert "alloc" in result.report()
+
+
+# --- load harness ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_load_run_converges(seed):
+    result = run_load(LoadSpec(seed=seed, clients=3, steps=120))
+    assert result.edits > 0
+    assert result.sequenced_ops > 0
+    assert result.final_clients >= 1
+    assert len(result.summary_digest) == 64
+
+
+def test_load_run_with_heavy_faults_converges():
+    spec = LoadSpec(seed=7, clients=4, steps=200, edit_weight=0.5,
+                    sync_weight=0.2, disconnect_weight=0.15,
+                    stash_weight=0.1, late_join_weight=0.05)
+    result = run_load(spec)
+    assert result.disconnects > 0
+    assert result.rehydrates + result.late_joins > 0
