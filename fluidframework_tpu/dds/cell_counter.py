@@ -19,6 +19,7 @@ from .shared_object import SharedObject
 
 class SharedCell(SharedObject):
     TYPE = "cell-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)
@@ -91,6 +92,7 @@ class SharedCell(SharedObject):
 
 class SharedCounter(SharedObject):
     TYPE = "counter-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)

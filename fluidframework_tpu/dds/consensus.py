@@ -40,6 +40,7 @@ class ConsensusQueue(SharedObject):
     hand-off: a held item whose holder leaves returns to the front)."""
 
     TYPE = "ordered-collection-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)
@@ -149,6 +150,17 @@ class ConsensusRegisterCollection(SharedObject):
         # key -> list of [value, seq] versions, oldest first
         self._registers: Dict[str, List[list]] = {}
 
+    def _resubmit_rebased(self, pending) -> None:
+        """Registers carry no positions, but ``ref_seq`` IS semantic here:
+        it records which versions the writer had observed (the supersede
+        filter in _process_core).  Re-pinning it to the current view would
+        silently supersede concurrent versions the author never saw, so a
+        stale resubmit keeps the *original* ref_seq — out-of-window is
+        harmless because the fold never resolves a view, it only compares
+        sequence numbers."""
+        for _old_client_seq, contents, metadata, ref_seq in pending:
+            self._resubmit_core(contents, metadata, ref_seq)
+
     # -- reads -----------------------------------------------------------------
 
     def read(self, key: str, default: Any = None) -> Any:
@@ -203,6 +215,7 @@ class TaskManager(SharedObject):
     leaving passes it down the queue."""
 
     TYPE = "task-manager-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)

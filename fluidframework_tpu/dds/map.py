@@ -124,6 +124,7 @@ class SharedMap(SharedObject):
     """Flat LWW key-value DDS."""
 
     TYPE = "map-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)
@@ -247,6 +248,7 @@ class SharedDirectory(SharedObject):
     own MapKernel.  Ops carry an absolute path."""
 
     TYPE = "directory-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(self, object_id: str) -> None:
         super().__init__(object_id)

@@ -413,6 +413,79 @@ class MergeTreeOracle:
                     seg.pending_props[key] = n
             seg.pending_groups.remove(group)
 
+    # -- rebase (regenerate pending ops at the current view) -------------------
+
+    def rebase_visible_len(self, seg: Segment, allowed) -> int:
+        """Visible length of ``seg`` in the view a *rebased resubmit* op will
+        be applied in by remote replicas: the fully-sequenced state plus the
+        segments whose pending ops were already regenerated (``allowed`` is
+        the set of their SegmentGroups).  Pending ops regenerated later in
+        the FIFO are not yet sequenced at that point, so they don't count —
+        this is what keeps regenerated positions exact (cf. the reference's
+        merge-tree op regeneration on reconnect)."""
+        if seg.insert_seq == UNASSIGNED_SEQ and not any(
+            g.kind == "insert" and g in allowed for g in seg.pending_groups
+        ):
+            return 0
+        if seg.removed_seq is not None:
+            if seg.removed_seq != UNASSIGNED_SEQ:
+                return 0
+            if any(g.kind == "remove" and g in allowed
+                   for g in seg.pending_groups):
+                return 0
+        return len(seg.text)
+
+    def rebase_position(self, target: Segment, allowed) -> int:
+        """Start position of ``target`` in the rebased-resubmit view."""
+        pos = 0
+        for seg in self.segments:
+            if seg is target:
+                return pos
+            pos += self.rebase_visible_len(seg, allowed)
+        raise ValueError("segment not in tree")
+
+    def rebase_reference_position(self, ref: "LocalReference",
+                                  allowed) -> int:
+        """Reference position in the rebased-resubmit view (same visibility
+        as :meth:`rebase_position`): own pending segments whose ops
+        regenerate *later* in the FIFO must not count — their inserts will
+        sequence after the op being regenerated."""
+        if ref.segment is None:
+            return 0
+        pos = 0
+        for seg in self.segments:
+            if seg is ref.segment:
+                if self.rebase_visible_len(seg, allowed) > 0:
+                    return pos + min(ref.offset, len(seg.text))
+                return pos
+            pos += self.rebase_visible_len(seg, allowed)
+        return pos
+
+    def rebase_length(self, allowed) -> int:
+        """Total visible length in the rebased-resubmit view."""
+        return sum(self.rebase_visible_len(s, allowed)
+                   for s in self.segments)
+
+    def rebase_normalize(self, seg: Segment, allowed) -> None:
+        """Physically relocate a pending-insert segment to the index where
+        remote replicas will place its regenerated op (the reference's
+        segment normalization on reconnect).  ``_insert_index`` stops
+        *before* the first sequenced segment at a boundary, so the
+        regenerated op lands immediately after the last segment visible in
+        the rebase view: cross every invisible neighbor — sequenced
+        tombstones AND own un-regenerated pending segments (each of the
+        latter is re-placed by its own later regeneration, whose position
+        then counts this segment via ``allowed``, keeping author and
+        remote orders identical)."""
+        i = self.segments.index(seg)
+        j = i
+        while j > 0 and self.rebase_visible_len(
+                self.segments[j - 1], allowed) == 0:
+            j -= 1
+        if j != i:
+            del self.segments[i]
+            self.segments.insert(j, seg)
+
     # -- local references (interval anchors) -----------------------------------
 
     @staticmethod

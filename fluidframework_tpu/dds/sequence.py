@@ -157,6 +157,102 @@ class SharedString(SharedObject):
             label, {"kind": "intervalDelete", "label": label, "id": interval_id}
         )
 
+    # -- rebase resubmit (view fell below the collaboration window) ------------
+
+    def _resubmit_rebased(self, pending) -> None:
+        """Regenerate pending ops against the current view, one op per
+        affected segment (the reference's merge-tree op regeneration on
+        reconnect).  Exactness comes from segment identity: each pending
+        SegmentGroup still holds the very segments the op touched, so the
+        rebased op re-targets them at their *current* positions — computed
+        in the view remote replicas will apply it in (sequenced state plus
+        already-regenerated earlier pending ops; see
+        MergeTreeOracle.rebase_visible_len)."""
+        groups = list(self._pending_groups)
+        self._pending_groups.clear()
+        allowed: set = set()
+        gi = 0
+        for _old_client_seq, contents, _meta, _ref_seq in pending:
+            kind = contents["kind"]
+            if kind in ("insert", "remove", "annotate"):
+                group = groups[gi]
+                gi += 1
+                self._regen_group(group, contents, allowed)
+            elif kind.startswith("interval"):
+                self._regen_interval(contents, allowed)
+            else:
+                raise ValueError(f"unknown pending sequence op {kind!r}")
+        assert gi == len(groups), "pending-op / segment-group FIFO skew"
+
+    def _regen_group(self, group: SegmentGroup, contents: dict,
+                     allowed: set) -> None:
+        segs = [s for s in self.tree.segments if group in s.pending_groups]
+        client = self._local_client()
+        for seg in segs:
+            seg.pending_groups.remove(group)
+            if group.kind == "insert":
+                self.tree.rebase_normalize(seg, allowed)
+                pos = self.tree.rebase_position(seg, allowed)
+                op = {"kind": "insert", "pos": pos, "text": seg.text}
+                if contents.get("props"):
+                    op["props"] = contents["props"]
+            elif group.kind == "remove":
+                if seg.removed_seq is not None \
+                        and seg.removed_seq != UNASSIGNED_SEQ:
+                    # A remote remove sequenced first while we were away and
+                    # ours never reached the log: nothing to resubmit — we
+                    # were never a summary-visible overlap remover.
+                    seg.pending_overlap.discard(client)
+                    continue
+                start = self.tree.rebase_position(seg, allowed)
+                op = {"kind": "remove", "start": start,
+                      "end": start + len(seg.text)}
+            else:  # annotate
+                if seg.removed_seq is not None \
+                        and seg.removed_seq != UNASSIGNED_SEQ:
+                    # Sequenced-removed segment: remote replicas would skip
+                    # it anyway; release the pending-prop holds.
+                    for key in group.props:
+                        n = seg.pending_props.get(key, 0) - 1
+                        if n <= 0:
+                            seg.pending_props.pop(key, None)
+                        else:
+                            seg.pending_props[key] = n
+                    continue
+                start = self.tree.rebase_position(seg, allowed)
+                op = {"kind": "annotate", "start": start,
+                      "end": start + len(seg.text), "props": group.props}
+            new_group = SegmentGroup(group.kind, props=group.props or None)
+            new_group.add(seg)
+            self._pending_groups.append(new_group)
+            self._submit_local_op(op)  # fresh ref_seq = the current view
+            allowed.add(new_group)
+
+    def _regen_interval(self, contents: dict, allowed: set) -> None:
+        """Rebase one pending interval op: endpoints re-read from the
+        optimistic overlay's live references (they slid with every edit),
+        resolved in the *rebase view* — own pending inserts that regenerate
+        later in the FIFO sequence after this op, so counting them would
+        shift the anchors right on every replica.  If the interval is gone
+        from the overlay, clamp the stale positions into the rebase-view
+        length (deterministic for every replica)."""
+        label = contents.get("label", "default")
+        iv = self.get_interval_collection(label).get(contents["id"])
+        op = dict(contents)
+        if iv is not None:
+            if op.get("start") is not None:
+                op["start"] = self.tree.rebase_reference_position(
+                    iv.start, allowed)
+            if op.get("end") is not None:
+                op["end"] = self.tree.rebase_reference_position(
+                    iv.end, allowed)
+        else:
+            n = self.tree.rebase_length(allowed)
+            for k in ("start", "end"):
+                if op.get(k) is not None:
+                    op[k] = min(op[k], n)
+        self._submit_local_op(op)
+
     def apply_stashed_op(self, contents) -> None:
         kind = contents["kind"]
         if kind == "insert":

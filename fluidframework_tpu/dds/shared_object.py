@@ -98,27 +98,65 @@ class SharedObject:
         self._last_submitted_client_seq = client_seq
         self._pending.append((client_seq, contents, local_metadata, ref_seq))
 
+    #: Whether this DDS's op contents are view-independent (no positions
+    #: resolved against ``ref_seq``): LWW keys, grow-only counters,
+    #: id-addressed tree edits.  Such ops can be rebased to the current view
+    #: by simply re-pinning ``ref_seq``; position-carrying DDSes leave this
+    #: False and override :meth:`_resubmit_rebased` with real op
+    #: regeneration (SharedString) or inherit the StaleOpError.
+    REBASE_POSITION_FREE = False
+
     def resubmit_pending(self) -> None:
         """Reconnect path: re-send all unacked ops (same contents, fresh
-        client_seqs).  Capability parity with PendingStateManager resubmit."""
+        client_seqs).  Capability parity with PendingStateManager resubmit.
+
+        If the collaboration window moved past a pending op's view while we
+        were away, its original ``ref_seq`` can no longer be sent (remote
+        zamboni may have compacted the state that view needs): the whole
+        batch is rebased instead — regenerated against the current view
+        (the reference's merge-tree op regeneration on reconnect)."""
         if self._delta_connection is None:
             return
         pending = list(self._pending)
         self._pending.clear()
         min_seq = getattr(self._delta_connection, "min_seq", None)
+        if min_seq is not None and any(
+            ref_seq is not None and ref_seq < min_seq
+            for _cs, _c, _m, ref_seq in pending
+        ):
+            try:
+                self._resubmit_rebased(pending)
+            except StaleOpError:
+                # Restore the snapshot so the documented recovery (stash and
+                # rehydrate) can still capture these ops.  Overrides must
+                # raise before submitting anything for this to be exact.
+                self._pending.extend(pending)
+                raise
+            return
         for _old_client_seq, contents, metadata, ref_seq in pending:
-            if ref_seq is not None and min_seq is not None \
-                    and ref_seq < min_seq:
-                # The collaboration window moved past the op's view while
-                # we were away: its positions can no longer be resolved
-                # (zamboni may have compacted state the view needs).  The
-                # reference closes the container; the host stashes pending
-                # state and rehydrates (which re-resolves positions).
-                raise StaleOpError(
-                    f"{self.id}: pending op ref_seq {ref_seq} is below the "
-                    f"collaboration window ({min_seq}); stash and rehydrate"
-                )
             self._resubmit_core(contents, metadata, ref_seq)
+
+    @property
+    def can_rebase(self) -> bool:
+        """Whether stale pending ops can be regenerated against the current
+        view: view-independent ops, or a DDS-specific rebase override."""
+        return self.REBASE_POSITION_FREE or (
+            type(self)._resubmit_rebased is not SharedObject._resubmit_rebased
+        )
+
+    def _resubmit_rebased(self, pending) -> None:
+        """Re-issue pending ops whose view fell below the collaboration
+        window.  Default: view-independent ops are re-pinned to the current
+        view (exact); position-carrying DDSes must override with real
+        regeneration, else the host must stash and rehydrate."""
+        if not self.REBASE_POSITION_FREE:
+            raise StaleOpError(
+                f"{self.id}: pending op view fell below the collaboration "
+                f"window and {type(self).__name__} cannot rebase it; stash "
+                f"and rehydrate"
+            )
+        for _old_client_seq, contents, metadata, _ref_seq in pending:
+            self._resubmit_core(contents, metadata, ref_seq=None)
 
     def _resubmit_core(self, contents: Any, metadata: Any,
                        ref_seq: Any = None) -> None:

@@ -461,6 +461,7 @@ class SharedTree(SharedObject):
     nodes, structural edits, LWW values, undo via inversion."""
 
     TYPE = "tree-tpu"
+    REBASE_POSITION_FREE = True
 
     def __init__(
         self, object_id: str,

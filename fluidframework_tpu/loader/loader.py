@@ -104,18 +104,24 @@ class Container:
         self.runtime.client_id = self.delta_manager.client_id
         self.runtime._client_ids.add(self.delta_manager.client_id)
         self.drain()
-        # Drop the offline-held outbox and any half-sent wire messages:
-        # resubmit_pending re-issues every unacked op with fresh client_seqs
-        # under the new connection (keeping both would double-send; the old
-        # connection's partial chunk trains die with its LEAVE).
+        self.discard_outbound()
+        self.resubmit_pending()
+        self.runtime.flush()
+
+    def discard_outbound(self) -> None:
+        """Drop the offline-held outbox and any half-sent wire messages:
+        resubmit_pending re-issues every unacked op with fresh client_seqs
+        under the new connection (keeping both would double-send; the old
+        connection's partial chunk trains die with its LEAVE)."""
         self.runtime._outbox.clear()
         self.runtime._pending_wire.clear()
-        # Meta-ops (ds/channel/blob attaches) first: their channels' ops
-        # must land on materialized targets.
+
+    def resubmit_pending(self) -> None:
+        """Re-issue every unacked op.  Meta-ops (ds/channel/blob attaches)
+        first: their channels' ops must land on materialized targets."""
         self.runtime.resubmit_pending_runtime_ops()
         for ds in self.runtime.datastores.values():
             ds.resubmit_pending()
-        self.runtime.flush()
 
     def close(self) -> None:
         self.delta_manager.close()
@@ -192,13 +198,14 @@ class Loader:
         doc_id: str,
         client_id: Optional[str] = None,
         pending_state: Optional[dict] = None,
-        stale_pending: str = "raise",
+        stale_pending: str = "rebase",
     ) -> Container:
         """Load a document: summary + catch-up replay + live connection.
         ``client_id=None`` loads read-only-detached (e.g. replay driver).
         ``pending_state`` rehydrates a previous session's unacked ops.
         ``stale_pending``: when the stash's view has fallen below the
-        collaboration window its position ops can no longer merge exactly —
+        collaboration window its ops cannot ship with their original view —
+        ``"rebase"`` (default) regenerates them against the current view,
         ``"raise"`` surfaces StaleOpError (host decides), ``"drop"``
         discards the stashed ops and loads clean."""
         if pending_state is not None and client_id is None:
@@ -216,8 +223,13 @@ class Loader:
         doc_id: str,
         client_id: Optional[str],
         pending_state: Optional[dict],
-        stale_pending: str = "raise",
+        stale_pending: str = "rebase",
     ) -> Container:
+        if stale_pending not in ("rebase", "drop", "raise"):
+            raise ValueError(
+                f"stale_pending must be 'rebase', 'drop', or 'raise', "
+                f"got {stale_pending!r}"
+            )
         service = self.factory.resolve(doc_id)
         runtime = self._new_runtime()
 
@@ -246,21 +258,32 @@ class Loader:
         container.delta_manager.note_delivered(runtime.ref_seq)
 
         if pending_state is not None and pending_state["pending"]:
-            # Stash staleness: its ops' views must still be inside the
-            # collaboration window or their positions can't merge exactly.
+            # Stash staleness: the collaboration window moved past the
+            # stash's view while the session was down.  Default ("rebase"):
+            # proceed — the resubmit below regenerates each op against the
+            # current view (per-DDS, segment-identity-exact for sequences).
             head_msn = max((m.min_seq for m in post_stash),
                            default=runtime.min_seq)
             if pending_state["refSeq"] < head_msn:
-                from ..dds.shared_object import StaleOpError
-
+                cannot = sorted({
+                    p["channel"] for p in pending_state["pending"]
+                    if not runtime.datastores[p["ds"]]
+                    .channels[p["channel"]].can_rebase
+                }) if stale_pending == "rebase" else []
                 if stale_pending == "drop":
                     pending_state = None
-                else:
+                elif stale_pending == "raise" or cannot:
+                    from ..dds.shared_object import StaleOpError
+
+                    why = (f"channels {cannot} cannot rebase their pending "
+                           f"ops; " if cannot else "")
                     raise StaleOpError(
                         f"{doc_id}: stashed pending state (refSeq "
                         f"{pending_state['refSeq']}) is below the "
-                        f"collaboration window ({head_msn}); pass "
+                        f"collaboration window ({head_msn}); {why}pass "
                         f"stale_pending='drop' to load without it"
+                        + ("" if cannot else " or 'rebase' to regenerate "
+                           "against the current view")
                     )
 
         if client_id is not None:
@@ -270,7 +293,21 @@ class Loader:
             # position-carrying contents resolve against the original view.
             container.runtime.connect(container.delta_manager, client_id)
             if pending_state is not None:
-                self._apply_stashed(runtime, pending_state, post_stash)
+                # Hold the auto-flush so the stashed re-submissions buffer in
+                # the outbox instead of hitting the wire: they are pinned to
+                # the stash-point view, which may lie below the live
+                # collaboration window.  Discard the buffered batch, catch up
+                # to head, and resubmit pending — ops go out pinned to an
+                # in-window view, regenerated (rebased) where the original
+                # view is stale.
+                runtime._batching += 1
+                try:
+                    self._apply_stashed(runtime, pending_state, post_stash)
+                finally:
+                    runtime._batching -= 1
+                container.discard_outbound()
+                container.drain()
+                container.resubmit_pending()
             container.drain()
             container.runtime.flush()
         return container

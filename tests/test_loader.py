@@ -355,3 +355,234 @@ def test_file_driver_durable_across_reopen(tmp_path):
     b.drain()
     assert text_of(b) == "still-durable"
     factory2.close()
+
+
+# --- stale pending: rebase at reconnect / rehydrate --------------------------
+
+
+def _advance_window(a, edits=8):
+    """Drive alice's view (and so the MSN, once she is the only connected
+    client) forward with edits that create and collect tombstones."""
+    for i in range(edits):
+        text_channel(a).insert_text(0, f"a{i}-")
+        a.drain()
+    t = text_of(a)
+    if len(t) > 6:
+        text_channel(a).remove_range(0, 4)
+        a.drain()
+
+
+def test_reconnect_rebases_stale_pending(monkeypatch):
+    """Pending ops whose view fell below the collaboration window are
+    regenerated against the current view at reconnect (not StaleOpError)."""
+    from fluidframework_tpu.dds.sequence import SharedString
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "base-text")
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+
+    b.disconnect()
+    text_channel(b).insert_text(4, "[bob]")
+    text_channel(b).remove_range(0, 2)
+    map_channel(b).set("who", "bob")
+    _advance_window(a)  # MSN moves past bob's pinned views
+
+    rebased = []
+    orig = SharedString._resubmit_rebased
+    monkeypatch.setattr(
+        SharedString, "_resubmit_rebased",
+        lambda self, pending: rebased.append(len(pending))
+        or orig(self, pending),
+    )
+    b.reconnect()
+    a.drain()
+    b.drain()
+    a.drain()
+
+    assert rebased, "stale pending should have taken the rebase path"
+    assert text_of(a) == text_of(b)
+    assert "[bob]" in text_of(a)
+    assert map_channel(a).get("who") == "bob"
+    assert a.runtime.summarize().digest() == b.runtime.summarize().digest()
+
+
+def test_rehydrate_rebases_stale_pending():
+    """A stash whose refSeq fell below the collaboration window rehydrates
+    by default: stashed ops re-applied at the stash point, then regenerated
+    against the caught-up view."""
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "0123456789")
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+
+    b.disconnect()
+    text_channel(b).insert_text(5, "<bob>")
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 1
+    _advance_window(a)
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    a.drain()
+    assert text_of(a) == text_of(b2)
+    assert "<bob>" in text_of(a)
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+def test_rehydrate_stale_pending_drop_mode():
+    """stale_pending='drop' still loads clean, discarding the stash."""
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "0123456789")
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    text_channel(b).insert_text(5, "<bob>")
+    stash = b.close_and_get_pending_state()
+    _advance_window(a)
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash,
+                        stale_pending="drop")
+    a.drain()
+    b2.drain()
+    assert text_of(a) == text_of(b2)
+    assert "<bob>" not in text_of(a)
+
+
+def test_rebase_interval_anchor_excludes_later_pending_inserts():
+    """A pending interval op regenerated at rebase must resolve endpoints
+    without counting own pending inserts later in the FIFO (they sequence
+    after it) — else the anchor shifts right on every replica."""
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build_text_doc)
+    text_channel(a).insert_text(0, "abcdef")
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    iv_id = text_channel(b).add_interval(1, 2)  # over 'b'
+    text_channel(b).insert_text(0, "ZZ")        # later in the pending FIFO
+    _advance_window(a)
+    b.reconnect()
+    a.drain()
+    b.drain()
+    a.drain()
+    assert text_of(a) == text_of(b)
+    pa = text_channel(a).get_interval_collection().endpoints(iv_id)
+    pb = text_channel(b).get_interval_collection().endpoints(iv_id)
+    assert pa == pb
+    s, e = pa
+    assert text_of(a)[s:e] == "b"
+
+
+def test_rebase_register_write_keeps_unobserved_versions():
+    """A stale register write resubmits with its ORIGINAL ref_seq: the
+    supersede filter compares observation points, so re-pinning to the
+    current view would wipe concurrent versions the author never saw."""
+    service, _factory, loader = make_stack()
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("register-collection-tpu", "reg")
+        ds.create_channel("sequence-tpu", "text")
+
+    def reg(c):
+        return c.runtime.get_datastore("ds").get_channel("reg")
+
+    a = loader.create("doc", "alice", build)
+    reg(a).write("k", "alice-v1")
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    reg(b).write("k", "bob-v")
+    reg(a).write("k", "alice-v2")
+    a.drain()
+    _advance_window(a)
+    b.reconnect()
+    a.drain()
+    b.drain()
+    a.drain()
+    assert reg(a).read_versions("k") == reg(b).read_versions("k")
+    assert set(reg(a).read_versions("k")) == {"alice-v2", "bob-v"}
+    assert reg(a).read("k") == "alice-v2"
+
+
+def test_stale_matrix_pending_stays_stashable_and_drop_recovers():
+    """A DDS that cannot rebase (SharedMatrix): reconnect raises
+    StaleOpError but the pending ops survive for stashing, and a truly
+    stale stash gets the actionable loader-level error before any
+    mutation; stale_pending='drop' recovers."""
+    from fluidframework_tpu.dds.shared_object import StaleOpError
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("matrix-tpu", "grid")
+        ds.create_channel("sequence-tpu", "text")
+
+    def grid(c):
+        return c.runtime.get_datastore("ds").get_channel("grid")
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    grid(a).insert_rows(0, 2)
+    grid(a).insert_cols(0, 2)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    grid(b).set_cell(0, 0, "bob")
+    stash = b.close_and_get_pending_state()  # crash offline: stale refSeq
+    _advance_window(a)
+
+    with pytest.raises(StaleOpError) as ei:
+        loader.resolve("doc", "bob2", pending_state=stash)
+    assert "grid" in str(ei.value) and "drop" in str(ei.value)
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash,
+                        stale_pending="drop")
+    a.drain()
+    b2.drain()
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+def test_stale_matrix_reconnect_raise_keeps_pending_stashable():
+    """resubmit_pending restores the pending snapshot when the rebase path
+    raises, so close_and_get_pending_state still captures the ops."""
+    from fluidframework_tpu.dds.shared_object import StaleOpError
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("matrix-tpu", "grid")
+        ds.create_channel("sequence-tpu", "text")
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    g = a.runtime.get_datastore("ds").get_channel("grid")
+    g.insert_rows(0, 2)
+    g.insert_cols(0, 2)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    b.runtime.get_datastore("ds").get_channel("grid").set_cell(0, 0, "bob")
+    _advance_window(a)
+    with pytest.raises(StaleOpError):
+        b.reconnect()
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 1
+    # The post-reconnect drain freshened the stash view: rehydrate works.
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    a.drain()
+    assert b2.runtime.get_datastore("ds").get_channel("grid") \
+        .get_cell(0, 0) == "bob"
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
