@@ -5,14 +5,25 @@ summaries.  The CPU baseline is the oracle replay harness (BASELINE.md: the
 1× denominator); the device path is the merge-tree kernel vmapped over the
 document axis on whatever backend jax selects (real TPU under the driver).
 
+Two numbers are measured and reported:
+- ``value`` / ``vs_baseline``: the HONEST END-TO-END rate — wall-clock from
+  raw op streams to canonical summaries materialized host-side for every
+  document (pack → upload → fold → fused-export download → C++ body
+  extraction), including every stage.
+- ``steady_fold_ops_per_sec``: the device fold alone (compiled, resident),
+  the rate a saturated pipeline approaches when host stages overlap
+  back-to-back batches.
+
 Prints exactly ONE JSON line to stdout:
-    {"metric": ..., "value": ops/sec, "unit": "ops/sec", "vs_baseline": ratio}
+    {"metric": ..., "value": ops/sec, "unit": "ops/sec", "vs_baseline": ratio,
+     ...stage breakdown + fallback counts...}
 Diagnostics go to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
@@ -24,9 +35,10 @@ from fluidframework_tpu.dds.sequence import SharedString
 from fluidframework_tpu.ops.interning import Interner
 from fluidframework_tpu.ops.mergetree_kernel import (
     MergeTreeDocInput,
-    _replay_batch_cold,
+    _replay_export_cold,
     pack_mergetree_batch,
     replay_mergetree_batch,
+    summaries_from_export,
 )
 from fluidframework_tpu.ops.native_pack import (
     decode_string_ops,
@@ -34,8 +46,6 @@ from fluidframework_tpu.ops.native_pack import (
     load_library,
 )
 from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
-
-import os
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", "10240"))
 OPS_PER_DOC = int(os.environ.get("BENCH_OPS", "96"))
@@ -50,9 +60,10 @@ ALPHABET = "abcdefghijklmnopqrstuvwxyz "
 
 def synth_doc(doc_idx: int, n_ops: int) -> MergeTreeDocInput:
     """A valid sequenced op stream: 3 clients round-robin, mixed edits.
-    70% of documents are pure insert/remove text traffic (ingested in the
-    native binary record format); 30% carry annotate ops with props and
-    take the Python pack path — a realistic mix that exercises both."""
+    70% of documents are pure insert/remove text traffic; 30% carry
+    annotate ops with props.  ALL streams are ingested in the native binary
+    record format (annotates ride encoder-local intern tables that packing
+    translates to the batch-global spaces in C++)."""
     rng = random.Random(doc_idx * 7919 + 13)
     annotating_doc = doc_idx % 10 >= 7
     ops, length = [], 0
@@ -61,7 +72,7 @@ def synth_doc(doc_idx: int, n_ops: int) -> MergeTreeDocInput:
         client = f"client{i % 3}"
         r = rng.random()
         if not annotating_doc:
-            r = min(r, 0.89)  # no annotates in binary-ingested docs
+            r = min(r, 0.89)  # no annotates in pure-text docs
         if r < 0.62 or length < 4:
             pos = rng.randint(0, length)
             text = "".join(
@@ -87,21 +98,29 @@ def synth_doc(doc_idx: int, n_ops: int) -> MergeTreeDocInput:
                 min_seq=0, type=MessageType.OP, contents=contents,
             )
         )
-    # Ingestion-time binary encoding: the op stream is written once in the
-    # liboppack record format; batch packing then runs in C++ (the
-    # ops/native_pack fast path).  Annotates carry props, so those streams
-    # keep the Python path — mirroring real mixed traffic.
-    has_props = any(m.contents["kind"] == "annotate" for m in ops)
-    if has_props:
-        return MergeTreeDocInput(
-            doc_id=f"doc{doc_idx}", ops=ops, final_seq=n_ops, final_msn=0
-        )
-    clients = Interner()
-    blob = encode_string_ops(ops, clients)
+    clients, keys, vals = Interner(), Interner(), Interner()
+    blob = encode_string_ops(ops, clients, keys, vals)
     return MergeTreeDocInput(
         doc_id=f"doc{doc_idx}", ops=[], binary_ops=blob,
-        binary_clients=list(clients.values), final_seq=n_ops, final_msn=0
+        binary_clients=list(clients.values),
+        binary_prop_keys=list(keys.values) or None,
+        binary_values=list(vals.values) or None,
+        final_seq=n_ops, final_msn=0,
     )
+
+
+def doc_ops(doc):
+    return decode_string_ops(
+        doc.binary_ops, list(doc.binary_clients),
+        prop_keys=doc.binary_prop_keys, values=doc.binary_values,
+    )
+
+
+def oracle_replay(doc):
+    replica = SharedString(doc.doc_id)
+    for msg in doc_ops(doc):
+        replica.process(msg, local=False)
+    return replica
 
 
 def main() -> None:
@@ -110,22 +129,15 @@ def main() -> None:
     total_ops = N_DOCS * OPS_PER_DOC
     print(
         f"generated {N_DOCS} docs x {OPS_PER_DOC} ops in {time.time()-t0:.1f}s "
-        f"(backend={jax.default_backend()})",
+        f"(backend={jax.default_backend()}, "
+        f"native={'yes' if load_library() is not None else 'NO'})",
         file=sys.stderr,
     )
 
     # --- CPU oracle baseline (the 1x denominator, BASELINE.md) ---
-    def doc_ops(doc):
-        if doc.binary_ops is not None:
-            return decode_string_ops(doc.binary_ops,
-                                     list(doc.binary_clients))
-        return doc.ops
-
     t0 = time.time()
     for doc in docs[:CPU_SAMPLE_DOCS]:
-        replica = SharedString(doc.doc_id)
-        for msg in doc_ops(doc):
-            replica.process(msg, local=False)
+        oracle_replay(doc)
     cpu_time = time.time() - t0
     cpu_ops_per_sec = CPU_SAMPLE_DOCS * OPS_PER_DOC / cpu_time
     print(
@@ -134,55 +146,102 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # --- device path: chunked fold, one compiled shape ---
-    native = load_library() is not None
+    # --- warm the compile cache outside the timed run (a fresh process
+    # pays XLA compilation once; steady service operation does not) ---
+    warm_state, warm_ops, _ = pack_mergetree_batch(docs[:CHUNK_DOCS])
+    S = warm_state.tstart.shape[1]
     t0 = time.time()
-    packed = [
-        pack_mergetree_batch(docs[i:i + CHUNK_DOCS])
-        for i in range(0, len(docs), CHUNK_DOCS)
-    ]
-    pack_time = time.time() - t0
-    print(f"pack path: {'C++ liboppack' if native else 'pure python'} | "
-          f"{len(packed)} chunks x {CHUNK_DOCS} docs", file=sys.stderr)
-    def fold(state, ops):
-        # cold docs: initial state built in-graph, only op arrays upload
-        return _replay_batch_cold(ops, state.tstart.shape[1])
-
-    t0 = time.time()
-    jax.block_until_ready(fold(packed[0][0], packed[0][1]))
+    jax.block_until_ready(_replay_export_cold(warm_ops, S))
     warm_time = time.time() - t0
-    device_time = float("inf")
-    for _rep in range(3):  # best-of-3: the device tunnel adds run noise
+    print(f"compile+first fold {warm_time:.1f}s (S={S})", file=sys.stderr)
+
+    # --- HONEST END-TO-END: raw streams → host-side canonical summaries.
+    # Stages pipeline: all folds dispatch asynchronously (device runs while
+    # the host packs the next chunk); downloads then drain in order while
+    # extraction of earlier chunks proceeds.
+    e2e_t0 = time.time()
+    pack_time = fold_dispatch_time = 0.0
+    metas, exports, packed = [], [], []
+    for i in range(0, len(docs), CHUNK_DOCS):
         t0 = time.time()
-        finals = [fold(state, ops) for state, ops, _meta in packed]
-        for final in finals:
-            jax.block_until_ready(final)
-        device_time = min(device_time, time.time() - t0)
-    device_ops_per_sec = total_ops / device_time
+        state, ops, meta = pack_mergetree_batch(docs[i:i + CHUNK_DOCS])
+        pack_time += time.time() - t0
+        t0 = time.time()
+        exports.append(_replay_export_cold(ops, state.tstart.shape[1]))
+        fold_dispatch_time += time.time() - t0
+        metas.append(meta)
+        packed.append((state, ops))
+    t0 = time.time()
+    exports_np = [np.asarray(e) for e in exports]  # D2H (fused, 1/chunk)
+    download_time = time.time() - t0
+    t0 = time.time()
+    summaries = []
+    stats: dict = {}
+    for meta, ex in zip(metas, exports_np):
+        summaries.extend(summaries_from_export(meta, ex, stats=stats))
+    extract_time = time.time() - t0
+    e2e_time = time.time() - e2e_t0
+    assert len(summaries) == N_DOCS
+    e2e_ops_per_sec = total_ops / e2e_time
+    fallbacks = stats.get("fallback_docs", 0)
     print(
-        f"pack {pack_time:.1f}s | compile+first {warm_time:.1f}s | "
-        f"steady replay {device_time:.3f}s = {device_ops_per_sec:,.0f} ops/s",
+        f"end-to-end {e2e_time:.2f}s = {e2e_ops_per_sec:,.0f} ops/s "
+        f"(pack {pack_time:.2f} | dispatch {fold_dispatch_time:.2f} | "
+        f"download {download_time:.2f} | extract+summarize "
+        f"{extract_time:.2f}) | oracle fallbacks {fallbacks}/{N_DOCS}",
         file=sys.stderr,
     )
 
-    # --- sanity: device bytes == oracle bytes on a couple of docs ---
-    check = replay_mergetree_batch(docs[:2])
-    for doc, dev_summary in zip(docs[:2], check):
-        replica = SharedString(doc.doc_id)
-        for msg in doc_ops(doc):
-            replica.process(msg, local=False)
-        assert dev_summary.digest() == replica.summarize().digest(), (
+    # --- steady-state device fold (resident data, compiled; reuses the
+    # packed chunks from the e2e run) ---
+    fold_time = float("inf")
+    for _rep in range(3):  # best-of-3: the device tunnel adds run noise
+        t0 = time.time()
+        finals = [
+            _replay_export_cold(ops, state.tstart.shape[1])
+            for state, ops in packed
+        ]
+        for final in finals:
+            jax.block_until_ready(final)
+        fold_time = min(fold_time, time.time() - t0)
+    fold_ops_per_sec = total_ops / fold_time
+    print(
+        f"steady fold {fold_time:.3f}s = {fold_ops_per_sec:,.0f} ops/s",
+        file=sys.stderr,
+    )
+
+    # --- sanity: device bytes == oracle bytes on sampled docs ---
+    sample = [docs[0], docs[7], docs[N_DOCS // 2]]
+    for doc, dev_summary in zip(sample, replay_mergetree_batch(sample)):
+        assert dev_summary.digest() == oracle_replay(doc).summarize().digest(), (
             f"bench sanity: {doc.doc_id} device summary != oracle"
         )
+    # and against the end-to-end pipeline output
+    assert summaries[0].digest() == oracle_replay(docs[0]).summarize().digest()
     print("sanity: device summaries byte-identical to oracle", file=sys.stderr)
 
     print(
         json.dumps(
             {
                 "metric": "sharedstring_catchup_replay_ops_per_sec",
-                "value": round(device_ops_per_sec, 1),
+                "value": round(e2e_ops_per_sec, 1),
                 "unit": "ops/sec",
-                "vs_baseline": round(device_ops_per_sec / cpu_ops_per_sec, 2),
+                "vs_baseline": round(e2e_ops_per_sec / cpu_ops_per_sec, 2),
+                "steady_fold_ops_per_sec": round(fold_ops_per_sec, 1),
+                "steady_fold_vs_baseline": round(
+                    fold_ops_per_sec / cpu_ops_per_sec, 2
+                ),
+                "cpu_baseline_ops_per_sec": round(cpu_ops_per_sec, 1),
+                "stages_sec": {
+                    "pack": round(pack_time, 3),
+                    "fold_dispatch": round(fold_dispatch_time, 3),
+                    "download": round(download_time, 3),
+                    "extract_summarize": round(extract_time, 3),
+                    "end_to_end": round(e2e_time, 3),
+                },
+                "oracle_fallback_docs": fallbacks,
+                "n_docs": N_DOCS,
+                "ops_per_doc": OPS_PER_DOC,
             }
         )
     )

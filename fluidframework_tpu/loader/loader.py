@@ -265,9 +265,17 @@ class Loader:
             head_msn = max((m.min_seq for m in post_stash),
                            default=runtime.min_seq)
             if pending_state["refSeq"] < head_msn:
+                # Only ops that will actually be re-applied gate the load:
+                # stashed ops already in the durable tail are deduped away
+                # and never need a rebase.
+                sequenced = self._already_sequenced(pending_state,
+                                                    post_stash)
+                old_ids = pending_state.get("clientIds", [])
                 cannot = sorted({
                     p["channel"] for p in pending_state["pending"]
-                    if not runtime.datastores[p["ds"]]
+                    if not any((cid, p["clientSeq"]) in sequenced
+                               for cid in old_ids)
+                    and not runtime.datastores[p["ds"]]
                     .channels[p["channel"]].can_rebase
                 }) if stale_pending == "rebase" else []
                 if stale_pending == "drop":
@@ -314,26 +322,40 @@ class Loader:
 
     # -- internals -------------------------------------------------------------
 
+    @staticmethod
+    def _already_sequenced(pending_state: dict,
+                           post_stash_tail: List[SequencedMessage]):
+        """(old client id, clientSeq) pairs from the stash that appear in
+        the durable tail — ops that DID reach the sequencer; the session
+        just crashed before processing the ack.  The tail is decoded
+        through the full op pipeline (grouped, compressed, AND chunked
+        batches), or over-threshold batches would hide sequenced ops and
+        cause a double-apply."""
+        from ..runtime.op_pipeline import decode_stream
+
+        old_ids = set(pending_state.get("clientIds", []))
+        sequenced = set()
+        for msg, batch in decode_stream(
+                m for m in post_stash_tail
+                if m.client_id in old_ids and m.type is MessageType.OP):
+            for sub in batch["ops"]:
+                sequenced.add((msg.client_id, sub["clientSeq"]))
+        return sequenced
+
     def _apply_stashed(self, runtime: ContainerRuntime, pending_state: dict,
                        post_stash_tail: List[SequencedMessage]) -> None:
         """Re-apply stashed pending ops as fresh local mutations (optimistic
         apply + submit) on exactly the state they were created against.
 
         An op the old session submitted may already have been *sequenced* —
-        the session just crashed before processing its ack.  Those arrive
-        in the post-stash tail as ordinary remote ops (the new client id
-        makes them non-local), so re-applying their stashed copies would
-        double-apply: drop any stashed op whose (old client id, clientSeq)
-        appears in the durable tail (the reference's PendingStateManager
-        dedup)."""
+        those arrive in the post-stash tail as ordinary remote ops (the new
+        client id makes them non-local), so re-applying their stashed
+        copies would double-apply: drop them (the reference's
+        PendingStateManager dedup)."""
         old_ids = set(pending_state.get("clientIds", []))
-        already_sequenced = set()
-        for msg in post_stash_tail:
-            if msg.client_id in old_ids and msg.type is MessageType.OP \
-                    and isinstance(msg.contents, dict) \
-                    and msg.contents.get("type") == "groupedBatch":
-                for sub in msg.contents["ops"]:
-                    already_sequenced.add((msg.client_id, sub["clientSeq"]))
+        already_sequenced = self._already_sequenced(
+            pending_state, post_stash_tail
+        )
         for p in pending_state["pending"]:
             if any((cid, p["clientSeq"]) in already_sequenced
                    for cid in old_ids):

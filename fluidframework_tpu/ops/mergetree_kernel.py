@@ -232,16 +232,15 @@ replay_vmapped = jax.vmap(replay_scan)
 _replay_batch = jax.jit(replay_vmapped)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
-    """Cold-start fold: documents with no base summary start from the empty
-    state, which is all zeros/sentinels — building it IN-GRAPH instead of
-    transferring (D, S) arrays of zeros through the host↔device link cuts
-    the per-chunk upload to the op arrays alone (the link, not the fold, is
-    the bottleneck on a tunneled chip)."""
+def _cold_start(ops: "MTOps", S: int) -> "MTState":
+    """Empty initial state built IN-GRAPH: documents with no base summary
+    start from all zeros/sentinels — constructing it on device instead of
+    transferring (D, S) arrays of zeros cuts the per-chunk upload to the op
+    arrays alone (the link, not the fold, is the bottleneck on a tunneled
+    chip)."""
     D = ops.kind.shape[0]
     K = ops.pvals.shape[2]
-    state = MTState(
+    return MTState(
         tstart=jnp.zeros((D, S), jnp.int32),
         tlen=jnp.zeros((D, S), jnp.int32),
         ins_seq=jnp.zeros((D, S), jnp.int32),
@@ -254,7 +253,70 @@ def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
         n=jnp.zeros((D,), jnp.int32),
         overflow=jnp.zeros((D,), jnp.bool_),
     )
-    return replay_vmapped(state, ops)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
+    return replay_vmapped(_cold_start(ops, S), ops)
+
+
+# Export row layout: per-slot fields stacked into ONE int32 array so the
+# device→host link costs a single transfer per fold (the tunneled-chip link
+# pays seconds of fixed latency per RPC — ten small arrays were 10× the
+# cost of one fused array).  Rows 0..7 are the slot fields, rows 8..8+K-1
+# the property columns, and the final row is misc: [n, overflow, live_len].
+EXPORT_SLOT_FIELDS = (
+    "tstart", "tlen", "ins_seq", "ins_client",
+    "rem_seq", "rem_client", "rem2_seq", "rem2_client",
+)
+
+
+def _export_state(final: MTState) -> jnp.ndarray:
+    """[D, 9+K, S] int32 fused view of everything summary extraction and
+    interval replay need from the final device state."""
+    D, S = final.tlen.shape
+    K = final.props.shape[2]
+    slot = jnp.arange(S)[None, :]
+    live = jnp.where(
+        (slot < final.n[:, None]) & (final.rem_seq == NOT_REMOVED),
+        final.tlen, 0,
+    ).sum(axis=1)
+    misc = jnp.zeros((D, S), jnp.int32)
+    misc = misc.at[:, 0].set(final.n)
+    misc = misc.at[:, 1].set(final.overflow.astype(jnp.int32))
+    misc = misc.at[:, 2].set(live)
+    rows = [getattr(final, f) for f in EXPORT_SLOT_FIELDS]
+    rows += [final.props[:, :, k] for k in range(K)]
+    rows.append(misc)
+    return jnp.stack(rows, axis=1)
+
+
+@jax.jit
+def _replay_export(state: MTState, ops: MTOps) -> jnp.ndarray:
+    return _export_state(replay_vmapped(state, ops))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _replay_export_cold(ops: "MTOps", S: int) -> jnp.ndarray:
+    return _export_state(replay_vmapped(_cold_start(ops, S), ops))
+
+
+def state_dict_from_export(export_np: np.ndarray) -> dict:
+    """Adapt a downloaded export buffer back to the state_np dict shape the
+    extraction/interval code consumes (zero-copy row views)."""
+    K = export_np.shape[1] - len(EXPORT_SLOT_FIELDS) - 1
+    out = {
+        f: export_np[:, i, :] for i, f in enumerate(EXPORT_SLOT_FIELDS)
+    }
+    out["props"] = np.moveaxis(
+        export_np[:, len(EXPORT_SLOT_FIELDS):len(EXPORT_SLOT_FIELDS) + K, :],
+        1, 2,
+    )
+    misc = export_np[:, -1, :]
+    out["n"] = misc[:, 0]
+    out["overflow"] = misc[:, 1]
+    out["live_len"] = misc[:, 2]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -275,12 +337,15 @@ class MergeTreeDocInput:
     base_msn: int = 0     # minSeq of the base summary
     base_intervals: Optional[Dict[str, dict]] = None  # intervals blob content
     # Native fast path: the ops pre-encoded as the liboppack binary record
-    # stream (ops/native_pack.py) + the client-id intern order the encoder
-    # used.  Only valid for prop-free insert/remove streams with no
-    # interval ops; when set, ``ops`` may be empty (the stream is
-    # authoritative) — C++ fills this doc's arrays.
+    # stream (ops/native_pack.py) + the encoder's doc-local intern tables
+    # (client ids; property keys / values when the stream annotates).
+    # Interval ops never ride the stream.  When set, ``ops`` may be empty
+    # (the stream is authoritative) — C++ fills this doc's arrays,
+    # translating doc-local property ids into the batch-global spaces.
     binary_ops: Optional[bytes] = None
     binary_clients: Optional[Sequence[str]] = None
+    binary_prop_keys: Optional[Sequence[str]] = None
+    binary_values: Optional[Sequence[Any]] = None
 
 
 class _DocPack:
@@ -309,13 +374,15 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     doc_packs = [_DocPack() for _ in docs]
 
     # Pre-scan for the shared property-key vocabulary K.  Binary-stream
-    # docs are prop-free by contract and skip it.
+    # docs contribute their encoder-local key tables.
     for doc in docs:
         if doc.base_records:
             for rec in doc.base_records:
                 for key in rec.get("p", {}):
                     prop_keys.intern(key)
         if doc.binary_ops is not None:
+            for key in (doc.binary_prop_keys or []):
+                prop_keys.intern(key)
             continue
         for msg in doc.ops:
             op = msg.contents
@@ -401,17 +468,31 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         st["n"][d] = len(doc.base_records or [])
 
         if doc.binary_ops is not None:
-            # Native fast path: C++ fills this doc's rows in one pass.
+            # Native fast path: C++ fills this doc's rows in one pass,
+            # translating encoder-local property ids to the batch-global
+            # intern spaces via the maps.
             from .native_pack import pack_doc_row
 
             for client in (doc.binary_clients or []):
                 pack.client_idx(client)
+            key_map = val_map = None
+            if doc.binary_prop_keys:
+                key_map = np.asarray(
+                    [prop_keys.intern(k) for k in doc.binary_prop_keys],
+                    np.int32,
+                )
+            if doc.binary_values:
+                val_map = np.asarray(
+                    [values.intern(v) for v in doc.binary_values],
+                    np.int32,
+                )
             row = {key: op[key][d]
                    for key in ("kind", "seq", "client", "ref_seq",
                                "a", "b", "tstart", "tlen", "pvals")}
             doc_bytes = bytearray()
             pack_doc_row(doc.binary_ops, row, K, len(arena), doc_bytes,
-                         text_bytes=binary_counts[d][1])
+                         text_bytes=binary_counts[d][1],
+                         key_map=key_map, val_map=val_map)
             arena.append(doc_bytes.decode("utf-8"))
             continue
 
@@ -543,7 +624,9 @@ def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:
         from .native_pack import decode_string_ops
 
         ops = decode_string_ops(doc.binary_ops,
-                                list(doc.binary_clients or []))
+                                list(doc.binary_clients or []),
+                                prop_keys=doc.binary_prop_keys,
+                                values=doc.binary_values)
     for msg in ops:
         replica.process(msg, local=False)
     replica.advance(doc.final_seq, doc.final_msn)
@@ -585,10 +668,76 @@ def summary_from_state(meta, state_np: dict, d: int,
     return tree
 
 
+def summaries_from_export(meta, export_np: np.ndarray,
+                          stats: Optional[dict] = None) -> List[SummaryTree]:
+    """Canonical summaries for a whole chunk from the fused export buffer.
+
+    Bodies come from the C++ extractor (one pass over the buffer) when
+    liboppack is available, else the per-slot Python extraction; interval
+    blobs and oracle-fallback docs take the host paths either way.
+    ``stats`` (optional dict) accumulates ``device_docs`` /
+    ``fallback_docs`` counters — the true device-vs-oracle split."""
+    from .interval_replay import FinalStateView, replay_intervals
+    from .native_pack import extract_bodies
+
+    docs = meta["docs"]
+    D = len(docs)
+    state_np = state_dict_from_export(export_np)
+    skip = np.zeros(D, np.uint8)
+    for d in range(D):
+        if meta["doc_packs"][d].needs_fallback or state_np["overflow"][d]:
+            skip[d] = 1
+    if stats is not None:
+        n_skip = int(skip.sum())
+        stats["fallback_docs"] = stats.get("fallback_docs", 0) + n_skip
+        stats["device_docs"] = stats.get("device_docs", 0) + D - n_skip
+    msn = np.asarray([doc.final_msn for doc in docs], np.int32)
+    arena_text = meta["arena"].finalize()
+    bodies = extract_bodies(
+        np.ascontiguousarray(export_np, np.int32), arena_text,
+        [list(meta["doc_packs"][d].clients.values) for d in range(D)],
+        meta["prop_keys"], list(meta["values"].values),
+        msn, skip, int(NOT_REMOVED),
+    )
+    out: List[SummaryTree] = []
+    for d, doc in enumerate(docs):
+        pack = meta["doc_packs"][d]
+        if skip[d]:
+            out.append(oracle_fallback_summary(doc))
+            continue
+        header = {
+            "seq": doc.final_seq,
+            "minSeq": doc.final_msn,
+            "length": int(state_np["live_len"][d]),
+        }
+        tree = SummaryTree()
+        tree.add_blob("header", canonical_json(header))
+        if bodies is not None:
+            tree.add_blob("body", bodies[d])
+        else:
+            tree.add_blob(
+                "body", canonical_json(_extract_records(meta, state_np, d))
+            )
+        if pack.interval_ops or doc.base_intervals:
+            view = FinalStateView(state_np, d, int(NOT_REMOVED))
+            intervals = replay_intervals(
+                view,
+                pack.interval_ops,
+                pack.client_idx,
+                base_intervals=doc.base_intervals,
+                base_seq=doc.base_seq,
+            )
+            if intervals:
+                tree.add_blob("intervals", canonical_json(intervals))
+        out.append(tree)
+    return out
+
+
 def replay_mergetree_batch(
     docs: Sequence[MergeTreeDocInput],
 ) -> List[SummaryTree]:
-    """Full pipeline: pack → vmapped device op-fold → canonical summaries.
+    """Full pipeline: pack → vmapped device op-fold → fused export download
+    → canonical summaries.
 
     Byte-identical to ``SharedString.summarize()`` after the oracle replays
     the same log (asserted by tests/test_mergetree_kernel.py).
@@ -600,13 +749,10 @@ def replay_mergetree_batch(
         if not any(d.base_records for d in batch):
             # all-cold chunk: initial state is built in-graph (no zero
             # upload; the host link is the bottleneck, not the fold)
-            final = _replay_batch_cold(ops, state.tstart.shape[1])
+            export = _replay_export_cold(ops, state.tstart.shape[1])
         else:
-            final = _replay_batch(state, ops)
-        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-        return [
-            summary_from_state(meta, state_np, d) for d in range(len(batch))
-        ]
+            export = _replay_export(state, ops)
+        return summaries_from_export(meta, np.asarray(export))
 
     return partition_replay(
         docs, known_oracle_fallback, oracle_fallback_summary, fold_batch

@@ -7,16 +7,23 @@ flat binary record format documented in ``native/oppack.cpp``; packing a
 document filling the padded (D, T) arrays and the shared text arena, no
 Python objects in the loop.
 
-Build: ``liboppack.so`` compiles on demand from ``native/oppack.cpp`` with
-g++ (cached next to the source, rebuilt when the source is newer).  If no
-toolchain is available the pure-Python encoder/packer pair keeps everything
-working — the native path is a strictly optional accelerator with
-bit-identical output (asserted by tests/test_native_pack.py).
+It also hosts the extraction fast path: ``oppack_extract`` turns the fused
+final-state export buffer into canonical summary-body JSON bytes for a whole
+chunk in one C++ pass (see ``extract_bodies``).
+
+Build: the library compiles on demand from ``native/oppack.cpp`` with g++.
+The artifact is keyed by a content hash of the source
+(``liboppack-<hash>.so``) so a stale binary can never shadow newer source —
+mtimes are meaningless after a git checkout.  If no toolchain is available
+the pure-Python encoder/packer pair keeps everything working — the native
+path is a strictly optional accelerator with bit-identical output (asserted
+by tests/test_native_pack.py).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import struct
 import subprocess
@@ -29,7 +36,6 @@ from ..protocol.messages import MessageType, SequencedMessage
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "oppack.cpp")
-_LIB = os.path.join(_REPO_ROOT, "native", "liboppack.so")
 
 _KINDS = {"insert": 1, "remove": 2, "annotate": 3}
 _HEADER = struct.Struct("<B7i")
@@ -128,18 +134,41 @@ _lib_tried = False
 def _build_library() -> Optional[str]:
     if not os.path.exists(_SRC):
         return None
-    if os.path.exists(_LIB) and \
-            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib_path = os.path.join(
+        _REPO_ROOT, "native", f"liboppack-{digest}.so"
+    )
+    if os.path.exists(lib_path):
+        return lib_path
+    tmp = lib_path + f".tmp{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             "-o", _LIB, _SRC],
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
-        return _LIB
+        os.replace(tmp, lib_path)  # atomic under concurrent builders
     except (OSError, subprocess.SubprocessError):
         return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    # Superseded hash builds: safe to drop (an mmap'd inode survives the
+    # unlink for any process still using it).
+    import glob
+
+    for old in glob.glob(os.path.join(_REPO_ROOT, "native",
+                                      "liboppack-*.so")):
+        if old != lib_path:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+    return lib_path
 
 
 def load_library() -> Optional[ctypes.CDLL]:
@@ -169,6 +198,29 @@ def load_library() -> Optional[ctypes.CDLL]:
         np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_void_p, ctypes.c_int32,   # key_map, n_keys
+        ctypes.c_void_p, ctypes.c_int32,   # val_map, n_vals
+    ]
+    lib.oppack_extract.restype = ctypes.c_int64
+    lib.oppack_extract.argtypes = [
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # export
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,   # arena
+        ctypes.c_char_p,                                   # client_json
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_char_p,                                   # key_json
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_char_p,                                   # val_json
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # msn
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # skip
+        ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # out
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # out_offs
     ]
     _lib_handle = lib
     return lib
@@ -213,12 +265,16 @@ def pack_doc_row(
     arena_base_chars: int,
     arena: bytearray,
     text_bytes: Optional[int] = None,
+    key_map: Optional[np.ndarray] = None,
+    val_map: Optional[np.ndarray] = None,
 ) -> int:
     """Fill one document's row of the batch arrays from its binary stream;
     appends text to ``arena`` (utf-8 bytes) and returns ops packed.
 
     ``row`` maps field name → the 1-D row views (``op['kind'][d]`` etc.,
-    C-contiguous); ``pvals`` is the (T, K) row."""
+    C-contiguous); ``pvals`` is the (T, K) row.  ``key_map``/``val_map``
+    (int32 arrays) translate encoder-local property key / value ids into
+    the batch-global intern spaces."""
     T = row["kind"].shape[0]
     lib = load_library()
     if lib is not None:
@@ -227,6 +283,10 @@ def pack_doc_row(
         scratch = np.zeros(max(text_bytes, 1), np.uint8)
         arena_bytes = ctypes.c_int64()
         arena_chars = ctypes.c_int64()
+        km = None if key_map is None else \
+            np.ascontiguousarray(key_map, np.int32)
+        vm = None if val_map is None else \
+            np.ascontiguousarray(val_map, np.int32)
         packed = lib.oppack_pack(
             blob, len(blob), T, K, arena_base_chars,
             row["kind"], row["seq"], row["client"], row["ref_seq"],
@@ -234,16 +294,22 @@ def pack_doc_row(
             row["pvals"].reshape(-1),
             scratch, len(scratch),
             ctypes.byref(arena_bytes), ctypes.byref(arena_chars),
+            None if km is None else km.ctypes.data,
+            0 if km is None else len(km),
+            None if vm is None else vm.ctypes.data,
+            0 if vm is None else len(vm),
         )
         if packed < 0:
             raise ValueError("malformed binary op stream")
         arena += scratch[:arena_bytes.value].tobytes()
         return packed
-    return _pack_py(blob, row, K, arena_base_chars, arena)
+    return _pack_py(blob, row, K, arena_base_chars, arena, key_map, val_map)
 
 
 def _pack_py(blob: bytes, row: Dict[str, np.ndarray], K: int,
-             arena_base_chars: int, arena: bytearray) -> int:
+             arena_base_chars: int, arena: bytearray,
+             key_map: Optional[np.ndarray] = None,
+             val_map: Optional[np.ndarray] = None) -> int:
     off, t, chars = 0, 0, 0
     while off < len(blob):
         kind, seq, ref, client, a, b, n_props, text_len = \
@@ -258,6 +324,10 @@ def _pack_py(blob: bytes, row: Dict[str, np.ndarray], K: int,
         for _ in range(n_props):
             k, v = _PAIR.unpack_from(blob, off)
             off += 8
+            if key_map is not None:
+                k = int(key_map[k])
+            if val_map is not None and v >= 0:
+                v = int(val_map[v])
             row["pvals"][t, k] = v
         if text_len:
             text = blob[off:off + text_len]
@@ -272,3 +342,95 @@ def _pack_py(blob: bytes, row: Dict[str, np.ndarray], K: int,
             row["tlen"][t] = 0
         t += 1
     return t
+
+
+# -- native summary-body extraction -------------------------------------------
+
+
+def extract_bodies(
+    export_np: np.ndarray,
+    arena_text: str,
+    doc_clients: Sequence[Sequence[str]],
+    prop_keys: Sequence[str],
+    values: Sequence,
+    msn: np.ndarray,
+    skip: np.ndarray,
+    not_removed: int,
+) -> Optional[List[bytes]]:
+    """Canonical summary-body JSON bytes for every doc of a chunk, via the
+    C++ extractor; None when the native library is unavailable (callers
+    fall back to the per-slot Python extraction).
+
+    ``export_np``: the fused [D, F, S] int32 export buffer;
+    ``doc_clients``: per-doc client-id tables in intern order;
+    ``prop_keys`` / ``values``: the chunk-global intern tables;
+    ``msn`` int32[D]; ``skip`` uint8[D] flags oracle-fallback docs."""
+    from ..protocol.summary import canonical_json
+
+    lib = load_library()
+    if lib is None:
+        return None
+    D, F, S = export_np.shape
+    K = F - 9
+    export_np = np.ascontiguousarray(export_np, np.int32)
+
+    def flatten(tokens: Sequence[bytes]):
+        offs = np.zeros(len(tokens) + 1, np.int64)
+        for i, tok in enumerate(tokens):
+            offs[i + 1] = offs[i] + len(tok)
+        return b"".join(tokens), offs
+
+    client_tokens: List[bytes] = []
+    doc_start = np.zeros(D + 1, np.int32)
+    for d, clients in enumerate(doc_clients):
+        client_tokens.extend(canonical_json(c) for c in clients)
+        doc_start[d + 1] = len(client_tokens)
+    client_blob, client_offs = flatten(client_tokens)
+
+    order = sorted(range(len(prop_keys)), key=lambda i: prop_keys[i])
+    key_cols = np.asarray(order, np.int32) if order else \
+        np.zeros(0, np.int32)
+    key_blob, key_offs = flatten(
+        [canonical_json(prop_keys[i]) for i in order]
+    )
+    # The export carries K (bucketed) property rows but only
+    # len(prop_keys) real columns; pad key_cols so k indexes stay aligned.
+    if K > len(order):
+        # Point the padding at the unused bucket columns themselves —
+        # they are always PROP_ABSENT in the export, so they emit nothing.
+        pad = np.zeros(K, np.int32)
+        pad[:len(order)] = key_cols
+        pad[len(order):] = np.arange(len(order), K, dtype=np.int32)
+        key_cols = pad
+        key_offs = np.concatenate(
+            [key_offs,
+             np.full(K - len(order), key_offs[-1], np.int64)]
+        )
+    val_blob, val_offs = flatten([canonical_json(v) for v in values])
+
+    arena_bytes = arena_text.encode("utf-8")
+    msn = np.ascontiguousarray(msn, np.int32)
+    skip = np.ascontiguousarray(skip, np.uint8)
+    out_offs = np.zeros(D + 1, np.int64)
+    cap = max(len(arena_bytes) * 2 + D * 64 + int(export_np.shape[2]) * D * 8,
+              1 << 16)
+    for _attempt in range(3):
+        out = np.zeros(cap, np.uint8)
+        rc = lib.oppack_extract(
+            export_np, D, F, S, K,
+            arena_bytes, len(arena_bytes), len(arena_text),
+            client_blob, client_offs, doc_start,
+            key_blob, key_offs, key_cols,
+            val_blob, val_offs, len(values),
+            msn, skip, not_removed,
+            out, cap, out_offs,
+        )
+        if rc == 0:
+            buf = out.tobytes()
+            return [
+                buf[out_offs[d]:out_offs[d + 1]] for d in range(D)
+            ]
+        if rc == -1:
+            raise ValueError("oppack_extract: malformed export buffer")
+        cap = int(-rc - 2) + 1024
+    raise RuntimeError("oppack_extract: capacity negotiation failed")

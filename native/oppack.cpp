@@ -25,8 +25,15 @@
 // can decode the byte arena once and every (tstart, tlen) span aligns.
 //
 // API (C ABI, ctypes-consumed):
-//   oppack_count(...)  — sizing pre-pass
-//   oppack_pack(...)   — fill one document's row of the batch arrays
+//   oppack_count(...)    — sizing pre-pass
+//   oppack_pack(...)     — fill one document's row of the batch arrays
+//   oppack_extract(...)  — final device state → canonical summary-body JSON
+//
+// oppack_extract consumes the fused export buffer ([D, F, S] int32, see
+// mergetree_kernel.EXPORT_SLOT_FIELDS) and emits, per document, the exact
+// bytes of canonical_json(normalized_records): sorted keys, minimal
+// separators, ensure_ascii=False (UTF-8 passthrough; only '"', '\\' and
+// control chars escape, matching python json.dumps).
 
 #include <cstdint>
 #include <cstring>
@@ -75,6 +82,9 @@ int oppack_count(const uint8_t* buf, int64_t len,
 
 // Packs one document's record stream into row-slices of the batch arrays.
 // `pvals` is the (T, K) row in C order, pre-filled with PROP_NOT_TOUCHED.
+// `key_map` / `val_map` translate the encoder's doc-local property key and
+// value ids into the batch-global intern spaces (null = identity; negative
+// value ids — PROP_ABSENT — pass through untranslated).
 // Returns ops packed, or -1 on malformed input / capacity overflow.
 int32_t oppack_pack(const uint8_t* buf, int64_t len,
                     int32_t T, int32_t K, int64_t arena_base_chars,
@@ -82,7 +92,9 @@ int32_t oppack_pack(const uint8_t* buf, int64_t len,
                     int32_t* ref_seq, int32_t* a, int32_t* b,
                     int32_t* tstart, int32_t* tlen, int32_t* pvals,
                     uint8_t* arena_out, int64_t arena_capacity,
-                    int64_t* arena_bytes, int64_t* arena_chars) {
+                    int64_t* arena_bytes, int64_t* arena_chars,
+                    const int32_t* key_map, int32_t n_keys,
+                    const int32_t* val_map, int32_t n_vals) {
     int64_t off = 0;
     int32_t t = 0;
     int64_t out_bytes = 0, out_chars = 0;
@@ -108,8 +120,18 @@ int32_t oppack_pack(const uint8_t* buf, int64_t len,
             int32_t pair[2];
             std::memcpy(pair, buf + off, 8);
             off += 8;
-            if (pair[0] < 0 || pair[0] >= K) return -1;
-            pvals[static_cast<int64_t>(t) * K + pair[0]] = pair[1];
+            int32_t col = pair[0];
+            int32_t val = pair[1];
+            if (key_map != nullptr) {
+                if (col < 0 || col >= n_keys) return -1;
+                col = key_map[col];
+            }
+            if (val_map != nullptr && val >= 0) {
+                if (val >= n_vals) return -1;
+                val = val_map[val];
+            }
+            if (col < 0 || col >= K) return -1;
+            pvals[static_cast<int64_t>(t) * K + col] = val;
         }
         if (text_len > 0) {
             if (out_bytes + text_len > arena_capacity) return -1;
@@ -129,6 +151,248 @@ int32_t oppack_pack(const uint8_t* buf, int64_t len,
     *arena_bytes = out_bytes;
     *arena_chars = out_chars;
     return t;
+}
+
+// Final device state → canonical summary-body JSON for every document of a
+// chunk, in one pass.  Layout contract with mergetree_kernel._export_state:
+//   export_buf: [D, F, S] int32, C order, F = 8 + K + 1
+//     rows 0..7: tstart, tlen, ins_seq, ins_client,
+//                rem_seq, rem_client, rem2_seq, rem2_client
+//     rows 8..8+K-1: property value ids (PROP_ABSENT = -1)
+//     row  8+K (misc): [n, overflow, live_len, 0...]
+//   arena_utf8: the chunk text arena; tstart/tlen are CHAR offsets, so a
+//     char→byte index is built once here.
+//   client_json / key_json / val_json: pre-serialized JSON tokens
+//     (canonical_json of each client name / property key / value),
+//     flattened with offset tables.  clients are per-doc
+//     (client_doc_start[d] .. client_doc_start[d+1] index the offs table);
+//     keys arrive in SORTED key order with key_cols[k] = the export row of
+//     the k-th sorted key.
+//   msn / final over per doc: msn drives tombstone expiry + seq clamping.
+// Output: out (capacity out_cap) receives the concatenated bodies;
+//   out_offs[d]..out_offs[d+1] delimit doc d.  Docs flagged by `skip` get
+//   empty bodies (oracle-fallback docs).  Returns 0, or the required
+//   capacity as a negative number minus one (caller regrows), or -1 on
+//   malformed input (since -1 also means "need 0 bytes", capacity requests
+//   use -(need)-2).
+int64_t oppack_extract(
+    const int32_t* export_buf, int32_t D, int32_t F, int32_t S, int32_t K,
+    const uint8_t* arena_utf8, int64_t arena_bytes_len, int64_t arena_chars,
+    const uint8_t* client_json, const int64_t* client_offs,
+    const int32_t* client_doc_start,
+    const uint8_t* key_json, const int64_t* key_offs,
+    const int32_t* key_cols,
+    const uint8_t* val_json, const int64_t* val_offs, int32_t n_vals,
+    const int32_t* msn, const uint8_t* skip,
+    int32_t not_removed,
+    uint8_t* out, int64_t out_cap, int64_t* out_offs) {
+    if (F != 8 + K + 1) return -1;
+    // char → byte index over the arena (one pass).
+    int64_t* idx = new int64_t[arena_chars + 1];
+    {
+        int64_t c = 0;
+        for (int64_t i = 0; i < arena_bytes_len; ++i) {
+            if ((arena_utf8[i] & 0xC0) != 0x80) {
+                if (c > arena_chars) { delete[] idx; return -1; }
+                idx[c++] = i;
+            }
+        }
+        if (c != arena_chars) { delete[] idx; return -1; }
+        idx[arena_chars] = arena_bytes_len;
+    }
+
+    int64_t w = 0;  // write cursor; keeps counting past capacity
+    bool fits = true;
+    bool bad = false;
+    auto put = [&](const uint8_t* p, int64_t n) {
+        if (fits && w + n <= out_cap) std::memcpy(out + w, p, n);
+        else fits = false;
+        w += n;
+    };
+    auto put_lit = [&](const char* s) {
+        put(reinterpret_cast<const uint8_t*>(s), std::strlen(s));
+    };
+    auto put_int = [&](int64_t v) {
+        char tmp[24];
+        int n = 0;
+        if (v < 0) { tmp[n++] = '-'; v = -v; }
+        char digits[20];
+        int nd = 0;
+        do { digits[nd++] = static_cast<char>('0' + v % 10); v /= 10; }
+        while (v > 0);
+        while (nd > 0) tmp[n++] = digits[--nd];
+        put(reinterpret_cast<const uint8_t*>(tmp), n);
+    };
+    // Escaped UTF-8 emit (ensure_ascii=False): passthrough except
+    // '"', '\\' and control chars — exactly python json.dumps.
+    auto put_escaped = [&](const uint8_t* tp, int64_t tn) {
+        int64_t run = 0;
+        for (int64_t i = 0; i < tn; ++i) {
+            const uint8_t ch = tp[i];
+            if (!(ch == '"' || ch == '\\' || ch < 0x20)) { ++run; continue; }
+            if (run) put(tp + i - run, run);
+            run = 0;
+            switch (ch) {
+                case '"': put_lit("\\\""); break;
+                case '\\': put_lit("\\\\"); break;
+                case '\b': put_lit("\\b"); break;
+                case '\t': put_lit("\\t"); break;
+                case '\n': put_lit("\\n"); break;
+                case '\f': put_lit("\\f"); break;
+                case '\r': put_lit("\\r"); break;
+                default: {
+                    char u[6];
+                    static const char* hex = "0123456789abcdef";
+                    u[0] = '\\'; u[1] = 'u'; u[2] = '0'; u[3] = '0';
+                    u[4] = hex[(ch >> 4) & 0xF];
+                    u[5] = hex[ch & 0xF];
+                    put(reinterpret_cast<const uint8_t*>(u), 6);
+                }
+            }
+        }
+        if (run) put(tp + tn - run, run);
+    };
+    auto put_client = [&](int32_t d, int32_t c) {
+        const int32_t ci = client_doc_start[d] + c;
+        if (ci >= client_doc_start[d + 1]) { bad = true; return; }
+        put(client_json + client_offs[ci],
+            client_offs[ci + 1] - client_offs[ci]);
+    };
+
+    const int64_t fs = static_cast<int64_t>(F) * S;
+    for (int32_t d = 0; d < D && !bad; ++d) {
+        out_offs[d] = w;
+        if (skip != nullptr && skip[d]) continue;
+        const int32_t* ex = export_buf + static_cast<int64_t>(d) * fs;
+        const int32_t* p_tstart = ex + 0 * S;
+        const int32_t* p_tlen = ex + 1 * S;
+        const int32_t* p_ins_seq = ex + 2 * S;
+        const int32_t* p_ins_client = ex + 3 * S;
+        const int32_t* p_rem_seq = ex + 4 * S;
+        const int32_t* p_rem_client = ex + 5 * S;
+        const int32_t* p_rem2_client = ex + 7 * S;
+        const int32_t n = ex[static_cast<int64_t>(8 + K) * S + 0];
+        const int32_t doc_msn = msn[d];
+        if (n < 0 || n > S) { bad = true; break; }
+
+        auto expired = [&](int32_t s) {
+            const int32_t rs = p_rem_seq[s];
+            return rs != not_removed && rs <= doc_msn;
+        };
+        // Merge-equality of two SURVIVING slots, mirroring
+        // _extract_records: normalized (s, c), removal triple, overlap
+        // remover, property row.  Expired tombstones between surviving
+        // slots are invisible to the merge (python compares against the
+        // last *emitted* record).
+        auto meta_eq = [&](int32_t x, int32_t y) {
+            const bool rx = p_rem_seq[x] != not_removed;
+            const bool ry = p_rem_seq[y] != not_removed;
+            const bool cx = p_ins_seq[x] <= doc_msn;
+            const bool cy = p_ins_seq[y] <= doc_msn;
+            if ((cx ? 0 : p_ins_seq[x]) != (cy ? 0 : p_ins_seq[y])) {
+                return false;
+            }
+            if ((cx ? -1 : p_ins_client[x]) != (cy ? -1 : p_ins_client[y])) {
+                return false;
+            }
+            if (rx != ry) return false;
+            if (rx && (p_rem_seq[x] != p_rem_seq[y] ||
+                       p_rem_client[x] != p_rem_client[y])) {
+                return false;
+            }
+            if (p_rem2_client[x] != p_rem2_client[y]) return false;
+            for (int32_t k = 0; k < K; ++k) {
+                if (ex[(8 + static_cast<int64_t>(k)) * S + x] !=
+                    ex[(8 + static_cast<int64_t>(k)) * S + y]) {
+                    return false;
+                }
+            }
+            return true;
+        };
+
+        put_lit("[");
+        bool first_rec = true;
+        int32_t s = 0;
+        while (s < n && !bad) {
+            if (expired(s)) { ++s; continue; }
+            // Gather the merge group: surviving slots equal to s, skipping
+            // expired tombstones in between.
+            // Two passes, no buffer: find the group end (cur), then emit
+            // text by re-walking [s, cur) and skipping expired slots.
+            int32_t cur = s + 1;
+            while (cur < n) {
+                if (expired(cur)) { ++cur; continue; }
+                if (!meta_eq(s, cur)) break;
+                ++cur;
+            }
+
+            const bool removed = p_rem_seq[s] != not_removed;
+            const bool clamp = p_ins_seq[s] <= doc_msn;
+            const int32_t seq_out = clamp ? 0 : p_ins_seq[s];
+            const int32_t c_out = clamp ? -1 : p_ins_client[s];
+
+            if (!first_rec) put_lit(",");
+            first_rec = false;
+            put_lit("{\"c\":");
+            if (c_out < 0) put_lit("null");
+            else put_client(d, c_out);
+            bool has_props = false;
+            for (int32_t k = 0; k < K && !has_props; ++k) {
+                has_props = ex[(8 + static_cast<int64_t>(k)) * S + s] >= 0;
+            }
+            if (has_props) {
+                put_lit(",\"p\":{");
+                bool first_p = true;
+                for (int32_t k = 0; k < K; ++k) {  // sorted key order
+                    const int32_t col = key_cols[k];
+                    const int32_t vid =
+                        ex[(8 + static_cast<int64_t>(col)) * S + s];
+                    if (vid < 0) continue;
+                    if (vid >= n_vals) { bad = true; break; }
+                    if (!first_p) put_lit(",");
+                    first_p = false;
+                    put(key_json + key_offs[k],
+                        key_offs[k + 1] - key_offs[k]);
+                    put_lit(":");
+                    put(val_json + val_offs[vid],
+                        val_offs[vid + 1] - val_offs[vid]);
+                }
+                put_lit("}");
+            }
+            if (removed) {
+                put_lit(",\"rc\":");
+                if (p_rem_client[s] < 0) put_lit("null");
+                else put_client(d, p_rem_client[s]);
+            }
+            if (p_rem2_client[s] >= 0) {
+                put_lit(",\"ro\":[");
+                put_client(d, p_rem2_client[s]);
+                put_lit("]");
+            }
+            if (removed) {
+                put_lit(",\"rs\":");
+                put_int(p_rem_seq[s]);
+            }
+            put_lit(",\"s\":");
+            put_int(seq_out);
+            put_lit(",\"t\":\"");
+            for (int32_t g = s; g < cur && !bad; ++g) {
+                if (expired(g)) continue;
+                const int64_t c0 = p_tstart[g];
+                const int64_t cl = p_tlen[g];
+                if (c0 < 0 || c0 + cl > arena_chars) { bad = true; break; }
+                put_escaped(arena_utf8 + idx[c0], idx[c0 + cl] - idx[c0]);
+            }
+            put_lit("\"}");
+            s = cur;
+        }
+        put_lit("]");
+    }
+    delete[] idx;
+    if (bad) return -1;
+    out_offs[D] = w;
+    if (!fits) return -w - 2;
+    return 0;
 }
 
 }  // extern "C"

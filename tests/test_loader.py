@@ -586,3 +586,33 @@ def test_stale_matrix_reconnect_raise_keeps_pending_stashable():
     assert b2.runtime.get_datastore("ds").get_channel("grid") \
         .get_cell(0, 0) == "bob"
     assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+def test_stale_stash_with_already_sequenced_matrix_op_loads():
+    """A stashed non-rebasable op that DID reach the sequencer is deduped
+    at rehydrate, so a stale stash must not raise for it."""
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("matrix-tpu", "grid")
+        ds.create_channel("sequence-tpu", "text")
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    g = a.runtime.get_datastore("ds").get_channel("grid")
+    g.insert_rows(0, 2)
+    g.insert_cols(0, 2)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    # The op is sequenced synchronously in-proc; bob never drains the ack.
+    b.runtime.get_datastore("ds").get_channel("grid").set_cell(0, 0, "bob")
+    stash = b.close_and_get_pending_state()
+    assert len(stash["pending"]) == 1
+    _advance_window(a)
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)  # no raise
+    a.drain()
+    b2.drain()
+    assert b2.runtime.get_datastore("ds").get_channel("grid") \
+        .get_cell(0, 0) == "bob"
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()

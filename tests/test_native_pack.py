@@ -133,3 +133,90 @@ def test_mixed_python_and_binary_docs_in_one_batch():
         for msg in ops:
             replica.process(msg, local=False)
         assert summary.digest() == replica.summarize().digest()
+
+
+def test_native_extract_bodies_byte_identity_hostile_text():
+    """C++ oppack_extract vs the per-slot Python extraction on streams with
+    JSON-escape-needing text, unicode, props, annotates, and window expiry:
+    the summary bytes must be identical (and match the oracle)."""
+    import random as _random
+
+    from fluidframework_tpu.dds.sequence import SharedString
+    from fluidframework_tpu.ops.interning import Interner
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        MergeTreeDocInput,
+        replay_mergetree_batch,
+    )
+    from fluidframework_tpu.ops.native_pack import (
+        encode_string_ops,
+        load_library,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    assert load_library() is not None, "native library must build in CI"
+
+    alphabet = ['"', "\\", "\n", "\t", "\x07", "é", "文", "𝄞", "a", "b ", "c"]
+    docs = []
+    for di in range(6):
+        rng = _random.Random(1000 + di)
+        ops, length = [], 0
+        for i in range(40):
+            seq = i + 1
+            client = f"c{i % 3}"
+            r = rng.random()
+            if r < 0.6 or length < 4:
+                text = "".join(
+                    rng.choice(alphabet) for _ in range(rng.randint(1, 5))
+                )
+                contents = {"kind": "insert",
+                            "pos": rng.randint(0, length), "text": text}
+                length += len(text)
+            elif r < 0.85:
+                start = rng.randint(0, length - 2)
+                end = min(length, start + rng.randint(1, 5))
+                contents = {"kind": "remove", "start": start, "end": end}
+                length -= end - start
+            else:
+                start = rng.randint(0, length - 2)
+                end = min(length, start + rng.randint(1, 4))
+                contents = {"kind": "annotate", "start": start, "end": end,
+                            "props": {"style": rng.choice(
+                                ["bold", "ital\"ic", None, 7])}}
+            ops.append(SequencedMessage(
+                seq=seq, client_id=client, client_seq=seq, ref_seq=seq - 1,
+                min_seq=0, type=MessageType.OP, contents=contents,
+            ))
+        final_msn = 12 if di % 2 else 0   # exercise tombstone expiry
+        if di < 3:
+            # message-list path
+            docs.append(MergeTreeDocInput(
+                doc_id=f"h{di}", ops=ops, final_seq=40, final_msn=final_msn,
+            ))
+        else:
+            # binary path WITH props (encoder-local intern tables)
+            clients, keys, vals = Interner(), Interner(), Interner()
+            blob = encode_string_ops(ops, clients, keys, vals)
+            docs.append(MergeTreeDocInput(
+                doc_id=f"h{di}", ops=[], binary_ops=blob,
+                binary_clients=list(clients.values),
+                binary_prop_keys=list(keys.values),
+                binary_values=list(vals.values),
+                final_seq=40, final_msn=final_msn,
+            ))
+    device = replay_mergetree_batch(docs)
+    for doc, dev in zip(docs, device):
+        replica = SharedString(doc.doc_id)
+        ops = doc.ops
+        if doc.binary_ops is not None:
+            from fluidframework_tpu.ops.native_pack import decode_string_ops
+            ops = decode_string_ops(
+                doc.binary_ops, list(doc.binary_clients),
+                prop_keys=doc.binary_prop_keys, values=doc.binary_values)
+        for msg in ops:
+            replica.process(msg, local=False)
+        replica.advance(doc.final_seq, doc.final_msn)
+        oracle = replica.summarize()
+        assert dev.digest() == oracle.digest(), doc.doc_id
