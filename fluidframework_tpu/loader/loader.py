@@ -112,9 +112,9 @@ class Container:
         """Drop the offline-held outbox and any half-sent wire messages:
         resubmit_pending re-issues every unacked op with fresh client_seqs
         under the new connection (keeping both would double-send; the old
-        connection's partial chunk trains die with its LEAVE)."""
-        self.runtime._outbox.clear()
-        self.runtime._pending_wire.clear()
+        connection's partial chunk trains die with its LEAVE).  Unsent
+        idRanges roll back into the compressor for re-attachment."""
+        self.runtime.discard_outbound()
 
     def resubmit_pending(self) -> None:
         """Re-issue every unacked op.  Meta-ops (ds/channel/blob attaches)

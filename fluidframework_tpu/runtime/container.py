@@ -107,7 +107,10 @@ class ContainerRuntime:
         # Encoded wire messages not yet accepted by the service: a failed
         # send resumes HERE (same bytes, same client_seqs) so partially-
         # delivered chunk trains and consumed idRanges are never re-encoded.
-        self._pending_wire: List[RawOperation] = []
+        # Entries are (raw_op, first_gen): first_gen is the idRange start a
+        # batch's lead message carries (None otherwise) so a discarded
+        # unsent batch can roll its range back into the compressor.
+        self._pending_wire: List[tuple] = []
         # Runtime meta-ops (dsAttach/channelAttach/blobAttach/gcSweep)
         # awaiting their sequenced echo — resubmitted on reconnect like
         # channel ops (they'd otherwise be lost with the cleared outbox).
@@ -220,26 +223,47 @@ class ContainerRuntime:
                              self.options.chunk_size)):
             if i == 0:
                 client_seq = batch[0]["clientSeq"]
+                first_gen = id_range["firstGen"] if id_range else None
             else:
                 # Extra chunk messages ride fresh runtime client_seqs
                 # (the sequencer dedups per message).
                 self._client_seq += 1
                 client_seq = self._client_seq
-            self._pending_wire.append(
+                first_gen = None
+            self._pending_wire.append((
                 RawOperation(
                     client_id=self.client_id,
                     client_seq=client_seq,
                     ref_seq=self.ref_seq,
                     type=MessageType.OP,
                     contents=wire_contents,
-                )
-            )
+                ),
+                first_gen,
+            ))
         self._drain_wire()
 
     def _drain_wire(self) -> None:
         while self._pending_wire:
-            self._service.submit(self._pending_wire[0])
+            try:
+                self._service.submit(self._pending_wire[0][0])
+            except (ConnectionError, TimeoutError, OSError):
+                # Transient transport failure: the encoded messages stay
+                # queued (identical bytes, same client_seqs) and the next
+                # flush resumes the send — the submitter's pending-op
+                # bookkeeping must not unwind for a retryable error.
+                return
             self._pending_wire.pop(0)  # only after the send was accepted
+
+    def discard_outbound(self) -> None:
+        """Drop the held outbox and unsent wire messages (reconnect /
+        rehydrate — resubmit re-issues everything), rolling any idRanges
+        the discarded batches consumed back into the compressor so the
+        next flush re-attaches those locals."""
+        gens = [g for _op, g in self._pending_wire if g is not None]
+        if gens:
+            self.id_compressor.rollback_ranges(min(gens))
+        self._pending_wire.clear()
+        self._outbox.clear()
 
     def perform_gc_sweep(self) -> List[str]:
         """Submit a sequenced sweep for datastores whose unreferenced grace

@@ -58,6 +58,13 @@ class IdCompressor:
         return {"session": self.session_id, "firstGen": first,
                 "count": count}
 
+    def rollback_ranges(self, first_gen: int) -> None:
+        """Un-take ranges from ``first_gen`` onward: their wire batches were
+        discarded before reaching the sequencer (reconnect / rehydrate), so
+        the next take re-attaches those locals — otherwise they would never
+        finalize and their op-space forms could never resolve remotely."""
+        self._taken_through = min(self._taken_through, first_gen - 1)
+
     # -- sequenced finalization (identical on every client) --------------------
 
     def finalize_range(self, range_: dict) -> None:

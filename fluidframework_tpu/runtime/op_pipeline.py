@@ -69,10 +69,24 @@ class ChunkReassembler:
 
     def feed(self, client_id: str, chunk: dict) -> Optional[dict]:
         """Returns the reassembled (and decompressed) batch contents when
-        the final chunk arrives, else None."""
-        parts = self._partial.setdefault(client_id,
-                                         [None] * chunk["total"])
-        parts[chunk["index"]] = chunk["data"]
+        the final chunk arrives, else None.
+
+        Wire fields are untrusted: a chunk whose index/total is malformed
+        or whose total disagrees with the client's partial train resets
+        that client's state and is dropped — corrupting reassembly (or
+        raising into the container) on a bad peer's message would take
+        down good replicas."""
+        total, index = chunk.get("total"), chunk.get("index")
+        if (not isinstance(total, int) or not isinstance(index, int)
+                or isinstance(total, bool) or isinstance(index, bool)
+                or total < 1 or not 0 <= index < total):
+            self._partial.pop(client_id, None)
+            return None
+        parts = self._partial.setdefault(client_id, [None] * total)
+        if len(parts) != total:
+            self._partial.pop(client_id, None)
+            return None
+        parts[index] = chunk["data"]
         if any(p is None for p in parts):
             return None
         del self._partial[client_id]

@@ -227,3 +227,68 @@ def test_blob_referenced_after_summary_point_survives():
     b = loader.resolve("doc", "bob")
     assert b.runtime.blob_manager.get_blob(kv(b).get("att")) \
         == b"late-referenced"
+
+
+def test_discarded_unsent_idrange_rolls_back_and_refinalizes():
+    """An idRange consumed into a wire batch that never reached the
+    sequencer must re-attach on the next flush (reconnect path), so the
+    minted locals still finalize on every replica."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalOrderingService
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("map-tpu", "kv")
+
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+    a = loader.create("doc", "alice", build)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+
+    comp = b.runtime.id_compressor
+    local = comp.generate()           # mint a local id
+    # A flush that encodes the batch (taking the creation range into the
+    # wire message) but whose send fails: the range sits in _pending_wire.
+    service_obj = b.runtime._service
+    orig_submit = service_obj.submit
+
+    def failing_submit(raw):
+        raise ConnectionError("link dropped mid-send")
+
+    service_obj.submit = failing_submit
+    try:
+        # The send failure is absorbed: the encoded batch (with its taken
+        # idRange) waits in _pending_wire and the op stays pending.
+        b.runtime.get_datastore("ds").get_channel("kv").set("id", local)
+    finally:
+        service_obj.submit = orig_submit
+    assert any(g is not None for _op, g in b.runtime._pending_wire), (
+        "test setup: the failed batch should hold a taken idRange"
+    )
+    b.disconnect()
+    b.reconnect()                     # discards unsent wire, resubmits
+    a.drain()
+    b.drain()
+    a.drain()
+    # The range re-attached: bob's local finalizes everywhere.
+    assert comp.normalize_to_op_space(local) >= 0, (
+        "rolled-back creation range never re-attached/finalized"
+    )
+    assert a.runtime.summarize().digest() == b.runtime.summarize().digest()
+
+
+def test_chunk_reassembler_rejects_malformed_chunks():
+    from fluidframework_tpu.runtime.op_pipeline import ChunkReassembler
+
+    r = ChunkReassembler()
+    assert r.feed("c", {"total": 2, "index": 0, "data": "aGk="}) is None
+    # malformed: index beyond total — state resets, no crash
+    assert r.feed("c", {"total": 2, "index": 5, "data": "aGk="}) is None
+    # total mismatch with a fresh partial train — state resets
+    assert r.feed("c", {"total": 3, "index": 0, "data": "aGk="}) is None
+    assert r.feed("c", {"total": 2, "index": 0, "data": "aGk="}) is None
+    assert r.feed("c", {"total": -1, "index": 0, "data": "aGk="}) is None
+    assert r.feed("c", {"total": True, "index": 0, "data": "x"}) is None
