@@ -260,45 +260,148 @@ def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
     return replay_vmapped(_cold_start(ops, S), ops)
 
 
-# Export row layout: per-slot fields stacked into ONE int32 array so the
+# Export row layout: per-slot fields stacked into ONE array so the
 # device→host link costs a single transfer per fold (the tunneled-chip link
 # pays seconds of fixed latency per RPC — ten small arrays were 10× the
 # cost of one fused array).  Rows 0..7 are the slot fields, rows 8..8+K-1
 # the property columns, and the final row is misc: [n, overflow, live_len].
+#
+# Two element widths exist.  The int32 layout is the always-correct default;
+# when every value a chunk can produce fits in int16 (pack-time check:
+# head seq, per-doc text chars, S, intern-table sizes all < 2**15-1 —
+# ``meta['i16_ok']``) the export is emitted as int16 with two transforms the
+# host inverts after download (``widen_export``): text offsets are rebased
+# per document (``tstart - doc_base[d]``; a doc's arena spans are contiguous
+# because packing appends per doc) and NOT_REMOVED maps to I16_NOT_REMOVED.
+# Halving the element width halves the dominant cost of the whole pipeline —
+# the device→host fetch over the tunneled link (VERDICT r2: the link, not
+# the fold, is the bottleneck).
 EXPORT_SLOT_FIELDS = (
     "tstart", "tlen", "ins_seq", "ins_client",
     "rem_seq", "rem_client", "rem2_seq", "rem2_client",
 )
+I16_NOT_REMOVED = np.int16(np.iinfo(np.int16).max)
+I16_LIMIT = int(np.iinfo(np.int16).max) - 1  # strict value bound for i16_ok
 
 
-def _export_state(final: MTState) -> jnp.ndarray:
-    """[D, 9+K, S] int32 fused view of everything summary extraction and
-    interval replay need from the final device state."""
+def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
+                  i16: bool = False) -> jnp.ndarray:
+    """[D, 9+K, S] fused view of everything summary extraction and interval
+    replay need from the final device state (int32, or int16 when ``i16``
+    with per-doc-rebased tstart and remapped NOT_REMOVED sentinels)."""
     D, S = final.tlen.shape
     K = final.props.shape[2]
     slot = jnp.arange(S)[None, :]
+    active = slot < final.n[:, None]
     live = jnp.where(
-        (slot < final.n[:, None]) & (final.rem_seq == NOT_REMOVED),
-        final.tlen, 0,
+        active & (final.rem_seq == NOT_REMOVED), final.tlen, 0,
     ).sum(axis=1)
     misc = jnp.zeros((D, S), jnp.int32)
     misc = misc.at[:, 0].set(final.n)
     misc = misc.at[:, 1].set(final.overflow.astype(jnp.int32))
     misc = misc.at[:, 2].set(live)
-    rows = [getattr(final, f) for f in EXPORT_SLOT_FIELDS]
+    # Slots beyond n hold shift leftovers no consumer reads; zero their
+    # tstart in BOTH widths so the two exports are bit-equivalent after
+    # ``widen_export`` (and export bytes are deterministic).
+    tstart = jnp.where(active, final.tstart, 0)
+    rem_seq, rem2_seq = final.rem_seq, final.rem2_seq
+    if i16:
+        tstart = jnp.where(active, tstart - doc_base[:, None], 0)
+        rem_seq = jnp.where(
+            rem_seq == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), rem_seq
+        )
+        rem2_seq = jnp.where(
+            rem2_seq == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), rem2_seq
+        )
+    named = {"tstart": tstart, "rem_seq": rem_seq, "rem2_seq": rem2_seq}
+    rows = [named.get(f, getattr(final, f)) for f in EXPORT_SLOT_FIELDS]
     rows += [final.props[:, :, k] for k in range(K)]
     rows.append(misc)
-    return jnp.stack(rows, axis=1)
+    out = jnp.stack(rows, axis=1)
+    return out.astype(jnp.int16) if i16 else out
 
 
-@jax.jit
-def _replay_export(state: MTState, ops: MTOps) -> jnp.ndarray:
-    return _export_state(replay_vmapped(state, ops))
+def widen_export(export_np: np.ndarray,
+                 doc_base: Optional[np.ndarray]) -> np.ndarray:
+    """Undo the int16 export transforms host-side: widen to int32, restore
+    NOT_REMOVED sentinels, re-add per-doc arena bases.  int32 buffers pass
+    through untouched."""
+    if export_np.dtype == np.int32:
+        return export_np
+    out = export_np.astype(np.int32)
+    R_SEQ = EXPORT_SLOT_FIELDS.index("rem_seq")
+    R2_SEQ = EXPORT_SLOT_FIELDS.index("rem2_seq")
+    for r in (R_SEQ, R2_SEQ):
+        row = out[:, r, :]
+        row[row == int(I16_NOT_REMOVED)] = NOT_REMOVED
+    if doc_base is not None:
+        # Re-add the per-doc arena base to live slots only (slots beyond n
+        # were zeroed on device and must stay zero to match the int32 path).
+        n = out[:, -1, 0]
+        active = np.arange(out.shape[2])[None, :] < n[:, None]
+        out[:, 0, :] += np.where(
+            active, np.asarray(doc_base, np.int32)[:, None], 0
+        )
+    return out
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _replay_export_cold(ops: "MTOps", S: int) -> jnp.ndarray:
-    return _export_state(replay_vmapped(_cold_start(ops, S), ops))
+def _fetch_format():
+    """A Format forcing the default row-major layout on export outputs.
+
+    The jit-chosen device layout makes the tunneled-link fetch degenerate
+    ~20× (VERDICT r2: 10.65s vs 0.58s for identical bytes); copying into the
+    default layout before the D2H makes the fetch ride the link at line
+    rate.  Returns None when the backend has no layout support (CPU tests)."""
+    try:
+        from jax.experimental.layout import Format, Layout
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.devices()[0]
+        if dev.platform == "cpu":
+            return None
+        return Format(Layout(major_to_minor=(0, 1, 2)),
+                      SingleDeviceSharding(dev))
+    except Exception:
+        return None
+
+
+@functools.lru_cache(maxsize=None)
+def _export_cold_fn(S: int, i16: bool):
+    """Compiled cold-start fold+export for one (S, width) bucket, its output
+    laid out for a line-rate fetch."""
+
+    def f(ops, doc_base):
+        return _export_state(
+            replay_vmapped(_cold_start(ops, S), ops), doc_base, i16
+        )
+
+    fmt = _fetch_format()
+    return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _export_warm_fn(i16: bool):
+    """Compiled warm-start (base state uploaded) fold+export."""
+
+    def f(state, ops, doc_base):
+        return _export_state(replay_vmapped(state, ops), doc_base, i16)
+
+    fmt = _fetch_format()
+    return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
+
+
+def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
+                  S: Optional[int] = None) -> jnp.ndarray:
+    """Dispatch the fold+export for a packed chunk (async); the result is
+    the fused export buffer handle, int16 when the chunk qualifies.  Pass
+    ``state=None`` for all-cold chunks (initial state built in-graph — no
+    zero upload)."""
+    i16 = bool(meta.get("i16_ok"))
+    doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
+        jnp.zeros((ops.kind.shape[0],), jnp.int32)
+    if state is None:
+        return _export_cold_fn(int(S), i16)(ops, doc_base)
+    return _export_warm_fn(i16)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:
@@ -443,8 +546,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "pvals": np.full((D, T, K), PROP_NOT_TOUCHED, np.int32),
     }
 
+    doc_base = np.zeros((D,), np.int32)
     for d, doc in enumerate(docs):
         pack = doc_packs[d]
+        doc_base[d] = len(arena)
         for s, rec in enumerate(doc.base_records or []):
             st["tstart"][d, s] = arena.append(rec["t"])
             st["tlen"][d, s] = len(rec["t"])
@@ -530,12 +635,31 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                     PROP_ABSENT if value is None else values.intern(value)
                 )
 
+    # int16-export eligibility: every value the final state can hold must fit
+    # strictly under the int16 sentinel (see the export layout comment).
+    max_doc_chars = 0
+    for d in range(D):
+        end = doc_base[d + 1] if d + 1 < D else len(arena)
+        max_doc_chars = max(max_doc_chars, int(end) - int(doc_base[d]))
+    max_seq = max(
+        int(op["seq"].max(initial=0)),
+        max((d.final_seq for d in docs), default=0),
+        max((d.base_seq for d in docs), default=0),
+    )
+    i16_ok = (
+        max_seq < I16_LIMIT
+        and max_doc_chars < I16_LIMIT
+        and S < I16_LIMIT
+        and len(values) < I16_LIMIT
+    )
     meta = {
         "doc_packs": doc_packs,
         "prop_keys": list(prop_keys.values),
         "values": values,
         "arena": arena,
         "docs": docs,
+        "doc_base": doc_base,
+        "i16_ok": i16_ok,
     }
     return MTState(**st), MTOps(**op), meta
 
@@ -682,6 +806,7 @@ def summaries_from_export(meta, export_np: np.ndarray,
 
     docs = meta["docs"]
     D = len(docs)
+    export_np = widen_export(export_np, meta.get("doc_base"))
     state_np = state_dict_from_export(export_np)
     skip = np.zeros(D, np.uint8)
     for d in range(D):
@@ -749,9 +874,9 @@ def replay_mergetree_batch(
         if not any(d.base_records for d in batch):
             # all-cold chunk: initial state is built in-graph (no zero
             # upload; the host link is the bottleneck, not the fold)
-            export = _replay_export_cold(ops, state.tstart.shape[1])
+            export = replay_export(None, ops, meta, S=state.tstart.shape[1])
         else:
-            export = _replay_export(state, ops)
+            export = replay_export(state, ops, meta)
         return summaries_from_export(meta, np.asarray(export))
 
     return partition_replay(

@@ -181,3 +181,67 @@ def test_mergetree_kernel_empty_doc_and_noop_padding():
     [summary] = replay_mergetree_batch([doc])
     fresh = SharedString("empty")
     assert summary.digest() == fresh.summarize().digest()
+
+
+def test_export_widths_agree_and_widen_roundtrips():
+    """The int16 export (doc-rebased tstart, remapped sentinels) must widen
+    back to exactly the int32 export, and both must extract to the same
+    canonical summaries (the i16 path halves the device→host transfer — the
+    measured pipeline bottleneck)."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        pack_mergetree_batch,
+        replay_export,
+        summaries_from_export,
+        widen_export,
+    )
+
+    docs = []
+    for seed in (70, 71, 72, 73):
+        replicas, factory = run_fuzz(
+            StringFuzzSpec(), seed=seed, n_clients=3, rounds=8
+        )
+        docs.append(_kernel_inputs_from_fuzz(factory, doc_id=f"w{seed}"))
+    state, ops, meta = pack_mergetree_batch(docs)
+    S = state.tstart.shape[1]
+    assert meta["i16_ok"], "small fuzz batch must qualify for int16 export"
+
+    ex16 = np.asarray(replay_export(None, ops, meta, S=S))
+    assert ex16.dtype == np.int16
+    meta32 = dict(meta, i16_ok=False)
+    ex32 = np.asarray(replay_export(None, ops, meta32, S=S))
+    assert ex32.dtype == np.int32
+    np.testing.assert_array_equal(
+        widen_export(ex16, meta["doc_base"]), ex32
+    )
+    d16 = [s.digest() for s in summaries_from_export(meta, ex16)]
+    d32 = [s.digest() for s in summaries_from_export(meta32, ex32)]
+    assert d16 == d32
+
+
+def test_export_i16_disabled_for_wide_values():
+    """A chunk whose head sequence exceeds the int16 range must fall back to
+    the int32 export and still match the oracle byte-for-byte."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.mergetree_kernel import pack_mergetree_batch
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    big = 40_000  # > int16 max
+    ops = [
+        SequencedMessage(seq=big + i, client_id="c0", client_seq=i + 1,
+                         ref_seq=big + i - 1, min_seq=0, type=MessageType.OP,
+                         contents={"kind": "insert", "pos": 0, "text": "ab"})
+        for i in range(3)
+    ]
+    doc = MergeTreeDocInput(doc_id="wide", ops=ops, final_seq=big + 3,
+                            final_msn=0)
+    _state, _ops, meta = pack_mergetree_batch([doc])
+    assert not meta["i16_ok"]
+    [summary] = replay_mergetree_batch([doc])
+    body = json.loads(summary.blob_bytes("body"))
+    assert "".join(rec["t"] for rec in body) == "ababab"
