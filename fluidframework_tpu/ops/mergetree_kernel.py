@@ -651,6 +651,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         and max_doc_chars < I16_LIMIT
         and S < I16_LIMIT
         and len(values) < I16_LIMIT
+        and max((len(p.clients) for p in doc_packs), default=0) < I16_LIMIT
     )
     meta = {
         "doc_packs": doc_packs,
