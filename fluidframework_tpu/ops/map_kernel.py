@@ -161,9 +161,18 @@ def replay_map_batch(docs: Sequence[MapDocInput]) -> List[SummaryTree]:
         num_keys=batch.num_keys,
         num_docs=batch.num_docs,
     )
+    return summaries_from_lww(batch, present, win_val)
+
+
+def summaries_from_lww(batch: _PackedBatch, present, win_val
+                       ) -> List[SummaryTree]:
+    """Device LWW reduction results → canonical per-doc summaries (shared
+    by the single-chip and mesh-sharded paths)."""
     present = np.asarray(present)
     win_val = np.asarray(win_val)
-    data_per_doc: List[Dict[str, Any]] = [dict() for _ in docs]
+    data_per_doc: List[Dict[str, Any]] = [
+        dict() for _ in range(batch.num_docs)
+    ]
     for gid, (doc_idx, key) in enumerate(batch.keys):
         if present[gid]:
             data_per_doc[doc_idx][key] = batch.values.lookup(int(win_val[gid]))

@@ -5,20 +5,29 @@ service replicas (SURVEY.md §5 'Distributed communication backend'); the
 TPU-native equivalent is a ``jax.sharding.Mesh`` with a ``docs`` axis —
 catch-up replay is embarrassingly document-parallel, so the op-fold shards
 along the doc axis with zero cross-chip traffic during the fold, and merged
-state (summary roots / lengths) is assembled with XLA collectives over ICI at
-the end.  Multi-slice scale-out rides the same shardings over DCN.
+state (summary roots / lengths / resolved handles) is assembled with XLA
+collectives over ICI at the end.  Multi-slice scale-out rides the same
+shardings over DCN.
 """
 
 from .shard import (
     doc_mesh,
+    map_sharded_replay_step,
+    matrix_sharded_replay_step,
+    replay_map_sharded,
+    replay_matrix_sharded,
     replay_mergetree_sharded,
     replay_tree_sharded,
-    tree_sharded_replay_step,
     sharded_replay_step,
+    tree_sharded_replay_step,
 )
 
 __all__ = [
     "doc_mesh",
+    "map_sharded_replay_step",
+    "matrix_sharded_replay_step",
+    "replay_map_sharded",
+    "replay_matrix_sharded",
     "replay_mergetree_sharded",
     "replay_tree_sharded",
     "sharded_replay_step",

@@ -127,6 +127,141 @@ def replay_mergetree_sharded(
     )
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def map_sharded_replay_step(mesh: Mesh, num_keys: int, num_docs: int):
+    """Jitted, mesh-sharded LWW map reduction (cached per shape — a fresh
+    jit closure every call would recompile identical shapes).
+
+    The map kernel's inputs are FLAT op arrays (one row per set/delete op,
+    grouped by global key id), so the shard axis is the op axis: each chip
+    reduces its op shard and XLA assembles the per-key winners with
+    cross-chip collectives (the segment reductions' combiner ops ride ICI),
+    returning replicated per-key results for the host summarizer."""
+    from ..ops.map_kernel import _map_lww_kernel
+
+    shard = NamedSharding(mesh, P(DOC_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    def _step(key_gid, op_seq, is_set, val_idx, key_doc,
+              clear_doc, clear_seq):
+        return _map_lww_kernel(
+            key_gid, op_seq, is_set, val_idx, key_doc, clear_doc, clear_seq,
+            num_keys=num_keys, num_docs=num_docs,
+        )
+
+    return jax.jit(
+        _step,
+        in_shardings=(shard, shard, shard, shard, replicated,
+                      shard, shard),
+        out_shardings=(replicated, replicated),
+    )
+
+
+def replay_map_sharded(docs, mesh: Optional[Mesh] = None) -> List[SummaryTree]:
+    """Multi-chip SharedMap catch-up replay; byte-compatible with
+    ``replay_map_batch`` and the CPU oracle."""
+    from ..ops.map_kernel import pack_map_batch, summaries_from_lww
+
+    if not docs:
+        return []
+    if mesh is None:
+        mesh = doc_mesh()
+    batch = pack_map_batch(docs)
+    # Flat buckets are powers of two >= 64, so they always split evenly
+    # over power-of-two meshes.
+    shard = NamedSharding(mesh, P(DOC_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    def put(arr, sh):
+        return jax.device_put(jnp.asarray(arr), sh)
+
+    step = map_sharded_replay_step(mesh, batch.num_keys, batch.num_docs)
+    present, win_val = step(
+        put(batch.key_gid, shard), put(batch.op_seq, shard),
+        put(batch.is_set, shard), put(batch.val_idx, shard),
+        put(batch.key_doc, replicated),
+        put(batch.clear_doc, shard), put(batch.clear_seq, shard),
+    )
+    return summaries_from_lww(batch, present, win_val)
+
+
+def matrix_sharded_replay_step(mesh: Mesh):
+    """Jitted, mesh-sharded matrix fold: the dual-axis permutation streams
+    (packed ``[2D, ...]``, two axis rows per matrix) partitioned along the
+    doc axis; per-op resolved cell handles are assembled cross-chip for the
+    host cell fold — the ICI all-gather."""
+    from ..ops.matrix_kernel import replay_resolving_vmapped
+
+    shard = NamedSharding(mesh, P(DOC_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    def _step(state: MTState, ops: MTOps):
+        final, resolved = replay_resolving_vmapped(state, ops)
+        resolved = jax.lax.with_sharding_constraint(resolved, replicated)
+        return final, resolved
+
+    state_shardings = MTState(
+        tstart=shard, tlen=shard, ins_seq=shard, ins_client=shard,
+        rem_seq=shard, rem_client=shard, rem2_seq=shard, rem2_client=shard,
+        props=shard, n=shard, overflow=shard,
+    )
+    ops_shardings = MTOps(
+        kind=shard, seq=shard, client=shard, ref_seq=shard, a=shard, b=shard,
+        tstart=shard, tlen=shard, pvals=shard,
+    )
+    return jax.jit(
+        _step,
+        in_shardings=(state_shardings, ops_shardings),
+        out_shardings=(state_shardings, replicated),
+    )
+
+
+def replay_matrix_sharded(
+    docs, mesh: Optional[Mesh] = None, step=None,
+) -> List[SummaryTree]:
+    """Multi-chip SharedMatrix catch-up replay (see replay_mergetree_sharded).
+
+    Matrices pack as TWO axis rows each, so the doc list pads to half the
+    mesh size to keep the [2D] axis evenly sharded."""
+    from ..ops.batching import partition_replay
+    from ..ops.matrix_kernel import (
+        MatrixDocInput,
+        known_matrix_fallback,
+        oracle_matrix_fallback,
+        pack_matrix_batch,
+        summary_from_matrix_state,
+    )
+
+    if mesh is None:
+        mesh = doc_mesh()
+    the_step = step if step is not None else (
+        matrix_sharded_replay_step(mesh) if docs else None
+    )
+
+    def fold_batch(batch):
+        n_real = len(batch)
+        padded = _pad_docs(
+            batch, max(1, mesh.size // 2),
+            lambda: MatrixDocInput(doc_id="\x00pad", ops=[]),
+        )
+        state, ops, meta = pack_matrix_batch(padded)
+        final, resolved = the_step(_shard_put(mesh, state),
+                                   _shard_put(mesh, ops))
+        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
+        resolved_np = np.asarray(resolved)
+        return [
+            summary_from_matrix_state(meta, state_np, resolved_np, d)
+            for d in range(n_real)
+        ]
+
+    return partition_replay(
+        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch
+    )
+
+
 def tree_sharded_replay_step(mesh: Mesh):
     """Jitted, mesh-sharded tree replay step: the edit-fold partitioned
     along the doc axis; per-doc overflow flags (the host needs every one to

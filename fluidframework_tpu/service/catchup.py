@@ -8,10 +8,14 @@ documents are packed into ragged tensors and folded by the merge-tree
 kernel in one vmapped scan, producing summaries byte-identical to the CPU
 oracle — so loading clients start from a fresh summary and replay nothing.
 
-Device routing today covers the flagship document shape (string channels
-whose prior summary is empty, i.e. whole history in the log); everything
-else folds through the CPU container-runtime path.  The split/scatter is
-the shared :func:`partition_replay` bookkeeping.
+Device routing covers every kernel-backed channel type — string, map,
+matrix, and tree channels (cold AND warm starts; a warm channel's summary
+re-enters its kernel as base state), including mixed-type documents.
+Channels of types with no device kernel (cell, counter, directory,
+consensus) fold host-side per channel inside an otherwise-device document;
+only container-level disqualifiers (runtime ops, GC state, blobs) fall all
+the way back to the CPU container-runtime path.  The split/scatter is the
+shared :func:`partition_replay` bookkeeping.
 """
 
 from __future__ import annotations
@@ -30,8 +34,14 @@ from ..runtime.registry import ChannelRegistry, default_registry
 from .orderer import LocalOrderingService
 
 STRING_TYPE = "sequence-tpu"
+MAP_TYPE = "map-tpu"
+MATRIX_TYPE = "matrix-tpu"
+TREE_TYPE = "tree-tpu"
+#: types with a device kernel; every other registered type folds host-side
+#: per channel (still inside a device-routed document).
+KERNEL_TYPES = (STRING_TYPE, MAP_TYPE, MATRIX_TYPE, TREE_TYPE)
 
-_EMPTY_STRING_DIGEST: Optional[str] = None
+_EMPTY_DIGESTS: Dict[str, str] = {}
 
 
 def _gc_state_empty(summary: SummaryTree) -> bool:
@@ -52,14 +62,15 @@ def _gc_state_empty(summary: SummaryTree) -> bool:
     return True
 
 
-def _empty_string_digest() -> str:
-    """Digest of a fresh, empty string-channel summary (id-independent)."""
-    global _EMPTY_STRING_DIGEST
-    if _EMPTY_STRING_DIGEST is None:
-        from ..dds.sequence import SharedString
-
-        _EMPTY_STRING_DIGEST = SharedString("-").summarize(0).digest()
-    return _EMPTY_STRING_DIGEST
+def _empty_digest(registry: ChannelRegistry, type_name: str) -> str:
+    """Digest of a fresh, empty channel summary for a type (id-independent:
+    no built-in channel summary embeds its id)."""
+    digest = _EMPTY_DIGESTS.get(type_name)
+    if digest is None:
+        channel = registry.get(type_name).create("-")
+        digest = channel.summarize(0).digest()
+        _EMPTY_DIGESTS[type_name] = digest
+    return digest
 
 
 @dataclasses.dataclass
@@ -68,9 +79,9 @@ class _DocWork:
     summary: SummaryTree
     ref_seq: int
     tail: List[SequencedMessage]
-    # device plan: [(ds_id, channel_id), ...] or None (CPU fallback);
-    # computed once at partition time.
-    plan: Optional[List[Tuple[str, str]]] = None
+    # device plan: [(ds_id, channel_id, type_name, channel_tree_or_None)]
+    # or None (CPU fallback); computed once at partition time.
+    plan: Optional[List[tuple]] = None
     # decoded (msg, batch) pairs — chunk/compression resolved once
     decoded: Optional[list] = None
 
@@ -109,6 +120,7 @@ class CatchupService:
         self.mc = (mc or MonitoringContext()).child("catchup")
         self.device_docs = 0
         self.cpu_docs = 0
+        self.host_channels = 0  # non-kernel channels folded host-side
 
     # -- public API ------------------------------------------------------------
 
@@ -122,12 +134,14 @@ class CatchupService:
         from ..utils.telemetry import PerformanceEvent
 
         device_before, cpu_before = self.device_docs, self.cpu_docs
+        host_before = self.host_channels
         with PerformanceEvent.timed_exec(
                 self.mc.logger, "bulkCatchup") as perf:
             results = self._catch_up(doc_ids, upload)
             perf["extra"].update(
                 deviceDocs=self.device_docs - device_before,
                 cpuDocs=self.cpu_docs - cpu_before,
+                hostChannels=self.host_channels - host_before,
                 docs=len(results))
         return results
 
@@ -180,11 +194,13 @@ class CatchupService:
     # -- device path -----------------------------------------------------------
 
     def _device_plan(self, work: _DocWork):
-        """Device-eligible shape: every channel is a string channel.  Cold
-        (empty prior summary) AND warm starts both fold on device — a warm
-        channel's summary body re-enters the kernel as base_records.
-        Returns [(ds_id, channel_id, base)] where ``base`` is None (cold)
-        or (records, base_seq, base_msn, intervals); None = CPU path."""
+        """Device-eligible shape: only container-level state must be
+        trivially foldable (no runtime ops, empty GC/blob state).  Every
+        registered channel type participates — kernel types fold on device
+        (cold or warm; a warm channel's summary re-enters its kernel as
+        base state), others fold host-side per channel.  Returns
+        [(ds_id, channel_id, type_name, channel_tree_or_None)] where None
+        marks a cold (empty prior summary) channel; None = CPU path."""
         try:
             ds_root = work.summary.get(".datastores")
         except KeyError:
@@ -209,59 +225,115 @@ class CatchupService:
             if channels is None:
                 return None  # unrecognized attributes shape: CPU path
             for channel_id, type_name in channels.items():
-                if type_name != STRING_TYPE:
-                    return None
+                try:
+                    self.registry.get(type_name)
+                except KeyError:
+                    return None  # unknown type: CPU path decides
                 channel_tree = subtree.children[channel_id]
-                if channel_tree.digest() == _empty_string_digest():
-                    base = None  # cold fold
-                else:
-                    header = json.loads(channel_tree.blob_bytes("header"))
-                    records = json.loads(channel_tree.blob_bytes("body"))
-                    try:
-                        intervals = json.loads(
-                            channel_tree.blob_bytes("intervals"))
-                    except KeyError:
-                        intervals = None
-                    base = (records, header["seq"], header["minSeq"],
-                            intervals)
-                plan.append((ds_id, channel_id, base))
+                if channel_tree.digest() == _empty_digest(
+                        self.registry, type_name):
+                    channel_tree = None  # cold fold
+                plan.append((ds_id, channel_id, type_name, channel_tree))
         return plan or None
 
+    @staticmethod
+    def _string_base_kwargs(channel_tree: Optional[SummaryTree]) -> dict:
+        if channel_tree is None:
+            return {}
+        header = json.loads(channel_tree.blob_bytes("header"))
+        records = json.loads(channel_tree.blob_bytes("body"))
+        try:
+            intervals = json.loads(channel_tree.blob_bytes("intervals"))
+        except KeyError:
+            intervals = None
+        return {
+            "base_records": records,
+            "base_seq": header["seq"],
+            "base_msn": header["minSeq"],
+            "base_intervals": intervals,
+        }
+
+    def _host_channel_fold(self, type_name: str, channel_id: str,
+                           channel_tree: Optional[SummaryTree],
+                           ops: List[SequencedMessage],
+                           final_msn: int) -> SummaryTree:
+        """Fold one non-kernel channel host-side: load (or create) the DDS,
+        apply its flattened op stream, summarize at the container MSN —
+        byte-identical to what the container runtime would produce."""
+        factory = self.registry.get(type_name)
+        if channel_tree is None:
+            channel = factory.create(channel_id)
+        else:
+            channel = factory.load(channel_id, channel_tree)
+        for msg in ops:
+            channel.process(msg, local=False)
+        return channel.summarize(final_msn)
+
     def _device_fold(self, works: List[_DocWork]) -> List[SummaryTree]:
-        """Batch every (doc, channel) pair as one kernel input; reassemble
-        full container summary trees host-side, byte-identical to
+        """Batch every (doc, channel) pair into its kernel's batch (one
+        device call per kernel type); fold non-kernel channels host-side;
+        reassemble full container summary trees, byte-identical to
         ``ContainerRuntime.summarize()``."""
-        inputs: List[MergeTreeDocInput] = []
-        for work in works:
+        from ..ops.map_kernel import MapDocInput, replay_map_batch
+        from ..ops.matrix_kernel import MatrixDocInput, replay_matrix_batch
+        from ..ops.tree_kernel import TreeDocInput, replay_tree_batch
+
+        # Collect per-kernel inputs; (work_idx, plan_idx) → result slot.
+        string_in: List[MergeTreeDocInput] = []
+        map_in: List[MapDocInput] = []
+        matrix_in: List[MatrixDocInput] = []
+        tree_in: List[TreeDocInput] = []
+        slots: Dict[Tuple[int, int], Tuple[str, int]] = {}
+        host_trees: Dict[Tuple[int, int], SummaryTree] = {}
+        for wi, work in enumerate(works):
             self.device_docs += 1
             final_seq = work.tail[-1].seq
             final_msn = max(m.min_seq for m in work.tail)
-            for ds_id, channel_id, base in work.plan:
-                if base is None:
-                    base_kwargs = {}
-                else:
-                    records, base_seq, base_msn, intervals = base
-                    base_kwargs = {
-                        "base_records": records,
-                        "base_seq": base_seq,
-                        "base_msn": base_msn,
-                        "base_intervals": intervals,
-                    }
-                inputs.append(
-                    MergeTreeDocInput(
-                        doc_id=f"{work.doc_id}/{ds_id}/{channel_id}",
-                        ops=flatten_channel_ops(work.decoded, ds_id,
-                                                channel_id),
-                        final_seq=final_seq,
-                        final_msn=final_msn,
-                        **base_kwargs,
+            for pi, (ds_id, channel_id, type_name, channel_tree) in \
+                    enumerate(work.plan):
+                cid = f"{work.doc_id}/{ds_id}/{channel_id}"
+                ops = flatten_channel_ops(work.decoded, ds_id, channel_id)
+                if type_name not in KERNEL_TYPES:
+                    self.host_channels += 1
+                    host_trees[wi, pi] = self._host_channel_fold(
+                        type_name, channel_id, channel_tree, ops, final_msn
                     )
-                )
-        channel_trees = replay_mergetree_batch(inputs)
+                elif type_name == STRING_TYPE:
+                    slots[wi, pi] = (STRING_TYPE, len(string_in))
+                    string_in.append(MergeTreeDocInput(
+                        doc_id=cid, ops=ops, final_seq=final_seq,
+                        final_msn=final_msn,
+                        **self._string_base_kwargs(channel_tree),
+                    ))
+                elif type_name == MAP_TYPE:
+                    base = None
+                    if channel_tree is not None:
+                        base = json.loads(
+                            channel_tree.blob_bytes("header"))["data"]
+                    slots[wi, pi] = (MAP_TYPE, len(map_in))
+                    map_in.append(MapDocInput(doc_id=cid, ops=ops, base=base))
+                elif type_name == MATRIX_TYPE:
+                    slots[wi, pi] = (MATRIX_TYPE, len(matrix_in))
+                    matrix_in.append(MatrixDocInput(
+                        doc_id=cid, ops=ops, base_summary=channel_tree,
+                        final_seq=final_seq, final_msn=final_msn,
+                    ))
+                else:
+                    assert type_name == TREE_TYPE
+                    slots[wi, pi] = (TREE_TYPE, len(tree_in))
+                    tree_in.append(TreeDocInput(
+                        doc_id=cid, ops=ops, base_summary=channel_tree,
+                        final_seq=final_seq, final_msn=final_msn,
+                    ))
+        results = {
+            STRING_TYPE: replay_mergetree_batch(string_in),
+            MAP_TYPE: replay_map_batch(map_in) if map_in else [],
+            MATRIX_TYPE: replay_matrix_batch(matrix_in) if matrix_in else [],
+            TREE_TYPE: replay_tree_batch(tree_in) if tree_in else [],
+        }
 
         out: List[SummaryTree] = []
-        i = 0
-        for work in works:
+        for wi, work in enumerate(works):
             final_seq = work.tail[-1].seq
             final_msn = max(m.min_seq for m in work.tail)
             quorum = self._fold_quorum(work)
@@ -283,26 +355,26 @@ class CatchupService:
                           canonical_json(GarbageCollector.empty_state()))
             tree.add_tree(".blobs")
             ds_tree = tree.add_tree(".datastores")
-            channel_by_pair = {
-                (entry[0], entry[1]): channel_trees[i + k]
-                for k, entry in enumerate(work.plan)
-            }
-            by_ds: Dict[str, List[str]] = {}
-            for ds_id, channel_id, _base in work.plan:
-                by_ds.setdefault(ds_id, []).append(channel_id)
+            by_ds: Dict[str, List[Tuple[str, str, int]]] = {}
+            for pi, (ds_id, channel_id, type_name, _base) in \
+                    enumerate(work.plan):
+                by_ds.setdefault(ds_id, []).append(
+                    (channel_id, type_name, pi)
+                )
             for ds_id in sorted(by_ds):
                 sub = SummaryTree()
                 channel_types = {}
-                for channel_id in sorted(by_ds[ds_id]):
-                    sub.children[channel_id] = channel_by_pair[
-                        (ds_id, channel_id)
-                    ]
-                    channel_types[channel_id] = STRING_TYPE
+                for channel_id, type_name, pi in sorted(by_ds[ds_id]):
+                    if (wi, pi) in host_trees:
+                        sub.children[channel_id] = host_trees[wi, pi]
+                    else:
+                        kind, idx = slots[wi, pi]
+                        sub.children[channel_id] = results[kind][idx]
+                    channel_types[channel_id] = type_name
                 sub.add_blob(".attributes", canonical_json(
                     {"channels": channel_types, "rooted": True}
                 ))
                 ds_tree.children[ds_id] = sub
-            i += len(work.plan)
             out.append(tree)
         return out
 

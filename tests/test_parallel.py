@@ -87,3 +87,53 @@ def test_tree_sharded_matches_oracle():
         oracle_digests.append(trees[0].summarize().digest())
     sharded = replay_tree_sharded(docs, mesh=doc_mesh())
     assert [s.digest() for s in sharded] == oracle_digests
+
+
+def test_map_sharded_matches_oracle_and_single_chip():
+    from fluidframework_tpu.ops.map_kernel import (
+        MapDocInput,
+        replay_map_batch,
+    )
+    from fluidframework_tpu.parallel import replay_map_sharded
+    from fluidframework_tpu.testing.fuzz import MapFuzzSpec
+
+    docs, oracle_digests = [], []
+    for seed in range(5):
+        replicas, factory = run_fuzz(
+            MapFuzzSpec(), seed=500 + seed, n_clients=2, rounds=8 + seed
+        )
+        docs.append(
+            MapDocInput(doc_id=f"m{seed}", ops=channel_log(factory, "fuzz"))
+        )
+        oracle_digests.append(replicas[0].summarize().digest())
+    sharded = replay_map_sharded(docs, mesh=doc_mesh())
+    assert [s.digest() for s in sharded] == oracle_digests
+    single = replay_map_batch(docs)
+    assert [s.digest() for s in single] == oracle_digests
+
+
+def test_matrix_sharded_matches_oracle_and_single_chip():
+    from fluidframework_tpu.ops.matrix_kernel import (
+        MatrixDocInput,
+        replay_matrix_batch,
+    )
+    from fluidframework_tpu.parallel import replay_matrix_sharded
+    from fluidframework_tpu.testing.fuzz import MatrixFuzzSpec
+
+    docs, oracle_digests = [], []
+    for seed in range(5):  # 5 docs -> [10] axis rows over 8 devices: uneven
+        replicas, factory = run_fuzz(
+            MatrixFuzzSpec(), seed=600 + seed, n_clients=2, rounds=8 + seed
+        )
+        docs.append(
+            MatrixDocInput(
+                doc_id=f"mx{seed}", ops=channel_log(factory, "fuzz"),
+                final_seq=factory.sequencer.seq,
+                final_msn=factory.sequencer.min_seq,
+            )
+        )
+        oracle_digests.append(replicas[0].summarize().digest())
+    sharded = replay_matrix_sharded(docs, mesh=doc_mesh())
+    assert [s.digest() for s in sharded] == oracle_digests
+    single = replay_matrix_batch(docs)
+    assert [s.digest() for s in single] == oracle_digests

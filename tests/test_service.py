@@ -423,8 +423,8 @@ def test_catchup_warm_start_from_prior_summary_on_device():
 
 
 def test_catchup_mixed_eligibility():
-    """String docs go to the device; a map doc folds on CPU; results land
-    for both."""
+    """String AND map docs both ride the device plan (map kernels route
+    through catch-up since round 3); results land for both."""
     service = LocalOrderingService()
     _seed_string_doc(service, "strdoc", edits=6)
 
@@ -444,7 +444,7 @@ def test_catchup_mixed_eligibility():
 
     svc = CatchupService(service)
     results = svc.catch_up()
-    assert svc.device_docs == 1 and svc.cpu_docs == 1
+    assert svc.device_docs == 2 and svc.cpu_docs == 0
     assert set(results) == {"strdoc", "mapdoc"}
 
     tree, _seq = service.storage.latest("mapdoc")
@@ -452,3 +452,94 @@ def test_catchup_mixed_eligibility():
     check.load(tree)
     loaded_kv = check.get_datastore("ds").get_channel("kv")
     assert loaded_kv.get("x") == 1 and loaded_kv.get("y") == 2
+
+
+def _drive_mixed_doc(runtimes, rng, rounds=6):
+    """Random traffic across all channels of the mixed-type datastore."""
+    for i in range(rounds):
+        rt = runtimes[i % len(runtimes)]
+        ds = rt.get_datastore("ds")
+        roll = rng.random()
+        if roll < 0.3:
+            t = ds.get_channel("text")
+            L = len(t.text)
+            if L < 4 or rng.random() < 0.7:
+                t.insert_text(rng.randint(0, L), "xy"[i % 2] * 2)
+            else:
+                s = rng.randint(0, L - 2)
+                t.remove_range(s, min(L, s + 2))
+        elif roll < 0.5:
+            ds.get_channel("kv").set(f"k{rng.randint(0, 5)}",
+                                     rng.randint(0, 99))
+        elif roll < 0.7:
+            m = ds.get_channel("grid")
+            if m.row_count == 0 or rng.random() < 0.4:
+                m.insert_rows(rng.randint(0, m.row_count), 1)
+            elif m.col_count == 0 or rng.random() < 0.6:
+                m.insert_cols(rng.randint(0, m.col_count), 1)
+            else:
+                m.set_cell(rng.randint(0, m.row_count - 1),
+                           rng.randint(0, m.col_count - 1),
+                           rng.randint(0, 99))
+        elif roll < 0.9:
+            tr = ds.get_channel("tree")
+            from fluidframework_tpu.dds.tree import ROOT_ID
+            kids = tr.children(ROOT_ID, "a")
+            if not kids or rng.random() < 0.7:
+                tr.insert(ROOT_ID, "a", rng.randint(0, len(kids)),
+                          [tr.build("n", value=rng.randint(0, 9))])
+            else:
+                tr.set_value(rng.choice(kids), rng.randint(0, 99))
+        else:
+            ds.get_channel("clicks").increment(1)
+        for r in runtimes:
+            r.drain()
+
+
+def test_catchup_mixed_types_fold_on_device():
+    """A mixed population (string+map+matrix+tree+counter channels, warm
+    rounds included) routes through the device plan: kernel channels fold
+    on device, the counter folds host-side per channel, and every summary
+    is byte-identical to the forced-CPU container fold."""
+    service = LocalOrderingService()
+    rng = __import__("random").Random("mixed")
+    all_runtimes = {}
+    for d in range(3):
+        doc_id = f"mixed{d}"
+        ep = service.create_document(doc_id)
+        seeded = ContainerRuntime()
+        ds = seeded.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+        ds.create_channel("map-tpu", "kv")
+        ds.create_channel("matrix-tpu", "grid")
+        ds.create_channel("tree-tpu", "tree")
+        ds.create_channel("counter-tpu", "clicks")
+        service.storage.upload(doc_id, seeded.summarize(), 0)
+        runtimes = []
+        for c in range(2):
+            rt = ContainerRuntime()
+            rt.load(service.storage.latest(doc_id)[0])
+            rt.connect(ep, f"client{c}")
+            rt.drain()
+            runtimes.append(rt)
+        all_runtimes[doc_id] = runtimes
+        _drive_mixed_doc(runtimes, rng, rounds=8)
+
+    svc = CatchupService(service)
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None
+
+    for round_idx in range(2):  # cold round, then a warm round
+        cpu_results = cpu.catch_up(upload=False)
+        results = svc.catch_up()
+        assert svc.device_docs == 3 * (round_idx + 1), (
+            "mixed docs must ride the device plan"
+        )
+        assert svc.cpu_docs == 0
+        assert svc.host_channels > 0  # the counter folded host-side
+        for doc_id, (handle, seq) in results.items():
+            assert cpu_results[doc_id] == (handle, seq), (
+                f"{doc_id}: device summary != CPU container fold"
+            )
+        for runtimes in all_runtimes.values():
+            _drive_mixed_doc(runtimes, rng, rounds=6)
