@@ -11,11 +11,14 @@ empty reference mount).  Drivers are duck-typed (see :mod:`definitions`):
   point from a static op log (replay-driver / replay-tool capability).
 - :mod:`file_driver`   — durable single-host deployment: file-backed op log
   and content-addressed summary store that reopen across processes.
+- :mod:`network_driver` — clients in OTHER processes over TCP, against the
+  :mod:`..service.server` front door (routerlicious-driver capability).
 """
 
 from .definitions import DocumentService, DocumentStorage
 from .file_driver import FileDocumentServiceFactory, FileSummaryStorage
 from .local_driver import LocalDocumentServiceFactory
+from .network_driver import NetworkDocumentServiceFactory
 from .replay_driver import ReplayDocumentService
 
 __all__ = [
@@ -24,5 +27,6 @@ __all__ = [
     "FileDocumentServiceFactory",
     "FileSummaryStorage",
     "LocalDocumentServiceFactory",
+    "NetworkDocumentServiceFactory",
     "ReplayDocumentService",
 ]

@@ -1,0 +1,324 @@
+"""Network driver: binds the loader to an ordering server over TCP.
+
+Capability parity with the reference's routerlicious-driver (SURVEY.md
+§2.4; upstream paths UNVERIFIED — empty reference mount): the client side
+of the frame protocol in ``service/server.py``.  One socket per factory is
+shared by every document; a reader thread routes responses to waiting
+callers and enqueues broadcast events, and a dispatcher thread delivers
+them to subscribers — so a subscriber callback may issue further blocking
+requests (the DeltaManager's gap repair does) without deadlocking the
+reader.
+
+Delivery threading: op/signal callbacks fire on the dispatcher thread.
+The intended consumer is the Loader's DeltaManager, whose delivery
+watermark dedups the overlap between a deltas snapshot and the live tail,
+and whose subscribers only append to the runtime's inbound queue (drained
+by the application thread).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
+
+WIRE_VERSION = 1
+_LEN = struct.Struct(">I")
+
+
+class RpcError(RuntimeError):
+    """Server-side error surfaced to the caller."""
+
+
+class _RpcClient:
+    """Shared framed-JSON socket with response routing + event dispatch."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=10)
+        self._sock.settimeout(None)
+        self._timeout = timeout
+        self._write_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, queue.Queue] = {}
+        self._pending_lock = threading.Lock()
+        self._events: queue.Queue = queue.Queue()
+        self._handlers: Dict[str, List[Callable[[dict], None]]] = {}
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True
+        )
+        self._dispatcher.start()
+
+    # -- wire ------------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            buf = b""
+            while True:
+                while len(buf) < _LEN.size:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    buf += chunk
+                (length,) = _LEN.unpack(buf[:_LEN.size])
+                buf = buf[_LEN.size:]
+                while len(buf) < length:
+                    chunk = self._sock.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    buf += chunk
+                frame = json.loads(buf[:length])
+                buf = buf[length:]
+                if "re" in frame:
+                    with self._pending_lock:
+                        slot = self._pending.pop(frame["re"], None)
+                    if slot is not None:
+                        slot.put(frame)
+                elif "event" in frame:
+                    self._events.put(frame)
+        except (ConnectionError, OSError, ValueError) as exc:
+            self._closed = True
+            # Fail every waiter so no caller hangs on a dead socket.
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for slot in pending.values():
+                slot.put({"ok": False, "error": f"connection lost: {exc}"})
+            self._events.put(None)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            frame = self._events.get()
+            if frame is None:
+                return
+            key = f"{frame['event']}:{frame.get('doc', '')}"
+            for fn in list(self._handlers.get(key, [])):
+                try:
+                    fn(frame)
+                except Exception:
+                    pass  # a broken subscriber must not kill delivery
+
+    def request(self, method: str, params: dict):
+        if self._closed:
+            raise RpcError("connection lost")
+        rid = next(self._ids)
+        slot: queue.Queue = queue.Queue(maxsize=1)
+        with self._pending_lock:
+            self._pending[rid] = slot
+        payload = json.dumps(
+            {"v": WIRE_VERSION, "id": rid, "method": method,
+             "params": params},
+            separators=(",", ":"),
+        ).encode("utf-8")
+        with self._write_lock:
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        try:
+            frame = slot.get(timeout=self._timeout)
+        except queue.Empty:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise RpcError(f"timeout waiting for {method}")
+        if not frame.get("ok"):
+            raise RpcError(frame.get("error", "unknown server error"))
+        return frame.get("result")
+
+    def on(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
+        self._handlers.setdefault(f"{event}:{doc_id}", []).append(fn)
+
+    def off(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
+        handlers = self._handlers.get(f"{event}:{doc_id}", [])
+        if fn in handlers:
+            handlers.remove(fn)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class NetworkConnection:
+    """The per-document delta connection (DocumentEndpoint surface)."""
+
+    def __init__(self, rpc: _RpcClient, doc_id: str) -> None:
+        self._rpc = rpc
+        self.doc_id = doc_id
+        self._subscribers: List[Callable[[SequencedMessage], None]] = []
+        self._signal_subscribers: List[Callable[[dict], None]] = []
+        self._tapped = False
+        rpc.on("op", doc_id, self._on_op_event)
+        rpc.on("signal", doc_id, self._on_signal_event)
+
+    def _ensure_tap(self) -> None:
+        if not self._tapped:
+            self._rpc.request("subscribe_doc", {"doc": self.doc_id})
+            self._tapped = True
+
+    def _on_op_event(self, frame: dict) -> None:
+        msg = SequencedMessage.from_dict(frame["msg"])
+        for fn in list(self._subscribers):
+            fn(msg)
+
+    def _on_signal_event(self, frame: dict) -> None:
+        for fn in list(self._signal_subscribers):
+            fn(frame["signal"])
+
+    # -- DocumentEndpoint surface ----------------------------------------------
+
+    @property
+    def log(self) -> List[SequencedMessage]:
+        return self.deltas()
+
+    @property
+    def head_seq(self) -> int:
+        return self._rpc.request("head", {"doc": self.doc_id})
+
+    def connect(self, client_id: str, session: Optional[str] = None) -> None:
+        self._ensure_tap()
+        self._rpc.request(
+            "connect",
+            {"doc": self.doc_id, "client": client_id, "session": session},
+        )
+
+    def disconnect(self, client_id: str) -> None:
+        self._rpc.request(
+            "disconnect", {"doc": self.doc_id, "client": client_id}
+        )
+
+    def submit(self, op: RawOperation) -> Optional[SequencedMessage]:
+        result = self._rpc.request(
+            "submit", {"doc": self.doc_id, "op": op.to_dict()}
+        )
+        return SequencedMessage.from_dict(result) if result else None
+
+    def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        self._ensure_tap()
+        self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
+        if fn in self._subscribers:
+            self._subscribers.remove(fn)
+
+    def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
+        self._rpc.request(
+            "update_ref_seq",
+            {"doc": self.doc_id, "client": client_id, "ref_seq": ref_seq},
+        )
+
+    def deltas(self, from_seq: int = 0,
+               to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        msgs = self._rpc.request(
+            "deltas",
+            {"doc": self.doc_id, "from_seq": from_seq, "to_seq": to_seq},
+        )
+        return [SequencedMessage.from_dict(m) for m in msgs]
+
+    def submit_signal(self, client_id: str, content,
+                      target_client_id: Optional[str] = None) -> None:
+        self._ensure_tap()
+        self._rpc.request(
+            "signal",
+            {"doc": self.doc_id, "client": client_id, "content": content,
+             "target": target_client_id},
+        )
+
+    def subscribe_signals(self, fn: Callable[[dict], None]) -> None:
+        self._ensure_tap()
+        self._signal_subscribers.append(fn)
+
+    def unsubscribe_signals(self, fn: Callable[[dict], None]) -> None:
+        if fn in self._signal_subscribers:
+            self._signal_subscribers.remove(fn)
+
+
+class _RemoteDeltaStorage:
+    """Ranged reads of the durable log over the wire."""
+
+    def __init__(self, conn: NetworkConnection) -> None:
+        self._conn = conn
+
+    def get(self, from_seq: int = 0,
+            to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        return self._conn.deltas(from_seq, to_seq)
+
+    def head(self) -> int:
+        return self._conn.head_seq
+
+
+class _RemoteStorage:
+    """The summary store over the wire."""
+
+    def __init__(self, rpc: _RpcClient, doc_id: str) -> None:
+        self._rpc = rpc
+        self.doc_id = doc_id
+
+    def latest(self, at_or_below: Optional[int] = None):
+        result = self._rpc.request(
+            "latest_summary",
+            {"doc": self.doc_id, "at_or_below": at_or_below},
+        )
+        if result is None:
+            return None, 0
+        return tree_from_obj(result["summary"]), result["ref_seq"]
+
+    def upload(self, tree: SummaryTree, ref_seq: int) -> str:
+        return self._rpc.request(
+            "upload_summary",
+            {"doc": self.doc_id, "summary": tree_to_obj(tree),
+             "ref_seq": ref_seq},
+        )
+
+    def read(self, handle: str):
+        return tree_from_obj(self._rpc.request(
+            "read_summary", {"handle": handle}
+        ))
+
+
+class NetworkDocumentServiceFactory:
+    """``IDocumentServiceFactory`` capability over a TCP ordering server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7070,
+                 timeout: float = 30.0) -> None:
+        self._rpc = _RpcClient(host, port, timeout=timeout)
+        self._connections: Dict[str, NetworkConnection] = {}
+
+    def _connection(self, doc_id: str) -> NetworkConnection:
+        conn = self._connections.get(doc_id)
+        if conn is None:
+            conn = NetworkConnection(self._rpc, doc_id)
+            self._connections[doc_id] = conn
+        return conn
+
+    def create_document(self, doc_id: str, initial_summary: SummaryTree,
+                        ref_seq: int = 0):
+        self._rpc.request(
+            "create_document",
+            {"doc": doc_id, "summary": tree_to_obj(initial_summary),
+             "ref_seq": ref_seq},
+        )
+        return self.resolve(doc_id)
+
+    def resolve(self, doc_id: str):
+        if not self._rpc.request("has_document", {"doc": doc_id}):
+            raise KeyError(f"document {doc_id!r} does not exist")
+        from .definitions import DocumentService
+
+        conn = self._connection(doc_id)
+        return DocumentService(
+            doc_id,
+            connection=conn,
+            delta_storage=_RemoteDeltaStorage(conn),
+            storage=_RemoteStorage(self._rpc, doc_id),
+        )
+
+    def close(self) -> None:
+        self._rpc.close()

@@ -61,8 +61,12 @@ class _PackedBatch:
     doc_ids: List[str] = field(default_factory=list)
 
 
-def pack_map_batch(docs: Sequence[MapDocInput]) -> _PackedBatch:
-    """Flatten a multi-document op log into device arrays (host side)."""
+def pack_map_batch(docs: Sequence[MapDocInput],
+                   bucket_floor: int = 64) -> _PackedBatch:
+    """Flatten a multi-document op log into device arrays (host side).
+
+    ``bucket_floor`` sets the minimum flat-array bucket; mesh-sharded
+    callers pass the mesh size so the op axis always splits evenly."""
     keys = Interner()
     values = Interner()
     key_gid, op_seq, is_set, val_idx = [], [], [], []
@@ -96,9 +100,10 @@ def pack_map_batch(docs: Sequence[MapDocInput]) -> _PackedBatch:
             else:
                 raise ValueError(f"unknown map op kind {kind!r}")
 
-    n = next_bucket(max(len(op_seq), 1))
-    m = next_bucket(max(len(clear_seq), 1))
-    g = next_bucket(max(len(keys), 1))
+    floor = max(64, bucket_floor)
+    n = next_bucket(max(len(op_seq), 1), floor=floor)
+    m = next_bucket(max(len(clear_seq), 1), floor=floor)
+    g = next_bucket(max(len(keys), 1), floor=floor)
 
     def pad(lst, size, fill):
         arr = np.full(size, fill, dtype=np.int32)

@@ -169,9 +169,9 @@ def replay_map_sharded(docs, mesh: Optional[Mesh] = None) -> List[SummaryTree]:
         return []
     if mesh is None:
         mesh = doc_mesh()
-    batch = pack_map_batch(docs)
-    # Flat buckets are powers of two >= 64, so they always split evenly
-    # over power-of-two meshes.
+    # Bucket floor = mesh size so the flat op axis splits evenly over
+    # power-of-two meshes of ANY size (buckets otherwise floor at 64).
+    batch = pack_map_batch(docs, bucket_floor=mesh.size)
     shard = NamedSharding(mesh, P(DOC_AXIS))
     replicated = NamedSharding(mesh, P())
 
@@ -188,8 +188,11 @@ def replay_map_sharded(docs, mesh: Optional[Mesh] = None) -> List[SummaryTree]:
     return summaries_from_lww(batch, present, win_val)
 
 
+@functools.lru_cache(maxsize=8)
 def matrix_sharded_replay_step(mesh: Mesh):
-    """Jitted, mesh-sharded matrix fold: the dual-axis permutation streams
+    """Jitted, mesh-sharded matrix fold (cached per mesh — a fresh jit
+    closure every call would recompile identical shapes): the dual-axis
+    permutation streams
     (packed ``[2D, ...]``, two axis rows per matrix) partitioned along the
     doc axis; per-op resolved cell handles are assembled cross-chip for the
     host cell fold — the ICI all-gather."""

@@ -69,6 +69,16 @@ class RawOperation:
             "contents": self.contents,
         }
 
+    @staticmethod
+    def from_dict(d: dict) -> "RawOperation":
+        return RawOperation(
+            client_id=d["clientId"],
+            client_seq=d.get("clientSequenceNumber", -1),
+            ref_seq=d.get("referenceSequenceNumber", 0),
+            type=MessageType(d["type"]),
+            contents=d.get("contents"),
+        )
+
 
 @dataclasses.dataclass
 class SequencedMessage:

@@ -137,3 +137,53 @@ class SummaryStorage:
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
         return self._objects[handle]
+
+
+# -- wire codec (versioned) ----------------------------------------------------
+
+#: Summary wire-format version.  Readers accept any version <= this they
+#: know how to decode; writers always emit the current version.
+SUMMARY_WIRE_VERSION = 1
+
+
+def tree_to_obj(tree: "SummaryTree") -> dict:
+    """SummaryTree -> JSON-safe wire object (version-stamped envelope at the
+    root; blobs are utf-8 text when possible, else base64)."""
+
+    def encode(node):
+        if isinstance(node, SummaryBlob):
+            try:
+                return {"b": node.content.decode("utf-8")}
+            except UnicodeDecodeError:
+                import base64
+
+                return {"b64": base64.b64encode(node.content).decode("ascii")}
+        return {"t": {name: encode(child)
+                      for name, child in node.children.items()}}
+
+    return {"v": SUMMARY_WIRE_VERSION, **encode(tree)}
+
+
+def tree_from_obj(obj: dict) -> "SummaryTree":
+    """Inverse of :func:`tree_to_obj`; refuses versions newer than this
+    reader understands."""
+    version = obj.get("v", 1)
+    if version > SUMMARY_WIRE_VERSION:
+        raise ValueError(
+            f"summary wire version {version} is newer than supported "
+            f"{SUMMARY_WIRE_VERSION}"
+        )
+
+    def decode(node):
+        if "b" in node:
+            return SummaryBlob(node["b"].encode("utf-8"))
+        if "b64" in node:
+            import base64
+
+            return SummaryBlob(base64.b64decode(node["b64"]))
+        tree = SummaryTree()
+        for name, child in node["t"].items():
+            tree.children[name] = decode(child)
+        return tree
+
+    return decode(obj)

@@ -41,7 +41,7 @@ TREE_TYPE = "tree-tpu"
 #: per channel (still inside a device-routed document).
 KERNEL_TYPES = (STRING_TYPE, MAP_TYPE, MATRIX_TYPE, TREE_TYPE)
 
-_EMPTY_DIGESTS: Dict[str, str] = {}
+_EMPTY_DIGESTS: Dict[tuple, str] = {}
 
 
 def _gc_state_empty(summary: SummaryTree) -> bool:
@@ -64,12 +64,15 @@ def _gc_state_empty(summary: SummaryTree) -> bool:
 
 def _empty_digest(registry: ChannelRegistry, type_name: str) -> str:
     """Digest of a fresh, empty channel summary for a type (id-independent:
-    no built-in channel summary embeds its id)."""
-    digest = _EMPTY_DIGESTS.get(type_name)
+    no built-in channel summary embeds its id).  Keyed per registry — two
+    services with different factories for the same type name must not
+    poison each other's cache."""
+    key = (id(registry), type_name)
+    digest = _EMPTY_DIGESTS.get(key)
     if digest is None:
         channel = registry.get(type_name).create("-")
         digest = channel.summarize(0).digest()
-        _EMPTY_DIGESTS[type_name] = digest
+        _EMPTY_DIGESTS[key] = digest
     return digest
 
 
@@ -255,18 +258,31 @@ class CatchupService:
 
     def _host_channel_fold(self, type_name: str, channel_id: str,
                            channel_tree: Optional[SummaryTree],
-                           ops: List[SequencedMessage],
+                           ops: List[SequencedMessage], work: _DocWork,
                            final_msn: int) -> SummaryTree:
-        """Fold one non-kernel channel host-side: load (or create) the DDS,
-        apply its flattened op stream, summarize at the container MSN —
-        byte-identical to what the container runtime would produce."""
+        """Fold one non-kernel channel host-side, byte-identical to what the
+        container runtime would produce: its op stream interleaved with the
+        tail's JOIN/LEAVE (consensus channels re-queue a departed client's
+        held items via ``observe_protocol``) and per-message window
+        advances."""
         factory = self.registry.get(type_name)
         if channel_tree is None:
             channel = factory.create(channel_id)
         else:
             channel = factory.load(channel_id, channel_tree)
-        for msg in ops:
-            channel.process(msg, local=False)
+        by_seq: Dict[int, List[SequencedMessage]] = {}
+        for m in ops:
+            by_seq.setdefault(m.seq, []).append(m)
+        observe = getattr(channel, "observe_protocol", None)
+        advance = getattr(channel, "advance", None)
+        for msg in work.tail:
+            if msg.type in (MessageType.JOIN, MessageType.LEAVE) \
+                    and observe is not None:
+                observe(msg)
+            for m in by_seq.get(msg.seq, []):
+                channel.process(m, local=False)
+            if advance is not None:
+                advance(msg.seq, msg.min_seq)
         return channel.summarize(final_msn)
 
     def _device_fold(self, works: List[_DocWork]) -> List[SummaryTree]:
@@ -296,7 +312,8 @@ class CatchupService:
                 if type_name not in KERNEL_TYPES:
                     self.host_channels += 1
                     host_trees[wi, pi] = self._host_channel_fold(
-                        type_name, channel_id, channel_tree, ops, final_msn
+                        type_name, channel_id, channel_tree, ops, work,
+                        final_msn,
                     )
                 elif type_name == STRING_TYPE:
                     slots[wi, pi] = (STRING_TYPE, len(string_in))

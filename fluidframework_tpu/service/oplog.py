@@ -31,9 +31,11 @@ class OpLog:
     survives process restarts (the crash-resume tests reopen it).
     """
 
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(self, path: Optional[str] = None,
+                 autoflush: bool = False) -> None:
         self._docs: Dict[str, List[SequencedMessage]] = {}
         self._path = path
+        self._autoflush = autoflush
         self._file: Optional[io.TextIOWrapper] = None
         if path is not None:
             if os.path.exists(path):
@@ -58,6 +60,12 @@ class OpLog:
         if self._file is not None:
             rec = {"doc": doc_id, "msg": msg.to_dict()}
             self._file.write(canonical_json(rec).decode("utf-8") + "\n")
+            if self._autoflush:
+                # Durable-before-broadcast: the append rides first in the
+                # sequencer broadcast chain, so flushing here means no
+                # client ever sees an op the log could lose (the
+                # reference's scriptorium-durability property).
+                self.flush()
 
     def flush(self) -> None:
         if self._file is not None:

@@ -1,0 +1,327 @@
+"""The network front door: a TCP ordering server over LocalOrderingService.
+
+Capability-equivalent of the reference's Alfred/Nexus socket ingress plus
+Tinylicious's standalone single-process server (SURVEY.md §2.3; upstream
+paths UNVERIFIED — empty reference mount): clients in OTHER processes speak
+a length-prefixed JSON frame protocol over localhost/LAN TCP to create
+documents, connect, submit ops, receive the sequenced broadcast, exchange
+signals, read delta ranges, and read/write summaries.
+
+Frame protocol (version-stamped; little deliberately, since the payloads
+are the same dicts the in-proc path uses):
+
+    [4-byte big-endian length][json bytes]
+
+    request:   {"v": 1, "id": N, "method": str, "params": {...}}
+    response:  {"v": 1, "re": N, "ok": true, "result": ...}
+               {"v": 1, "re": N, "ok": false, "error": str}
+    event:     {"v": 1, "event": "op"|"signal", "doc": str, ...}
+
+Broadcast ordering guarantee: `subscribe_doc`'s response is written to the
+socket before any subsequent op event for that document (asyncio per-
+connection FIFO), and the deltas snapshot a client then requests rides the
+same socket — so the client sees (response, snapshot, live tail) with any
+overlap deduplicated client-side by the DeltaManager's delivery watermark.
+
+Run standalone (the Tinylicious shape):
+
+    python -m fluidframework_tpu.service.server --port 7070 [--dir path]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import threading
+from typing import Dict, Optional, Set
+
+from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.summary import tree_from_obj, tree_to_obj
+from .orderer import LocalOrderingService
+
+WIRE_VERSION = 1
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 << 20
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    try:
+        payload = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return json.loads(payload)
+
+
+def frame_bytes(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    return _LEN.pack(len(payload)) + payload
+
+
+class _ClientSession:
+    """One TCP connection's server-side state."""
+
+    def __init__(self, server: "OrderingServer",
+                 writer: asyncio.StreamWriter) -> None:
+        self.server = server
+        self.writer = writer
+        self.subscribed_docs: Set[str] = set()
+        self.signal_docs: Set[str] = set()
+        self.connected_clients: Dict[str, str] = {}  # client_id -> doc_id
+        self._fns: Dict[str, tuple] = {}  # doc -> (op_fn, signal_fn)
+
+    #: Disconnect a session whose unread broadcast backlog exceeds this
+    #: (a stalled reader must not grow the server's buffers without bound;
+    #: the client reconnects and backfills from durable storage).
+    WRITE_HIGH_WATER = 32 << 20
+
+    def send(self, obj: dict) -> None:
+        """Thread-safe-ish frame write: always scheduled on the loop."""
+        self.server.loop.call_soon_threadsafe(self._write, obj)
+
+    def _write(self, obj: dict) -> None:
+        if self.writer.is_closing():
+            return
+        transport = self.writer.transport
+        if transport is not None and \
+                transport.get_write_buffer_size() > self.WRITE_HIGH_WATER:
+            # Laggard: drop the connection rather than buffer unboundedly.
+            self.close()
+            self.writer.close()
+            return
+        self.writer.write(frame_bytes(obj))
+
+    # -- broadcast taps --------------------------------------------------------
+
+    def tap(self, doc_id: str) -> None:
+        if doc_id in self.subscribed_docs:
+            return
+        endpoint = self.server.service.endpoint(doc_id)
+
+        def on_op(msg: SequencedMessage) -> None:
+            self.send({"v": WIRE_VERSION, "event": "op", "doc": doc_id,
+                       "msg": msg.to_dict()})
+
+        def on_signal(signal: dict) -> None:
+            target = signal.get("targetClientId")
+            if target is not None and target not in self.connected_clients:
+                return
+            self.send({"v": WIRE_VERSION, "event": "signal", "doc": doc_id,
+                       "signal": signal})
+
+        endpoint.subscribe(on_op)
+        endpoint.subscribe_signals(on_signal)
+        self._fns[doc_id] = (on_op, on_signal)
+        self.subscribed_docs.add(doc_id)
+
+    def close(self) -> None:
+        for doc_id, (op_fn, signal_fn) in self._fns.items():
+            try:
+                endpoint = self.server.service.endpoint(doc_id)
+                endpoint.unsubscribe(op_fn)
+                endpoint.unsubscribe_signals(signal_fn)
+            except KeyError:
+                pass
+        self._fns.clear()
+        for client_id, doc_id in list(self.connected_clients.items()):
+            try:
+                self.server.service.endpoint(doc_id).disconnect(client_id)
+            except KeyError:
+                pass
+        self.connected_clients.clear()
+
+
+class OrderingServer:
+    """Asyncio TCP server exposing a LocalOrderingService to the network."""
+
+    def __init__(self, service: Optional[LocalOrderingService] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.service = service if service is not None else \
+            LocalOrderingService()
+        self.host = host
+        self.port = port
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    # -- request dispatch ------------------------------------------------------
+
+    def _dispatch(self, session: _ClientSession, method: str,
+                  params: dict):
+        service = self.service
+        if method == "create_document":
+            service.create_document(params["doc"])
+            if "summary" in params:
+                service.storage.upload(
+                    params["doc"], tree_from_obj(params["summary"]),
+                    params.get("ref_seq", 0),
+                )
+            return True
+        if method == "has_document":
+            return service.has_document(params["doc"])
+        if method == "subscribe_doc":
+            session.tap(params["doc"])
+            return service.endpoint(params["doc"]).head_seq
+        if method == "connect":
+            endpoint = service.endpoint(params["doc"])
+            endpoint.connect(params["client"], params.get("session"))
+            session.connected_clients[params["client"]] = params["doc"]
+            return True
+        if method == "disconnect":
+            service.endpoint(params["doc"]).disconnect(params["client"])
+            session.connected_clients.pop(params["client"], None)
+            return True
+        if method == "submit":
+            msg = service.endpoint(params["doc"]).submit(
+                RawOperation.from_dict(params["op"])
+            )
+            return msg.to_dict() if msg is not None else None
+        if method == "update_ref_seq":
+            service.endpoint(params["doc"]).update_ref_seq(
+                params["client"], params["ref_seq"]
+            )
+            return True
+        if method == "deltas":
+            msgs = service.endpoint(params["doc"]).deltas(
+                params.get("from_seq", 0), params.get("to_seq")
+            )
+            return [m.to_dict() for m in msgs]
+        if method == "head":
+            return service.endpoint(params["doc"]).head_seq
+        if method == "signal":
+            service.endpoint(params["doc"]).submit_signal(
+                params["client"], params.get("content"),
+                params.get("target"),
+            )
+            return True
+        if method == "latest_summary":
+            tree, ref_seq = service.storage.latest(
+                params["doc"], at_or_below=params.get("at_or_below")
+            )
+            if tree is None:
+                return None
+            return {"summary": tree_to_obj(tree), "ref_seq": ref_seq}
+        if method == "upload_summary":
+            return service.storage.upload(
+                params["doc"], tree_from_obj(params["summary"]),
+                params["ref_seq"],
+            )
+        if method == "read_summary":
+            node = service.storage.read(params["handle"])
+            return tree_to_obj(node)
+        if method == "ping":
+            return "pong"
+        raise ValueError(f"unknown method {method!r}")
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        session = _ClientSession(self, writer)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                if frame.get("v", 1) > WIRE_VERSION:
+                    response = {"v": WIRE_VERSION, "re": frame.get("id"),
+                                "ok": False,
+                                "error": f"unsupported wire version "
+                                         f"{frame.get('v')}"}
+                else:
+                    try:
+                        result = self._dispatch(
+                            session, frame.get("method"),
+                            frame.get("params", {}),
+                        )
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": True, "result": result}
+                    except Exception as exc:  # surfaced to the client
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": False, "error": str(exc)}
+                session._write(response)
+                await writer.drain()
+        finally:
+            session.close()
+            writer.close()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start_in_thread(self) -> threading.Thread:
+        """Run the server on a daemon thread (tests, embedded use);
+        returns once the port is bound."""
+        started = threading.Event()
+
+        async def _run():
+            await self.start()
+            started.set()
+            async with self._server:
+                await self._server.serve_forever()
+
+        thread = threading.Thread(
+            target=lambda: asyncio.run(_run()), daemon=True
+        )
+        thread.start()
+        started.wait(timeout=10)
+        return thread
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Standalone ordering server (Tinylicious capability)"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7070)
+    parser.add_argument(
+        "--dir", default=None,
+        help="persist the op log AND summary store under this directory "
+             "(documents survive server restarts)",
+    )
+    args = parser.parse_args(argv)
+
+    oplog = storage = None
+    if args.dir:
+        import os
+
+        from ..drivers.file_driver import FileSummaryStorage
+        from .oplog import OpLog
+
+        os.makedirs(args.dir, exist_ok=True)
+        oplog = OpLog(path=os.path.join(args.dir, "oplog.ndjson"),
+                      autoflush=True)
+        storage = FileSummaryStorage(os.path.join(args.dir, "summaries"))
+    service = LocalOrderingService(oplog=oplog, storage=storage)
+    server = OrderingServer(service, host=args.host, port=args.port)
+
+    async def _run():
+        await server.start()
+        print(f"ordering server listening on {server.host}:{server.port}",
+              flush=True)
+        async with server._server:
+            await server._server.serve_forever()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()
