@@ -22,15 +22,12 @@ import itertools
 import json
 import queue
 import socket
-import struct
 import threading
 from typing import Callable, Dict, List, Optional
 
 from ..protocol.messages import RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
-
-WIRE_VERSION = 1
-_LEN = struct.Struct(">I")
+from ..protocol.wire import LEN as _LEN, WIRE_VERSION
 
 
 class RpcError(RuntimeError):
@@ -62,22 +59,19 @@ class _RpcClient:
 
     def _read_loop(self) -> None:
         try:
-            buf = b""
+            # Buffered file interface: exact-size reads without quadratic
+            # bytes-concatenation on large frames (big summaries).
+            rfile = self._sock.makefile("rb")
+
+            def read_exact(n: int) -> bytes:
+                data = rfile.read(n)
+                if data is None or len(data) != n:
+                    raise ConnectionError("server closed")
+                return data
+
             while True:
-                while len(buf) < _LEN.size:
-                    chunk = self._sock.recv(65536)
-                    if not chunk:
-                        raise ConnectionError("server closed")
-                    buf += chunk
-                (length,) = _LEN.unpack(buf[:_LEN.size])
-                buf = buf[_LEN.size:]
-                while len(buf) < length:
-                    chunk = self._sock.recv(65536)
-                    if not chunk:
-                        raise ConnectionError("server closed")
-                    buf += chunk
-                frame = json.loads(buf[:length])
-                buf = buf[length:]
+                (length,) = _LEN.unpack(read_exact(_LEN.size))
+                frame = json.loads(read_exact(length))
                 if "re" in frame:
                     with self._pending_lock:
                         slot = self._pending.pop(frame["re"], None)
@@ -113,13 +107,25 @@ class _RpcClient:
         slot: queue.Queue = queue.Queue(maxsize=1)
         with self._pending_lock:
             self._pending[rid] = slot
+        if self._closed:
+            # The reader died between the first check and slot
+            # registration; its drain may have run already — fail fast
+            # instead of waiting out the timeout on a dead socket.
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise RpcError("connection lost")
         payload = json.dumps(
             {"v": WIRE_VERSION, "id": rid, "method": method,
              "params": params},
             separators=(",", ":"),
         ).encode("utf-8")
-        with self._write_lock:
-            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        try:
+            with self._write_lock:
+                self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        except OSError as exc:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise RpcError(f"send failed: {exc}")
         try:
             frame = slot.get(timeout=self._timeout)
         except queue.Empty:

@@ -10,6 +10,7 @@ replication sharding constraint.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -40,8 +41,10 @@ def doc_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.asarray(devices), (DOC_AXIS,))
 
 
+@functools.lru_cache(maxsize=8)
 def sharded_replay_step(mesh: Mesh):
-    """Build the jitted, mesh-sharded full replay step.
+    """Build the jitted, mesh-sharded full replay step (cached per mesh —
+    a fresh jit closure every call would recompile identical shapes).
 
     Returns ``step(state, ops) -> (final_state, lengths)`` where the fold is
     partitioned along the doc axis and ``lengths`` (per-doc visible length —
@@ -125,9 +128,6 @@ def replay_mergetree_sharded(
     return partition_replay(
         docs, known_oracle_fallback, oracle_fallback_summary, fold_batch
     )
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=64)
@@ -265,8 +265,10 @@ def replay_matrix_sharded(
     )
 
 
+@functools.lru_cache(maxsize=8)
 def tree_sharded_replay_step(mesh: Mesh):
-    """Jitted, mesh-sharded tree replay step: the edit-fold partitioned
+    """Jitted, mesh-sharded tree replay step (cached per mesh): the
+    edit-fold partitioned
     along the doc axis; per-doc overflow flags (the host needs every one to
     route fallbacks) assembled cross-chip — the ICI all-gather."""
     from ..ops.tree_kernel import TreeEdits, TreeState

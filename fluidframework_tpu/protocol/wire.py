@@ -1,0 +1,21 @@
+"""Shared wire-protocol constants and framing for the TCP server/driver.
+
+One definition point so a protocol bump can never ship a client/server
+pair that disagree on the version they stamp/accept.
+
+Frame layout: [4-byte big-endian length][json bytes].
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+WIRE_VERSION = 1
+LEN = struct.Struct(">I")
+MAX_FRAME = 256 << 20
+
+
+def frame_bytes(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    return LEN.pack(len(payload)) + payload

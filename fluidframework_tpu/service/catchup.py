@@ -41,7 +41,11 @@ TREE_TYPE = "tree-tpu"
 #: per channel (still inside a device-routed document).
 KERNEL_TYPES = (STRING_TYPE, MAP_TYPE, MATRIX_TYPE, TREE_TYPE)
 
-_EMPTY_DIGESTS: Dict[tuple, str] = {}
+import weakref
+
+#: registry -> {type_name: empty digest}; weak keys so a dropped registry
+#: frees its entries and a recycled address can never serve stale digests.
+_EMPTY_DIGESTS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def _gc_state_empty(summary: SummaryTree) -> bool:
@@ -64,15 +68,15 @@ def _gc_state_empty(summary: SummaryTree) -> bool:
 
 def _empty_digest(registry: ChannelRegistry, type_name: str) -> str:
     """Digest of a fresh, empty channel summary for a type (id-independent:
-    no built-in channel summary embeds its id).  Keyed per registry — two
-    services with different factories for the same type name must not
-    poison each other's cache."""
-    key = (id(registry), type_name)
-    digest = _EMPTY_DIGESTS.get(key)
+    no built-in channel summary embeds its id).  Cached per registry OBJECT
+    (weakly) — two services with different factories for the same type name
+    must not poison each other's cache."""
+    per_registry = _EMPTY_DIGESTS.setdefault(registry, {})
+    digest = per_registry.get(type_name)
     if digest is None:
         channel = registry.get(type_name).create("-")
         digest = channel.summarize(0).digest()
-        _EMPTY_DIGESTS[key] = digest
+        per_registry[type_name] = digest
     return digest
 
 

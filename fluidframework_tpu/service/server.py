@@ -32,17 +32,13 @@ from __future__ import annotations
 
 import asyncio
 import json
-import struct
 import threading
 from typing import Dict, Optional, Set
 
 from ..protocol.messages import RawOperation, SequencedMessage
 from ..protocol.summary import tree_from_obj, tree_to_obj
+from ..protocol.wire import LEN as _LEN, MAX_FRAME, WIRE_VERSION, frame_bytes
 from .orderer import LocalOrderingService
-
-WIRE_VERSION = 1
-_LEN = struct.Struct(">I")
-MAX_FRAME = 256 << 20
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
@@ -58,11 +54,6 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
     return json.loads(payload)
-
-
-def frame_bytes(obj: dict) -> bytes:
-    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    return _LEN.pack(len(payload)) + payload
 
 
 class _ClientSession:

@@ -31,6 +31,27 @@ from fluidframework_tpu.service.server import OrderingServer
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _spawn_server(port, *extra_args):
+    """Start the standalone server subprocess and wait for its 'listening'
+    marker, skipping any warning lines other libraries print first."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.server",
+         "--port", str(port), *extra_args],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "listening" in line:
+            return proc
+        if line == "" and proc.poll() is not None:
+            break
+    proc.terminate()
+    raise AssertionError("server never reported listening")
+
+
 @pytest.fixture()
 def server():
     srv = OrderingServer(port=0)
@@ -165,14 +186,8 @@ def test_multiprocess_convergence(tmp_path):
     probe.close()
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    server_proc = subprocess.Popen(
-        [sys.executable, "-m", "fluidframework_tpu.service.server",
-         "--port", str(port)],
-        cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
+    server_proc = _spawn_server(port)
     try:
-        assert "listening" in server_proc.stdout.readline()
         clients = [
             subprocess.Popen(
                 [sys.executable, "-c",
@@ -233,19 +248,7 @@ def test_standalone_server_restart_recovers_documents(tmp_path):
     probe.bind(("127.0.0.1", 0))
     port = probe.getsockname()[1]
     probe.close()
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-
-    def start():
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "fluidframework_tpu.service.server",
-             "--port", str(port), "--dir", str(tmp_path)],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        assert "listening" in proc.stdout.readline()
-        return proc
-
-    proc = start()
+    proc = _spawn_server(port, "--dir", str(tmp_path))
     try:
         c = Loader(NetworkDocumentServiceFactory(port=port)).create(
             "persisted", "alice",
@@ -259,7 +262,7 @@ def test_standalone_server_restart_recovers_documents(tmp_path):
         proc.terminate()
         proc.wait(timeout=10)
 
-    proc = start()
+    proc = _spawn_server(port, "--dir", str(tmp_path))
     try:
         fresh = Loader(NetworkDocumentServiceFactory(port=port)) \
             .resolve("persisted")
