@@ -27,7 +27,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..protocol.messages import RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
-from ..protocol.wire import LEN as _LEN, WIRE_VERSION
+from ..protocol.wire import LEN as _LEN, WIRE_VERSION, frame_bytes
 
 
 class RpcError(RuntimeError):
@@ -114,14 +114,13 @@ class _RpcClient:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise RpcError("connection lost")
-        payload = json.dumps(
+        frame = frame_bytes(
             {"v": WIRE_VERSION, "id": rid, "method": method,
-             "params": params},
-            separators=(",", ":"),
-        ).encode("utf-8")
+             "params": params}
+        )
         try:
             with self._write_lock:
-                self._sock.sendall(_LEN.pack(len(payload)) + payload)
+                self._sock.sendall(frame)
         except OSError as exc:
             with self._pending_lock:
                 self._pending.pop(rid, None)

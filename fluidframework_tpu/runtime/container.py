@@ -31,6 +31,7 @@ from typing import Deque, Dict, List, Optional
 import base64
 
 from ..protocol.messages import MessageType, RawOperation, SequencedMessage
+from ..protocol.quorum import QuorumProposals
 from ..protocol.summary import SummaryTree, canonical_json
 from .blobs import BlobManager
 from .datastore import FluidDataStoreRuntime
@@ -97,6 +98,10 @@ class ContainerRuntime:
         self._outbox: List[dict] = []
         self._batching = 0
         self.election = OrderedClientElection()  # quorum, join-ordered
+        # Propose/accept protocol state (code details etc.): pending until
+        # the MSN passes the proposal seq, then committed — identically on
+        # every replica (protocol/quorum.py).
+        self.quorum_proposals = QuorumProposals()
         self.message_observers: List = []  # fn(msg) after each message
         # Distributed id compression: locals mint free; creation ranges
         # ride outbound batches and finalize identically on every client.
@@ -333,6 +338,7 @@ class ContainerRuntime:
         self.ref_seq = max(self.ref_seq, msg.seq)
         self.min_seq = max(self.min_seq, msg.min_seq)
         self.election.observe(msg)
+        self.quorum_proposals.observe(msg)
         contents = msg.contents
         if msg.type is MessageType.OP and isinstance(contents, dict):
             if contents.get("type") == "chunk":
@@ -422,16 +428,54 @@ class ContainerRuntime:
             ds.resubmit_pending()
         self.flush()
 
+    # -- quorum proposals ------------------------------------------------------
+
+    def propose(self, key: str, value) -> None:
+        """Submit a quorum proposal (code details etc.).  It sequences like
+        any op, stays pending until the MSN passes its seq, then commits on
+        every replica (``quorum_proposals.get(key)``).  An unsequenced
+        proposal dropped by a reconnect is NOT resubmitted — proposals are
+        idempotent to re-propose, and the reference likewise rejects
+        in-flight proposals on connection loss.
+
+        client_seq ordering: the outbox flushes FIRST so held channel ops
+        take their (lower) client_seqs before the proposal — a proposal
+        jumping the queue would advance the sequencer's dedup floor and
+        silently drop the later batch flush.  For the same reason proposing
+        inside ``order_sequentially`` or while unable to send refuses."""
+        if self._service is None or self.client_id is None:
+            raise RuntimeError("propose requires a connected container")
+        if self._batching:
+            raise RuntimeError("cannot propose inside order_sequentially")
+        if not getattr(self._service, "can_send", True):
+            raise ConnectionError(
+                "cannot propose while disconnected or read-only"
+            )
+        self.flush()
+        self._client_seq += 1
+        raw = RawOperation(
+            client_id=self.client_id,
+            client_seq=self._client_seq,
+            ref_seq=self.ref_seq,
+            type=MessageType.PROPOSAL,
+            contents={"key": key, "value": value},
+        )
+        self._pending_wire.append((raw, None))
+        self._drain_wire()
+
     # -- summaries -------------------------------------------------------------
 
     def summarize(self) -> SummaryTree:
         tree = SummaryTree()
         meta = {"seq": self.ref_seq, "minSeq": self.min_seq}
         tree.add_blob(".metadata", canonical_json(meta))
-        # Protocol state: the quorum snapshot (new clients can't replay
+        # Protocol state: quorum membership + propose/accept state (new
         # pre-summary JOINs — the log below the summary is collectible).
         tree.add_blob(
-            ".protocol", canonical_json({"quorum": self.election.quorum})
+            ".protocol", canonical_json({
+                "proposals": self.quorum_proposals.serialize(),
+                "quorum": self.election.quorum,
+            })
         )
         tree.add_blob(
             ".idCompressor", canonical_json(self.id_compressor.serialize())
@@ -462,6 +506,10 @@ class ContainerRuntime:
         self.min_seq = meta["minSeq"]
         protocol = json.loads(summary.blob_bytes(".protocol"))
         self.election._order = list(protocol["quorum"])
+        # Missing key = an N-1 summary written before proposals existed.
+        self.quorum_proposals = QuorumProposals.deserialize(
+            protocol.get("proposals")
+        )
         if ".idCompressor" in summary.children:
             self.id_compressor = IdCompressor.deserialize(
                 json.loads(summary.blob_bytes(".idCompressor"))

@@ -357,13 +357,14 @@ class CatchupService:
         for wi, work in enumerate(works):
             final_seq = work.tail[-1].seq
             final_msn = max(m.min_seq for m in work.tail)
-            quorum = self._fold_quorum(work)
             tree = SummaryTree()
             tree.add_blob(
                 ".metadata",
                 canonical_json({"seq": final_seq, "minSeq": final_msn}),
             )
-            tree.add_blob(".protocol", canonical_json({"quorum": quorum}))
+            tree.add_blob(
+                ".protocol", canonical_json(self._fold_protocol(work))
+            )
             tree.add_blob(
                 ".idCompressor",
                 canonical_json(self._fold_id_compressor(work)),
@@ -414,9 +415,15 @@ class CatchupService:
                 comp.finalize_range(batch["idRange"])
         return comp.serialize()
 
-    def _fold_quorum(self, work: _DocWork) -> List[str]:
+    def _fold_protocol(self, work: _DocWork) -> dict:
+        """Replay the tail over the prior protocol state: quorum membership
+        (JOIN/LEAVE) and propose/accept (PROPOSAL + MSN advancement) — the
+        exact fold ContainerRuntime.process performs."""
+        from ..protocol.quorum import QuorumProposals
+
         protocol = json.loads(work.summary.blob_bytes(".protocol"))
         order: List[str] = list(protocol["quorum"])
+        proposals = QuorumProposals.deserialize(protocol.get("proposals"))
         for msg in work.tail:
             if msg.type is MessageType.JOIN:
                 cid = msg.contents["clientId"]
@@ -426,4 +433,5 @@ class CatchupService:
                 cid = msg.contents["clientId"]
                 if cid in order:
                     order.remove(cid)
-        return order
+            proposals.observe(msg)
+        return {"proposals": proposals.serialize(), "quorum": order}

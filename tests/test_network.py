@@ -41,8 +41,15 @@ def _spawn_server(port, *extra_args):
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
+    import select
+
     deadline = time.time() + 30
     while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if not ready:
+            if proc.poll() is not None:
+                break
+            continue
         line = proc.stdout.readline()
         if "listening" in line:
             return proc

@@ -1,0 +1,190 @@
+"""Quorum proposals: propose/accept over the sequenced stream.
+
+Acceptance rule (protocol/quorum.py): a proposal sequenced at S commits
+when the MSN reaches S.  These tests drive real runtimes through the
+ordering service: convergence under concurrent proposers, survival across
+summarize/reload, and byte-parity of the catch-up service's protocol fold.
+"""
+
+import random
+
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service import LocalOrderingService
+from fluidframework_tpu.service.catchup import CatchupService
+
+
+def _connected(service, doc_id, client_id, with_text=True):
+    if not service.has_document(doc_id):
+        ep = service.create_document(doc_id)
+    else:
+        ep = service.endpoint(doc_id)
+    rt = ContainerRuntime()
+    if with_text:
+        rt.create_datastore("ds").create_channel("sequence-tpu", "text")
+    rt.connect(ep, client_id)
+    rt.drain()
+    return rt, ep
+
+
+def _pump(runtimes, rounds=2):
+    """Everyone submits a trivial op (advancing their ref_seq at the
+    sequencer) and drains — the MSN catches up to the head."""
+    for _ in range(rounds):
+        for rt in runtimes:
+            text = rt.get_datastore("ds").get_channel("text")
+            text.insert_text(len(text.text), ".")
+        for rt in runtimes:
+            rt.drain()
+
+
+def test_proposal_accepts_when_msn_passes():
+    service = LocalOrderingService()
+    a, ep = _connected(service, "doc", "alice")
+    b, _ = _connected(service, "doc", "bob")
+    a.drain()
+    b.drain()
+
+    a.propose("code", {"package": "app", "version": "2.0"})
+    a.drain()
+    b.drain()
+    # sequenced but pending: bob's ref_seq hasn't passed the proposal yet
+    assert not a.quorum_proposals.has("code")
+    assert a.quorum_proposals.pending()
+
+    _pump([a, b])
+    assert a.quorum_proposals.get("code") == \
+        b.quorum_proposals.get("code") == \
+        {"package": "app", "version": "2.0"}
+    assert not a.quorum_proposals.pending()
+
+
+def test_concurrent_proposers_converge_to_the_later_seq():
+    service = LocalOrderingService()
+    a, _ = _connected(service, "doc", "alice")
+    b, _ = _connected(service, "doc", "bob")
+    a.drain()
+    b.drain()
+
+    # Both propose before either drains: both sequence; the later seq wins
+    # the final value on every replica.
+    a.propose("code", "A")
+    b.propose("code", "B")
+    a.drain()
+    b.drain()
+    _pump([a, b])
+    assert a.quorum_proposals.get("code") == b.quorum_proposals.get("code")
+    # sequence order decided it: whichever proposal sequenced second
+    assert a.quorum_proposals.get("code") in ("A", "B")
+    assert a.summarize().digest() == b.summarize().digest()
+
+
+def test_pending_proposal_survives_summarize_and_reload():
+    service = LocalOrderingService()
+    a, ep = _connected(service, "doc", "alice")
+    b, _ = _connected(service, "doc", "bob")
+    a.drain()
+    b.drain()
+    a.propose("flag", 7)
+    a.drain()
+    b.drain()
+    assert a.quorum_proposals.pending()  # MSN still behind
+
+    snapshot = a.summarize()
+    loaded = ContainerRuntime()
+    loaded_seq = loaded.load(snapshot)
+    assert loaded.quorum_proposals.pending() == a.quorum_proposals.pending()
+
+    # the live replicas advance the MSN; the loaded one replays the tail
+    _pump([a, b])
+    for msg in ep.deltas(from_seq=loaded_seq):
+        loaded.process(msg)
+    assert loaded.quorum_proposals.get("flag") == 7
+    assert a.quorum_proposals.get("flag") == 7
+    assert loaded.summarize().digest() == a.summarize().digest()
+
+
+def test_catchup_service_folds_proposals_byte_identically():
+    service = LocalOrderingService()
+    a, _ = _connected(service, "doc", "alice")
+    b, _ = _connected(service, "doc", "bob")
+    a.drain()
+    b.drain()
+    service.storage.upload("doc", a.summarize(), a.ref_seq)
+
+    a.propose("code", {"v": 1})
+    a.drain()
+    b.drain()
+    _pump([a, b])
+    b.propose("pending-key", "still-pending")  # stays pending in the tail
+    a.drain()
+    b.drain()
+
+    svc = CatchupService(service)
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None
+    assert svc.catch_up(upload=False) == cpu.catch_up(upload=False)
+    assert svc.device_docs == 1
+
+
+def test_fuzzed_proposals_converge(seed=1234):
+    """Randomized interleaving of proposals and edits from 3 clients:
+    every replica ends with the same accepted values and byte-identical
+    summaries."""
+    rng = random.Random(seed)
+    service = LocalOrderingService()
+    runtimes = []
+    for i in range(3):
+        rt, _ = _connected(service, "doc", f"client{i}")
+        runtimes.append(rt)
+    for rt in runtimes:
+        rt.drain()
+
+    keys = ["code", "theme", "limit"]
+    for step in range(60):
+        rt = rng.choice(runtimes)
+        if rng.random() < 0.3:
+            rt.propose(rng.choice(keys), rng.randint(0, 99))
+        else:
+            text = rt.get_datastore("ds").get_channel("text")
+            text.insert_text(rng.randint(0, len(text.text)), "x")
+        if rng.random() < 0.5:
+            for r in runtimes:
+                r.drain()
+    _pump(runtimes, rounds=3)
+
+    accepted = [rt.quorum_proposals.accepted() for rt in runtimes]
+    assert accepted[0] == accepted[1] == accepted[2]
+    assert accepted[0], "fuzz run must accept at least one proposal"
+    digests = {rt.summarize().digest() for rt in runtimes}
+    assert len(digests) == 1
+
+
+def test_propose_does_not_jump_the_outbox_queue():
+    """A proposal submitted while channel ops sit unflushed must not take a
+    later client_seq and sequence first — the sequencer's dedup floor would
+    silently drop the batch when it finally flushed (review-found).  The
+    outbox flushes before the proposal, and proposing inside an atomic
+    batch refuses."""
+    import pytest
+
+    service = LocalOrderingService()
+    a, ep = _connected(service, "doc", "alice")
+    b, _ = _connected(service, "doc", "bob")
+    a.drain()
+    b.drain()
+
+    with pytest.raises(RuntimeError):
+        with a.order_sequentially():
+            a.propose("code", "nope")
+
+    # batched edit + proposal: the edit must survive sequencing
+    with a.order_sequentially():
+        a.get_datastore("ds").get_channel("text").insert_text(0, "batched")
+    a.propose("code", "v2")
+    a.drain()
+    b.drain()
+    _pump([a, b])
+    assert a.quorum_proposals.get("code") == "v2"
+    assert b.get_datastore("ds").get_channel("text").text.startswith("batched") or \
+        "batched" in b.get_datastore("ds").get_channel("text").text
+    assert a.summarize().digest() == b.summarize().digest()
