@@ -32,7 +32,23 @@ agree with every remote replica's placement.
 overlapping removers are recorded in ``overlap_removers`` (their views must
 still see the segment as removed).  A pending local removal loses its claim to
 an earlier-sequenced remote remove.  Concurrent inserts into a concurrently
-removed range survive (no obliterate yet — matches reference default).
+removed range survive.
+
+**Obliterate** — removes the range *and wins against concurrent inserts*
+(the reference's obliterateRange).  Every slot the obliterate covers —
+visible segments, existing tombstones, and invisible concurrent inserts
+strictly inside the range — accumulates a ``{seq: client}`` STAMP (stamps
+are a set: overlapping obliterates all record, monotonically, which makes
+every arrival verdict stable once computable).  An insert dies on arrival
+iff its tie-break position lands strictly between two slots sharing a
+stamp the inserter had not seen (``stamp seq > ref_seq``) from another
+client; the EARLIEST such shared stamp becomes its remover.  Endpoint
+inserts survive.  Obliterate-killed segments take ``removed_seq <`` their
+own insert seq, so no sequenced view ever shows them; tombstone expiry
+therefore also waits for ``insert_seq <= min_seq`` and every stamp
+``<= min_seq`` — an active obliterate's tombstones must survive
+summarize/reload for tail inserts to resolve against (records carry
+``ob`` stamp lists while in-window).
 
 **Zamboni** — once the collaboration window floor (``min_seq``) passes a
 tombstone's ``removed_seq``, no future op's view can distinguish it, so it is
@@ -63,6 +79,8 @@ class Segment:
         "removed_client",
         "overlap_removers",
         "pending_overlap",
+        "ob_stamps",
+        "pending_ref",
         "props",
         "pending_props",
         "pending_groups",
@@ -90,6 +108,15 @@ class Segment:
         # earlier-sequenced remote remove waits here until its ack.
         self.overlap_removers: Set[str] = set()
         self.pending_overlap: Set[str] = set()
+        # SEQUENCED obliterate stamps covering this slot: {seq: client}.
+        # Monotone (stamps only accumulate) — the obliterate-on-arrival
+        # verdict for concurrent inserts never flips once computable.
+        # Pending local obliterates stamp at their ack, not before.
+        self.ob_stamps: Dict[int, str] = {}
+        # For a pending (UNASSIGNED) insert: the channel seq its author had
+        # processed at submit time — the ref_seq its sequenced op will carry
+        # (the arrival-verdict prediction compares stamps against it).
+        self.pending_ref: int = 0
         self.props: Dict[str, Any] = dict(props) if props else {}
         self.pending_props: Dict[str, int] = {}
         self.pending_groups: List["SegmentGroup"] = []
@@ -230,6 +257,8 @@ class MergeTreeOracle:
         right.removed_client = seg.removed_client
         right.overlap_removers = set(seg.overlap_removers)
         right.pending_overlap = set(seg.pending_overlap)
+        right.ob_stamps = dict(seg.ob_stamps)
+        right.pending_ref = seg.pending_ref
         right.props = dict(seg.props)
         right.pending_props = dict(seg.pending_props)
         seg.text = seg.text[:offset]
@@ -317,10 +346,74 @@ class MergeTreeOracle:
     ) -> Segment:
         idx = self._insert_index(pos, ref_seq, client)
         seg = Segment(text, seq, client, props)
+        if seq == UNASSIGNED_SEQ:
+            seg.pending_ref = self.current_seq
+        # Obliterate-on-arrival: the insert dies iff it lands strictly
+        # between two slots stamped by the SAME obliterate the inserter had
+        # not seen (ob_seq > ref_seq).  Endpoint inserts (an unstamped or
+        # differently-stamped neighbor on either side) survive.
+        if seq != UNASSIGNED_SEQ:
+            self._arrival_obliterate(seg, idx, idx, ref_seq, client)
         self.segments.insert(idx, seg)
         if group is not None:
             group.add(seg)
         return seg
+
+    def _arrival_obliterate(self, seg: Segment, left_idx: int,
+                            right_idx: int, ref_seq: int,
+                            client: str) -> bool:
+        """The obliterate-on-arrival neighbor rule for a sequenced insert:
+        scan outward to the nearest SEQUENCED slots (replica-local pending
+        segments differ across replicas and must not decide a sequenced
+        verdict); kill iff the two share a stamp the inserter had not seen
+        (``> ref_seq``) from another client — the insert landed strictly
+        inside that obliterate's range.  The EARLIEST shared killer stamp
+        becomes the remover (deterministic on every replica).  ``left_idx``
+        is the exclusive upper bound of the left scan; ``right_idx`` the
+        inclusive start of the right scan (pre-insert indices on the apply
+        path, post-insert on the ack path)."""
+        left = right = None
+        for j in range(left_idx - 1, -1, -1):
+            if self.segments[j].insert_seq != UNASSIGNED_SEQ:
+                left = self.segments[j]
+                break
+        for j in range(right_idx, len(self.segments)):
+            if self.segments[j].insert_seq != UNASSIGNED_SEQ:
+                right = self.segments[j]
+                break
+        if left is None or right is None:
+            return False
+        killers = [
+            s for s, c in left.ob_stamps.items()
+            if s > ref_seq and c != client and s in right.ob_stamps
+        ]
+        if not killers:
+            return False
+        s = min(killers)
+        seg.removed_seq = s
+        seg.removed_client = left.ob_stamps[s]
+        seg.ob_stamps[s] = left.ob_stamps[s]
+        return True
+
+    def _mark_removed(self, seg: Segment, seq: int, client: str) -> None:
+        """First-wins removal bookkeeping shared by remove and obliterate."""
+        if seg.removed_seq is None:
+            seg.removed_seq = seq
+            seg.removed_client = client
+        elif seg.removed_seq == UNASSIGNED_SEQ:
+            # A pending local removal loses to this earlier-sequenced
+            # remove; demote the pending remover to a *pending* overlap
+            # remover (not summary-visible until its own op sequences).
+            if seq != UNASSIGNED_SEQ:
+                seg.pending_overlap.add(seg.removed_client)
+                seg.removed_seq = seq
+                seg.removed_client = client
+            # (seq == UNASSIGNED here is impossible: a pending-removed
+            # segment is invisible to the local view.)
+        else:
+            # seq is always assigned here: a locally-pending remove can
+            # only target view-visible (not-yet-removed) segments.
+            seg.overlap_removers.add(client)
 
     def apply_remove(
         self,
@@ -332,27 +425,119 @@ class MergeTreeOracle:
         group: Optional[SegmentGroup] = None,
     ) -> None:
         for seg in self._walk_range(start, end, ref_seq, client):
-            if seg.removed_seq is None:
-                seg.removed_seq = seq
-                seg.removed_client = client
-            elif seg.removed_seq == UNASSIGNED_SEQ:
-                # A pending local removal loses to this earlier-sequenced
-                # remove; demote the pending remover to a *pending* overlap
-                # remover (not summary-visible until its own op sequences).
-                if seq != UNASSIGNED_SEQ:
-                    seg.pending_overlap.add(seg.removed_client)
-                    seg.removed_seq = seq
-                    seg.removed_client = client
-                # (seq == UNASSIGNED here is impossible: a pending-removed
-                # segment is invisible to the local view.)
-            else:
-                # seq is always assigned here: a locally-pending remove can
-                # only target view-visible (not-yet-removed) segments.
-                seg.overlap_removers.add(client)
+            self._mark_removed(seg, seq, client)
             if seq != UNASSIGNED_SEQ:
                 self._slide_refs(seg)
             if group is not None:
                 group.add(seg)
+
+    def apply_obliterate(
+        self,
+        start: int,
+        end: int,
+        seq: int,
+        client: str,
+        ref_seq: int,
+        group: Optional[SegmentGroup] = None,
+    ) -> None:
+        """Remove the view range [start, end) AND stamp every covered slot
+        so concurrent inserts into the range die on arrival (see the module
+        docstring).  The removal bookkeeping is identical to apply_remove;
+        the stamp additionally lands on already-tombstoned slots and on
+        invisible concurrent inserts strictly inside the range."""
+        if start >= end:
+            return
+        # Pass 1: visible coverage — remove + stamp (the _walk_range split
+        # bookkeeping is shared with remove).
+        for seg in self._walk_range(start, end, ref_seq, client):
+            self._mark_removed(seg, seq, client)
+            if seq != UNASSIGNED_SEQ:
+                seg.ob_stamps[seq] = client
+                self._slide_refs(seg)
+            if group is not None:
+                group.add(seg)
+        # Pass 2: zero-width slots strictly inside the range.  A pending
+        # local obliterate defers this pass to its ack (the stamp cannot be
+        # compared against ref_seqs until it sequences).
+        if seq != UNASSIGNED_SEQ:
+            self._obliterate_zero_width(start, end, seq, client, ref_seq)
+            self.current_seq = max(self.current_seq, seq)
+
+    def _obliterate_zero_width(self, start: int, end: int, seq: int,
+                               client: str, ref_seq: int) -> None:
+        """Stamp zero-width slots strictly inside the obliterated view
+        range: existing tombstones (stamp only) and invisible concurrent
+        inserts (remove + stamp)."""
+        c = 0
+        for seg in self.segments:
+            # Bounded fold view: removals made BY THIS OP (seq == this op,
+            # not < it) stay visible, so positions here match the pristine
+            # view every remote resolves the range in — the op's own pass-1
+            # removals must not collapse the walk (fuzz-found).
+            v = self._visible_len(seg, ref_seq, client, up_to_seq=seq)
+            if v == 0 and start < c < end \
+                    and seg.insert_seq != UNASSIGNED_SEQ:
+                # Sequenced zero-width slots strictly inside: existing
+                # tombstones (stamp only) and invisible concurrent inserts
+                # (remove + stamp).
+                if seg.removed_seq is None or \
+                        seg.removed_seq == UNASSIGNED_SEQ:
+                    self._mark_removed(seg, seq, client)
+                    self._slide_refs(seg)
+                seg.ob_stamps[seq] = client
+            c += v
+        # OUR OWN un-acked inserts (only the author's replica holds
+        # UNASSIGNED segments) are killed by remote replicas via the
+        # ARRIVAL NEIGHBOR RULE when they sequence — predict that verdict
+        # now with the same rule, or later local ops would count text no
+        # remote view contains.  (Position-in-range is NOT the rule: the
+        # fold view can collapse concurrent removals and put a pending
+        # segment "inside" a range whose arrival neighbors are unstamped —
+        # fuzz-found.)  The verdict is stable from this moment: anything
+        # that later lands between a same-stamped pair dies and keeps the
+        # pair's stamp, so a pending segment's neighbor verdict never
+        # changes before its ack.
+        self._predict_pending_kills()
+
+    def _predict_pending_kills(self) -> None:
+        """Re-evaluate the arrival verdict for every OWN pending insert."""
+        for idx, seg in enumerate(self.segments):
+            if seg.insert_seq != UNASSIGNED_SEQ:
+                continue
+            if seg.removed_seq is not None and \
+                    seg.removed_seq != UNASSIGNED_SEQ:
+                continue  # already sequenced-dead
+            pending_remover = None
+            if seg.removed_seq == UNASSIGNED_SEQ:
+                pending_remover = seg.removed_client
+                seg.removed_seq = None  # let the rule decide cleanly
+                seg.removed_client = None
+            if self._arrival_obliterate(seg, idx, idx + 1,
+                                        seg.pending_ref, seg.insert_client):
+                if pending_remover is not None:
+                    seg.pending_overlap.add(pending_remover)
+                self._slide_refs(seg)
+            elif pending_remover is not None:
+                seg.removed_seq = UNASSIGNED_SEQ
+                seg.removed_client = pending_remover
+
+    def ack_obliterate(self, group: SegmentGroup, seq: int, client: str,
+                       start: int, end: int, ref_seq: int) -> None:
+        """Own obliterate sequenced: assign the removal seq (ack_remove
+        bookkeeping), materialize the stamp, and run the zero-width pass at
+        the now-known seq — the author's state converges with every remote
+        replica's apply_obliterate."""
+        for seg in group.segments:
+            if seg.removed_seq == UNASSIGNED_SEQ and \
+                    seg.removed_client == client:
+                seg.removed_seq = seq
+            elif client in seg.pending_overlap:
+                seg.pending_overlap.discard(client)
+                seg.overlap_removers.add(client)
+            seg.ob_stamps[seq] = client
+            self._slide_refs(seg)
+            seg.pending_groups.remove(group)
+        self._obliterate_zero_width(start, end, seq, client, ref_seq)
 
     def apply_annotate(
         self,
@@ -386,10 +571,26 @@ class MergeTreeOracle:
 
     # -- ack (own op sequenced) ------------------------------------------------
 
-    def ack_insert(self, group: SegmentGroup, seq: int) -> None:
+    def ack_insert(self, group: SegmentGroup, seq: int,
+                   client: str = NO_CLIENT,
+                   ref_seq: Optional[int] = None) -> None:
         for seg in group.segments:
             if seg.insert_seq == UNASSIGNED_SEQ:
                 seg.insert_seq = seq
+                # Obliterate-on-arrival, author side: remote replicas kill
+                # this insert via the neighbor rule the moment it arrives;
+                # the author's replica must reach the same verdict at ack.
+                if ref_seq is not None and seg.removed_seq is None:
+                    try:
+                        idx = self.segments.index(seg)
+                    except ValueError:
+                        idx = -1
+                    if idx >= 0:
+                        killed = self._arrival_obliterate(
+                            seg, idx, idx + 1, ref_seq, client
+                        )
+                        if killed:
+                            self._slide_refs(seg)
             seg.pending_groups.remove(group)
 
     def ack_remove(self, group: SegmentGroup, seq: int, client: str) -> None:
@@ -430,7 +631,7 @@ class MergeTreeOracle:
         if seg.removed_seq is not None:
             if seg.removed_seq != UNASSIGNED_SEQ:
                 return 0
-            if any(g.kind == "remove" and g in allowed
+            if any(g.kind in ("remove", "obliterate") and g in allowed
                    for g in seg.pending_groups):
                 return 0
         return len(seg.text)
@@ -580,6 +781,11 @@ class MergeTreeOracle:
                 seg.removed_seq is not None
                 and seg.removed_seq != UNASSIGNED_SEQ
                 and seg.removed_seq <= msn
+                # Obliterate-killed slots have removed_seq < insert_seq and
+                # active obliterate stamps must outlive the window: tail
+                # inserts resolve their death against these tombstones.
+                and seg.insert_seq <= msn
+                and all(s <= msn for s in seg.ob_stamps)
                 and not seg.pending_groups
                 and not seg.refs
             )
@@ -601,8 +807,14 @@ class MergeTreeOracle:
             rs, rc = seg.removed_seq, seg.removed_client
             if rs == UNASSIGNED_SEQ:
                 rs, rc = None, None  # pending removal: not sequenced
-            if rs is not None and rs <= msn:
-                continue  # expired tombstone
+            # In-window stamps only; expired ones can never decide a
+            # future arrival (every later ref >= msn >= stamp).
+            stamps = sorted(
+                (s, c2) for s, c2 in seg.ob_stamps.items() if s > msn
+            )
+            if rs is not None and rs <= msn and seg.insert_seq <= msn \
+                    and not stamps:
+                continue  # expired tombstone (see zamboni for the ob rule)
             s, c = seg.insert_seq, seg.insert_client
             if s <= msn:
                 s, c = 0, None
@@ -610,6 +822,8 @@ class MergeTreeOracle:
             if rs is not None:
                 rec["rs"] = rs
                 rec["rc"] = rc
+            if stamps:
+                rec["ob"] = [[s2, c2] for s2, c2 in stamps]
             if seg.overlap_removers:
                 # Sequenced overlap removers are part of the replicated state:
                 # their later ops (with old ref_seqs) must still see the
@@ -624,6 +838,7 @@ class MergeTreeOracle:
                     and prev["c"] == rec["c"]
                     and prev.get("rs") == rec.get("rs")
                     and prev.get("rc") == rec.get("rc")
+                    and prev.get("ob") == rec.get("ob")
                     and prev.get("ro") == rec.get("ro")
                     and prev.get("p") == rec.get("p")
                 ):
@@ -644,6 +859,8 @@ class MergeTreeOracle:
             if "rs" in rec:
                 seg.removed_seq = rec["rs"]
                 seg.removed_client = rec.get("rc")
+            if "ob" in rec:
+                seg.ob_stamps = {s: c for s, c in rec["ob"]}
             if "ro" in rec:
                 seg.overlap_removers = set(rec["ro"])
             self.segments.append(seg)

@@ -91,6 +91,29 @@ class SharedString(SharedObject):
                    {"kind": "remove", "start": start, "end": end,
                     "removedText": removed}, local=True)
 
+    def obliterate_range(self, start: int, end: int) -> None:
+        """Remove [start, end) AND win against concurrent inserts into the
+        range (the reference's obliterateRange; see merge_tree docstring for
+        the exact arrival rule)."""
+        if start >= end:
+            return
+        client = self._local_client()
+        removed = self.text[start:end]
+        group = SegmentGroup("obliterate")
+        self.tree.apply_obliterate(
+            start, end, UNASSIGNED_SEQ, client, self.tree.current_seq,
+            group=group,
+        )
+        self._pending_groups.append(group)
+        self._submit_local_op(
+            {"kind": "obliterate", "start": start, "end": end}
+        )
+        if not self.is_attached:
+            self._ack_detached(group, {"kind": "obliterate"})
+        self._emit("sequenceDelta",
+                   {"kind": "obliterate", "start": start, "end": end,
+                    "removedText": removed}, local=True)
+
     def annotate_range(self, start: int, end: int, props: Dict[str, Any]) -> None:
         if start >= end or not props:
             return
@@ -174,7 +197,7 @@ class SharedString(SharedObject):
         gi = 0
         for _old_client_seq, contents, _meta, _ref_seq in pending:
             kind = contents["kind"]
-            if kind in ("insert", "remove", "annotate"):
+            if kind in ("insert", "remove", "annotate", "obliterate"):
                 group = groups[gi]
                 gi += 1
                 self._regen_group(group, contents, allowed)
@@ -188,6 +211,37 @@ class SharedString(SharedObject):
                      allowed: set) -> None:
         segs = [s for s in self.tree.segments if group in s.pending_groups]
         client = self._local_client()
+        if group.kind == "obliterate":
+            # A range obliterate must regenerate as ONE op over its whole
+            # span: per-segment ranges would turn interior seams into
+            # endpoints (where concurrent inserts survive) and lose the
+            # zero-width stamping between covered segments — the feature's
+            # defining guarantee (review-found).  Covered segments stay
+            # contiguous in the rebase view (interleaved tombstones have
+            # zero width there).
+            start = end = None
+            for seg in segs:
+                seg.pending_groups.remove(group)
+                if seg.removed_seq is not None \
+                        and seg.removed_seq != UNASSIGNED_SEQ:
+                    seg.pending_overlap.discard(client)
+                    continue
+                pos = self.tree.rebase_position(seg, allowed)
+                if start is None:
+                    start = pos
+                end = pos + len(seg.text)
+            if start is not None and end > start:
+                new_group = SegmentGroup("obliterate")
+                for seg in segs:
+                    if seg.removed_seq == UNASSIGNED_SEQ and \
+                            seg.removed_client == client:
+                        new_group.add(seg)
+                self._pending_groups.append(new_group)
+                self._submit_local_op(
+                    {"kind": "obliterate", "start": start, "end": end}
+                )
+                allowed.add(new_group)
+            return
         for seg in segs:
             seg.pending_groups.remove(group)
             if group.kind == "insert":
@@ -260,6 +314,8 @@ class SharedString(SharedObject):
                              contents.get("props"))
         elif kind == "remove":
             self.remove_range(contents["start"], contents["end"])
+        elif kind == "obliterate":
+            self.obliterate_range(contents["start"], contents["end"])
         elif kind == "annotate":
             self.annotate_range(contents["start"], contents["end"],
                                 contents["props"])
@@ -276,6 +332,10 @@ class SharedString(SharedObject):
             self.tree.ack_insert(group, 0)
         elif group.kind == "remove":
             self.tree.ack_remove(group, 0, self._local_client())
+        elif group.kind == "obliterate":
+            # Detached state has no concurrency: the zero-width pass is
+            # vacuous, so ack over an empty range.
+            self.tree.ack_obliterate(group, 0, self._local_client(), 0, 0, 0)
         else:
             self.tree.ack_annotate(group, op.get("props", {}))
 
@@ -297,9 +357,13 @@ class SharedString(SharedObject):
             group = self._pending_groups.popleft()
             assert group.kind == kind, f"ack mismatch: {group.kind} vs {kind}"
             if kind == "insert":
-                self.tree.ack_insert(group, msg.seq)
+                self.tree.ack_insert(group, msg.seq, msg.client_id,
+                                     msg.ref_seq)
             elif kind == "remove":
                 self.tree.ack_remove(group, msg.seq, msg.client_id)
+            elif kind == "obliterate":
+                self.tree.ack_obliterate(group, msg.seq, msg.client_id,
+                                         op["start"], op["end"], msg.ref_seq)
             elif kind == "annotate":
                 self.tree.ack_annotate(group, op["props"])
         else:
@@ -310,6 +374,10 @@ class SharedString(SharedObject):
                 )
             elif kind == "remove":
                 self.tree.apply_remove(
+                    op["start"], op["end"], msg.seq, msg.client_id, msg.ref_seq
+                )
+            elif kind == "obliterate":
+                self.tree.apply_obliterate(
                     op["start"], op["end"], msg.seq, msg.client_id, msg.ref_seq
                 )
             elif kind == "annotate":

@@ -50,7 +50,9 @@ from .mergetree_kernel import (
     _visible_len,
 )
 
-K_RESOLVE = 4  # pure read: resolve position -> handle (no state change)
+K_RESOLVE = 5  # pure read: resolve position -> handle (no state change)
+# (4 is K_OBLITERATE in the shared op-kind space; permutation streams never
+# carry it, but the shared _apply_op must not mistake a resolve for one.)
 
 
 def _resolve_handle(state: MTState, op) -> jnp.ndarray:
@@ -177,6 +179,10 @@ def pack_matrix_batch(docs: Sequence[MatrixDocInput]):
         "rem_client": np.full((D2, S), -1, np.int32),
         "rem2_seq": np.full((D2, S), NOT_REMOVED, np.int32),
         "rem2_client": np.full((D2, S), -1, np.int32),
+        "ob1_seq": np.full((D2, S), NOT_REMOVED, np.int32),
+        "ob1_client": np.full((D2, S), -1, np.int32),
+        "ob2_seq": np.full((D2, S), NOT_REMOVED, np.int32),
+        "ob2_client": np.full((D2, S), -1, np.int32),
         "props": np.zeros((D2, S, 1), np.int32),  # unused by matrix
         "n": np.zeros((D2,), np.int32),
         "overflow": np.zeros((D2,), np.bool_),
@@ -186,6 +192,7 @@ def pack_matrix_batch(docs: Sequence[MatrixDocInput]):
         "seq": np.zeros((D2, T), np.int32),
         "client": np.zeros((D2, T), np.int32),
         "ref_seq": np.zeros((D2, T), np.int32),
+        "min_seq": np.zeros((D2, T), np.int32),
         "a": np.zeros((D2, T), np.int32),
         "b": np.zeros((D2, T), np.int32),
         "tstart": np.zeros((D2, T), np.int32),

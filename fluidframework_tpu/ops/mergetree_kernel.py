@@ -55,7 +55,7 @@ NOT_REMOVED = np.int32(np.iinfo(np.int32).max)
 PROP_ABSENT = -1      # key not set on the segment
 PROP_NOT_TOUCHED = -2  # annotate op does not touch this key
 
-K_NOOP, K_INSERT, K_REMOVE, K_ANNOTATE = 0, 1, 2, 3
+K_NOOP, K_INSERT, K_REMOVE, K_ANNOTATE, K_OBLITERATE = 0, 1, 2, 3, 4
 
 
 class MTState(NamedTuple):
@@ -69,6 +69,10 @@ class MTState(NamedTuple):
     rem_client: jnp.ndarray  # [S] -1 if alive
     rem2_seq: jnp.ndarray    # [S] second (overlap) remover seq / NOT_REMOVED
     rem2_client: jnp.ndarray # [S] second remover client / -1
+    ob1_seq: jnp.ndarray     # [S] first obliterate stamp seq / NOT_REMOVED
+    ob1_client: jnp.ndarray  # [S] first stamp client / -1
+    ob2_seq: jnp.ndarray     # [S] second obliterate stamp seq / NOT_REMOVED
+    ob2_client: jnp.ndarray  # [S] second stamp client / -1
     props: jnp.ndarray       # [S, K] interned value ids / PROP_ABSENT
     n: jnp.ndarray           # [] live slot count
     overflow: jnp.ndarray    # [] bool: >2 removers hit one segment
@@ -81,6 +85,7 @@ class MTOps(NamedTuple):
     seq: jnp.ndarray      # [T]
     client: jnp.ndarray   # [T] per-doc client idx
     ref_seq: jnp.ndarray  # [T]
+    min_seq: jnp.ndarray  # [T] stamped MSN (drives expiry parity w/ zamboni)
     a: jnp.ndarray        # [T] pos (insert) / start (remove, annotate)
     b: jnp.ndarray        # [T] end (remove, annotate)
     tstart: jnp.ndarray   # [T] arena offset of inserted text
@@ -135,6 +140,10 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
         rem_client=shift(state.rem_client),
         rem2_seq=shift(state.rem2_seq),
         rem2_client=shift(state.rem2_client),
+        ob1_seq=shift(state.ob1_seq),
+        ob1_client=shift(state.ob1_client),
+        ob2_seq=shift(state.ob2_seq),
+        ob2_client=shift(state.ob2_client),
         props=shift(state.props),
         n=state.n + 1,
         overflow=state.overflow,
@@ -149,21 +158,65 @@ def _apply_op(state: MTState, op) -> MTState:
     is_ins = op.kind == K_INSERT
     is_rem = op.kind == K_REMOVE
     is_ann = op.kind == K_ANNOTATE
+    is_obl = op.kind == K_OBLITERATE
+    is_rangey = is_rem | is_ann | is_obl
 
     # Boundary splits (shared by all op kinds).
-    state = _split_at(state, op.a, ref_seq, client, is_ins | is_rem | is_ann)
-    state = _split_at(state, op.b, ref_seq, client, is_rem | is_ann)
+    state = _split_at(state, op.a, ref_seq, client, is_ins | is_rangey)
+    state = _split_at(state, op.b, ref_seq, client, is_rangey)
 
     v = _visible_len(state, ref_seq, client)
     cum = _excl_cumsum(v)
     slot = jnp.arange(S)
     active = slot < state.n
+    # Zamboni parity: slots the oracle has physically collected by this
+    # fold position (expired tombstones at the op's stamped MSN) must act
+    # as ABSENT — never stamped, never a neighbor in the arrival scan.
+    msn = op.min_seq
+    ob1_live = (state.ob1_seq != NOT_REMOVED) & (state.ob1_seq > msn)
+    ob2_live = (state.ob2_seq != NOT_REMOVED) & (state.ob2_seq > msn)
+    expired = (
+        (state.rem_seq != NOT_REMOVED) & (state.rem_seq <= msn)
+        & (state.ins_seq <= msn) & ~ob1_live & ~ob2_live
+    )
 
     # --- insert: tie-break index = first slot with cum >= pos (catch-up has
     # no pending segments; stop before the first sequenced segment).
     can = (cum >= op.a) & active
     j = jnp.where(can.any(), jnp.argmax(can), state.n)
     src = jnp.where(slot <= j, slot, slot - 1)
+
+    # Obliterate-on-arrival (see dds/merge_tree.py docstring): the insert
+    # dies iff its pool neighbors share a stamp the inserter had not seen
+    # from another client; the EARLIEST shared stamp is the remover.
+    # Neighbors = nearest NON-EXPIRED slots around the tie-break index.
+    present = active & ~expired
+    left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1))
+    right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S))
+
+    def stamp_at(f, idx, valid):
+        return jnp.where(valid, f[jnp.clip(idx, 0, S - 1)],
+                         jnp.int32(NOT_REMOVED))
+
+    has_left = left_idx >= 0
+    has_right = right_idx < S
+    l1s = stamp_at(state.ob1_seq, left_idx, has_left)
+    l2s = stamp_at(state.ob2_seq, left_idx, has_left)
+    l1c = stamp_at(state.ob1_client, left_idx, has_left)
+    l2c = stamp_at(state.ob2_client, left_idx, has_left)
+    r1s = stamp_at(state.ob1_seq, right_idx, has_right)
+    r2s = stamp_at(state.ob2_seq, right_idx, has_right)
+
+    def killer_of(ls, lc):
+        shared = (ls != NOT_REMOVED) & ((ls == r1s) | (ls == r2s))
+        ok = shared & (ls > ref_seq) & (lc != client)
+        return jnp.where(ok, ls, jnp.int32(NOT_REMOVED)), lc
+
+    k1s, k1c = killer_of(l1s, l1c)
+    k2s, k2c = killer_of(l2s, l2c)
+    kill_seq = jnp.minimum(k1s, k2s)
+    kill_client = jnp.where(k1s <= k2s, k1c, k2c)
+    killed = kill_seq != NOT_REMOVED
 
     def shifted(f, newval):
         moved = jnp.take(f, src, axis=0)
@@ -176,10 +229,18 @@ def _apply_op(state: MTState, op) -> MTState:
         tlen=shifted(state.tlen, op.tlen),
         ins_seq=shifted(state.ins_seq, op.seq),
         ins_client=shifted(state.ins_client, client),
-        rem_seq=shifted(state.rem_seq, NOT_REMOVED),
-        rem_client=shifted(state.rem_client, -1),
+        rem_seq=shifted(state.rem_seq,
+                        jnp.where(killed, kill_seq, NOT_REMOVED)),
+        rem_client=shifted(state.rem_client,
+                           jnp.where(killed, kill_client, -1)),
         rem2_seq=shifted(state.rem2_seq, NOT_REMOVED),
         rem2_client=shifted(state.rem2_client, -1),
+        ob1_seq=shifted(state.ob1_seq,
+                        jnp.where(killed, kill_seq, NOT_REMOVED)),
+        ob1_client=shifted(state.ob1_client,
+                           jnp.where(killed, kill_client, -1)),
+        ob2_seq=shifted(state.ob2_seq, NOT_REMOVED),
+        ob2_client=shifted(state.ob2_client, -1),
         props=shifted(
             state.props,
             jnp.where(op.pvals == PROP_NOT_TOUCHED, PROP_ABSENT, op.pvals),
@@ -191,21 +252,42 @@ def _apply_op(state: MTState, op) -> MTState:
         lambda new, old: jnp.where(is_ins, new, old), ins_state, state
     )
 
-    # --- remove / annotate target: segments fully inside [a, b) in the view
-    # (splits above made partial overlaps exact).  Computed on the pre-insert
-    # cum/v, which is correct because the masks are exclusive by kind.
+    # --- remove / annotate / obliterate target: segments fully inside
+    # [a, b) in the view (splits above made partial overlaps exact).
+    # Computed on the pre-insert cum/v, which is correct because the masks
+    # are exclusive by kind.
     covered = (cum >= op.a) & (cum + v <= op.b) & (v > 0) & active
 
-    first_win = covered & (state.rem_seq == NOT_REMOVED) & is_rem
-    again = covered & (state.rem_seq != NOT_REMOVED) & is_rem
+    is_rem_like = is_rem | is_obl
+    first_win = covered & (state.rem_seq == NOT_REMOVED) & is_rem_like
+    again = covered & (state.rem_seq != NOT_REMOVED) & is_rem_like
     second = again & (state.rem2_seq == NOT_REMOVED)
     third = again & (state.rem2_seq != NOT_REMOVED)
+    # Obliterate additionally stamps zero-width slots strictly inside the
+    # range: tombstones (stamp only) and invisible concurrent inserts
+    # (remove + stamp) — the oracle's zero-width pass.  Two stamp slots;
+    # a third distinct obliterate on one slot overflows to the oracle.
+    obl_zero = active & ~expired & (v == 0) \
+        & (cum > op.a) & (cum < op.b) & is_obl
+    obl_zero_alive = obl_zero & (state.rem_seq == NOT_REMOVED)
+    first_win = first_win | obl_zero_alive
+    stamp = (covered & is_obl) | obl_zero
+    to_ob1 = stamp & (state.ob1_seq == NOT_REMOVED)
+    to_ob2 = stamp & ~to_ob1 & (state.ob2_seq == NOT_REMOVED) \
+        & (state.ob1_seq != op.seq)
+    ob_over = stamp & (state.ob1_seq != NOT_REMOVED) \
+        & (state.ob2_seq != NOT_REMOVED) \
+        & (state.ob1_seq != op.seq) & (state.ob2_seq != op.seq)
     state = state._replace(
         rem_seq=jnp.where(first_win, op.seq, state.rem_seq),
         rem_client=jnp.where(first_win, client, state.rem_client),
         rem2_seq=jnp.where(second, op.seq, state.rem2_seq),
         rem2_client=jnp.where(second, client, state.rem2_client),
-        overflow=state.overflow | third.any(),
+        ob1_seq=jnp.where(to_ob1, op.seq, state.ob1_seq),
+        ob1_client=jnp.where(to_ob1, client, state.ob1_client),
+        ob2_seq=jnp.where(to_ob2, op.seq, state.ob2_seq),
+        ob2_client=jnp.where(to_ob2, client, state.ob2_client),
+        overflow=state.overflow | third.any() | ob_over.any(),
     )
 
     touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] & (covered & is_ann)[:, None]
@@ -249,6 +331,10 @@ def _cold_start(ops: "MTOps", S: int) -> "MTState":
         rem_client=jnp.full((D, S), -1, jnp.int32),
         rem2_seq=jnp.full((D, S), NOT_REMOVED, jnp.int32),
         rem2_client=jnp.full((D, S), -1, jnp.int32),
+        ob1_seq=jnp.full((D, S), NOT_REMOVED, jnp.int32),
+        ob1_client=jnp.full((D, S), -1, jnp.int32),
+        ob2_seq=jnp.full((D, S), NOT_REMOVED, jnp.int32),
+        ob2_client=jnp.full((D, S), -1, jnp.int32),
         props=jnp.full((D, S, K), PROP_ABSENT, jnp.int32),
         n=jnp.zeros((D,), jnp.int32),
         overflow=jnp.zeros((D,), jnp.bool_),
@@ -279,14 +365,17 @@ def _replay_batch_cold(ops: "MTOps", S: int) -> "MTState":
 EXPORT_SLOT_FIELDS = (
     "tstart", "tlen", "ins_seq", "ins_client",
     "rem_seq", "rem_client", "rem2_seq", "rem2_client",
+    "ob1_seq", "ob1_client", "ob2_seq", "ob2_client",
 )
+#: rows holding seqs with the NOT_REMOVED sentinel (i16 remap set)
+SENTINEL_SEQ_FIELDS = ("rem_seq", "rem2_seq", "ob1_seq", "ob2_seq")
 I16_NOT_REMOVED = np.int16(np.iinfo(np.int16).max)
 I16_LIMIT = int(np.iinfo(np.int16).max) - 1  # strict value bound for i16_ok
 
 
 def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
                   i16: bool = False) -> jnp.ndarray:
-    """[D, 9+K, S] fused view of everything summary extraction and interval
+    """[D, 13+K, S] fused view of everything summary extraction and interval
     replay need from the final device state (int32, or int16 when ``i16``
     with per-doc-rebased tstart and remapped NOT_REMOVED sentinels)."""
     D, S = final.tlen.shape
@@ -304,16 +393,14 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
     # tstart in BOTH widths so the two exports are bit-equivalent after
     # ``widen_export`` (and export bytes are deterministic).
     tstart = jnp.where(active, final.tstart, 0)
-    rem_seq, rem2_seq = final.rem_seq, final.rem2_seq
+    named = {"tstart": tstart}
     if i16:
-        tstart = jnp.where(active, tstart - doc_base[:, None], 0)
-        rem_seq = jnp.where(
-            rem_seq == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), rem_seq
-        )
-        rem2_seq = jnp.where(
-            rem2_seq == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), rem2_seq
-        )
-    named = {"tstart": tstart, "rem_seq": rem_seq, "rem2_seq": rem2_seq}
+        named["tstart"] = jnp.where(active, tstart - doc_base[:, None], 0)
+        for f in SENTINEL_SEQ_FIELDS:
+            val = getattr(final, f)
+            named[f] = jnp.where(
+                val == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), val
+            )
     rows = [named.get(f, getattr(final, f)) for f in EXPORT_SLOT_FIELDS]
     rows += [final.props[:, :, k] for k in range(K)]
     rows.append(misc)
@@ -329,10 +416,8 @@ def widen_export(export_np: np.ndarray,
     if export_np.dtype == np.int32:
         return export_np
     out = export_np.astype(np.int32)
-    R_SEQ = EXPORT_SLOT_FIELDS.index("rem_seq")
-    R2_SEQ = EXPORT_SLOT_FIELDS.index("rem2_seq")
-    for r in (R_SEQ, R2_SEQ):
-        row = out[:, r, :]
+    for f in SENTINEL_SEQ_FIELDS:
+        row = out[:, EXPORT_SLOT_FIELDS.index(f), :]
         row[row == int(I16_NOT_REMOVED)] = NOT_REMOVED
     if doc_base is not None:
         # Re-add the per-doc arena base to live slots only (slots beyond n
@@ -530,6 +615,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "rem_client": np.full((D, S), -1, np.int32),
         "rem2_seq": np.full((D, S), NOT_REMOVED, np.int32),
         "rem2_client": np.full((D, S), -1, np.int32),
+        "ob1_seq": np.full((D, S), NOT_REMOVED, np.int32),
+        "ob1_client": np.full((D, S), -1, np.int32),
+        "ob2_seq": np.full((D, S), NOT_REMOVED, np.int32),
+        "ob2_client": np.full((D, S), -1, np.int32),
         "props": np.full((D, S, K), PROP_ABSENT, np.int32),
         "n": np.zeros((D,), np.int32),
         "overflow": np.zeros((D,), np.bool_),
@@ -539,6 +628,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "seq": np.zeros((D, T), np.int32),
         "client": np.zeros((D, T), np.int32),
         "ref_seq": np.zeros((D, T), np.int32),
+        "min_seq": np.zeros((D, T), np.int32),
         "a": np.zeros((D, T), np.int32),
         "b": np.zeros((D, T), np.int32),
         "tstart": np.zeros((D, T), np.int32),
@@ -550,6 +640,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     for d, doc in enumerate(docs):
         pack = doc_packs[d]
         doc_base[d] = len(arena)
+        if known_oracle_fallback(doc):
+            # Docs routed here without the partition_replay pre-filter
+            # still get the oracle (the docstring's pack-time parity).
+            pack.needs_fallback = True
         for s, rec in enumerate(doc.base_records or []):
             st["tstart"][d, s] = arena.append(rec["t"])
             st["tlen"][d, s] = len(rec["t"])
@@ -558,6 +652,15 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             if "rs" in rec:
                 st["rem_seq"][d, s] = rec["rs"]
                 st["rem_client"][d, s] = pack.client_idx(rec.get("rc"))
+            ob = rec.get("ob", [])
+            if ob:
+                st["ob1_seq"][d, s] = ob[0][0]
+                st["ob1_client"][d, s] = pack.client_idx(ob[0][1])
+                if len(ob) > 1:
+                    st["ob2_seq"][d, s] = ob[1][0]
+                    st["ob2_client"][d, s] = pack.client_idx(ob[1][1])
+                if len(ob) > 2:
+                    pack.needs_fallback = True  # device tracks two stamps
             ro = rec.get("ro", [])
             if ro:
                 # Second-remover slot is exact for one overlap remover; the
@@ -593,7 +696,8 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                 )
             row = {key: op[key][d]
                    for key in ("kind", "seq", "client", "ref_seq",
-                               "a", "b", "tstart", "tlen", "pvals")}
+                               "min_seq", "a", "b", "tstart", "tlen",
+                               "pvals")}
             doc_bytes = bytearray()
             pack_doc_row(doc.binary_ops, row, K, len(arena), doc_bytes,
                          text_bytes=binary_counts[d][1],
@@ -614,6 +718,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             op["seq"][d, t] = msg.seq
             op["client"][d, t] = pack.client_idx(msg.client_id)
             op["ref_seq"][d, t] = msg.ref_seq
+            op["min_seq"][d, t] = msg.min_seq
             if kind == "insert":
                 op["kind"][d, t] = K_INSERT
                 op["a"][d, t] = contents["pos"]
@@ -621,6 +726,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                 op["tlen"][d, t] = len(contents["text"])
             elif kind == "remove":
                 op["kind"][d, t] = K_REMOVE
+                op["a"][d, t] = contents["start"]
+                op["b"][d, t] = contents["end"]
+            elif kind == "obliterate":
+                op["kind"][d, t] = K_OBLITERATE
                 op["a"][d, t] = contents["start"]
                 op["b"][d, t] = contents["end"]
             elif kind == "annotate":
@@ -678,8 +787,15 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
     for s in range(n):
         rs = int(state_np["rem_seq"][d, s])
         removed = rs != NOT_REMOVED
-        if removed and rs <= msn:
-            continue  # expired tombstone
+        stamps = []
+        for o in ("ob1", "ob2"):
+            o_s = int(state_np[f"{o}_seq"][d, s])
+            if o_s != NOT_REMOVED and o_s > msn:
+                oc = int(state_np[f"{o}_client"][d, s])
+                stamps.append([o_s, pack.clients.lookup(oc)])
+        if removed and rs <= msn \
+                and int(state_np["ins_seq"][d, s]) <= msn and not stamps:
+            continue  # expired tombstone (active stamps pin it)
         ins_seq = int(state_np["ins_seq"][d, s])
         ins_client = int(state_np["ins_client"][d, s])
         if ins_seq <= msn:
@@ -698,6 +814,8 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
             rec["rs"] = rs
             rc = int(state_np["rem_client"][d, s])
             rec["rc"] = pack.clients.lookup(rc) if rc >= 0 else None
+        if stamps:
+            rec["ob"] = stamps
         rc2 = int(state_np["rem2_client"][d, s])
         if rc2 >= 0:
             rec["ro"] = [pack.clients.lookup(rc2)]
@@ -715,6 +833,7 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
                 and prev["c"] == rec["c"]
                 and prev.get("rs") == rec.get("rs")
                 and prev.get("rc") == rec.get("rc")
+                and prev.get("ob") == rec.get("ob")
                 and prev.get("ro") == rec.get("ro")
                 and prev.get("p") == rec.get("p")
             ):
@@ -725,12 +844,32 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
 
 
 def known_oracle_fallback(doc: MergeTreeDocInput) -> bool:
-    """True when a doc is known *before packing* to need the oracle path
-    (>1 overlap remover on a base record — the device tracks exactly two
-    removers and the base format carries no overlap seqs).  Pack-time's
-    ``needs_fallback`` applies the same rule; filtering first keeps such docs
-    from inflating the shared power-of-two buckets and wasting their fold."""
-    return any(len(r.get("ro", [])) > 1 for r in doc.base_records or [])
+    """True when a doc is known *before packing* to need the oracle path:
+    >1 overlap remover on a base record (the device tracks exactly two
+    removers and the base format carries no overlap seqs), >2 obliterate
+    stamps on a base record (two device stamp slots), or interval ops
+    mixed with obliterate ops (reference-slide timing over obliterated
+    segments is host-folded only through the oracle).  Pack-time's
+    ``needs_fallback`` applies the same rules; filtering first keeps such
+    docs from inflating the shared power-of-two buckets."""
+    for r in doc.base_records or []:
+        if len(r.get("ro", [])) > 1 or len(r.get("ob", [])) > 2:
+            return True
+    has_interval = doc.base_intervals is not None
+    has_obl = False
+    for msg in doc.ops:
+        kind = msg.contents.get("kind", "")
+        if kind.startswith("interval"):
+            has_interval = True
+        elif kind == "obliterate":
+            has_obl = True
+    if doc.binary_ops is not None and has_interval and not has_obl:
+        from .native_pack import binary_has_obliterate
+
+        has_obl = binary_has_obliterate(doc.binary_ops)
+    if has_obl and has_interval:
+        return True
+    return False
 
 
 def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:

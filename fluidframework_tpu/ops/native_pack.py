@@ -37,8 +37,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "oppack.cpp")
 
-_KINDS = {"insert": 1, "remove": 2, "annotate": 3}
-_HEADER = struct.Struct("<B7i")
+_KINDS = {"insert": 1, "remove": 2, "annotate": 3, "obliterate": 4}
+_HEADER = struct.Struct("<B8i")
 _PAIR = struct.Struct("<2i")
 
 
@@ -78,8 +78,8 @@ def encode_string_ops(
             k = prop_key_intern.intern(key)
             v = -1 if value is None else value_intern.intern(value)
             pairs.append((k, v))
-        out += _HEADER.pack(kind, msg.seq, msg.ref_seq, client, a, b,
-                            len(pairs), len(text))
+        out += _HEADER.pack(kind, msg.seq, msg.ref_seq, msg.min_seq,
+                            client, a, b, len(pairs), len(text))
         for pair in pairs:
             out += _PAIR.pack(*pair)
         out += text
@@ -97,7 +97,7 @@ def decode_string_ops(
     off = 0
     kinds = {v: k for k, v in _KINDS.items()}
     while off < len(blob):
-        kind, seq, ref, client, a, b, n_props, text_len = \
+        kind, seq, ref, min_seq, client, a, b, n_props, text_len = \
             _HEADER.unpack_from(blob, off)
         off += _HEADER.size
         props = {}
@@ -118,7 +118,7 @@ def decode_string_ops(
                 contents["props"] = props
         out.append(SequencedMessage(
             seq=seq, client_id=clients[client] if client >= 0 else None,
-            client_seq=seq, ref_seq=ref, min_seq=0,
+            client_seq=seq, ref_seq=ref, min_seq=min_seq,
             type=MessageType.OP, contents=contents,
         ))
     return out
@@ -194,7 +194,7 @@ def load_library() -> Optional[ctypes.CDLL]:
     lib.oppack_pack.argtypes = [
         ctypes.c_char_p, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
-    ] + [np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")] * 9 + [
+    ] + [np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")] * 10 + [
         np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
@@ -245,8 +245,8 @@ def count_stream(blob: bytes) -> Tuple[int, int, int]:
 def _count_py(blob: bytes) -> Tuple[int, int, int]:
     off, n, tb, tc = 0, 0, 0, 0
     while off < len(blob):
-        _kind, _seq, _ref, _cl, _a, _b, n_props, text_len = \
-            _HEADER.unpack_from(blob, off)
+        (_kind, _seq, _ref, _msn, _cl, _a, _b, n_props,
+         text_len) = _HEADER.unpack_from(blob, off)
         off += _HEADER.size + 8 * n_props
         text = blob[off:off + text_len]
         if len(text) != text_len:
@@ -256,6 +256,18 @@ def _count_py(blob: bytes) -> Tuple[int, int, int]:
         tc += len(text.decode("utf-8"))
         n += 1
     return n, tb, tc
+
+
+def binary_has_obliterate(blob: bytes) -> bool:
+    """Header-only scan: does the stream contain an obliterate record?"""
+    off = 0
+    while off < len(blob):
+        kind, _s, _r, _m, _c, _a, _b, n_props, text_len = \
+            _HEADER.unpack_from(blob, off)
+        if kind == _KINDS["obliterate"]:
+            return True
+        off += _HEADER.size + 8 * n_props + text_len
+    return False
 
 
 def pack_doc_row(
@@ -290,7 +302,7 @@ def pack_doc_row(
         packed = lib.oppack_pack(
             blob, len(blob), T, K, arena_base_chars,
             row["kind"], row["seq"], row["client"], row["ref_seq"],
-            row["a"], row["b"], row["tstart"], row["tlen"],
+            row["min_seq"], row["a"], row["b"], row["tstart"], row["tlen"],
             row["pvals"].reshape(-1),
             scratch, len(scratch),
             ctypes.byref(arena_bytes), ctypes.byref(arena_chars),
@@ -312,12 +324,13 @@ def _pack_py(blob: bytes, row: Dict[str, np.ndarray], K: int,
              val_map: Optional[np.ndarray] = None) -> int:
     off, t, chars = 0, 0, 0
     while off < len(blob):
-        kind, seq, ref, client, a, b, n_props, text_len = \
+        kind, seq, ref, min_seq, client, a, b, n_props, text_len = \
             _HEADER.unpack_from(blob, off)
         off += _HEADER.size
         row["kind"][t] = kind
         row["seq"][t] = seq
         row["ref_seq"][t] = ref
+        row["min_seq"][t] = min_seq
         row["client"][t] = client
         row["a"][t] = a
         row["b"][t] = b
@@ -371,7 +384,7 @@ def extract_bodies(
     if lib is None:
         return None
     D, F, S = export_np.shape
-    K = F - 9
+    K = F - 13
     export_np = np.ascontiguousarray(export_np, np.int32)
 
     def flatten(tokens: Sequence[bytes]):

@@ -66,11 +66,12 @@ def sharded_replay_step(mesh: Mesh):
     state_shardings = MTState(
         tstart=shard, tlen=shard, ins_seq=shard, ins_client=shard,
         rem_seq=shard, rem_client=shard, rem2_seq=shard, rem2_client=shard,
+        ob1_seq=shard, ob1_client=shard, ob2_seq=shard, ob2_client=shard,
         props=shard, n=shard, overflow=shard,
     )
     ops_shardings = MTOps(
-        kind=shard, seq=shard, client=shard, ref_seq=shard, a=shard, b=shard,
-        tstart=shard, tlen=shard, pvals=shard,
+        kind=shard, seq=shard, client=shard, ref_seq=shard, min_seq=shard,
+        a=shard, b=shard, tstart=shard, tlen=shard, pvals=shard,
     )
     return jax.jit(
         _step,
@@ -209,11 +210,12 @@ def matrix_sharded_replay_step(mesh: Mesh):
     state_shardings = MTState(
         tstart=shard, tlen=shard, ins_seq=shard, ins_client=shard,
         rem_seq=shard, rem_client=shard, rem2_seq=shard, rem2_client=shard,
+        ob1_seq=shard, ob1_client=shard, ob2_seq=shard, ob2_client=shard,
         props=shard, n=shard, overflow=shard,
     )
     ops_shardings = MTOps(
-        kind=shard, seq=shard, client=shard, ref_seq=shard, a=shard, b=shard,
-        tstart=shard, tlen=shard, pvals=shard,
+        kind=shard, seq=shard, client=shard, ref_seq=shard, min_seq=shard,
+        a=shard, b=shard, tstart=shard, tlen=shard, pvals=shard,
     )
     return jax.jit(
         _step,

@@ -40,9 +40,11 @@ class FuzzSpec:
 
 
 class StringFuzzSpec(FuzzSpec):
-    def __init__(self, annotate: bool = True, intervals: bool = False) -> None:
+    def __init__(self, annotate: bool = True, intervals: bool = False,
+                 obliterate: bool = False) -> None:
         self.annotate = annotate
         self.intervals = intervals
+        self.obliterate = obliterate
 
     def create(self, object_id: str) -> SharedObject:
         from ..dds.sequence import SharedString
@@ -59,6 +61,9 @@ class StringFuzzSpec(FuzzSpec):
             pos = rng.randint(0, n)
             text = "".join(rng.choice(ALPHABET) for _ in range(rng.randint(1, 6)))
             dds.insert_text(pos, text)
+        elif self.obliterate and r < 0.68:
+            start = rng.randint(0, n - 1)
+            dds.obliterate_range(start, min(n, start + rng.randint(1, 8)))
         elif r < 0.8 or not self.annotate:
             start = rng.randint(0, n - 1)
             dds.remove_range(start, min(n, start + rng.randint(1, 8)))

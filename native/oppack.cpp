@@ -9,9 +9,10 @@
 // per-op dict lookups.
 //
 // Record layout (little-endian, packed):
-//   u8  kind        (1=insert, 2=remove, 3=annotate)
+//   u8  kind        (1=insert, 2=remove, 3=annotate, 4=obliterate)
 //   i32 seq
 //   i32 ref_seq
+//   i32 min_seq     (stamped MSN — zamboni-expiry parity on device)
 //   i32 client_idx  (interned by the encoder)
 //   i32 a           (pos | start)
 //   i32 b           (end; 0 for insert)
@@ -33,13 +34,15 @@
 // mergetree_kernel.EXPORT_SLOT_FIELDS) and emits, per document, the exact
 // bytes of canonical_json(normalized_records): sorted keys, minimal
 // separators, ensure_ascii=False (UTF-8 passthrough; only '"', '\\' and
-// control chars escape, matching python json.dumps).
+// control chars escape, matching python json.dumps).  Slot rows carry two
+// obliterate stamp pairs (rows 8..11); in-window stamps (> msn) emit as
+// "ob":[[seq,"client"],...] and pin their tombstones past normal expiry.
 
 #include <cstdint>
 #include <cstring>
 
 namespace {
-constexpr int64_t kHeader = 1 + 4 * 7;  // kind byte + 7 i32 fields
+constexpr int64_t kHeader = 1 + 4 * 8;  // kind byte + 8 i32 fields
 
 inline int64_t count_codepoints(const uint8_t* p, int64_t n) {
     int64_t chars = 0;
@@ -60,10 +63,10 @@ int oppack_count(const uint8_t* buf, int64_t len,
     int64_t bytes = 0, chars = 0;
     while (off < len) {
         if (off + kHeader > len) return -1;
-        int32_t fields[7];
-        std::memcpy(fields, buf + off + 1, 4 * 7);
-        const int32_t n_props = fields[5];
-        const int32_t text_len = fields[6];
+        int32_t fields[8];
+        std::memcpy(fields, buf + off + 1, 4 * 8);
+        const int32_t n_props = fields[6];
+        const int32_t text_len = fields[7];
         off += kHeader;
         if (n_props < 0 || text_len < 0) return -1;
         if (off + 8 * static_cast<int64_t>(n_props) + text_len > len)
@@ -89,8 +92,9 @@ int oppack_count(const uint8_t* buf, int64_t len,
 int32_t oppack_pack(const uint8_t* buf, int64_t len,
                     int32_t T, int32_t K, int64_t arena_base_chars,
                     int32_t* kind, int32_t* seq, int32_t* client,
-                    int32_t* ref_seq, int32_t* a, int32_t* b,
-                    int32_t* tstart, int32_t* tlen, int32_t* pvals,
+                    int32_t* ref_seq, int32_t* min_seq, int32_t* a,
+                    int32_t* b, int32_t* tstart, int32_t* tlen,
+                    int32_t* pvals,
                     uint8_t* arena_out, int64_t arena_capacity,
                     int64_t* arena_bytes, int64_t* arena_chars,
                     const int32_t* key_map, int32_t n_keys,
@@ -102,20 +106,21 @@ int32_t oppack_pack(const uint8_t* buf, int64_t len,
         if (off + kHeader > len) return -1;
         if (t >= T) return -1;
         const uint8_t k = buf[off];
-        int32_t fields[7];
-        std::memcpy(fields, buf + off + 1, 4 * 7);
+        int32_t fields[8];
+        std::memcpy(fields, buf + off + 1, 4 * 8);
         off += kHeader;
-        const int32_t n_props = fields[5];
-        const int32_t text_len = fields[6];
+        const int32_t n_props = fields[6];
+        const int32_t text_len = fields[7];
         if (n_props < 0 || text_len < 0) return -1;
         if (off + 8 * static_cast<int64_t>(n_props) + text_len > len)
             return -1;
         kind[t] = static_cast<int32_t>(k);
         seq[t] = fields[0];
         ref_seq[t] = fields[1];
-        client[t] = fields[2];
-        a[t] = fields[3];
-        b[t] = fields[4];
+        min_seq[t] = fields[2];
+        client[t] = fields[3];
+        a[t] = fields[4];
+        b[t] = fields[5];
         for (int32_t i = 0; i < n_props; ++i) {
             int32_t pair[2];
             std::memcpy(pair, buf + off, 8);
@@ -155,11 +160,12 @@ int32_t oppack_pack(const uint8_t* buf, int64_t len,
 
 // Final device state → canonical summary-body JSON for every document of a
 // chunk, in one pass.  Layout contract with mergetree_kernel._export_state:
-//   export_buf: [D, F, S] int32, C order, F = 8 + K + 1
+//   export_buf: [D, F, S] int32, C order, F = 12 + K + 1
 //     rows 0..7: tstart, tlen, ins_seq, ins_client,
 //                rem_seq, rem_client, rem2_seq, rem2_client
-//     rows 8..8+K-1: property value ids (PROP_ABSENT = -1)
-//     row  8+K (misc): [n, overflow, live_len, 0...]
+//     rows 8..11: ob1_seq, ob1_client, ob2_seq, ob2_client
+//     rows 12..12+K-1: property value ids (PROP_ABSENT = -1)
+//     row  12+K (misc): [n, overflow, live_len, 0...]
 //   arena_utf8: the chunk text arena; tstart/tlen are CHAR offsets, so a
 //     char→byte index is built once here.
 //   client_json / key_json / val_json: pre-serialized JSON tokens
@@ -186,7 +192,7 @@ int64_t oppack_extract(
     const int32_t* msn, const uint8_t* skip,
     int32_t not_removed,
     uint8_t* out, int64_t out_cap, int64_t* out_offs) {
-    if (F != 8 + K + 1) return -1;
+    if (F != 12 + K + 1) return -1;
     // char → byte index over the arena (one pass).
     int64_t* idx = new int64_t[arena_chars + 1];
     {
@@ -271,13 +277,26 @@ int64_t oppack_extract(
         const int32_t* p_rem_seq = ex + 4 * S;
         const int32_t* p_rem_client = ex + 5 * S;
         const int32_t* p_rem2_client = ex + 7 * S;
-        const int32_t n = ex[static_cast<int64_t>(8 + K) * S + 0];
+        const int32_t* p_ob1_seq = ex + 8 * S;
+        const int32_t* p_ob1_client = ex + 9 * S;
+        const int32_t* p_ob2_seq = ex + 10 * S;
+        const int32_t* p_ob2_client = ex + 11 * S;
+        const int32_t n = ex[static_cast<int64_t>(12 + K) * S + 0];
         const int32_t doc_msn = msn[d];
         if (n < 0 || n > S) { bad = true; break; }
 
+        // In-window obliterate stamps pin a tombstone past normal expiry
+        // (tail inserts resolve their arrival verdict against it).
+        auto live_stamps = [&](int32_t s) {
+            int32_t count = 0;
+            if (p_ob1_seq[s] != not_removed && p_ob1_seq[s] > doc_msn) ++count;
+            if (p_ob2_seq[s] != not_removed && p_ob2_seq[s] > doc_msn) ++count;
+            return count;
+        };
         auto expired = [&](int32_t s) {
             const int32_t rs = p_rem_seq[s];
-            return rs != not_removed && rs <= doc_msn;
+            return rs != not_removed && rs <= doc_msn &&
+                   p_ins_seq[s] <= doc_msn && live_stamps(s) == 0;
         };
         // Merge-equality of two SURVIVING slots, mirroring
         // _extract_records: normalized (s, c), removal triple, overlap
@@ -301,9 +320,23 @@ int64_t oppack_extract(
                 return false;
             }
             if (p_rem2_client[x] != p_rem2_client[y]) return false;
+            // in-window stamp lists must match
+            const bool o1x = p_ob1_seq[x] != not_removed &&
+                             p_ob1_seq[x] > doc_msn;
+            const bool o1y = p_ob1_seq[y] != not_removed &&
+                             p_ob1_seq[y] > doc_msn;
+            const bool o2x = p_ob2_seq[x] != not_removed &&
+                             p_ob2_seq[x] > doc_msn;
+            const bool o2y = p_ob2_seq[y] != not_removed &&
+                             p_ob2_seq[y] > doc_msn;
+            if (o1x != o1y || o2x != o2y) return false;
+            if (o1x && (p_ob1_seq[x] != p_ob1_seq[y] ||
+                        p_ob1_client[x] != p_ob1_client[y])) return false;
+            if (o2x && (p_ob2_seq[x] != p_ob2_seq[y] ||
+                        p_ob2_client[x] != p_ob2_client[y])) return false;
             for (int32_t k = 0; k < K; ++k) {
-                if (ex[(8 + static_cast<int64_t>(k)) * S + x] !=
-                    ex[(8 + static_cast<int64_t>(k)) * S + y]) {
+                if (ex[(12 + static_cast<int64_t>(k)) * S + x] !=
+                    ex[(12 + static_cast<int64_t>(k)) * S + y]) {
                     return false;
                 }
             }
@@ -336,9 +369,28 @@ int64_t oppack_extract(
             put_lit("{\"c\":");
             if (c_out < 0) put_lit("null");
             else put_client(d, c_out);
+            if (live_stamps(s) > 0) {
+                put_lit(",\"ob\":[");
+                bool first_ob = true;
+                const int32_t ob_seqs[2] = {p_ob1_seq[s], p_ob2_seq[s]};
+                const int32_t ob_clients[2] = {p_ob1_client[s],
+                                               p_ob2_client[s]};
+                for (int i = 0; i < 2; ++i) {
+                    if (ob_seqs[i] == not_removed || ob_seqs[i] <= doc_msn)
+                        continue;
+                    if (!first_ob) put_lit(",");
+                    first_ob = false;
+                    put_lit("[");
+                    put_int(ob_seqs[i]);
+                    put_lit(",");
+                    put_client(d, ob_clients[i]);
+                    put_lit("]");
+                }
+                put_lit("]");
+            }
             bool has_props = false;
             for (int32_t k = 0; k < K && !has_props; ++k) {
-                has_props = ex[(8 + static_cast<int64_t>(k)) * S + s] >= 0;
+                has_props = ex[(12 + static_cast<int64_t>(k)) * S + s] >= 0;
             }
             if (has_props) {
                 put_lit(",\"p\":{");
@@ -346,7 +398,7 @@ int64_t oppack_extract(
                 for (int32_t k = 0; k < K; ++k) {  // sorted key order
                     const int32_t col = key_cols[k];
                     const int32_t vid =
-                        ex[(8 + static_cast<int64_t>(col)) * S + s];
+                        ex[(12 + static_cast<int64_t>(col)) * S + s];
                     if (vid < 0) continue;
                     if (vid >= n_vals) { bad = true; break; }
                     if (!first_p) put_lit(",");

@@ -198,3 +198,57 @@ def test_beast_style_random_soak_two_clients():
             factory.process_all_messages()
             factory.advance_min_seq()
     assert_converged(factory, strings)
+
+
+def test_obliterate_fuzz_converges_bounded_lag():
+    """Obliterate under concurrency: 3 clients submit concurrent batches
+    (inserts/removes/annotates/obliterates) optimistically, syncing each
+    round — every replica converges to identical text and summary bytes.
+
+    KNOWN LIMITATION (documented in SEMANTICS.md): replicas lagging many
+    rounds behind while others obliterate around their pending state can
+    still diverge; deep-lag hardening is future work.  Bounded-lag (each
+    round fully delivered before the next) is fuzz-green."""
+    import random as _random
+
+    from fluidframework_tpu.testing.fuzz import StringFuzzSpec
+    from fluidframework_tpu.testing.mocks import MockContainerRuntimeFactory
+
+    spec = StringFuzzSpec(obliterate=True)
+    for seed in range(25):
+        rng = _random.Random(seed)
+        factory = MockContainerRuntimeFactory()
+        replicas = []
+        for i in range(3):
+            client = factory.create_client(f"client{i}")
+            replicas.append(client.attach(spec.create("fuzz")))
+        for round_no in range(15):
+            for replica in replicas:
+                for _ in range(3):
+                    if rng.random() < spec.op_probability:
+                        spec.random_op(rng, replica)
+            factory.process_all_messages()
+            texts = {r.text for r in replicas}
+            assert len(texts) == 1, f"seed {seed} round {round_no}: {texts}"
+            if rng.random() < 0.5:
+                factory.advance_min_seq()
+        digests = {r.summarize().digest() for r in replicas}
+        assert len(digests) == 1, f"seed {seed}: divergent summaries"
+
+
+def test_obliterate_kills_concurrent_insert():
+    """The defining behavior: an insert into a concurrently obliterated
+    range dies; the same insert into a merely removed range survives."""
+    from fluidframework_tpu.testing.mocks import MockContainerRuntimeFactory
+
+    for kind, expect in (("obliterate", "AD"), ("remove", "AxD")):
+        factory = MockContainerRuntimeFactory()
+        a = factory.create_client("a").attach(SharedString("doc"))
+        b = factory.create_client("b").attach(SharedString("doc"))
+        a.insert_text(0, "ABCD")
+        factory.process_all_messages()
+        # concurrent: a obliterates/removes [1,3) while b inserts at 2
+        getattr(a, f"{kind}_range")(1, 3)
+        b.insert_text(2, "x")
+        factory.process_all_messages()
+        assert a.text == b.text == expect, f"{kind}: {a.text!r}"
