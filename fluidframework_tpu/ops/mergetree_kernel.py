@@ -844,6 +844,18 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
 
 
 def known_oracle_fallback(doc: MergeTreeDocInput) -> bool:
+    # Memoized per doc object: partition_replay pre-filters with this and
+    # pack-time parity re-checks it — the op/binary scans must not run
+    # twice on the packing hot path (review-found).
+    cached = getattr(doc, "_fallback_verdict", None)
+    if cached is not None:
+        return cached
+    verdict = _known_oracle_fallback_uncached(doc)
+    doc._fallback_verdict = verdict
+    return verdict
+
+
+def _known_oracle_fallback_uncached(doc: MergeTreeDocInput) -> bool:
     """True when a doc is known *before packing* to need the oracle path:
     >1 overlap remover on a base record (the device tracks exactly two
     removers and the base format carries no overlap seqs), >2 obliterate

@@ -37,7 +37,7 @@ from .blobs import BlobManager
 from .datastore import FluidDataStoreRuntime
 from .gc import GarbageCollector, GCOptions
 from .id_compressor import IdCompressor
-from .op_pipeline import ChunkReassembler, encode_batch, maybe_decompress
+from .op_pipeline import ChunkReassembler, encode_batch, maybe_decompress, check_batch_version
 from .registry import ChannelRegistry, default_registry
 
 
@@ -219,7 +219,7 @@ class ContainerRuntime:
         if not self._outbox:
             return
         batch, self._outbox = self._outbox, []
-        contents = {"type": "groupedBatch", "ops": batch}
+        contents = {"type": "groupedBatch", "v": 1, "ops": batch}
         id_range = self.id_compressor.take_next_creation_range()
         if id_range is not None:
             contents["idRange"] = id_range
@@ -349,6 +349,7 @@ class ContainerRuntime:
                 contents = maybe_decompress(contents)
         if msg.type is MessageType.OP and isinstance(contents, dict) \
                 and contents.get("type") == "groupedBatch":
+            check_batch_version(contents)
             if "idRange" in contents:
                 self.id_compressor.finalize_range(contents["idRange"])
             local = msg.client_id in self._client_ids
@@ -465,9 +466,14 @@ class ContainerRuntime:
 
     # -- summaries -------------------------------------------------------------
 
+    #: Container summary FORMAT version: readers accept at-or-below
+    #: (absent = 1) and refuse newer — see load().
+    SUMMARY_FORMAT_VERSION = 1
+
     def summarize(self) -> SummaryTree:
         tree = SummaryTree()
-        meta = {"seq": self.ref_seq, "minSeq": self.min_seq}
+        meta = {"seq": self.ref_seq, "minSeq": self.min_seq,
+                "format": self.SUMMARY_FORMAT_VERSION}
         tree.add_blob(".metadata", canonical_json(meta))
         # Protocol state: quorum membership + propose/accept state (new
         # pre-summary JOINs — the log below the summary is collectible).
@@ -502,6 +508,12 @@ class ContainerRuntime:
         """Load from a summary; returns the summary's sequence point (the
         caller replays the op tail after it)."""
         meta = json.loads(summary.blob_bytes(".metadata"))
+        fmt = meta.get("format", 1)  # absent = the pre-version format
+        if fmt > self.SUMMARY_FORMAT_VERSION:
+            raise ValueError(
+                f"summary format {fmt} is newer than supported "
+                f"{self.SUMMARY_FORMAT_VERSION}"
+            )
         self.ref_seq = meta["seq"]
         self.min_seq = meta["minSeq"]
         protocol = json.loads(summary.blob_bytes(".protocol"))

@@ -24,6 +24,20 @@ from typing import Dict, List, Optional
 
 from ..protocol.summary import canonical_json
 
+#: Wire-format version of batch envelopes.  Writers stamp it; readers
+#: accept anything at or below (absent = 1, the pre-version format) and
+#: refuse newer — a rolled-back replica must fail loudly, not misparse.
+BATCH_WIRE_VERSION = 1
+
+
+def check_batch_version(contents: dict) -> None:
+    v = contents.get("v", 1)
+    if v > BATCH_WIRE_VERSION:
+        raise ValueError(
+            f"batch wire version {v} is newer than supported "
+            f"{BATCH_WIRE_VERSION}"
+        )
+
 
 def encode_batch(contents: dict, compression_threshold: int,
                  chunk_size: int) -> List[dict]:
@@ -116,5 +130,6 @@ def decode_stream(messages):
             continue
         contents = maybe_decompress(contents)
         if contents.get("type") == "groupedBatch":
+            check_batch_version(contents)
             yield (msg if contents is msg.contents
                    else dataclasses.replace(msg, contents=contents)), contents

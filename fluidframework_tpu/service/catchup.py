@@ -360,7 +360,10 @@ class CatchupService:
             tree = SummaryTree()
             tree.add_blob(
                 ".metadata",
-                canonical_json({"seq": final_seq, "minSeq": final_msn}),
+                canonical_json({
+                    "seq": final_seq, "minSeq": final_msn,
+                    "format": ContainerRuntime.SUMMARY_FORMAT_VERSION,
+                }),
             )
             tree.add_blob(
                 ".protocol", canonical_json(self._fold_protocol(work))

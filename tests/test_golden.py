@@ -1,0 +1,108 @@
+"""Golden-file back-compat: committed summary bytes must stay loadable and
+re-summarize byte-identically forever (the reference's snapshot-test
+capability, SURVEY.md §4).
+
+``tests/golden/container_v1.json`` holds a mixed-channel container summary
+(string with an obliterate in-window, map, matrix, tree, an accepted quorum
+proposal), its digest, a sequenced op tail, and the digest after replaying
+the tail.  If ANY codec change breaks these bytes, this test fails — format
+changes must bump the version and keep an N-1 read path instead.
+"""
+
+import json
+import os
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import SequencedMessage
+from fluidframework_tpu.protocol.summary import (
+    SUMMARY_WIRE_VERSION,
+    tree_from_obj,
+    tree_to_obj,
+)
+from fluidframework_tpu.runtime.container import ContainerRuntime
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "container_v1.json")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+def test_golden_summary_reloads_byte_identically(golden):
+    tree = tree_from_obj(golden["summary"])
+    assert tree.digest() == golden["summary_digest"], (
+        "committed summary bytes no longer reproduce their digest — a "
+        "codec change broke back-compat"
+    )
+    runtime = ContainerRuntime()
+    loaded_seq = runtime.load(tree)
+    assert loaded_seq == golden["summary_seq"]
+    # a freshly produced summary of the loaded state is byte-identical
+    assert runtime.summarize().digest() == golden["summary_digest"]
+
+
+def test_golden_tail_replay_reaches_committed_digest(golden):
+    runtime = ContainerRuntime()
+    runtime.load(tree_from_obj(golden["summary"]))
+    for d in golden["tail"]:
+        runtime.process(SequencedMessage.from_dict(d))
+    assert runtime.summarize().digest() == golden["final_digest"]
+    assert runtime.get_datastore("ds").get_channel("text").text == \
+        golden["final_text"]
+    # quorum proposal survived the round trip
+    assert runtime.quorum_proposals.get("code") == {"pkg": "golden", "v": 1}
+
+
+def test_golden_wire_roundtrip_is_stable(golden):
+    tree = tree_from_obj(golden["summary"])
+    again = tree_from_obj(tree_to_obj(tree))
+    assert again.digest() == golden["summary_digest"]
+
+
+# -- version skew --------------------------------------------------------------
+
+
+def test_newer_summary_format_is_refused(golden):
+    tree = tree_from_obj(golden["summary"])
+    meta = json.loads(tree.blob_bytes(".metadata"))
+    meta["format"] = ContainerRuntime.SUMMARY_FORMAT_VERSION + 1
+    tree.add_json_blob(".metadata", meta)
+    with pytest.raises(ValueError, match="newer than supported"):
+        ContainerRuntime().load(tree)
+
+
+def test_older_versionless_summary_still_loads(golden):
+    """The N-1 read path: a summary written before version stamping
+    (no 'format' key) loads as version 1."""
+    tree = tree_from_obj(golden["summary"])
+    meta = json.loads(tree.blob_bytes(".metadata"))
+    meta.pop("format")
+    tree.add_json_blob(".metadata", meta)
+    runtime = ContainerRuntime()
+    runtime.load(tree)
+    assert runtime.ref_seq == golden["summary_seq"]
+
+
+def test_newer_batch_wire_version_is_refused():
+    from fluidframework_tpu.runtime.op_pipeline import (
+        BATCH_WIRE_VERSION,
+        check_batch_version,
+    )
+
+    check_batch_version({"type": "groupedBatch", "ops": []})  # absent = v1
+    check_batch_version({"type": "groupedBatch", "v": 1, "ops": []})
+    with pytest.raises(ValueError, match="newer than supported"):
+        check_batch_version(
+            {"type": "groupedBatch", "v": BATCH_WIRE_VERSION + 1, "ops": []}
+        )
+
+
+def test_newer_summary_wire_version_is_refused(golden):
+    obj = dict(golden["summary"])
+    obj["v"] = SUMMARY_WIRE_VERSION + 1
+    with pytest.raises(ValueError, match="newer than supported"):
+        tree_from_obj(obj)
