@@ -265,6 +265,7 @@ class _RemoteStorage:
     def __init__(self, rpc: _RpcClient, doc_id: str) -> None:
         self._rpc = rpc
         self.doc_id = doc_id
+        self._last_uploaded: Optional[SummaryTree] = None
 
     def latest(self, at_or_below: Optional[int] = None):
         result = self._rpc.request(
@@ -276,11 +277,29 @@ class _RemoteStorage:
         return tree_from_obj(result["summary"]), result["ref_seq"]
 
     def upload(self, tree: SummaryTree, ref_seq: int) -> str:
-        return self._rpc.request(
-            "upload_summary",
-            {"doc": self.doc_id, "summary": tree_to_obj(tree),
-             "ref_seq": ref_seq},
-        )
+        """Incremental against the doc's latest server-side summary when we
+        have it cached: unchanged subtrees cross the wire as handles."""
+        from ..protocol.summary import tree_to_incremental_obj, tree_to_obj
+
+        obj = tree_to_incremental_obj(tree, self._last_uploaded)
+        try:
+            handle = self._rpc.request(
+                "upload_summary",
+                {"doc": self.doc_id, "summary": obj, "ref_seq": ref_seq},
+            )
+        except RpcError:
+            if self._last_uploaded is None:
+                raise
+            # The server no longer has the base objects (restore/eviction):
+            # resend in full and stop assuming the cache.
+            self._last_uploaded = None
+            handle = self._rpc.request(
+                "upload_summary",
+                {"doc": self.doc_id, "summary": tree_to_obj(tree),
+                 "ref_seq": ref_seq},
+            )
+        self._last_uploaded = tree
+        return handle
 
     def read(self, handle: str):
         return tree_from_obj(self._rpc.request(

@@ -20,7 +20,7 @@ from __future__ import annotations
 import hashlib
 import json
 from dataclasses import dataclass, field
-from typing import Dict, Union
+from typing import Dict, Union, Optional
 
 
 def canonical_json(obj) -> bytes:
@@ -64,17 +64,30 @@ class SummaryTree:
         self.children[name] = sub
         return sub
 
-    def digest(self) -> str:
-        """Merkle digest over sorted child names — the summary handle."""
+    def digest(self, _memo: Optional[dict] = None) -> str:
+        """Merkle digest over sorted child names — the summary handle.
+        ``_memo`` (id(node) -> digest) lets bulk walks hash each subtree
+        once instead of once per ancestor (incremental upload)."""
+        if _memo is not None:
+            cached = _memo.get(id(self))
+            if cached is not None:
+                return cached
         h = hashlib.sha256()
         h.update(b"tree\x00")
         for name in sorted(self.children):
             child = self.children[name]
             h.update(name.encode("utf-8"))
             h.update(b"\x00")
-            h.update(child.digest().encode("ascii"))
+            if isinstance(child, SummaryTree):
+                d = child.digest(_memo)
+            else:
+                d = child.digest()
+            h.update(d.encode("ascii"))
             h.update(b"\x00")
-        return h.hexdigest()
+        out = h.hexdigest()
+        if _memo is not None:
+            _memo[id(self)] = out
+        return out
 
     def get(self, path: str) -> Union["SummaryTree", SummaryBlob]:
         """Resolve a '/'-separated path to a node."""
@@ -111,6 +124,20 @@ class SummaryStorage:
         self._commits.setdefault(doc_id, []).append((handle, ref_seq))
         return handle
 
+    def upload_obj(self, doc_id: str, obj: dict, ref_seq: int) -> str:
+        """Upload from a (possibly INCREMENTAL) wire object: ``{"h": ...}``
+        nodes reference unchanged subtrees of an earlier summary already in
+        this store — the reference's handle-reuse upload (incremental
+        summaries).  Raises KeyError if a referenced handle is unknown
+        (callers fall back to a full upload)."""
+        tree = tree_from_obj(obj, resolve=self.read)
+        if not isinstance(tree, SummaryTree):
+            raise ValueError("summary root must be a tree")
+        return self.upload(doc_id, tree, ref_seq)
+
+    def has(self, handle: str) -> bool:
+        return handle in self._objects
+
     def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
         digest = node.digest()
         self._objects[digest] = node
@@ -146,27 +173,34 @@ class SummaryStorage:
 SUMMARY_WIRE_VERSION = 1
 
 
+def _encode_blob(blob: "SummaryBlob") -> dict:
+    """ONE wire encoding for blobs (utf-8 text, else base64) — shared by
+    the full and incremental encoders so they can never diverge."""
+    try:
+        return {"b": blob.content.decode("utf-8")}
+    except UnicodeDecodeError:
+        import base64
+
+        return {"b64": base64.b64encode(blob.content).decode("ascii")}
+
+
 def tree_to_obj(tree: "SummaryTree") -> dict:
     """SummaryTree -> JSON-safe wire object (version-stamped envelope at the
     root; blobs are utf-8 text when possible, else base64)."""
 
     def encode(node):
         if isinstance(node, SummaryBlob):
-            try:
-                return {"b": node.content.decode("utf-8")}
-            except UnicodeDecodeError:
-                import base64
-
-                return {"b64": base64.b64encode(node.content).decode("ascii")}
+            return _encode_blob(node)
         return {"t": {name: encode(child)
                       for name, child in node.children.items()}}
 
     return {"v": SUMMARY_WIRE_VERSION, **encode(tree)}
 
 
-def tree_from_obj(obj: dict) -> "SummaryTree":
+def tree_from_obj(obj: dict, resolve=None) -> "SummaryTree":
     """Inverse of :func:`tree_to_obj`; refuses versions newer than this
-    reader understands."""
+    reader understands.  ``resolve(handle)`` materializes ``{"h": ...}``
+    nodes (incremental uploads); without it a handle node raises."""
     version = obj.get("v", 1)
     if version > SUMMARY_WIRE_VERSION:
         raise ValueError(
@@ -175,6 +209,10 @@ def tree_from_obj(obj: dict) -> "SummaryTree":
         )
 
     def decode(node):
+        if "h" in node:
+            if resolve is None:
+                raise ValueError("handle node in a non-incremental context")
+            return resolve(node["h"])
         if "b" in node:
             return SummaryBlob(node["b"].encode("utf-8"))
         if "b64" in node:
@@ -187,3 +225,32 @@ def tree_from_obj(obj: dict) -> "SummaryTree":
         return tree
 
     return decode(obj)
+
+
+def tree_to_incremental_obj(tree: "SummaryTree",
+                            base: Optional["SummaryTree"]) -> dict:
+    """Wire object where every subtree/blob unchanged vs ``base`` collapses
+    to a ``{"h": digest}`` handle reference (the reference's incremental
+    summary upload: unchanged subtrees ride as handles to the previous
+    summary).  With ``base=None`` this is :func:`tree_to_obj`."""
+    if base is None:
+        return tree_to_obj(tree)
+    memo: dict = {}
+
+    def digest_of(node):
+        return node.digest(memo) if isinstance(node, SummaryTree) \
+            else node.digest()
+
+    def encode(node, base_node):
+        if base_node is not None and digest_of(node) == digest_of(base_node):
+            return {"h": digest_of(node)}
+        if isinstance(node, SummaryBlob):
+            return _encode_blob(node)
+        base_children = base_node.children \
+            if isinstance(base_node, SummaryTree) else {}
+        return {"t": {
+            name: encode(child, base_children.get(name))
+            for name, child in node.children.items()
+        }}
+
+    return {"v": SUMMARY_WIRE_VERSION, **encode(tree, base)}

@@ -37,7 +37,13 @@ from .blobs import BlobManager
 from .datastore import FluidDataStoreRuntime
 from .gc import GarbageCollector, GCOptions
 from .id_compressor import IdCompressor
-from .op_pipeline import ChunkReassembler, encode_batch, maybe_decompress, check_batch_version
+from .op_pipeline import (
+    BATCH_WIRE_VERSION,
+    ChunkReassembler,
+    check_batch_version,
+    encode_batch,
+    maybe_decompress,
+)
 from .registry import ChannelRegistry, default_registry
 
 
@@ -219,7 +225,8 @@ class ContainerRuntime:
         if not self._outbox:
             return
         batch, self._outbox = self._outbox, []
-        contents = {"type": "groupedBatch", "v": 1, "ops": batch}
+        contents = {"type": "groupedBatch", "v": BATCH_WIRE_VERSION,
+                    "ops": batch}
         id_range = self.id_compressor.take_next_creation_range()
         if id_range is not None:
             contents["idRange"] = id_range
@@ -470,10 +477,16 @@ class ContainerRuntime:
     #: (absent = 1) and refuse newer — see load().
     SUMMARY_FORMAT_VERSION = 1
 
+    @staticmethod
+    def container_metadata(seq: int, min_seq: int) -> dict:
+        """The .metadata blob content — ONE construction point shared with
+        the catch-up service (their root digests must stay identical)."""
+        return {"seq": seq, "minSeq": min_seq,
+                "format": ContainerRuntime.SUMMARY_FORMAT_VERSION}
+
     def summarize(self) -> SummaryTree:
         tree = SummaryTree()
-        meta = {"seq": self.ref_seq, "minSeq": self.min_seq,
-                "format": self.SUMMARY_FORMAT_VERSION}
+        meta = self.container_metadata(self.ref_seq, self.min_seq)
         tree.add_blob(".metadata", canonical_json(meta))
         # Protocol state: quorum membership + propose/accept state (new
         # pre-summary JOINs — the log below the summary is collectible).

@@ -26,6 +26,9 @@ class SummarizerOptions:
 
     ops_per_summary: int = 50    # summarize every N sequenced ops
     min_ops: int = 1             # never summarize with fewer new ops
+    #: record last_full_bytes alongside incremental uploads (costs one
+    #: full-tree encode per summary; disable for very large documents)
+    track_upload_ratio: bool = True
 
 
 class SummaryManager:
@@ -55,6 +58,9 @@ class SummaryManager:
         self.nacks_received = 0
         self.ops_since_summary = 0
         self.summaries_written = 0
+        # Incremental-upload accounting (set by summarize_now).
+        self.last_upload_bytes = 0
+        self.last_full_bytes = 0
         runtime.message_observers.append(self._on_message)
 
     # -- the message hook ------------------------------------------------------
@@ -103,10 +109,43 @@ class SummaryManager:
     # -- the summarize action --------------------------------------------------
 
     def summarize_now(self) -> Optional[str]:
-        """Write + upload + announce one summary; returns its handle."""
+        """Write + upload + announce one summary; returns its handle.
+
+        Uploads INCREMENTALLY against the last announced summary when its
+        tree is still in the store: unchanged subtrees ride as handle
+        references (the reference's incremental-summary capability), and
+        ``last_upload_bytes`` / ``last_full_bytes`` record the saving."""
+        from ..protocol.summary import (
+            canonical_json,
+            tree_to_incremental_obj,
+            tree_to_obj,
+        )
+
         tree = self.runtime.summarize()
         ref_seq = self.runtime.ref_seq
-        handle = self.storage.upload(self.doc_id, tree, ref_seq)
+        if self.options.track_upload_ratio:
+            # Telemetry denominator: serializing the FULL tree costs the
+            # O(tree) encode the incremental path avoids — flip the option
+            # off for very large documents.
+            self.last_full_bytes = len(canonical_json(tree_to_obj(tree)))
+        else:
+            self.last_full_bytes = 0
+        base = None
+        has = getattr(self.storage, "has", None)
+        upload_obj = getattr(self.storage, "upload_obj", None)
+        if has is not None and upload_obj is not None \
+                and self.last_ack_handle is not None \
+                and has(self.last_ack_handle):
+            base = self.storage.read(self.last_ack_handle)
+        if base is not None:
+            obj = tree_to_incremental_obj(tree, base)
+            self.last_upload_bytes = len(canonical_json(obj))
+            handle = upload_obj(self.doc_id, obj, ref_seq)
+        else:
+            # Driver storages without incremental support, or no usable
+            # base: full upload.
+            self.last_upload_bytes = self.last_full_bytes
+            handle = self.storage.upload(self.doc_id, tree, ref_seq)
         self.summaries_written += 1
         self.runtime._service.submit(
             RawOperation(

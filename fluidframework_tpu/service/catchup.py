@@ -360,10 +360,9 @@ class CatchupService:
             tree = SummaryTree()
             tree.add_blob(
                 ".metadata",
-                canonical_json({
-                    "seq": final_seq, "minSeq": final_msn,
-                    "format": ContainerRuntime.SUMMARY_FORMAT_VERSION,
-                }),
+                canonical_json(
+                    ContainerRuntime.container_metadata(final_seq, final_msn)
+                ),
             )
             tree.add_blob(
                 ".protocol", canonical_json(self._fold_protocol(work))

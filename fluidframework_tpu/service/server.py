@@ -199,9 +199,10 @@ class OrderingServer:
                 return None
             return {"summary": tree_to_obj(tree), "ref_seq": ref_seq}
         if method == "upload_summary":
-            return service.storage.upload(
-                params["doc"], tree_from_obj(params["summary"]),
-                params["ref_seq"],
+            # Incremental upload: {"h": ...} nodes resolve against the
+            # server store (unchanged subtrees never cross the wire).
+            return service.storage.upload_obj(
+                params["doc"], params["summary"], params["ref_seq"],
             )
         if method == "read_summary":
             node = service.storage.read(params["handle"])
