@@ -25,7 +25,7 @@ import socket
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.messages import NackError, RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
 from ..protocol.wire import LEN as _LEN, WIRE_VERSION, frame_bytes
 
@@ -132,6 +132,10 @@ class _RpcClient:
                 self._pending.pop(rid, None)
             raise RpcError(f"timeout waiting for {method}")
         if not frame.get("ok"):
+            nack = frame.get("nack")
+            if nack is not None:
+                raise NackError(nack.get("reason", "nacked"),
+                                retry_after=nack.get("retryAfter", 0.0))
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
 

@@ -23,7 +23,9 @@ import enum
 import itertools
 from typing import Callable, List, Optional
 
-from ..protocol.messages import RawOperation, SequencedMessage
+import time
+
+from ..protocol.messages import NackError, RawOperation, SequencedMessage
 
 _session_counter = itertools.count(1)
 
@@ -46,6 +48,17 @@ class DeltaManager:
         self.read_only = False
         self.last_delivered_seq = 0
         self.gaps_repaired = 0
+        self.nacks = 0
+        # An op-level NACK with retryAfter holds outbound sends (can_send
+        # False) until this wall-clock moment; optimistic local state stays
+        # intact and everything rides out on the next writable flush.
+        self.nacked_until = 0.0
+        # A staleView nack means the queued wire bytes reference a view
+        # below the collaboration window: resending identical bytes would
+        # livelock.  The container's pump sees this flag and reconnects,
+        # which discards the stale encodings and REBASES pending ops to a
+        # fresh view (the existing reconnect machinery).
+        self.rebase_required = False
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._ahead: dict = {}  # seq -> parked out-of-order message
         self._live_fn = None
@@ -93,14 +106,33 @@ class DeltaManager:
         submit time would fire *after* the DDS's optimistic apply and
         strand a diverged replica."""
         return (self.state is ConnectionState.CONNECTED
-                and not self.read_only)
+                and not self.read_only
+                and time.time() >= self.nacked_until)
 
     def submit(self, op: RawOperation):
         if self.read_only:
             raise PermissionError("container is in read-only mode")
         if self.state is not ConnectionState.CONNECTED:
             raise ConnectionError(f"not connected (state={self.state.value})")
-        return self._service.connection().submit(op)
+        if time.time() < self.nacked_until:
+            # Direct submitters honor the retryAfter hold too (the flush
+            # path is already gated by can_send).
+            raise NackError("held by retryAfter",
+                            retry_after=self.nacked_until - time.time())
+        try:
+            return self._service.connection().submit(op)
+        except NackError as nack:
+            # The service refused the op (throttle / stale view): hold
+            # sends for retryAfter; the runtime keeps the encoded ops
+            # queued (NackError IS a ConnectionError) and the next
+            # writable flush resends them.
+            self.nacks += 1
+            self.nacked_until = max(
+                self.nacked_until, time.time() + nack.retry_after
+            )
+            if nack.code == "staleView":
+                self.rebase_required = True
+            raise
 
     # -- signals ---------------------------------------------------------------
 

@@ -88,8 +88,18 @@ class Container:
 
     def drain(self) -> int:
         """Process everything queued inbound (tests/hosts drive delivery
-        explicitly; a live host would pump this from its event loop)."""
-        return self.runtime.drain()
+        explicitly; a live host would pump this from its event loop).
+
+        A staleView op-nack (queued wire bytes referencing a view below the
+        collaboration window) is repaired here by reconnecting: the
+        reconnect discards the stale encodings and rebases pending ops to
+        a fresh view — resending identical bytes would livelock."""
+        n = self.runtime.drain()
+        if self.delta_manager.rebase_required:
+            self.delta_manager.rebase_required = False
+            self.reconnect()
+            n += self.runtime.drain()
+        return n
 
     # -- connection lifecycle --------------------------------------------------
 

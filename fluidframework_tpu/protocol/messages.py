@@ -50,6 +50,25 @@ class MessageType(str, enum.Enum):
     SIGNAL = "signal"            # unsequenced ephemeral broadcast (presence)
 
 
+class NackError(ConnectionError):
+    """An op the service refused to sequence (throttling, stale ref_seq).
+
+    Subclasses ConnectionError deliberately: the runtime's wire-drain
+    already treats ConnectionError as "keep the encoded ops queued and
+    retry on a later flush", which is exactly nack semantics — the
+    DeltaManager additionally honors ``retry_after`` before re-sending.
+    """
+
+    def __init__(self, reason: str, retry_after: float = 0.0,
+                 code: str = "throttled") -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after = retry_after
+        #: "throttled" (resend the same bytes later) or "staleView" (the
+        #: encoded view is unresolvable: rebase + resubmit via reconnect)
+        self.code = code
+
+
 @dataclasses.dataclass
 class RawOperation:
     """An op as submitted by a client, before sequencing."""

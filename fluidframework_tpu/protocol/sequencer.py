@@ -19,7 +19,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List, Optional
 
-from .messages import INITIAL_SEQ, MessageType, RawOperation, SequencedMessage
+from .messages import (
+    INITIAL_SEQ,
+    MessageType,
+    NackError,
+    RawOperation,
+    SequencedMessage,
+)
 
 
 @dataclasses.dataclass
@@ -39,9 +45,14 @@ class Sequencer:
     and the fuzz harness can drive interleavings explicitly.
     """
 
-    def __init__(self, start_seq: int = INITIAL_SEQ) -> None:
+    def __init__(self, start_seq: int = INITIAL_SEQ,
+                 throttle=None) -> None:
         self._seq = start_seq
         self._min_seq = start_seq
+        #: optional policy: callable(client_id) -> retry-after seconds when
+        #: this submit should be NACKed (throttling), else None.
+        self.throttle = throttle
+        self.nacks_issued = 0
         self._clients: Dict[str, ClientConnection] = {}
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._log: List[SequencedMessage] = []
@@ -123,6 +134,21 @@ class Sequencer:
             raise ValueError(f"client {op.client_id!r} is not connected")
         if op.client_seq <= conn.last_client_seq:
             return None  # duplicate — dedup by clientSeq
+        if self.throttle is not None:
+            retry_after = self.throttle(op.client_id)
+            if retry_after is not None:
+                self.nacks_issued += 1
+                raise NackError("throttled", retry_after=float(retry_after))
+        if op.ref_seq < self.min_seq:
+            # A view below the collaboration window cannot be resolved
+            # (zamboni collected what it referenced): the client must
+            # rebase and resubmit against a fresh view (reconnect path).
+            self.nacks_issued += 1
+            raise NackError(
+                f"refSeq {op.ref_seq} below the collaboration window "
+                f"(minSeq {self.min_seq})", retry_after=0.0,
+                code="staleView",
+            )
         conn.last_client_seq = op.client_seq
         conn.ref_seq = max(conn.ref_seq, op.ref_seq)
         return self._stamp(

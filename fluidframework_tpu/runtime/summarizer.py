@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
-from ..protocol.messages import MessageType, RawOperation, SequencedMessage
+from ..protocol.messages import MessageType, RawOperation, SequencedMessage, NackError
 from ..protocol.summary import SummaryStorage
 from .container import ContainerRuntime, OrderedClientElection
 
@@ -29,6 +29,10 @@ class SummarizerOptions:
     #: record last_full_bytes alongside incremental uploads (costs one
     #: full-tree encode per summary; disable for very large documents)
     track_upload_ratio: bool = True
+    #: after a summary NACK, retry once this many ops have sequenced —
+    #: doubling per consecutive nack (deterministic in-proc backoff),
+    #: resetting on the next ack
+    nack_retry_ops: int = 4
 
 
 class SummaryManager:
@@ -56,6 +60,7 @@ class SummaryManager:
         self.last_acked_handle: Optional[str] = None
         self.last_acked_seq = 0
         self.nacks_received = 0
+        self.consecutive_nacks = 0
         self.ops_since_summary = 0
         self.summaries_written = 0
         # Incremental-upload accounting (set by summarize_now).
@@ -82,6 +87,7 @@ class SummaryManager:
         elif msg.type is MessageType.SUMMARY_ACK:
             self.last_acked_handle = msg.contents["handle"]
             self.last_acked_seq = msg.contents["seq"]
+            self.consecutive_nacks = 0
         elif msg.type is MessageType.SUMMARY_NACK:
             # No immediate retry (a persistent nack reason would loop);
             # the next ops_per_summary window naturally re-attempts — the
@@ -89,12 +95,21 @@ class SummaryManager:
             # Roll the takeover baseline back to the last *accepted* summary
             # so a re-elected summarizer never builds on the rejected one.
             self.nacks_received += 1
+            self.consecutive_nacks += 1
             self.last_summary_seq = self.last_acked_seq
             self.last_ack_handle = self.last_acked_handle
+        # Normal cadence, or — after a NACK — an exponential-backoff
+        # retry window (nack_retry_ops * 2^(nacks-1) sequenced ops), so a
+        # transient rejection re-attempts without waiting out the full
+        # summary window and a persistent one cannot hot-loop.
+        threshold = self.options.ops_per_summary
+        if self.consecutive_nacks:
+            threshold = min(threshold, self.options.nack_retry_ops
+                            * (2 ** (self.consecutive_nacks - 1)))
         if (
             self._is_summarizer
             and msg.type is not MessageType.SUMMARIZE
-            and self.ops_since_summary >= self.options.ops_per_summary
+            and self.ops_since_summary >= threshold
             and self.ops_since_summary >= self.options.min_ops
         ):
             self.summarize_now()
@@ -147,15 +162,25 @@ class SummaryManager:
             self.last_upload_bytes = self.last_full_bytes
             handle = self.storage.upload(self.doc_id, tree, ref_seq)
         self.summaries_written += 1
-        self.runtime._service.submit(
-            RawOperation(
-                client_id=self.runtime.client_id,
-                client_seq=self._next_summary_client_seq(),
-                ref_seq=ref_seq,
-                type=MessageType.SUMMARIZE,
-                contents={"handle": handle, "seq": ref_seq},
+        try:
+            self.runtime._service.submit(
+                RawOperation(
+                    client_id=self.runtime.client_id,
+                    client_seq=self._next_summary_client_seq(),
+                    ref_seq=ref_seq,
+                    type=MessageType.SUMMARIZE,
+                    contents={"handle": handle, "seq": ref_seq},
+                )
             )
-        )
+        except NackError:
+            # The announcement was refused (throttle / retryAfter hold).
+            # The uploaded tree is not lost — a later attempt re-announces;
+            # count it as a nack so the retry follows the backoff window
+            # instead of hot-looping inside the delivery observer.
+            self.consecutive_nacks += 1
+            self.nacks_received += 1
+            self.ops_since_summary = 0
+            return None
         return handle
 
     def _next_summary_client_seq(self) -> int:

@@ -35,11 +35,12 @@ class DocumentOrderer:
         oplog: OpLog,
         storage: SummaryStorage,
         sequencer: Optional[Sequencer] = None,
+        throttle=None,
     ) -> None:
         self.doc_id = doc_id
         self.oplog = oplog
         self.storage = storage
-        self.sequencer = sequencer or Sequencer()
+        self.sequencer = sequencer or Sequencer(throttle=throttle)
         # Durable append rides first in the broadcast chain: by the time any
         # client sees a message it is already in the log (scriptorium-before-
         # broadcast, collapsing the reference's Kafka fan-out).
@@ -178,16 +179,20 @@ class LocalOrderingService:
         self,
         oplog: Optional[OpLog] = None,
         storage: Optional[SummaryStorage] = None,
+        throttle=None,
     ) -> None:
         self.oplog = oplog if oplog is not None else OpLog()
         self.storage = storage if storage is not None else SummaryStorage()
+        #: optional per-submit throttle policy handed to every document's
+        #: sequencer: callable(client_id) -> retry-after seconds | None.
+        self.throttle = throttle
         self._orderers: Dict[str, DocumentOrderer] = {}
 
     def create_document(self, doc_id: str) -> DocumentEndpoint:
         if doc_id in self._orderers:
             raise ValueError(f"document {doc_id!r} already exists")
         self._orderers[doc_id] = DocumentOrderer(
-            doc_id, self.oplog, self.storage
+            doc_id, self.oplog, self.storage, throttle=self.throttle
         )
         return DocumentEndpoint(self._orderers[doc_id])
 

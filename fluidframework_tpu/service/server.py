@@ -35,7 +35,7 @@ import json
 import threading
 from typing import Dict, Optional, Set
 
-from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.messages import NackError, RawOperation, SequencedMessage
 from ..protocol.summary import tree_from_obj, tree_to_obj
 from ..protocol.wire import LEN as _LEN, MAX_FRAME, WIRE_VERSION, frame_bytes
 from .orderer import LocalOrderingService
@@ -233,6 +233,12 @@ class OrderingServer:
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
                                     "ok": True, "result": result}
+                    except NackError as nack:
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": False, "error": nack.reason,
+                                    "nack": {"retryAfter": nack.retry_after,
+                                             "reason": nack.reason}}
                     except Exception as exc:  # surfaced to the client
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),

@@ -25,6 +25,9 @@ from ..service import LocalOrderingService
 class LoadSpec:
     seed: int = 0
     clients: int = 4
+    #: fault injection: NACK every Nth submit service-side (0 = off); the
+    #: nacked ops must still converge (the runtime requeues + resends)
+    nack_every: int = 0
     steps: int = 200               # total scheduled actions
     edit_weight: float = 0.70
     sync_weight: float = 0.15
@@ -44,11 +47,22 @@ class LoadResult:
     final_clients: int
     sequenced_ops: int
     summary_digest: str
+    nacks_issued: int = 0
 
 
 def run_load(spec: LoadSpec) -> LoadResult:
     rng = random.Random(spec.seed)
-    service = LocalOrderingService()
+    throttle = None
+    if spec.nack_every:
+        counter = {"n": 0}
+
+        def throttle(_client_id):
+            counter["n"] += 1
+            if counter["n"] % spec.nack_every == 0:
+                return 0.0  # immediate-retry nack (fault injection)
+            return None
+
+    service = LocalOrderingService(throttle=throttle)
     loader = Loader(LocalDocumentServiceFactory(service))
 
     def build(rt):
@@ -134,14 +148,40 @@ def run_load(spec: LoadSpec) -> LoadResult:
         if offline[cid]:
             container.reconnect()
             offline[cid] = False
-    for _ in range(4):  # a few rounds: reconnect resubmits need re-drains
+    # Pump until TRUE quiescence: reconnect resubmits and nack-requeued
+    # wire messages (fault injection) may need several flush+drain rounds
+    # before every replica has flushed everything and seen the head.
+    for _round in range(64):
         for container in containers.values():
+            container.runtime.flush()
             container.drain()
+        head = service.oplog.head("load-doc")
+        if all(
+            c.runtime.ref_seq == head
+            and not c.runtime._pending_wire
+            and not c.runtime._outbox
+            for c in containers.values()
+        ):
+            break
+    else:
+        raise AssertionError("load run never quiesced after 64 rounds")
 
     digests = {c.runtime.summarize().digest() for c in containers.values()}
-    assert len(digests) == 1, (
-        f"load run diverged: {len(digests)} distinct summaries"
-    )
+    if len(digests) != 1:
+        detail = []
+        for cid, c in containers.items():
+            text = c.runtime.get_datastore("ds").get_channel("text")
+            dm = c.delta_manager
+            detail.append(
+                f"{cid}: seq={c.runtime.ref_seq} nacks={dm.nacks} "
+                f"state={dm.state.value} "
+                f"pending_wire={len(c.runtime._pending_wire)} "
+                f"outbox={len(c.runtime._outbox)} text={text.text[:40]!r}"
+            )
+        raise AssertionError(
+            "load run diverged: "
+            + f"{len(digests)} distinct summaries\n" + "\n".join(detail)
+        )
     return LoadResult(
         steps=spec.steps,
         edits=edits,
@@ -151,4 +191,7 @@ def run_load(spec: LoadSpec) -> LoadResult:
         final_clients=len(containers),
         sequenced_ops=service.oplog.head("load-doc"),
         summary_digest=next(iter(digests)),
+        nacks_issued=sum(
+            o.sequencer.nacks_issued for o in service._orderers.values()
+        ),
     )

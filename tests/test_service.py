@@ -251,9 +251,10 @@ def test_fresh_session_reusing_client_id_gets_fresh_floor():
     ep.connect("bob", session="one")
     ep.submit(op("bob", 1))
     ep.submit(op("bob", 2))
-    # fresh session, same id
+    # fresh session, same id — submits against a CURRENT view (a stale
+    # ref below the collaboration window would be op-nacked)
     ep.connect("bob", session="two")
-    msg = ep.submit(op("bob", 1))  # client_seq restarts
+    msg = ep.submit(op("bob", 1, ref_seq=ep.head_seq))  # client_seq restarts
     assert msg is not None
     # the swap is visible in the stream as LEAVE + JOIN
     types = [m.type for m in ep.log]
