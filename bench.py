@@ -138,6 +138,46 @@ def oracle_replay(doc):
     return replica
 
 
+def _forced_layout_canary() -> None:
+    """Compile-and-fetch a TINY forced-layout program in a SUBPROCESS with
+    a timeout before the warmup compiles the real one.  If the canary
+    hangs or fails (an unhealthy tunnel can wedge on layout-constrained
+    compilation), flip the kill switch so the run completes without the
+    forced-layout fetch optimization instead of hanging the whole bench."""
+    import subprocess
+
+    if os.environ.get("FF_NO_FORCED_LAYOUT"):
+        return
+    # Run BEFORE the parent touches the backend: on exclusive-ownership
+    # TPU runtimes the subprocess must be able to acquire the device.
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "import sys\n"
+        "sys.exit(0) if jax.default_backend() == 'cpu' else None\n"
+        "from jax.experimental.layout import Format, Layout\n"
+        "from jax.sharding import SingleDeviceSharding\n"
+        "import numpy as np\n"
+        "fmt = Format(Layout(major_to_minor=(0, 1, 2)),"
+        " SingleDeviceSharding(jax.devices()[0]))\n"
+        "f = jax.jit(lambda x: x * 2, out_shardings=fmt)\n"
+        "out = np.asarray(f(jnp.ones((4, 4, 8), jnp.int16)))\n"
+        "assert out[0, 0, 0] == 2\n"
+        "print('canary-ok')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=180,
+        )
+        ok = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        os.environ["FF_NO_FORCED_LAYOUT"] = "1"
+        print("forced-layout canary FAILED; running without the "
+              "layout-forced fetch", file=sys.stderr)
+
+
 def link_microbench() -> dict:
     """Measure the host↔device link in-run: per-RPC latency (best of 3
     one-element round trips) and MB/s each way on a 16MB default-layout
@@ -275,6 +315,7 @@ def run_e2e(docs):
 
 
 def main() -> None:
+    _forced_layout_canary()  # before ANY parent-side backend init
     t0 = time.time()
     docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
     total_ops = N_DOCS * OPS_PER_DOC

@@ -135,7 +135,8 @@ class _RpcClient:
             nack = frame.get("nack")
             if nack is not None:
                 raise NackError(nack.get("reason", "nacked"),
-                                retry_after=nack.get("retryAfter", 0.0))
+                                retry_after=nack.get("retryAfter", 0.0),
+                                code=nack.get("code", "throttled"))
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
 
@@ -264,21 +265,41 @@ class _RemoteDeltaStorage:
 
 
 class _RemoteStorage:
-    """The summary store over the wire."""
+    """The summary store over the wire, with a client-side snapshot cache
+    (odsp-driver capability): summaries fetched or uploaded are remembered
+    by handle, and ``latest`` advertises the cached handles so an unchanged
+    snapshot never crosses the wire again."""
+
+    #: retained snapshots per document connection
+    CACHE_LIMIT = 8
 
     def __init__(self, rpc: _RpcClient, doc_id: str) -> None:
         self._rpc = rpc
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
+        self._snapshot_cache: "dict[str, SummaryTree]" = {}
+
+    def _remember(self, handle: str, tree: SummaryTree) -> None:
+        self._snapshot_cache[handle] = tree
+        while len(self._snapshot_cache) > self.CACHE_LIMIT:
+            self._snapshot_cache.pop(next(iter(self._snapshot_cache)))
 
     def latest(self, at_or_below: Optional[int] = None):
         result = self._rpc.request(
             "latest_summary",
-            {"doc": self.doc_id, "at_or_below": at_or_below},
+            {"doc": self.doc_id, "at_or_below": at_or_below,
+             "have": list(self._snapshot_cache)},
         )
         if result is None:
             return None, 0
-        return tree_from_obj(result["summary"]), result["ref_seq"]
+        handle = result.get("handle")
+        if "summary" in result:
+            tree = tree_from_obj(result["summary"])
+            if handle:
+                self._remember(handle, tree)
+        else:
+            tree = self._snapshot_cache[handle]  # server said we have it
+        return tree, result["ref_seq"]
 
     def upload(self, tree: SummaryTree, ref_seq: int) -> str:
         """Incremental against the doc's latest server-side summary when we
@@ -303,11 +324,25 @@ class _RemoteStorage:
                  "ref_seq": ref_seq},
             )
         self._last_uploaded = tree
+        self._remember(handle, tree)
         return handle
 
     def read(self, handle: str):
-        return tree_from_obj(self._rpc.request(
+        cached = self._snapshot_cache.get(handle)
+        if cached is not None:
+            return cached
+        tree = tree_from_obj(self._rpc.request(
             "read_summary", {"handle": handle}
+        ))
+        self._remember(handle, tree)
+        return tree
+
+    def read_partial(self, handle: str, path: str):
+        """Partial snapshot fetch: one subtree/blob by path — the odsp
+        snapshot-virtualization capability (bounded download for huge
+        documents)."""
+        return tree_from_obj(self._rpc.request(
+            "read_summary", {"handle": handle, "path": path}
         ))
 
 
@@ -315,9 +350,19 @@ class NetworkDocumentServiceFactory:
     """``IDocumentServiceFactory`` capability over a TCP ordering server."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
-                 timeout: float = 30.0) -> None:
+                 timeout: float = 30.0, tenant: Optional[str] = None,
+                 secret: Optional[str] = None) -> None:
         self._rpc = _RpcClient(host, port, timeout=timeout)
         self._connections: Dict[str, NetworkConnection] = {}
+        if tenant is not None:
+            # Riddler capability: authenticate the connection before any
+            # document traffic; the server namespaces docs per tenant.
+            try:
+                self._rpc.request("auth",
+                                  {"tenant": tenant, "secret": secret})
+            except BaseException:
+                self._rpc.close()  # no factory object escapes to close()
+                raise
 
     def _connection(self, doc_id: str) -> NetworkConnection:
         conn = self._connections.get(doc_id)

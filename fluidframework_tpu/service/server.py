@@ -67,6 +67,7 @@ class _ClientSession:
         self.signal_docs: Set[str] = set()
         self.connected_clients: Dict[str, str] = {}  # client_id -> doc_id
         self._fns: Dict[str, tuple] = {}  # doc -> (op_fn, signal_fn)
+        self.tenant: Optional[str] = None  # set by a successful "auth"
 
     #: Disconnect a session whose unread broadcast backlog exceeds this
     #: (a stalled reader must not grow the server's buffers without bound;
@@ -91,20 +92,21 @@ class _ClientSession:
 
     # -- broadcast taps --------------------------------------------------------
 
-    def tap(self, doc_id: str) -> None:
+    def tap(self, doc_id: str, wire_doc: Optional[str] = None) -> None:
         if doc_id in self.subscribed_docs:
             return
         endpoint = self.server.service.endpoint(doc_id)
+        out_doc = wire_doc if wire_doc is not None else doc_id
 
         def on_op(msg: SequencedMessage) -> None:
-            self.send({"v": WIRE_VERSION, "event": "op", "doc": doc_id,
+            self.send({"v": WIRE_VERSION, "event": "op", "doc": out_doc,
                        "msg": msg.to_dict()})
 
         def on_signal(signal: dict) -> None:
             target = signal.get("targetClientId")
             if target is not None and target not in self.connected_clients:
                 return
-            self.send({"v": WIRE_VERSION, "event": "signal", "doc": doc_id,
+            self.send({"v": WIRE_VERSION, "event": "signal", "doc": out_doc,
                        "signal": signal})
 
         endpoint.subscribe(on_op)
@@ -133,11 +135,19 @@ class OrderingServer:
     """Asyncio TCP server exposing a LocalOrderingService to the network."""
 
     def __init__(self, service: Optional[LocalOrderingService] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 tenants: Optional[Dict[str, str]] = None) -> None:
         self.service = service if service is not None else \
             LocalOrderingService()
         self.host = host
         self.port = port
+        #: tenant id -> shared secret (the Riddler capability).  When set,
+        #: every connection must "auth" first; document ids are namespaced
+        #: per tenant so tenants cannot see each other's documents.
+        self.tenants = tenants
+        #: root summary handle -> owning tenant (handle reads are scoped:
+        #: a handle is only readable by the tenant whose documents own it)
+        self._handle_tenant: Dict[str, str] = {}
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
 
@@ -146,18 +156,40 @@ class OrderingServer:
     def _dispatch(self, session: _ClientSession, method: str,
                   params: dict):
         service = self.service
+        if method == "auth":
+            if self.tenants is None:
+                return True  # open server: auth is a no-op
+            tenant = params.get("tenant")
+            if self.tenants.get(tenant) != params.get("secret"):
+                raise PermissionError("invalid tenant credentials")
+            session.tenant = tenant
+            return True
+        if method == "ping":
+            return "pong"
+        client_doc = params.get("doc")
+        if self.tenants is not None:
+            if session.tenant is None:
+                raise PermissionError("authenticate first")
+            # Namespace every document id under the tenant: tenants can
+            # never address each other's documents.
+            if "doc" in params:
+                params = dict(params, doc=f"{session.tenant}/{params['doc']}")
         if method == "create_document":
             service.create_document(params["doc"])
             if "summary" in params:
+                tree = tree_from_obj(params["summary"])
                 service.storage.upload(
-                    params["doc"], tree_from_obj(params["summary"]),
-                    params.get("ref_seq", 0),
+                    params["doc"], tree, params.get("ref_seq", 0),
                 )
+                if session.tenant is not None:
+                    self._handle_tenant[tree.digest()] = session.tenant
             return True
         if method == "has_document":
             return service.has_document(params["doc"])
         if method == "subscribe_doc":
-            session.tap(params["doc"])
+            # Broadcast frames carry the CLIENT-visible doc id (tenant
+            # namespacing is server-internal).
+            session.tap(params["doc"], wire_doc=client_doc)
             return service.endpoint(params["doc"]).head_seq
         if method == "connect":
             endpoint = service.endpoint(params["doc"])
@@ -197,18 +229,44 @@ class OrderingServer:
             )
             if tree is None:
                 return None
-            return {"summary": tree_to_obj(tree), "ref_seq": ref_seq}
+            handle = tree.digest()
+            if session.tenant is not None:
+                self._handle_tenant[handle] = session.tenant
+            if handle in (params.get("have") or []):
+                # Client-side snapshot cache hit: the body never crosses
+                # the wire (odsp-driver caching capability).
+                return {"handle": handle, "ref_seq": ref_seq}
+            return {"handle": handle, "summary": tree_to_obj(tree),
+                    "ref_seq": ref_seq}
         if method == "upload_summary":
             # Incremental upload: {"h": ...} nodes resolve against the
             # server store (unchanged subtrees never cross the wire).
-            return service.storage.upload_obj(
+            handle = service.storage.upload_obj(
                 params["doc"], params["summary"], params["ref_seq"],
             )
+            if session.tenant is not None:
+                self._handle_tenant[handle] = session.tenant
+            return handle
         if method == "read_summary":
+            if self.tenants is not None and \
+                    self._handle_tenant.get(params["handle"]) != \
+                    session.tenant:
+                # Handles are content-addressed and global; scope reads to
+                # the owning tenant or snapshots would leak across tenants.
+                raise PermissionError("unknown handle for this tenant")
             node = service.storage.read(params["handle"])
+            path = params.get("path")
+            if path:
+                # Partial snapshot virtualization: fetch one subtree/blob
+                # instead of the whole snapshot (odsp capability).
+                node = node.get(path)
+            from ..protocol.summary import SummaryBlob
+
+            if isinstance(node, SummaryBlob):
+                from ..protocol.summary import _encode_blob
+
+                return {"v": 1, **_encode_blob(node)}
             return tree_to_obj(node)
-        if method == "ping":
-            return "pong"
         raise ValueError(f"unknown method {method!r}")
 
     async def _handle(self, reader: asyncio.StreamReader,
@@ -238,7 +296,8 @@ class OrderingServer:
                                     "re": frame.get("id"),
                                     "ok": False, "error": nack.reason,
                                     "nack": {"retryAfter": nack.retry_after,
-                                             "reason": nack.reason}}
+                                             "reason": nack.reason,
+                                             "code": nack.code}}
                     except Exception as exc:  # surfaced to the client
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),

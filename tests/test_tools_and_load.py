@@ -119,3 +119,47 @@ def test_load_run_with_heavy_faults_converges():
     result = run_load(spec)
     assert result.disconnects > 0
     assert result.rehydrates + result.late_joins > 0
+
+
+def test_devtools_inspector_snapshot():
+    """The runtime inspector renders live state read-only: channels, quorum,
+    proposals, connection and summarizer stats — and inspecting twice gives
+    the same snapshot (no mutation)."""
+    import json as _json
+
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.runtime.summarizer import (
+        SummarizerOptions,
+        SummaryManager,
+    )
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.tools.devtools import inspect_runtime
+
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    rt = ContainerRuntime()
+    ds = rt.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+    ds.create_channel("map-tpu", "kv")
+    ds.create_channel("counter-tpu", "n")
+    rt.connect(ep, "alice")
+    rt.drain()
+    mgr = SummaryManager(rt, service.storage, "doc",
+                         SummarizerOptions(ops_per_summary=1000))
+    rt.get_datastore("ds").get_channel("text").insert_text(0, "hello")
+    rt.get_datastore("ds").get_channel("kv").set("k", 1)
+    rt.get_datastore("ds").get_channel("n").increment(2)
+    rt.propose("code", "v1")
+    rt.drain()
+
+    snap = inspect_runtime(rt, summary_manager=mgr)
+    _json.dumps(snap)  # JSON-safe
+    assert snap["clientId"] == "alice"
+    assert snap["quorum"] == ["alice"]
+    channels = snap["datastores"]["ds"]["channels"]
+    assert channels["text"]["preview"] == "hello"
+    assert channels["kv"]["preview"] == {"k": 1}
+    assert channels["n"]["value"] == 2
+    assert snap["proposals"]["pending"] or snap["proposals"]["accepted"]
+    assert snap["summarizer"]["isSummarizer"] is True
+    assert inspect_runtime(rt, summary_manager=mgr) == snap  # read-only
