@@ -186,6 +186,12 @@ class LocalOrderingService:
         #: optional per-submit throttle policy handed to every document's
         #: sequencer: callable(client_id) -> retry-after seconds | None.
         self.throttle = throttle
+        #: summary-node digest -> {tenant ids allowed to read it}.  Lives on
+        #: the SHARED service (not a front-door instance) so multi-instance
+        #: deployments agree; content-addressed nodes can be owned by many
+        #: tenants at once.  A production store would prune these with
+        #: summary eviction; entries are per-node and tiny.
+        self.handle_tenants: Dict[str, set] = {}
         self._orderers: Dict[str, DocumentOrderer] = {}
 
     def create_document(self, doc_id: str) -> DocumentEndpoint:

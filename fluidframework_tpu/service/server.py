@@ -145,11 +145,48 @@ class OrderingServer:
         #: every connection must "auth" first; document ids are namespaced
         #: per tenant so tenants cannot see each other's documents.
         self.tenants = tenants
-        #: root summary handle -> owning tenant (handle reads are scoped:
-        #: a handle is only readable by the tenant whose documents own it)
-        self._handle_tenant: Dict[str, str] = {}
+
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
+
+    # -- tenancy scoping -------------------------------------------------------
+
+    def _grant_tree(self, tree, tenant: Optional[str]) -> None:
+        """Grant the tenant read access to EVERY node digest of a summary
+        (incremental uploads reference arbitrary subtree handles)."""
+        if tenant is None:
+            return
+        memo: dict = {}
+        grants = self.service.handle_tenants
+
+        def walk(node):
+            from ..protocol.summary import SummaryTree
+
+            digest = node.digest(memo) if isinstance(node, SummaryTree) \
+                else node.digest()
+            grants.setdefault(digest, set()).add(tenant)
+            if isinstance(node, SummaryTree):
+                for child in node.children.values():
+                    walk(child)
+
+        walk(tree)
+
+    def _check_readable(self, handle: str, tenant: Optional[str]) -> None:
+        if self.tenants is None:
+            return
+        if tenant not in self.service.handle_tenants.get(handle, ()):  # noqa
+            raise PermissionError("unknown handle for this tenant")
+
+    def _check_incremental_refs(self, obj, tenant: Optional[str]) -> None:
+        """Every {"h": ...} node an incremental upload references must be
+        readable by the uploader — resolving unowned handles would
+        materialize another tenant's snapshot into this tenant's doc."""
+        if self.tenants is None or not isinstance(obj, dict):
+            return
+        if "h" in obj:
+            self._check_readable(obj["h"], tenant)
+        for child in (obj.get("t") or {}).values():
+            self._check_incremental_refs(child, tenant)
 
     # -- request dispatch ------------------------------------------------------
 
@@ -181,8 +218,7 @@ class OrderingServer:
                 service.storage.upload(
                     params["doc"], tree, params.get("ref_seq", 0),
                 )
-                if session.tenant is not None:
-                    self._handle_tenant[tree.digest()] = session.tenant
+                self._grant_tree(tree, session.tenant)
             return True
         if method == "has_document":
             return service.has_document(params["doc"])
@@ -230,8 +266,7 @@ class OrderingServer:
             if tree is None:
                 return None
             handle = tree.digest()
-            if session.tenant is not None:
-                self._handle_tenant[handle] = session.tenant
+            self._grant_tree(tree, session.tenant)
             if handle in (params.get("have") or []):
                 # Client-side snapshot cache hit: the body never crosses
                 # the wire (odsp-driver caching capability).
@@ -240,20 +275,19 @@ class OrderingServer:
                     "ref_seq": ref_seq}
         if method == "upload_summary":
             # Incremental upload: {"h": ...} nodes resolve against the
-            # server store (unchanged subtrees never cross the wire).
+            # server store (unchanged subtrees never cross the wire) —
+            # but only handles this tenant may read (a foreign handle
+            # would materialize another tenant's snapshot).
+            self._check_incremental_refs(params["summary"], session.tenant)
             handle = service.storage.upload_obj(
                 params["doc"], params["summary"], params["ref_seq"],
             )
-            if session.tenant is not None:
-                self._handle_tenant[handle] = session.tenant
+            self._grant_tree(service.storage.read(handle), session.tenant)
             return handle
         if method == "read_summary":
-            if self.tenants is not None and \
-                    self._handle_tenant.get(params["handle"]) != \
-                    session.tenant:
-                # Handles are content-addressed and global; scope reads to
-                # the owning tenant or snapshots would leak across tenants.
-                raise PermissionError("unknown handle for this tenant")
+            # Handles are content-addressed and global; scope reads to
+            # granted tenants or snapshots would leak across tenants.
+            self._check_readable(params["handle"], session.tenant)
             node = service.storage.read(params["handle"])
             path = params.get("path")
             if path:

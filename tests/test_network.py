@@ -391,3 +391,61 @@ def test_multi_instance_fan_out(tmp_path):
     assert b.runtime.get_datastore("ds").get_channel("t").text == "fan-out"
     for f in (fa, fb):
         f.close()
+
+
+def test_tenancy_shared_content_and_multi_instance():
+    """Review-found tenancy holes, regression-locked: (1) two tenants
+    uploading IDENTICAL content both keep read access (content-addressed
+    handles are multi-owner); (2) a tenant cannot materialize a foreign
+    snapshot via incremental {"h": ...} references; (3) grants live on the
+    SHARED service, so a second front-door instance honors them."""
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service import LocalOrderingService
+
+    shared = LocalOrderingService()
+    tenants = {"acme": "a", "beta": "b"}
+    s1 = OrderingServer(shared, port=0, tenants=tenants)
+    s1.start_in_thread()
+    s2 = OrderingServer(shared, port=0, tenants=tenants)
+    s2.start_in_thread()
+
+    acme = NetworkDocumentServiceFactory(port=s1.port, tenant="acme",
+                                         secret="a")
+    beta = NetworkDocumentServiceFactory(port=s1.port, tenant="beta",
+                                         secret="b")
+
+    template = ContainerRuntime()
+    template.create_datastore("ds").create_channel("sequence-tpu", "t")
+    tree = template.summarize()
+    handle = tree.digest()
+
+    acme.create_document("doc", tree)   # same bytes...
+    beta.create_document("doc", tree)   # ...uploaded by BOTH tenants
+    # (1) both tenants still read the shared-content handle
+    acme_svc = acme.resolve("doc")
+    beta_svc = beta.resolve("doc")
+    assert acme_svc.storage.read(handle).digest() == handle
+    assert beta_svc.storage.read(handle).digest() == handle
+
+    # (2) beta edits its doc so a NEW acme-only handle exists, then tries
+    # to steal it via an incremental reference
+    a_rt = ContainerRuntime()
+    a_rt.load(acme_svc.storage.latest()[0])
+    a_rt.connect(acme_svc.connection(), "alice")
+    a_rt.drain()
+    a_rt.get_datastore("ds").get_channel("t").insert_text(0, "secret")
+    a_rt.drain()
+    secret_handle = acme_svc.storage.upload(a_rt.summarize(), a_rt.ref_seq)
+    with pytest.raises(RpcError):
+        beta._rpc.request("upload_summary", {
+            "doc": "doc", "summary": {"v": 1, "h": secret_handle},
+            "ref_seq": 99,
+        })
+
+    # (3) the SAME tenant through the OTHER front-door instance can read
+    acme2 = NetworkDocumentServiceFactory(port=s2.port, tenant="acme",
+                                          secret="a")
+    assert acme2.resolve("doc").storage.read(secret_handle).digest() == \
+        secret_handle
+    for f in (acme, beta, acme2):
+        f.close()
