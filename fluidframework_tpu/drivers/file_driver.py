@@ -7,10 +7,12 @@ history).  Everything lives under one directory:
 
     <root>/ops.jsonl          — the durable op log (OpLog format)
     <root>/objects/<digest>   — content-addressed summary nodes (JSON)
-    <root>/commits.jsonl      — (doc_id, handle, ref_seq) commit records
+    <root>/commits.jsonl      — commit-chain records (doc, handle, refSeq,
+                                parent, message) — git-style history
+    <root>/refs.jsonl         — ref updates (doc, ref, commit); last wins
 
 Reopening the directory restores the full service: documents recover from
-the op log, summaries from the object store."""
+the op log, summaries + commit history + refs from the object store."""
 
 from __future__ import annotations
 
@@ -19,10 +21,30 @@ import json
 import os
 from typing import Optional, Union
 
-from ..protocol.summary import SummaryBlob, SummaryStorage, SummaryTree
+from ..protocol.summary import (
+    SummaryBlob,
+    SummaryCommit,
+    SummaryStorage,
+    SummaryTree,
+)
 from ..service.oplog import OpLog
 from ..service.orderer import LocalOrderingService
 from .local_driver import LocalDocumentServiceFactory
+
+
+def _iter_jsonl(path: str):
+    if not os.path.exists(path):
+        return
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def _append_jsonl(path: str, rec: dict) -> None:
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
 
 
 def _serialize_node(node: Union[SummaryTree, SummaryBlob]) -> bytes:
@@ -44,28 +66,44 @@ class FileSummaryStorage(SummaryStorage):
         self.root = root
         self._objects_dir = os.path.join(root, "objects")
         self._commits_path = os.path.join(root, "commits.jsonl")
+        self._refs_path = os.path.join(root, "refs.jsonl")
         os.makedirs(self._objects_dir, exist_ok=True)
-        if os.path.exists(self._commits_path):
-            with open(self._commits_path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    rec = json.loads(line)
-                    self._commits.setdefault(rec["doc"], []).append(
-                        (rec["handle"], rec["refSeq"])
-                    )
+        for rec in _iter_jsonl(self._commits_path):
+            # Rebuild the commit chain.  Old-format records carry no
+            # "parent" field: chain them linearly onto the doc's rebuilt
+            # head (exactly how they were written).
+            parent = rec.get("parent", self.head(rec["doc"]))
+            self._record_commit(SummaryCommit(
+                doc_id=rec["doc"], tree=rec["handle"],
+                parent=parent, ref_seq=rec["refSeq"],
+                message=rec.get("message", ""),
+            ))
+        for rec in _iter_jsonl(self._refs_path):
+            # Last record wins per (doc, ref).  Same validation create_ref
+            # enforces: a pin whose commit never made it to commits.jsonl
+            # (torn write) is dropped rather than left to KeyError readers.
+            if rec["commit"] in self._commit_objects:
+                self._set_ref(rec["doc"], rec["ref"], rec["commit"])
 
     # -- persistence hooks -----------------------------------------------------
 
-    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int) -> str:
-        handle = super().upload(doc_id, tree, ref_seq)
-        with open(self._commits_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(
-                {"doc": doc_id, "handle": handle, "refSeq": ref_seq},
-                sort_keys=True,
-            ) + "\n")
+    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
+               message: str = "") -> str:
+        handle = super().upload(doc_id, tree, ref_seq, message=message)
+        # Persist the commit the base class actually recorded (it is the
+        # new head) — never a parallel reconstruction that could diverge.
+        commit = self.read_commit(self.head(doc_id))
+        _append_jsonl(self._commits_path, {
+            "doc": commit.doc_id, "handle": commit.tree,
+            "refSeq": commit.ref_seq, "parent": commit.parent,
+            "message": commit.message,
+        })
         return handle
+
+    def create_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
+        super().create_ref(doc_id, name, commit_digest)
+        _append_jsonl(self._refs_path,
+                      {"doc": doc_id, "ref": name, "commit": commit_digest})
 
     def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
         digest = super()._store(node)

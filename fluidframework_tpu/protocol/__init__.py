@@ -12,7 +12,13 @@ from .messages import (
     SequencedMessage,
 )
 from .sequencer import ClientConnection, Sequencer
-from .summary import SummaryBlob, SummaryTree, SummaryStorage, canonical_json
+from .summary import (
+    SummaryBlob,
+    SummaryCommit,
+    SummaryTree,
+    SummaryStorage,
+    canonical_json,
+)
 
 __all__ = [
     "UNASSIGNED_SEQ",
@@ -22,6 +28,7 @@ __all__ = [
     "ClientConnection",
     "Sequencer",
     "SummaryBlob",
+    "SummaryCommit",
     "SummaryTree",
     "SummaryStorage",
     "canonical_json",

@@ -107,22 +107,146 @@ class SummaryTree:
         return node.content
 
 
+@dataclass(frozen=True)
+class SummaryCommit:
+    """A git-style commit object: points at a summary tree, chains to its
+    parent commit, and records the sequence number the tree covers — the
+    Historian/gitrest capability of per-document commit history (summaries
+    upstream are literally git commits over git trees; SURVEY.md §2.3,
+    upstream paths UNVERIFIED — empty reference mount)."""
+
+    doc_id: str
+    tree: str  # summary-tree digest (the handle clients exchange)
+    parent: Optional[str]  # parent commit digest, None for the root commit
+    ref_seq: int
+    message: str = ""
+
+    def digest(self) -> str:
+        # canonical_json delimits fields unambiguously (free-form doc_id /
+        # message cannot shift field boundaries) and follows the module's
+        # one-serializer convention.
+        body = canonical_json({
+            "doc": self.doc_id, "tree": self.tree, "parent": self.parent,
+            "refSeq": self.ref_seq, "message": self.message,
+        })
+        return hashlib.sha256(b"commit\x00" + body).hexdigest()
+
+
 class SummaryStorage:
     """Content-addressed summary store (Historian/gitrest capability).
 
-    Stores summary trees by digest; tracks a linear history of (root handle,
-    reference seq) commits per document, so catch-up = latest summary + op
-    tail from the sequencer log.
+    Stores summary trees by digest and, per document, a **commit chain**:
+    every upload creates a :class:`SummaryCommit` whose parent is the
+    document's current head, and advances the ``main`` ref.  Named refs can
+    pin any commit (tags / debugging branches); :meth:`history` walks the
+    parent chain.  Catch-up = latest summary + op tail from the sequencer
+    log.
     """
+
+    DEFAULT_REF = "main"
 
     def __init__(self) -> None:
         self._objects: Dict[str, Union[SummaryTree, SummaryBlob]] = {}
-        self._commits: Dict[str, list] = {}  # doc_id -> [(handle, ref_seq)]
+        self._commit_objects: Dict[str, SummaryCommit] = {}
+        self._refs: Dict[str, Dict[str, str]] = {}  # doc -> ref -> commit
+        # (doc, tree, ref_seq) -> newest commit digest; O(1) ack stamping.
+        self._commit_index: Dict[tuple, str] = {}
 
-    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int) -> str:
+    def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
+               message: str = "") -> str:
         handle = self._store(tree)
-        self._commits.setdefault(doc_id, []).append((handle, ref_seq))
+        commit = SummaryCommit(
+            doc_id=doc_id, tree=handle,
+            parent=self.head(doc_id), ref_seq=ref_seq, message=message,
+        )
+        self._record_commit(commit)
         return handle
+
+    # -- commit/ref history chain ----------------------------------------------
+
+    def _record_commit(self, commit: SummaryCommit) -> None:
+        digest = commit.digest()
+        self._commit_objects[digest] = commit
+        self._commit_index[
+            (commit.doc_id, commit.tree, commit.ref_seq)
+        ] = digest
+        self._set_ref(commit.doc_id, self.DEFAULT_REF, digest)
+
+    def _set_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
+        self._refs.setdefault(doc_id, {})[name] = commit_digest
+
+    def head(self, doc_id: str, ref: str = DEFAULT_REF) -> Optional[str]:
+        """Commit digest the ref points at, or None."""
+        return self._refs.get(doc_id, {}).get(ref)
+
+    def read_commit(self, digest: str) -> SummaryCommit:
+        return self._commit_objects[digest]
+
+    def refs(self, doc_id: str) -> Dict[str, str]:
+        return dict(self._refs.get(doc_id, {}))
+
+    def create_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
+        """Pin a named ref (tag/branch) at an existing commit.  ``main`` is
+        derived from the upload chain and cannot be repointed — that keeps
+        the persisted chain the single source of truth for the head."""
+        if name == self.DEFAULT_REF:
+            raise ValueError(f"{name!r} is maintained by upload()")
+        if commit_digest not in self._commit_objects:
+            raise KeyError(commit_digest)
+        if self._commit_objects[commit_digest].doc_id != doc_id:
+            raise ValueError(
+                f"commit {commit_digest} belongs to document "
+                f"{self._commit_objects[commit_digest].doc_id!r}, "
+                f"not {doc_id!r}"
+            )
+        self._set_ref(doc_id, name, commit_digest)
+
+    def _walk(self, digest: Optional[str]):
+        """Generator over the parent chain from ``digest``, newest first;
+        a missing link is reported as corruption, not a bare KeyError."""
+        while digest is not None:
+            commit = self._commit_objects.get(digest)
+            if commit is None:
+                raise ValueError(
+                    f"corrupt commit chain: commit {digest} is missing "
+                    "(truncated or partially-copied store?)"
+                )
+            yield commit
+            digest = commit.parent
+
+    def history(self, doc_id: str, ref: str = DEFAULT_REF,
+                limit: Optional[int] = None):
+        """Newest-first walk of the commit chain from ``ref``.  With
+        ``limit``, the walk stops as soon as it has enough — commits past
+        the limit are never resolved (so a truncated tail beyond the
+        requested window cannot fail the call)."""
+        if limit is not None and limit <= 0:
+            return []
+        out = []
+        for commit in self._walk(self.head(doc_id, ref)):
+            out.append(commit)
+            if limit is not None and len(out) == limit:
+                break
+        return out
+
+    def checkout(self, doc_id: str, ref: str = DEFAULT_REF):
+        """(tree, ref_seq) at a ref's head, or (None, 0) — the history-aware
+        sibling of :meth:`latest`."""
+        digest = self.head(doc_id, ref)
+        if digest is None:
+            return None, 0
+        commit = next(self._walk(digest))
+        node = self.read(commit.tree)
+        assert isinstance(node, SummaryTree)
+        return node, commit.ref_seq
+
+    def commit_for(self, doc_id: str, tree_handle: str,
+                   ref_seq: int) -> Optional[str]:
+        """Digest of the newest commit for (tree, ref_seq) — the pair the
+        summarize op carries, so content-identical trees uploaded at
+        different sequence points resolve to their own commits (scribe
+        stamps this into summary acks)."""
+        return self._commit_index.get((doc_id, tree_handle, ref_seq))
 
     def upload_obj(self, doc_id: str, obj: dict, ref_seq: int) -> str:
         """Upload from a (possibly INCREMENTAL) wire object: ``{"h": ...}``
@@ -150,17 +274,12 @@ class SummaryStorage:
         """Returns (tree, ref_seq) of the newest summary, or (None, 0).
         With ``at_or_below``, the newest summary whose ref_seq does not
         exceed it (historical reconstruction / replay driver)."""
-        commits = self._commits.get(doc_id)
-        if not commits:
-            return None, 0
-        if at_or_below is not None:
-            commits = [c for c in commits if c[1] <= at_or_below]
-            if not commits:
-                return None, 0
-        handle, ref_seq = commits[-1]
-        node = self.read(handle)  # read() so disk-backed stores lazy-load
-        assert isinstance(node, SummaryTree)
-        return node, ref_seq
+        for commit in self._walk(self.head(doc_id)):
+            if at_or_below is None or commit.ref_seq <= at_or_below:
+                node = self.read(commit.tree)  # disk-backed stores lazy-load
+                assert isinstance(node, SummaryTree)
+                return node, commit.ref_seq
+        return None, 0
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
         return self._objects[handle]

@@ -45,10 +45,13 @@ class Scribe:
             self.last_acked_handle = handle
             self.last_acked_seq = ref_seq
             self.acks += 1
-            self._sequencer.server_message(
-                MessageType.SUMMARY_ACK,
-                {"handle": handle, "seq": ref_seq, "summarizeSeq": msg.seq},
-            )
+            ack = {"handle": handle, "seq": ref_seq, "summarizeSeq": msg.seq}
+            # Stamp the git-style commit this summary landed as (the
+            # reference's ack carries the service's summary commit handle).
+            commit = self._storage.commit_for(self.doc_id, handle, ref_seq)
+            if commit is not None:
+                ack["commit"] = commit
+            self._sequencer.server_message(MessageType.SUMMARY_ACK, ack)
         else:
             self.nacks += 1
             self._sequencer.server_message(

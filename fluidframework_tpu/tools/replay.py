@@ -7,7 +7,7 @@ mount).
 
 Usage:
     python -m fluidframework_tpu.tools.replay <store-dir> <doc-id> \
-        [--to-seq N] [--json] [--show ds/channel]
+        [--to-seq N] [--json] [--show ds/channel] [--history]
 
 Reads the durable file store (FileDocumentServiceFactory layout), loads the
 document as of ``--to-seq`` (default: head) through the replay driver, and
@@ -70,7 +70,28 @@ def main(argv=None) -> int:
                         help="machine-readable output")
     parser.add_argument("--show", default=None, metavar="DS/CHANNEL",
                         help="print one channel's content")
+    parser.add_argument("--history", action="store_true",
+                        help="print the document's summary commit chain")
     args = parser.parse_args(argv)
+
+    if args.history:
+        if args.show:
+            parser.error("--show does not combine with --history")
+        storage = FileSummaryStorage(args.store_dir)
+        commits = storage.history(args.doc_id)
+        if args.to_seq is not None:
+            commits = [c for c in commits if c.ref_seq <= args.to_seq]
+        if args.json:
+            print(json.dumps([
+                {"commit": c.digest(), "tree": c.tree, "parent": c.parent,
+                 "refSeq": c.ref_seq, "message": c.message}
+                for c in commits
+            ], sort_keys=True))
+        else:
+            for c in commits:
+                print(f"{c.digest()[:12]}  tree {c.tree[:12]}  "
+                      f"@seq {c.ref_seq}  {c.message}")
+        return 0
 
     report = replay(args.store_dir, args.doc_id, args.to_seq)
     runtime = report.pop("_runtime")

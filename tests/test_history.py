@@ -1,0 +1,237 @@
+"""Summary commit/ref history chain (Historian/gitrest capability):
+git-style commits over summary trees, named refs, history walk, file
+persistence, and commit digests stamped into scribe acks."""
+
+from fluidframework_tpu.drivers.file_driver import FileSummaryStorage
+from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.protocol.summary import (
+    SummaryStorage,
+    SummaryTree,
+)
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.runtime.summarizer import (
+    SummarizerOptions,
+    SummaryManager,
+)
+from fluidframework_tpu.service import LocalOrderingService
+
+
+def _tree(text: str) -> SummaryTree:
+    tree = SummaryTree()
+    tree.add_blob("content", text.encode("utf-8"))
+    return tree
+
+
+def _fill(storage, doc="doc"):
+    handles = []
+    for i, word in enumerate(["one", "two", "three"]):
+        handles.append(
+            storage.upload(doc, _tree(word), ref_seq=10 * (i + 1),
+                           message=f"summary {word}")
+        )
+    return handles
+
+
+def test_commit_chain_walk():
+    storage = SummaryStorage()
+    handles = _fill(storage)
+
+    commits = storage.history("doc")
+    assert len(commits) == 3
+    # newest-first, trees match upload order reversed
+    assert [c.tree for c in commits] == list(reversed(handles))
+    assert [c.ref_seq for c in commits] == [30, 20, 10]
+    # parent pointers chain, root commit has none
+    assert commits[0].parent == commits[1].digest()
+    assert commits[1].parent == commits[2].digest()
+    assert commits[2].parent is None
+    # head is the newest commit
+    assert storage.head("doc") == commits[0].digest()
+    # checkout agrees with latest()
+    tree, seq = storage.checkout("doc")
+    latest_tree, latest_seq = storage.latest("doc")
+    assert (tree.digest(), seq) == (latest_tree.digest(), latest_seq)
+    # commit_for resolves (tree, ref_seq) to its commit
+    assert storage.commit_for("doc", handles[1], 20) == commits[1].digest()
+    assert storage.commit_for("doc", handles[1], 999) is None
+    assert storage.commit_for("doc", "nope", 10) is None
+    # identical trees uploaded at two sequence points resolve separately
+    dup = storage.upload("doc", _tree("three"), ref_seq=40)
+    assert dup == handles[2]  # content-addressed: same tree handle
+    assert storage.commit_for("doc", dup, 40) != \
+        storage.commit_for("doc", dup, 30)
+
+
+def test_named_refs_pin_old_commits():
+    storage = SummaryStorage()
+    _fill(storage)
+    commits = storage.history("doc")
+    storage.create_ref("doc", "v1", commits[-1].digest())
+
+    assert set(storage.refs("doc")) == {"main", "v1"}
+    tree, seq = storage.checkout("doc", ref="v1")
+    assert seq == 10
+    assert tree.blob_bytes("content") == b"one"
+    # history from the pinned ref sees only the prefix
+    assert [c.ref_seq for c in storage.history("doc", ref="v1")] == [10]
+
+
+def test_history_limit():
+    storage = SummaryStorage()
+    _fill(storage)
+    assert [c.ref_seq for c in storage.history("doc", limit=2)] == [30, 20]
+
+
+def test_file_storage_history_survives_reopen(tmp_path):
+    root = str(tmp_path / "store")
+    storage = FileSummaryStorage(root)
+    _fill(storage)
+    commits = storage.history("doc")
+    storage.create_ref("doc", "release", commits[1].digest())
+
+    reopened = FileSummaryStorage(root)
+    recommits = reopened.history("doc")
+    assert [c.digest() for c in recommits] == [c.digest() for c in commits]
+    assert [c.message for c in recommits] == [
+        "summary three", "summary two", "summary one"
+    ]
+    assert reopened.refs("doc") == storage.refs("doc")
+    tree, seq = reopened.checkout("doc", ref="release")
+    assert seq == 20
+    assert tree.blob_bytes("content") == b"two"
+
+
+def test_scribe_ack_carries_commit_digest():
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    runtime = ContainerRuntime()
+    ds = runtime.create_datastore("ds")
+    text = ds.create_channel("sequence-tpu", "text")
+    runtime.connect(ep, "a")
+    runtime.drain()
+    mgr = SummaryManager(runtime, service.storage, "doc",
+                         SummarizerOptions(ops_per_summary=1000))
+    text.insert_text(0, "hello")
+    runtime.drain()
+    mgr.summarize_now()
+    runtime.drain()
+
+    acks = [m for m in ep.log if m.type is MessageType.SUMMARY_ACK]
+    assert len(acks) == 1
+    commit_digest = acks[0].contents["commit"]
+    commit = service.storage.read_commit(commit_digest)
+    assert commit.tree == acks[0].contents["handle"]
+    assert service.storage.head("doc") == commit_digest
+
+
+def test_unknown_ref_target_rejected():
+    storage = SummaryStorage()
+    _fill(storage)
+    try:
+        storage.create_ref("doc", "bad", "not-a-commit")
+    except KeyError:
+        pass
+    else:
+        raise AssertionError("create_ref accepted an unknown commit")
+
+
+def test_torn_store_reopens_without_dangling_refs(tmp_path):
+    import json
+    import os
+
+    root = str(tmp_path / "store")
+    storage = FileSummaryStorage(root)
+    _fill(storage)
+    commits = storage.history("doc")
+    storage.create_ref("doc", "ok", commits[0].digest())
+    # simulate a torn write: a pin whose commit record was lost
+    with open(os.path.join(root, "refs.jsonl"), "a", encoding="utf-8") as f:
+        f.write(json.dumps(
+            {"doc": "doc", "ref": "lost", "commit": "f" * 64}) + "\n")
+
+    reopened = FileSummaryStorage(root)  # must not raise
+    assert "lost" not in reopened.refs("doc")
+    assert reopened.refs("doc")["ok"] == commits[0].digest()
+
+
+def test_corrupt_chain_reports_missing_commit():
+    import pytest
+
+    storage = SummaryStorage()
+    _fill(storage)
+    head = storage.head("doc")
+    # sever the chain below the head
+    parent = storage.read_commit(head).parent
+    del storage._commit_objects[parent]
+    with pytest.raises(ValueError, match="corrupt commit chain"):
+        storage.history("doc")
+
+
+def test_old_format_commit_records_still_load(tmp_path):
+    import json
+    import os
+
+    root = str(tmp_path / "store")
+    storage = FileSummaryStorage(root)
+    handles = _fill(storage)
+    # rewrite commits.jsonl in the old (parent-less) format
+    with open(os.path.join(root, "commits.jsonl"), "w",
+              encoding="utf-8") as f:
+        for handle, seq in zip(handles, (10, 20, 30)):
+            f.write(json.dumps(
+                {"doc": "doc", "handle": handle, "refSeq": seq}) + "\n")
+    reopened = FileSummaryStorage(root)
+    commits = reopened.history("doc")
+    assert [c.tree for c in commits] == list(reversed(handles))
+    assert commits[2].parent is None
+    tree, seq = reopened.latest("doc", at_or_below=25)
+    assert (tree.blob_bytes("content"), seq) == (b"two", 20)
+
+
+def test_cross_document_ref_rejected():
+    import pytest
+
+    storage = SummaryStorage()
+    _fill(storage, doc="docA")
+    _fill(storage, doc="docB")
+    with pytest.raises(ValueError, match="belongs to document"):
+        storage.create_ref("docA", "v1", storage.head("docB"))
+
+
+def test_history_limit_skips_truncated_tail():
+    storage = SummaryStorage()
+    _fill(storage)
+    commits = storage.history("doc")
+    # sever the oldest link; a limited walk that never reaches it succeeds
+    del storage._commit_objects[commits[2].digest()]
+    assert [c.ref_seq for c in storage.history("doc", limit=2)] == [30, 20]
+    assert storage.history("doc", limit=0) == []
+
+
+def test_history_cli_respects_to_seq(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "store")
+    storage = FileSummaryStorage(root)
+    _fill(storage)
+    out = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.tools.replay",
+         root, "doc", "--history", "--json", "--to-seq", "25"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert [r["refSeq"] for r in json.loads(out.stdout)] == [20, 10]
+
+
+def test_main_cannot_be_repointed():
+    storage = SummaryStorage()
+    _fill(storage)
+    commits = storage.history("doc")
+    try:
+        storage.create_ref("doc", "main", commits[-1].digest())
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("create_ref repointed main")
