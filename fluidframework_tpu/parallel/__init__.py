@@ -11,6 +11,7 @@ shardings over DCN.
 """
 
 from .shard import (
+    dcn_mesh,
     doc_mesh,
     map_sharded_replay_step,
     matrix_sharded_replay_step,
@@ -23,6 +24,7 @@ from .shard import (
 )
 
 __all__ = [
+    "dcn_mesh",
     "doc_mesh",
     "map_sharded_replay_step",
     "matrix_sharded_replay_step",

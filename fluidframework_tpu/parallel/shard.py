@@ -6,6 +6,18 @@ with no communication (each chip folds its shard of documents), and the
 final cross-chip assembly (per-doc summary digests/lengths replicated for
 the host summarizer) is a single all-gather over ICI, expressed as a
 replication sharding constraint.
+
+Multi-slice (DCN) scale-out: :func:`dcn_mesh` builds a 2-D
+``("slice", "docs")`` mesh — the slice axis spans TPU slices connected
+over DCN, the docs axis spans chips within a slice over ICI.  Every step
+builder shards the document dimension over *all* mesh axes (pure data
+parallelism across the whole fleet), so the fold itself never
+communicates; only the small replicated assembly outputs (per-doc
+lengths / overflow flags) cross DCN, and XLA gathers them
+hierarchically — ICI within a slice first, then one small DCN exchange —
+which is exactly how the reference's capability maps to TPU fabric
+(SURVEY.md §5 distributed-comm: Kafka/Redis fan-out → ICI collectives
+within a slice, DCN only for cross-slice assembly).
 """
 
 from __future__ import annotations
@@ -32,6 +44,7 @@ from ..ops.mergetree_kernel import (
 from ..protocol.summary import SummaryTree
 
 DOC_AXIS = "docs"
+SLICE_AXIS = "slice"
 
 
 def doc_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -39,6 +52,49 @@ def doc_mesh(devices: Optional[Sequence] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (DOC_AXIS,))
+
+
+def dcn_mesh(n_slices: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 2-D ``(slice, docs)`` mesh for multi-slice deployments: outer axis
+    across slices (DCN), inner axis across a slice's chips (ICI).
+
+    Devices are grouped by their hardware slice when the platform exposes
+    ``slice_index`` (real multi-slice TPU), so the inner mesh axis never
+    straddles a DCN boundary; flat device lists (tests, single slice)
+    reshape in order."""
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(
+        devices, key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
+    )
+    if n_slices <= 0 or len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices"
+        )
+    per_slice = len(devices) // n_slices
+    hw_slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    if len(hw_slices) > 1:
+        # Real multi-slice hardware: every mesh row must stay within one
+        # hardware slice, or "ICI" docs-axis collectives silently cross
+        # DCN and the performance contract of this mesh is violated.
+        for row_start in range(0, len(devices), per_slice):
+            row = devices[row_start:row_start + per_slice]
+            if len({getattr(d, "slice_index", 0) or 0 for d in row}) > 1:
+                raise ValueError(
+                    f"n_slices={n_slices} does not match the hardware "
+                    f"slice grouping ({len(hw_slices)} slices of "
+                    f"{len(devices) // len(hw_slices)} devices); a mesh "
+                    "row would straddle a DCN boundary"
+                )
+    grid = np.asarray(devices).reshape(n_slices, per_slice)
+    return Mesh(grid, (SLICE_AXIS, DOC_AXIS))
+
+
+def _doc_spec(mesh: Mesh) -> P:
+    """Shard the leading (document/op) dimension over ALL mesh axes — on a
+    1-D mesh this is P("docs"); on a dcn_mesh it is P(("slice", "docs")),
+    i.e. data parallelism across the whole fleet."""
+    return P(tuple(mesh.axis_names))
 
 
 @functools.lru_cache(maxsize=8)
@@ -51,7 +107,7 @@ def sharded_replay_step(mesh: Mesh):
     the scalar assembled cross-chip for summarizer headers) comes back
     replicated, forcing the ICI all-gather.
     """
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def _step(state: MTState, ops: MTOps):
@@ -90,7 +146,7 @@ def _pad_docs(docs: Sequence, multiple: int, make_pad):
 
 
 def _shard_put(mesh: Mesh, tree):
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), shard), tree)
 
 
@@ -143,7 +199,7 @@ def map_sharded_replay_step(mesh: Mesh, num_keys: int, num_docs: int):
     returning replicated per-key results for the host summarizer."""
     from ..ops.map_kernel import _map_lww_kernel
 
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def _step(key_gid, op_seq, is_set, val_idx, key_doc,
@@ -173,7 +229,7 @@ def replay_map_sharded(docs, mesh: Optional[Mesh] = None) -> List[SummaryTree]:
     # Bucket floor = mesh size so the flat op axis splits evenly over
     # power-of-two meshes of ANY size (buckets otherwise floor at 64).
     batch = pack_map_batch(docs, bucket_floor=mesh.size)
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def put(arr, sh):
@@ -199,7 +255,7 @@ def matrix_sharded_replay_step(mesh: Mesh):
     host cell fold — the ICI all-gather."""
     from ..ops.matrix_kernel import replay_resolving_vmapped
 
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def _step(state: MTState, ops: MTOps):
@@ -276,7 +332,7 @@ def tree_sharded_replay_step(mesh: Mesh):
     from ..ops.tree_kernel import TreeEdits, TreeState
     from ..ops.tree_kernel import replay_vmapped as tree_replay_vmapped
 
-    shard = NamedSharding(mesh, P(DOC_AXIS))
+    shard = NamedSharding(mesh, _doc_spec(mesh))
     replicated = NamedSharding(mesh, P())
 
     def _step(state: TreeState, edits: TreeEdits):
