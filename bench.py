@@ -196,6 +196,17 @@ def link_microbench() -> dict:
         t0 = time.time()
         np.asarray(h)
         lat_down = min(lat_down, time.time() - t0)
+    # Trivial-program execution latency: separates a sick COMPUTE path
+    # (dispatch/executor degradation) from a sick TRANSFER path when the
+    # fold rate collapses — without this the two are indistinguishable in
+    # the stage breakdown.
+    tiny = jax.jit(lambda x: x * 2)
+    jax.block_until_ready(tiny(h))  # compile outside the timing
+    lat_exec = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(tiny(h))
+        lat_exec = min(lat_exec, time.time() - t0)
     t0 = time.time()
     hb = jax.device_put(big)
     jax.block_until_ready(hb)
@@ -207,6 +218,7 @@ def link_microbench() -> dict:
     return {
         "rpc_latency_up_s": round(lat_up, 4),
         "rpc_latency_down_s": round(lat_down, 4),
+        "exec_latency_s": round(lat_exec, 4),
         "h2d_MBps": round(mb / max(up - lat_up, up * 0.2, 1e-9), 1),
         "d2h_MBps": round(mb / max(down - lat_down, down * 0.2, 1e-9), 1),
     }

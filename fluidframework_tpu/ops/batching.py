@@ -18,11 +18,15 @@ def partition_replay(
     known_fallback: Callable[[Doc], bool],
     fallback_fn: Callable[[Doc], Result],
     batch_fn: Callable[[List[Doc]], List[Result]],
+    stats: Optional[dict] = None,
 ) -> List[Result]:
     """Route docs matching ``known_fallback`` through ``fallback_fn`` (the
     oracle), fold the rest as one device batch, and return results in the
     original order.  Filtering first keeps fallback docs from inflating the
-    shared power-of-two pack buckets and wasting their shard of the fold."""
+    shared power-of-two pack buckets and wasting their shard of the fold.
+    ``stats`` (optional dict) accumulates a ``fallback_docs`` counter for
+    the pre-pack routing (post-fold fallbacks are the extractors' to
+    count)."""
     if not docs:
         return []
     out: List[Optional[Result]] = [None] * len(docs)
@@ -30,6 +34,8 @@ def partition_replay(
     for i, doc in enumerate(docs):
         if known_fallback(doc):
             out[i] = fallback_fn(doc)
+            if stats is not None:
+                stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
         else:
             device_idx.append(i)
     if device_idx:

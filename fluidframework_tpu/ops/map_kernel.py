@@ -148,12 +148,17 @@ def _map_lww_kernel(
     return present, win_val
 
 
-def replay_map_batch(docs: Sequence[MapDocInput]) -> List[SummaryTree]:
+def replay_map_batch(docs: Sequence[MapDocInput],
+                     stats: Optional[dict] = None) -> List[SummaryTree]:
     """Full pipeline: pack → device LWW reduction → canonical summaries.
 
     Returns one SummaryTree per input doc whose bytes equal
     ``SharedMap.summarize()`` after the oracle applies the same ops.
+    The LWW reduction has no oracle-fallback cases, so ``stats`` counts
+    every doc as a device doc.
     """
+    if stats is not None:
+        stats["device_docs"] = stats.get("device_docs", 0) + len(docs)
     batch = pack_map_batch(docs)
     present, win_val = _map_lww_kernel(
         batch.key_gid,

@@ -370,12 +370,20 @@ def oracle_matrix_fallback(doc: MatrixDocInput) -> SummaryTree:
     return replica.summarize()
 
 
-def summary_from_matrix_state(meta, state_np, resolved_np, d: int) -> SummaryTree:
+def summary_from_matrix_state(meta, state_np, resolved_np, d: int,
+                              stats: Optional[dict] = None) -> SummaryTree:
+    """``stats`` counts this doc as device/fallback WHERE the routing
+    decision is made, so the counters can never drift from the actual
+    serving path."""
     doc: MatrixDocInput = meta["docs"][d]
     pack: _MatrixDocPack = meta["packs"][d]
     values: Interner = meta["values"]
     if bool(state_np["overflow"][2 * d]) or bool(state_np["overflow"][2 * d + 1]):
+        if stats is not None:
+            stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
         return oracle_matrix_fallback(doc)
+    if stats is not None:
+        stats["device_docs"] = stats.get("device_docs", 0) + 1
     msn = doc.final_msn
     row_records, row_map = _axis_records(state_np, 2 * d, msn, pack.clients)
     col_records, col_map = _axis_records(state_np, 2 * d + 1, msn, pack.clients)
@@ -416,10 +424,13 @@ def summary_from_matrix_state(meta, state_np, resolved_np, d: int) -> SummaryTre
     return tree
 
 
-def replay_matrix_batch(docs: Sequence[MatrixDocInput]) -> List[SummaryTree]:
+def replay_matrix_batch(docs: Sequence[MatrixDocInput],
+                        stats: Optional[dict] = None) -> List[SummaryTree]:
     """Full pipeline: pack → vmapped dual-axis device fold → host cell fold →
     canonical summaries.  Byte-identical to ``SharedMatrix.summarize()``
-    (asserted by tests/test_matrix_kernel.py)."""
+    (asserted by tests/test_matrix_kernel.py).  ``stats`` accumulates
+    ``device_docs`` / ``fallback_docs`` (pre-pack routing + per-axis
+    overflow fallbacks)."""
     from .batching import partition_replay
 
     def fold_batch(batch):
@@ -428,10 +439,12 @@ def replay_matrix_batch(docs: Sequence[MatrixDocInput]) -> List[SummaryTree]:
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
         resolved_np = np.asarray(resolved)
         return [
-            summary_from_matrix_state(meta, state_np, resolved_np, d)
+            summary_from_matrix_state(meta, state_np, resolved_np, d,
+                                      stats=stats)
             for d in range(len(batch))
         ]
 
     return partition_replay(
-        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch
+        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch,
+        stats=stats,
     )

@@ -1016,12 +1016,15 @@ def summaries_from_export(meta, export_np: np.ndarray,
 
 def replay_mergetree_batch(
     docs: Sequence[MergeTreeDocInput],
+    stats: Optional[dict] = None,
 ) -> List[SummaryTree]:
     """Full pipeline: pack → vmapped device op-fold → fused export download
     → canonical summaries.
 
     Byte-identical to ``SharedString.summarize()`` after the oracle replays
     the same log (asserted by tests/test_mergetree_kernel.py).
+    ``stats`` accumulates ``device_docs`` / ``fallback_docs`` (pre-pack
+    routing + post-fold overflow fallbacks).
     """
     from .batching import partition_replay
 
@@ -1033,8 +1036,9 @@ def replay_mergetree_batch(
             export = replay_export(None, ops, meta, S=state.tstart.shape[1])
         else:
             export = replay_export(state, ops, meta)
-        return summaries_from_export(meta, np.asarray(export))
+        return summaries_from_export(meta, np.asarray(export), stats=stats)
 
     return partition_replay(
-        docs, known_oracle_fallback, oracle_fallback_summary, fold_batch
+        docs, known_oracle_fallback, oracle_fallback_summary, fold_batch,
+        stats=stats,
     )

@@ -502,12 +502,20 @@ def oracle_fallback_summary(doc: TreeDocInput) -> SummaryTree:
     return replica.summarize()
 
 
-def summary_from_state(meta, state_np: dict, d: int) -> SummaryTree:
-    """Final device state → the oracle's canonical summary bytes."""
+def summary_from_state(meta, state_np: dict, d: int,
+                       stats: Optional[dict] = None) -> SummaryTree:
+    """Final device state → the oracle's canonical summary bytes.
+    ``stats`` counts this doc as device/fallback WHERE the routing
+    decision is made, so the counters can never drift from the actual
+    serving path."""
     doc: TreeDocInput = meta["docs"][d]
     pack: _DocPack = meta["doc_packs"][d]
     if pack.needs_fallback or bool(state_np["overflow"][d]):
+        if stats is not None:
+            stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
         return oracle_fallback_summary(doc)
+    if stats is not None:
+        stats["device_docs"] = stats.get("device_docs", 0) + 1
     values: Interner = meta["values"]
     msn = max(doc.final_msn, pack.base_min_seq)
 
@@ -578,11 +586,14 @@ def summary_from_state(meta, state_np: dict, d: int) -> SummaryTree:
     return tree
 
 
-def replay_tree_batch(docs: Sequence[TreeDocInput]) -> List[SummaryTree]:
+def replay_tree_batch(docs: Sequence[TreeDocInput],
+                      stats: Optional[dict] = None) -> List[SummaryTree]:
     """Full pipeline: pack → vmapped device edit-fold → canonical summaries.
 
     Byte-identical to ``SharedTree.summarize()`` after the oracle replays
-    the same log (asserted by tests/test_tree_kernel.py).
+    the same log (asserted by tests/test_tree_kernel.py).  ``stats``
+    accumulates ``device_docs`` / ``fallback_docs`` (pack-time revive /
+    multi-id-move detection + fold overflow).
     """
     if not docs:
         return []
@@ -591,5 +602,5 @@ def replay_tree_batch(docs: Sequence[TreeDocInput]) -> List[SummaryTree]:
     final = _replay_batch(state, edits)
     state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
     for d in range(len(docs)):
-        out[d] = summary_from_state(meta, state_np, d)
+        out[d] = summary_from_state(meta, state_np, d, stats=stats)
     return out

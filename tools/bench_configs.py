@@ -277,10 +277,11 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn):
     # so a tiny warm batch would compile a different shape and leave the
     # real compilation inside the timed loop.
     device_batch_fn(docs[:CHUNK])
+    stats: dict = {}
     t0 = time.time()
     summaries = []
     for i in range(0, len(docs), CHUNK):
-        summaries.extend(device_batch_fn(docs[i:i + CHUNK]))
+        summaries.extend(device_batch_fn(docs[i:i + CHUNK], stats=stats))
     dev_t = time.time() - t0
     dev_rate = total_ops / dev_t
 
@@ -295,11 +296,14 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn):
         "device_ops_per_sec": round(dev_rate, 1),
         "vs_baseline": round(dev_rate / cpu_rate, 2),
         "device_sec": round(dev_t, 3),
+        "fallback_docs": stats.get("fallback_docs", 0),
+        "device_docs": stats.get("device_docs", 0),
     }
     print(
         f"{name:12s} docs={len(docs):5d} ops={total_ops:7d} "
         f"cpu={cpu_rate:10,.0f}/s device={dev_rate:10,.0f}/s "
-        f"ratio={row['vs_baseline']:6.2f}x",
+        f"ratio={row['vs_baseline']:6.2f}x "
+        f"fallbacks={row['fallback_docs']}/{len(docs)}",
         file=sys.stderr,
     )
     return row
