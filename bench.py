@@ -67,6 +67,7 @@ CPU_SAMPLE_DOCS = int(os.environ.get("BENCH_CPU_SAMPLE", "64"))
 # measured best at 1024 docs/chunk on v5e (larger single batches degrade
 # per-op throughput and >4k-doc transfers can trip device faults).
 CHUNK_DOCS = int(os.environ.get("BENCH_CHUNK", "1024"))
+PACK_THREADS = int(os.environ.get("BENCH_PACK_THREADS", "3"))
 ALPHABET = "abcdefghijklmnopqrstuvwxyz "
 
 
@@ -258,25 +259,51 @@ def run_e2e(docs):
                 if abort.is_set():
                     return None
 
+    def pack_one(lo):
+        t0 = time.time()
+        state, ops, meta = pack_mergetree_batch(docs[lo:lo + CHUNK_DOCS])
+        return state, ops, meta, time.time() - t0
+
     def packer():
+        # Packing is parallel across chunks (the C++ row-filling releases
+        # the GIL), dispatch stays in submission order.  At 50× the whole
+        # pipeline budget is under a second — a single-threaded pack stage
+        # alone would exceed it.  Submission rides a bounded sliding
+        # window so in-flight packed chunks stay capped (backpressure from
+        # the downstream queues) and an abort only waits out the ≤
+        # PACK_THREADS packs already running, cancelling the rest.
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        starts = list(range(0, len(docs), CHUNK_DOCS))
+        window = PACK_THREADS + 1
+        futs: collections.deque = collections.deque()
         try:
-            for i in range(0, len(docs), CHUNK_DOCS):
-                t0 = time.time()
-                state, ops, meta = pack_mergetree_batch(
-                    docs[i:i + CHUNK_DOCS]
-                )
-                stage["pack"] += time.time() - t0
-                t0 = time.time()
-                S = state.tstart.shape[1]
-                ex = replay_export(None, ops, meta, S=S)
-                stage["dispatch"] += time.time() - t0
-                packed_chunks.append((ops, meta, S))
-                if not put(folded, (meta, ex)):
-                    return
+            with ThreadPoolExecutor(max_workers=PACK_THREADS) as pool:
+                next_i = 0
+                while next_i < len(starts) and len(futs) < window:
+                    futs.append(pool.submit(pack_one, starts[next_i]))
+                    next_i += 1
+                while futs:
+                    fut = futs.popleft()
+                    state, ops, meta, dt = fut.result()
+                    if next_i < len(starts):
+                        futs.append(pool.submit(pack_one, starts[next_i]))
+                        next_i += 1
+                    stage["pack"] += dt  # busy (overlapped) seconds
+                    t0 = time.time()
+                    S = state.tstart.shape[1]
+                    ex = replay_export(None, ops, meta, S=S)
+                    stage["dispatch"] += time.time() - t0
+                    packed_chunks.append((ops, meta, S))
+                    if not put(folded, (meta, ex)):
+                        return
         except BaseException as e:  # surface in main thread
             errors.append(e)
             abort.set()
         finally:
+            for f in futs:
+                f.cancel()
             put(folded, None)
 
     def downloader():

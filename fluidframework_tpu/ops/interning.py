@@ -23,6 +23,21 @@ def next_bucket(n: int, floor: int = 64) -> int:
     return size
 
 
+def next_bucket_fine(n: int, floor: int = 64) -> int:
+    """Round up to the {f, 1.5f, 2f, 3f, 4f, 6f, ...} ladder — powers of two
+    plus their 1.5× midpoints.  Twice the shape variants of
+    :func:`next_bucket`, but up to 25% less padding: use it for dimensions
+    whose cost is per-element on the hot path (device→host transfer bytes,
+    scan length), not for axes that must divide a mesh."""
+    size = floor
+    while True:
+        if n <= size:
+            return size
+        if n <= size * 3 // 2:
+            return size * 3 // 2
+        size *= 2
+
+
 class Interner:
     """Dense id assignment by first appearance."""
 

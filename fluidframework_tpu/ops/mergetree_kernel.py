@@ -47,7 +47,7 @@ import numpy as np
 
 from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
-from .interning import Interner, TextArena, next_bucket
+from .interning import Interner, TextArena, next_bucket, next_bucket_fine
 from .native_pack import count_stream
 
 NOT_REMOVED = np.int32(np.iinfo(np.int32).max)
@@ -367,6 +367,11 @@ EXPORT_SLOT_FIELDS = (
     "rem_seq", "rem_client", "rem2_seq", "rem2_client",
     "ob1_seq", "ob1_client", "ob2_seq", "ob2_client",
 )
+#: the slot fields with no obliterate content — the export layout when a
+#: chunk provably carries no obliterates (``meta["ob_rows"]`` False)
+NON_OB_SLOT_FIELDS = EXPORT_SLOT_FIELDS[:8]
+#: the obliterate rows elided from such exports, with their sentinel fills
+OB_SLOT_FIELDS = EXPORT_SLOT_FIELDS[8:]
 #: rows holding seqs with the NOT_REMOVED sentinel (i16 remap set)
 SENTINEL_SEQ_FIELDS = ("rem_seq", "rem2_seq", "ob1_seq", "ob2_seq")
 I16_NOT_REMOVED = np.int16(np.iinfo(np.int16).max)
@@ -374,10 +379,16 @@ I16_LIMIT = int(np.iinfo(np.int16).max) - 1  # strict value bound for i16_ok
 
 
 def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
-                  i16: bool = False) -> jnp.ndarray:
+                  i16: bool = False, ob_rows: bool = True) -> jnp.ndarray:
     """[D, 13+K, S] fused view of everything summary extraction and interval
     replay need from the final device state (int32, or int16 when ``i16``
-    with per-doc-rebased tstart and remapped NOT_REMOVED sentinels)."""
+    with per-doc-rebased tstart and remapped NOT_REMOVED sentinels).
+
+    With ``ob_rows=False`` (the chunk provably contains no obliterate ops
+    or base stamps — pack-time fact) the four obliterate rows are elided
+    from the transfer entirely; ``widen_export`` reinserts their sentinel
+    values host-side.  That is 4 of 12 slot rows off the device→host
+    fetch, the pipeline's measured bottleneck."""
     D, S = final.tlen.shape
     K = final.props.shape[2]
     slot = jnp.arange(S)[None, :]
@@ -394,14 +405,17 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
     # ``widen_export`` (and export bytes are deterministic).
     tstart = jnp.where(active, final.tstart, 0)
     named = {"tstart": tstart}
+    fields = EXPORT_SLOT_FIELDS if ob_rows else NON_OB_SLOT_FIELDS
     if i16:
         named["tstart"] = jnp.where(active, tstart - doc_base[:, None], 0)
         for f in SENTINEL_SEQ_FIELDS:
+            if f not in fields:
+                continue
             val = getattr(final, f)
             named[f] = jnp.where(
                 val == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), val
             )
-    rows = [named.get(f, getattr(final, f)) for f in EXPORT_SLOT_FIELDS]
+    rows = [named.get(f, getattr(final, f)) for f in fields]
     rows += [final.props[:, :, k] for k in range(K)]
     rows.append(misc)
     out = jnp.stack(rows, axis=1)
@@ -409,23 +423,42 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
 
 
 def widen_export(export_np: np.ndarray,
-                 doc_base: Optional[np.ndarray]) -> np.ndarray:
-    """Undo the int16 export transforms host-side: widen to int32, restore
-    NOT_REMOVED sentinels, re-add per-doc arena bases.  int32 buffers pass
-    through untouched."""
+                 doc_base: Optional[np.ndarray],
+                 ob_rows: bool = True) -> np.ndarray:
+    """Undo the export transfer transforms host-side, always returning the
+    CANONICAL full int32 layout: widen int16 to int32, restore NOT_REMOVED
+    sentinels, re-add per-doc arena bases, and — for obliterate-free
+    exports (``ob_rows=False``) — reinsert the four elided obliterate rows
+    with their sentinel fills.  Full-layout int32 buffers pass through
+    untouched."""
+    fields = EXPORT_SLOT_FIELDS if ob_rows else NON_OB_SLOT_FIELDS
     if export_np.dtype == np.int32:
-        return export_np
-    out = export_np.astype(np.int32)
-    for f in SENTINEL_SEQ_FIELDS:
-        row = out[:, EXPORT_SLOT_FIELDS.index(f), :]
-        row[row == int(I16_NOT_REMOVED)] = NOT_REMOVED
-    if doc_base is not None:
-        # Re-add the per-doc arena base to live slots only (slots beyond n
-        # were zeroed on device and must stay zero to match the int32 path).
-        n = out[:, -1, 0]
-        active = np.arange(out.shape[2])[None, :] < n[:, None]
-        out[:, 0, :] += np.where(
-            active, np.asarray(doc_base, np.int32)[:, None], 0
+        out = export_np
+    else:
+        out = export_np.astype(np.int32)
+        for f in SENTINEL_SEQ_FIELDS:
+            if f not in fields:
+                continue
+            row = out[:, fields.index(f), :]
+            row[row == int(I16_NOT_REMOVED)] = NOT_REMOVED
+        if doc_base is not None:
+            # Re-add the per-doc arena base to live slots only (slots
+            # beyond n were zeroed on device and must stay zero to match
+            # the int32 path).
+            n = out[:, -1, 0]
+            active = np.arange(out.shape[2])[None, :] < n[:, None]
+            out[:, 0, :] += np.where(
+                active, np.asarray(doc_base, np.int32)[:, None], 0
+            )
+    if not ob_rows:
+        D, _R, S = out.shape
+        n_ob = len(OB_SLOT_FIELDS)
+        filler = np.empty((D, n_ob, S), np.int32)
+        for i, f in enumerate(OB_SLOT_FIELDS):
+            filler[:, i, :] = NOT_REMOVED if f.endswith("_seq") else -1
+        split = len(NON_OB_SLOT_FIELDS)
+        out = np.concatenate(
+            [out[:, :split], filler, out[:, split:]], axis=1
         )
     return out
 
@@ -455,13 +488,13 @@ def _fetch_format():
 
 
 @functools.lru_cache(maxsize=None)
-def _export_cold_fn(S: int, i16: bool):
-    """Compiled cold-start fold+export for one (S, width) bucket, its output
-    laid out for a line-rate fetch."""
+def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True):
+    """Compiled cold-start fold+export for one (S, width, layout) bucket,
+    its output laid out for a line-rate fetch."""
 
     def f(ops, doc_base):
         return _export_state(
-            replay_vmapped(_cold_start(ops, S), ops), doc_base, i16
+            replay_vmapped(_cold_start(ops, S), ops), doc_base, i16, ob_rows
         )
 
     fmt = _fetch_format()
@@ -469,11 +502,12 @@ def _export_cold_fn(S: int, i16: bool):
 
 
 @functools.lru_cache(maxsize=None)
-def _export_warm_fn(i16: bool):
+def _export_warm_fn(i16: bool, ob_rows: bool = True):
     """Compiled warm-start (base state uploaded) fold+export."""
 
     def f(state, ops, doc_base):
-        return _export_state(replay_vmapped(state, ops), doc_base, i16)
+        return _export_state(replay_vmapped(state, ops), doc_base, i16,
+                             ob_rows)
 
     fmt = _fetch_format()
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
@@ -486,11 +520,12 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     ``state=None`` for all-cold chunks (initial state built in-graph — no
     zero upload)."""
     i16 = bool(meta.get("i16_ok"))
+    ob_rows = bool(meta.get("ob_rows", True))
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
     if state is None:
-        return _export_cold_fn(int(S), i16)(ops, doc_base)
-    return _export_warm_fn(i16)(state, ops, doc_base)
+        return _export_cold_fn(int(S), i16, ob_rows)(ops, doc_base)
+    return _export_warm_fn(i16, ob_rows)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:
@@ -602,12 +637,16 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         sum(1 for m in d.ops if not m.contents["kind"].startswith("interval"))
         for i, d in enumerate(docs)
     ]
-    T = next_bucket(max(text_op_counts, default=1), floor=16)
+    # S and T use the finer bucket ladder: both are pure per-element costs
+    # (T = scan length, S = export-transfer bytes — the pipeline bottleneck)
+    # and neither needs to divide the mesh, so the extra shape variants buy
+    # up to 25% less padding on the hot path.
+    T = next_bucket_fine(max(text_op_counts, default=1), floor=16)
     base_counts = [len(d.base_records or []) for d in docs]
     S = max(
         (bc + 2 * t for bc, t in zip(base_counts, text_op_counts)), default=1
     )
-    S = next_bucket(max(S, 1), floor=32)
+    S = next_bucket_fine(max(S, 1), floor=32)
 
     D = len(docs)
     st = {
@@ -641,6 +680,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     }
 
     doc_base = np.zeros((D,), np.int32)
+    base_has_ob = False
     for d, doc in enumerate(docs):
         pack = doc_packs[d]
         doc_base[d] = len(arena)
@@ -658,6 +698,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                 st["rem_client"][d, s] = pack.client_idx(rec.get("rc"))
             ob = rec.get("ob", [])
             if ob:
+                base_has_ob = True
                 st["ob1_seq"][d, s] = ob[0][0]
                 st["ob1_client"][d, s] = pack.client_idx(ob[0][1])
                 if len(ob) > 1:
@@ -774,6 +815,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "docs": docs,
         "doc_base": doc_base,
         "i16_ok": i16_ok,
+        # Export the 4 obliterate rows only when the chunk can touch them
+        # (a pack-time fact: an obliterate op anywhere — including C++-
+        # filled binary rows, which land in op["kind"] — or a base stamp).
+        "ob_rows": base_has_ob or bool((op["kind"] == K_OBLITERATE).any()),
     }
     return MTState(**st), MTOps(**op), meta
 
@@ -962,7 +1007,8 @@ def summaries_from_export(meta, export_np: np.ndarray,
 
     docs = meta["docs"]
     D = len(docs)
-    export_np = widen_export(export_np, meta.get("doc_base"))
+    export_np = widen_export(export_np, meta.get("doc_base"),
+                             ob_rows=meta.get("ob_rows", True))
     state_np = state_dict_from_export(export_np)
     skip = np.zeros(D, np.uint8)
     for d in range(D):

@@ -212,12 +212,75 @@ def test_export_widths_agree_and_widen_roundtrips():
     meta32 = dict(meta, i16_ok=False)
     ex32 = np.asarray(replay_export(None, ops, meta32, S=S))
     assert ex32.dtype == np.int32
+    ob = meta["ob_rows"]
     np.testing.assert_array_equal(
-        widen_export(ex16, meta["doc_base"]), ex32
+        widen_export(ex16, meta["doc_base"], ob_rows=ob),
+        widen_export(ex32, None, ob_rows=ob),
     )
     d16 = [s.digest() for s in summaries_from_export(meta, ex16)]
     d32 = [s.digest() for s in summaries_from_export(meta32, ex32)]
     assert d16 == d32
+
+
+def test_obliterate_rows_elided_when_chunk_has_none():
+    """A chunk with no obliterate ops transfers 4 fewer slot rows; the
+    host reinserts sentinels and summaries stay byte-identical.  A chunk
+    WITH an obliterate keeps the full layout."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        EXPORT_SLOT_FIELDS,
+        NON_OB_SLOT_FIELDS,
+        pack_mergetree_batch,
+        replay_export,
+        summaries_from_export,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def op(seq, contents):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=0, type=MessageType.OP, contents=contents,
+        )
+
+    plain = MergeTreeDocInput(
+        doc_id="plain",
+        ops=[op(1, {"kind": "insert", "pos": 0, "text": "hello"}),
+             op(2, {"kind": "remove", "start": 1, "end": 3})],
+        final_seq=2, final_msn=0,
+    )
+    state, ops, meta = pack_mergetree_batch([plain])
+    assert meta["ob_rows"] is False
+    K = len(meta["prop_keys"]) if meta["prop_keys"] else 1
+    ex = np.asarray(replay_export(None, ops, meta, S=state.tstart.shape[1]))
+    assert ex.shape[1] == len(NON_OB_SLOT_FIELDS) + K + 1
+    [summary] = summaries_from_export(meta, ex)
+    replica = SharedString("plain")
+    for msg in plain.ops:
+        replica.process(msg, local=False)
+    assert summary.digest() == replica.summarize().digest()
+
+    obd = MergeTreeDocInput(
+        doc_id="ob",
+        ops=[op(1, {"kind": "insert", "pos": 0, "text": "hello"}),
+             op(2, {"kind": "obliterate", "start": 1, "end": 3})],
+        final_seq=2, final_msn=0,
+    )
+    state2, ops2, meta2 = pack_mergetree_batch([obd])
+    assert meta2["ob_rows"] is True
+    ex2 = np.asarray(
+        replay_export(None, ops2, meta2, S=state2.tstart.shape[1])
+    )
+    K2 = len(meta2["prop_keys"]) if meta2["prop_keys"] else 1
+    assert ex2.shape[1] == len(EXPORT_SLOT_FIELDS) + K2 + 1
+    [summary2] = summaries_from_export(meta2, ex2)
+    replica2 = SharedString("ob")
+    for msg in obd.ops:
+        replica2.process(msg, local=False)
+    assert summary2.digest() == replica2.summarize().digest()
 
 
 def test_export_i16_disabled_for_wide_values():
