@@ -280,30 +280,37 @@ def run_e2e(docs):
         futs: collections.deque = collections.deque()
         try:
             with ThreadPoolExecutor(max_workers=PACK_THREADS) as pool:
-                next_i = 0
-                while next_i < len(starts) and len(futs) < window:
-                    futs.append(pool.submit(pack_one, starts[next_i]))
-                    next_i += 1
-                while futs:
-                    fut = futs.popleft()
-                    state, ops, meta, dt = fut.result()
-                    if next_i < len(starts):
+                try:
+                    next_i = 0
+                    while next_i < len(starts) and len(futs) < window:
                         futs.append(pool.submit(pack_one, starts[next_i]))
                         next_i += 1
-                    stage["pack"] += dt  # busy (overlapped) seconds
-                    t0 = time.time()
-                    S = state.tstart.shape[1]
-                    ex = replay_export(None, ops, meta, S=S)
-                    stage["dispatch"] += time.time() - t0
-                    packed_chunks.append((ops, meta, S))
-                    if not put(folded, (meta, ex)):
-                        return
+                    while futs:
+                        fut = futs.popleft()
+                        state, ops, meta, dt = fut.result()
+                        if next_i < len(starts):
+                            futs.append(
+                                pool.submit(pack_one, starts[next_i])
+                            )
+                            next_i += 1
+                        stage["pack"] += dt  # busy (overlapped) seconds
+                        t0 = time.time()
+                        S = state.tstart.shape[1]
+                        ex = replay_export(None, ops, meta, S=S)
+                        stage["dispatch"] += time.time() - t0
+                        packed_chunks.append((ops, meta, S))
+                        if not put(folded, (meta, ex)):
+                            return
+                finally:
+                    # Cancel BEFORE the pool context exits — shutdown
+                    # waits for queued futures, so cancelling after it
+                    # would be dead code and delay error surfacing.
+                    for f in futs:
+                        f.cancel()
         except BaseException as e:  # surface in main thread
             errors.append(e)
             abort.set()
         finally:
-            for f in futs:
-                f.cancel()
             put(folded, None)
 
     def downloader():

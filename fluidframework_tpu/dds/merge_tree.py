@@ -133,12 +133,20 @@ class SegmentGroup:
     new half to every group the original belonged to (reference capability:
     merge-tree SegmentGroup)."""
 
-    __slots__ = ("kind", "segments", "props")
+    __slots__ = ("kind", "segments", "props", "client", "predicted")
 
-    def __init__(self, kind: str, props: Optional[Dict[str, Any]] = None) -> None:
+    def __init__(self, kind: str, props: Optional[Dict[str, Any]] = None,
+                 client: Optional[str] = None) -> None:
         self.kind = kind
         self.segments: List[Segment] = []
         self.props = props or {}
+        #: submitting client (set for pending obliterates — the arrival
+        #: prediction marks kills in the obliterator's name)
+        self.client = client
+        #: segments that joined via the pending-obliterate arrival
+        #: prediction (remotes see them as zero-width stamp targets, not
+        #: pass-1 coverage — the ack bookkeeping differs)
+        self.predicted: set = set()
 
     def add(self, seg: Segment) -> None:
         self.segments.append(seg)
@@ -210,6 +218,14 @@ class MergeTreeOracle:
             return True
         involved = (
             client == seg.removed_client or client in seg.overlap_removers
+            # An obliterate STAMP makes its author involved too: the
+            # author's optimistic view hid every covered slot, so views in
+            # the author's name must hide the tombstone even when another
+            # client's earlier remove won the removal itself (zero-width
+            # stamps carry no remover bookkeeping — the stamp is the only
+            # durable record of the author's coverage; fuzz-found).
+            or any(cl == client and (up_to_seq is None or s < up_to_seq)
+                   for s, cl in seg.ob_stamps.items())
         )
         if up_to_seq is None:
             # Optimistic view: the client's own pending (unsequenced) overlap
@@ -265,6 +281,8 @@ class MergeTreeOracle:
         # The split halves both belong to any pending op group the original did.
         for group in list(seg.pending_groups):
             group.add(right)
+            if seg in group.predicted:
+                group.predicted.add(right)
         # Local references at offsets past the split move to the right half.
         keep, move = [], []
         for ref in seg.refs:
@@ -353,7 +371,8 @@ class MergeTreeOracle:
         # not seen (ob_seq > ref_seq).  Endpoint inserts (an unstamped or
         # differently-stamped neighbor on either side) survive.
         if seq != UNASSIGNED_SEQ:
-            self._arrival_obliterate(seg, idx, idx, ref_seq, client)
+            if not self._arrival_obliterate(seg, idx, idx, ref_seq, client):
+                self._pending_obliterate_prediction(seg, idx)
         self.segments.insert(idx, seg)
         if group is not None:
             group.add(seg)
@@ -394,6 +413,44 @@ class MergeTreeOracle:
         seg.removed_client = left.ob_stamps[s]
         seg.ob_stamps[s] = left.ob_stamps[s]
         return True
+
+    def _pending_obliterate_prediction(self, seg: Segment, idx: int) -> bool:
+        """A replica holding a PENDING local obliterate must give an
+        arriving concurrent sequenced insert the verdict every remote
+        replica will compute once the obliterate sequences — otherwise the
+        obliterator's follow-up ops count text no remote view contains
+        (deep-lag divergence, fuzz-found).
+
+        Slot-order test: the insert dies iff it lands STRICTLY between the
+        pending group's outermost covered slots.  That is exactly the
+        sequenced neighbor rule's eventual verdict: the ack's zero-width
+        pass stamps every sequenced slot between covered slots, so at ack
+        both arrival neighbors of an interior insert carry the shared
+        stamp, while an insert at or beyond a boundary slot keeps an
+        unstamped outer neighbor.  The kill is recorded as a pending
+        removal in the obliterator's name and the segment joins the group,
+        so ``ack_obliterate`` assigns the same final (seq, client) every
+        remote computes.  ``idx`` is the pre-insert insertion index."""
+        bounds: Dict[int, list] = {}  # id(group) -> [group, first, last]
+        for j, s in enumerate(self.segments):
+            for g in s.pending_groups:
+                if g.kind != "obliterate" or g.client is None:
+                    continue
+                entry = bounds.get(id(g))
+                if entry is None:
+                    bounds[id(g)] = [g, j, j]
+                else:
+                    entry[2] = j
+        killed = False
+        for g, first, last in bounds.values():
+            if first < idx <= last:
+                if not killed:
+                    seg.removed_seq = UNASSIGNED_SEQ
+                    seg.removed_client = g.client
+                    killed = True
+                g.add(seg)
+                g.predicted.add(seg)
+        return killed
 
     def _mark_removed(self, seg: Segment, seq: int, client: str) -> None:
         """First-wins removal bookkeeping shared by remove and obliterate."""
@@ -447,9 +504,23 @@ class MergeTreeOracle:
         invisible concurrent inserts strictly inside the range."""
         if start >= end:
             return
+        # Collect the visible coverage first (boundary splits happen inside
+        # _walk_range), then SNAPSHOT the pristine pass-2 view before any
+        # marking: pass 1's removal/overlap bookkeeping on this very op's
+        # segments must not collapse the position walk pass 2 resolves the
+        # range in (fuzz-found: a covered segment that lost to an earlier
+        # remove reads as involved-invisible once pass 1 adds this client
+        # to its overlap set, shifting every zero-width slot after it).
+        covered = list(self._walk_range(start, end, ref_seq, client))
+        pristine = None
+        if seq != UNASSIGNED_SEQ:
+            pristine = [
+                self._visible_len(s, ref_seq, client, up_to_seq=seq)
+                for s in self.segments
+            ]
         # Pass 1: visible coverage — remove + stamp (the _walk_range split
         # bookkeeping is shared with remove).
-        for seg in self._walk_range(start, end, ref_seq, client):
+        for seg in covered:
             self._mark_removed(seg, seq, client)
             if seq != UNASSIGNED_SEQ:
                 seg.ob_stamps[seq] = client
@@ -460,21 +531,27 @@ class MergeTreeOracle:
         # local obliterate defers this pass to its ack (the stamp cannot be
         # compared against ref_seqs until it sequences).
         if seq != UNASSIGNED_SEQ:
-            self._obliterate_zero_width(start, end, seq, client, ref_seq)
+            self._obliterate_zero_width(start, end, seq, client, ref_seq,
+                                        vis=pristine)
             self.current_seq = max(self.current_seq, seq)
 
     def _obliterate_zero_width(self, start: int, end: int, seq: int,
-                               client: str, ref_seq: int) -> None:
+                               client: str, ref_seq: int,
+                               vis: Optional[List[int]] = None) -> None:
         """Stamp zero-width slots strictly inside the obliterated view
         range: existing tombstones (stamp only) and invisible concurrent
-        inserts (remove + stamp)."""
+        inserts (remove + stamp).  ``vis`` is the pristine per-segment
+        visible-length snapshot taken before this op mutated any state
+        (callers pass it whenever earlier passes of the same op marked
+        segments; without it the view is computed live)."""
         c = 0
-        for seg in self.segments:
+        for i, seg in enumerate(self.segments):
             # Bounded fold view: removals made BY THIS OP (seq == this op,
             # not < it) stay visible, so positions here match the pristine
             # view every remote resolves the range in — the op's own pass-1
             # removals must not collapse the walk (fuzz-found).
-            v = self._visible_len(seg, ref_seq, client, up_to_seq=seq)
+            v = vis[i] if vis is not None else \
+                self._visible_len(seg, ref_seq, client, up_to_seq=seq)
             if v == 0 and start < c < end \
                     and seg.insert_seq != UNASSIGNED_SEQ:
                 # Sequenced zero-width slots strictly inside: existing
@@ -527,17 +604,32 @@ class MergeTreeOracle:
         bookkeeping), materialize the stamp, and run the zero-width pass at
         the now-known seq — the author's state converges with every remote
         replica's apply_obliterate."""
+        # Pristine pass-2 snapshot BEFORE the group pass promotes demoted
+        # removers: promotion makes those segments read involved-invisible
+        # and would collapse the zero-width position walk (same hazard the
+        # apply path snapshots against).
+        pristine = [
+            self._visible_len(s, ref_seq, client, up_to_seq=seq)
+            for s in self.segments
+        ]
         for seg in group.segments:
             if seg.removed_seq == UNASSIGNED_SEQ and \
                     seg.removed_client == client:
                 seg.removed_seq = seq
             elif client in seg.pending_overlap:
                 seg.pending_overlap.discard(client)
-                seg.overlap_removers.add(client)
+                # A segment that joined the group via the arrival
+                # prediction and then lost to an earlier-sequenced remove
+                # is a ZERO-WIDTH slot to every remote (they stamp it,
+                # never record this client as a remover) — promotion to
+                # overlap remover would diverge from them.
+                if seg not in group.predicted:
+                    seg.overlap_removers.add(client)
             seg.ob_stamps[seq] = client
             self._slide_refs(seg)
             seg.pending_groups.remove(group)
-        self._obliterate_zero_width(start, end, seq, client, ref_seq)
+        self._obliterate_zero_width(start, end, seq, client, ref_seq,
+                                    vis=pristine)
 
     def apply_annotate(
         self,

@@ -99,7 +99,7 @@ class SharedString(SharedObject):
             return
         client = self._local_client()
         removed = self.text[start:end]
-        group = SegmentGroup("obliterate")
+        group = SegmentGroup("obliterate", client=client)
         self.tree.apply_obliterate(
             start, end, UNASSIGNED_SEQ, client, self.tree.current_seq,
             group=group,
@@ -231,7 +231,7 @@ class SharedString(SharedObject):
                     start = pos
                 end = pos + len(seg.text)
             if start is not None and end > start:
-                new_group = SegmentGroup("obliterate")
+                new_group = SegmentGroup("obliterate", client=client)
                 for seg in segs:
                     if seg.removed_seq == UNASSIGNED_SEQ and \
                             seg.removed_client == client:
