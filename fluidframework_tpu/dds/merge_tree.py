@@ -187,6 +187,10 @@ class MergeTreeOracle:
         self.segments: List[Segment] = []
         self.current_seq = 0
         self.min_seq = 0
+        #: live pending local obliterate groups — the arrival-prediction
+        #: fast path: pure sequenced replay (catch-up) never has any, so
+        #: apply_insert stays O(1) there instead of scanning the pool
+        self.pending_obliterates: set = set()
 
     # -- visibility ------------------------------------------------------------
 
@@ -431,6 +435,8 @@ class MergeTreeOracle:
         removal in the obliterator's name and the segment joins the group,
         so ``ack_obliterate`` assigns the same final (seq, client) every
         remote computes.  ``idx`` is the pre-insert insertion index."""
+        if not self.pending_obliterates:
+            return False  # pure sequenced replay: O(1) fast path
         bounds: Dict[int, list] = {}  # id(group) -> [group, first, last]
         for j, s in enumerate(self.segments):
             for g in s.pending_groups:
@@ -534,6 +540,8 @@ class MergeTreeOracle:
             self._obliterate_zero_width(start, end, seq, client, ref_seq,
                                         vis=pristine)
             self.current_seq = max(self.current_seq, seq)
+        elif group is not None:
+            self.pending_obliterates.add(group)
 
     def _obliterate_zero_width(self, start: int, end: int, seq: int,
                                client: str, ref_seq: int,
@@ -604,6 +612,7 @@ class MergeTreeOracle:
         bookkeeping), materialize the stamp, and run the zero-width pass at
         the now-known seq — the author's state converges with every remote
         replica's apply_obliterate."""
+        self.pending_obliterates.discard(group)
         # Pristine pass-2 snapshot BEFORE the group pass promotes demoted
         # removers: promotion makes those segments read involved-invisible
         # and would collapse the zero-width position walk (same hazard the

@@ -212,6 +212,7 @@ class SharedString(SharedObject):
         segs = [s for s in self.tree.segments if group in s.pending_groups]
         client = self._local_client()
         if group.kind == "obliterate":
+            self.tree.pending_obliterates.discard(group)
             # A range obliterate must regenerate as ONE op over its whole
             # span: per-segment ranges would turn interior seams into
             # endpoints (where concurrent inserts survive) and lose the
@@ -236,6 +237,7 @@ class SharedString(SharedObject):
                     if seg.removed_seq == UNASSIGNED_SEQ and \
                             seg.removed_client == client:
                         new_group.add(seg)
+                self.tree.pending_obliterates.add(new_group)
                 self._pending_groups.append(new_group)
                 self._submit_local_op(
                     {"kind": "obliterate", "start": start, "end": end}

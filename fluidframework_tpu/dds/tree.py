@@ -210,6 +210,34 @@ class Forest:
         except ValueError:
             pass
 
+    def purge_expired(self, node_id: str, min_seq: int) -> None:
+        """Pop this EXPIRED tombstone; descendants that are alive or still
+        in-window become detached LIMBO roots (``parent=None``) — they stay
+        addressable by id, because a later sequenced move can rescue a node
+        that an earlier op relocated into the tombstone's subtree
+        (id-addressed moves make this protocol-reachable; fuzz-found).
+        Descendants that are themselves expired pop recursively."""
+        n = self.nodes.pop(node_id, None)
+        if n is None:
+            return
+        for _field, kids in list(n.fields.items()):
+            for cid in list(kids):
+                c = self.nodes.get(cid)
+                if c is None:
+                    continue
+                if c.removed_seq is not None and c.removed_seq <= min_seq:
+                    self.purge_expired(cid, min_seq)
+                else:
+                    c.parent = None  # limbo root
+
+    def limbo_roots(self) -> List[str]:
+        """Detached (rescuable) roots, sorted by id — the limbo section of
+        summaries."""
+        return sorted(
+            nid for nid, n in self.nodes.items()
+            if nid != ROOT_ID and n.parent is None
+        )
+
     def purge_subtree(self, node_id: str) -> None:
         n = self.nodes.pop(node_id, None)
         if n is None:
@@ -327,7 +355,13 @@ def invert(changeset: dict, forest: Forest) -> dict:
 
 def _materialize(
     forest: Forest, spec: dict, parent_id: str, field: str, seq: int,
-) -> None:
+) -> bool:
+    """Create the spec'd subtree; returns False (creating nothing) when the
+    id already exists — a node rescued out of a purged subtree keeps its
+    current location ("move wins the location"), so revive repair data must
+    not clone it."""
+    if forest.contains(spec["id"]):
+        return False
     n = TreeNode(
         id=spec["id"], type=spec["type"],
         value=spec.get("value"), value_seq=max(seq, 0),
@@ -337,8 +371,9 @@ def _materialize(
     forest.nodes[n.id] = n
     for f, children in spec.get("fields", {}).items():
         for child in children:
-            _materialize(forest, child, n.id, f, seq)
-            n.fields.setdefault(f, []).append(child["id"])
+            if _materialize(forest, child, n.id, f, seq):
+                n.fields.setdefault(f, []).append(child["id"])
+    return True
 
 
 def apply_changeset(forest: Forest, cs: dict, seq: int) -> None:
@@ -360,12 +395,11 @@ def apply_changeset(forest: Forest, cs: dict, seq: int) -> None:
             prev = anchor if (
                 anchor is FIELD_START or forest.contains(anchor)
             ) else FIELD_START
-            for spec in edit["content"]:
-                _materialize(forest, spec, parent_id, edit["field"], seq)
-            forest.place_block(
-                parent_id, edit["field"], prev,
-                [c["id"] for c in edit["content"]],
-            )
+            created = [
+                spec["id"] for spec in edit["content"]
+                if _materialize(forest, spec, parent_id, edit["field"], seq)
+            ]
+            forest.place_block(parent_id, edit["field"], prev, created)
         elif kind == "remove":
             for nid in edit["ids"]:
                 n = forest.nodes.get(nid)
@@ -386,13 +420,14 @@ def apply_changeset(forest: Forest, cs: dict, seq: int) -> None:
                         anchor
                     ):
                         anchor = FIELD_START
-                    for spec in content:
-                        _materialize(
+                    created = [
+                        spec["id"] for spec in content
+                        if _materialize(
                             forest, spec, edit["parent"], edit["field"], seq
                         )
+                    ]
                     forest.place_block(
-                        edit["parent"], edit["field"], anchor,
-                        [c["id"] for c in content],
+                        edit["parent"], edit["field"], anchor, created
                     )
                     forest.node(nid).removed_seq = None
         elif kind == "set":
@@ -741,7 +776,7 @@ class SharedTree(SharedObject):
             for nid in expired:
                 if self.seq_forest.contains(nid):
                     self.seq_forest.detach(nid)
-                    self.seq_forest.purge_subtree(nid)
+                    self.seq_forest.purge_expired(nid, min_seq)
             self._invalidate()
 
     # -- summaries (normalized; SEMANTICS.md §canonical-summaries) -------------
@@ -754,6 +789,31 @@ class SharedTree(SharedObject):
             "minSeq": min_seq,
             "seq": self._last_seq,
         }
+        # Detached (rescuable) subtrees survive summarize/reload — a later
+        # sequenced move can still relocate them by id, so a freshly
+        # loaded replica must know them or it would skip the rescue every
+        # long-lived replica applies.  Limbo is derived from THIS summary's
+        # window, not from past purges: with a caller min_seq beyond the
+        # channel's advanced window (the container summarizes with its own
+        # MSN), tombstones expire at serialization time and their kept
+        # descendants must surface exactly as if the purge had run — the
+        # same kept-under-unkept rule the device kernel extraction applies.
+        limbo_ids = set(self.seq_forest.limbo_roots())
+        for nid, n in self.seq_forest.nodes.items():
+            if nid == ROOT_ID or n.parent is None:
+                continue
+            if not self._summary_keep(nid, min_seq):
+                continue
+            pid = n.parent[0]
+            if pid != ROOT_ID and not self._summary_keep(pid, min_seq):
+                limbo_ids.add(nid)
+        limbo = [
+            self._summary_node(nid, min_seq)
+            for nid in sorted(limbo_ids)
+            if self._summary_keep(nid, min_seq)
+        ]
+        if limbo:
+            root_obj["limbo"] = limbo
         tree.add_blob("header", canonical_json(root_obj))
         return tree
 
@@ -804,6 +864,9 @@ class SharedTree(SharedObject):
             for child in children:
                 self._load_node(child, ROOT_ID, f)
                 root.fields.setdefault(f, []).append(child["id"])
+        for spec in obj.get("limbo", []):
+            self._load_node(spec, ROOT_ID, "")
+            self.seq_forest.node(spec["id"]).parent = None  # detached
         self.discard_pending()
         self._invalidate()
 

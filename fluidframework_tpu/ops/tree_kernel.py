@@ -78,6 +78,10 @@ class TreeEdits(NamedTuple):
     first: jnp.ndarray      # [T] block chain head (insert) / target (others)
     tail: jnp.ndarray       # [T] block chain tail (insert; == first for move)
     value: jnp.ndarray      # [T] interned value id (set)
+    purge_msn: jnp.ndarray  # [T] purge boundary when this edit applies: the
+    #                         max min_seq over all PRIOR messages (+ base
+    #                         minSeq) — the oracle pops expired tombstones
+    #                         exactly up to here before applying this edit
 
 
 def _splice_after(state: TreeState, c, anchor, first, tail) -> TreeState:
@@ -138,8 +142,19 @@ def _apply_edit(state: TreeState, e) -> TreeState:
     is_mov = e.kind == K_MOVE
     target = e.first
 
-    # --- insert: splice the pre-materialized chain.
-    ins = _splice_after(state, e.container, e.anchor, e.first, e.tail)
+    def _expired(idx):
+        rs = state.removed_seq[idx]
+        return (rs != NOT_REMOVED) & (rs <= e.purge_msn)
+
+    # --- insert: splice the pre-materialized chain.  A popped (expired-
+    # purged) anchor falls back to field start, as the oracle's
+    # contains(anchor) check does.  (Inserts into popped PARENTS are a
+    # pack-time oracle fallback — their skipped content would need an
+    # existence simulation here.)
+    ins_anchor = jnp.where(
+        (e.anchor != NIL) & _expired(e.anchor), NIL, e.anchor
+    )
+    ins = _splice_after(state, e.container, ins_anchor, e.first, e.tail)
     state = jax.tree.map(
         lambda new, old: jnp.where(is_ins, new, old), ins, state
     )
@@ -164,10 +179,21 @@ def _apply_edit(state: TreeState, e) -> TreeState:
         ),
     )
 
-    # --- move: cycle test, detach, splice, restamp.
+    # --- move: purge gates + cycle test, detach, splice, restamp.
+    # The oracle pops expired tombstones before applying this edit; a move
+    # whose TARGET was popped, or whose destination PARENT was popped, is a
+    # no-op there and must be here (ids referencing live limbo nodes still
+    # move — that's the rescue path).
     hit, deep = _in_subtree(state, e.container, target)
-    do_move = is_mov & ~hit
+    dest_owner = state.container_parent[e.container]
+    do_move = is_mov & ~hit & ~_expired(target) & ~_expired(dest_owner)
     anchor = jnp.where(e.anchor == target, NIL, e.anchor)
+    # A popped anchor falls back to field start (the oracle's
+    # contains(anchor) check); a live limbo anchor keeps the same fallback
+    # via the not-in-this-container test inside _splice_after.
+    anchor = jnp.where(
+        (anchor != NIL) & _expired(anchor), NIL, anchor
+    )
     moved = _detach(state, target)
     moved = _splice_after(moved, e.container, anchor, target, target)
     moved = moved._replace(
@@ -322,10 +348,15 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
                     chains.setdefault(c, []).append(materialize(ch, c))
             return idx
 
+        base_obj = None
         if doc.base_summary is not None:
-            obj = json.loads(doc.base_summary.blob_bytes("header"))
+            base_obj = obj = json.loads(doc.base_summary.blob_bytes("header"))
             pack.header_seq = obj.get("seq", 0)
             pack.base_min_seq = obj.get("minSeq", 0)
+            if obj.get("limbo"):
+                # Detached-but-rescuable subtrees in the base need a
+                # container-less representation — oracle fallback.
+                pack.needs_fallback = True
             for f, children in obj.get("fields", {}).items():
                 c = pack.container(0, f)
                 for ch in children:
@@ -344,12 +375,49 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
                 for ch in chs:
                     fix_seqs(ch)
 
+        # Host-exact removal times (first remover wins; base tombstones
+        # count) — they decide, per edit, whether the oracle had already
+        # popped a referenced node when the edit applied.
+        removal_time: Dict[str, int] = {}
+
+        def note_removals(spec):
+            if spec.get("removedSeq") is not None:
+                removal_time[spec["id"]] = spec["removedSeq"]
+            for chs in spec.get("fields", {}).values():
+                for ch in chs:
+                    note_removals(ch)
+
+        if base_obj is not None:
+            for chs in base_obj.get("fields", {}).values():
+                for ch in chs:
+                    note_removals(ch)
+        for msg in doc.ops:
+            for edit in msg.contents["edits"]:
+                if edit["kind"] == "remove":
+                    for nid in edit["ids"]:
+                        removal_time.setdefault(nid, msg.seq)
+
+        # purge boundary while applying a message = max min_seq over all
+        # PRIOR messages (+ the base minSeq) — the oracle advances the
+        # window AFTER applying each message.
+        boundary = pack.base_min_seq
+
+        def popped(node_id: str) -> bool:
+            rt = removal_time.get(node_id)
+            return rt is not None and rt <= boundary
+
         for msg in doc.ops:
             pack.header_seq = max(pack.header_seq, msg.seq)
             pack.base_min_seq = max(pack.base_min_seq, msg.min_seq)
+            rows_before = len(edit_rows)
             for edit in msg.contents["edits"]:
                 kind = edit["kind"]
                 if kind == "insert":
+                    if popped(edit["parent"]):
+                        # The oracle skips this insert entirely (parent
+                        # popped); follow-on references to its content
+                        # would need an existence simulation — fallback.
+                        pack.needs_fallback = True
                     parent_idx = pack.node(edit["parent"])
                     c = pack.container(parent_idx, edit["field"])
                     block: List[int] = []
@@ -412,6 +480,9 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
                     pack.needs_fallback = True  # purge-timing interaction
                 else:
                     raise ValueError(f"unknown edit kind {kind!r}")
+            for row in edit_rows[rows_before:]:
+                row["purge_msn"] = boundary
+            boundary = max(boundary, msg.min_seq)
 
         packed_docs.append((node_rows, chains, edit_rows))
 
@@ -439,6 +510,7 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
         "first": np.zeros((D, T), np.int32),
         "tail": np.zeros((D, T), np.int32),
         "value": np.full((D, T), NO_VALUE, np.int32),
+        "purge_msn": np.full((D, T), -1, np.int32),
     }
 
     for d, (node_rows, chains, edit_rows) in enumerate(packed_docs):
@@ -484,6 +556,7 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
             ed["first"][d, t] = e["first"]
             ed["tail"][d, t] = e.get("tail", e["first"])
             ed["value"][d, t] = e.get("value", NO_VALUE)
+            ed["purge_msn"][d, t] = e.get("purge_msn", -1)
 
     meta = {"doc_packs": doc_packs, "values": values, "docs": docs}
     return TreeState(**st), TreeEdits(**ed), meta
@@ -581,6 +654,21 @@ def summary_from_state(meta, state_np: dict, d: int,
         "minSeq": msn,
         "seq": pack.header_seq,
     }
+    # Limbo: kept nodes still linked in a chain whose owning node is NOT
+    # kept (their enclosing tombstone expired).  The oracle detaches them
+    # at purge time; here they surface at extraction — same set, because
+    # rescued nodes were re-linked under kept owners by their moves.
+    # Unlinked rows (e.g. content of oracle-skipped inserts, which are a
+    # pack-time fallback anyway) are reachable from no chain.
+    limbo_idxs = []
+    for c in range(len(pack.containers)):
+        owner = int(state_np["container_parent"][d][c])
+        if owner == NIL or keep(owner):
+            continue
+        limbo_idxs.extend(i for i in chain(c) if keep(i))
+    if limbo_idxs:
+        limbo_idxs.sort(key=lambda i: pack.node_ids.values[i])
+        root_obj["limbo"] = [node_obj(i) for i in limbo_idxs]
     tree = SummaryTree()
     tree.add_blob("header", canonical_json(root_obj))
     return tree

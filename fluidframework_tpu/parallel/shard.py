@@ -349,7 +349,7 @@ def tree_sharded_replay_step(mesh: Mesh):
     )
     edit_shardings = TreeEdits(
         kind=shard, seq=shard, container=shard, anchor=shard,
-        first=shard, tail=shard, value=shard,
+        first=shard, tail=shard, value=shard, purge_msn=shard,
     )
     return jax.jit(
         _step,

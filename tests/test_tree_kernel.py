@@ -174,3 +174,146 @@ def test_deterministic_across_runs():
     d1 = replay_tree_batch([doc])[0].digest()
     d2 = replay_tree_batch([doc])[0].digest()
     assert d1 == d2
+
+
+def test_limbo_rescue_survives_purge_summary_and_device():
+    """A node moved into a subtree whose tombstone then EXPIRES must stay
+    rescuable by id: the purge detaches it to limbo instead of deleting it,
+    summaries carry a "limbo" section so reloads converge, the device fold
+    applies the rescue move exactly, and a limbo-carrying base summary
+    routes the warm fold to the oracle (fuzz-found divergence class)."""
+    import json
+
+    from fluidframework_tpu.dds.tree import SharedTree
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def op(seq, min_seq, edits):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=min_seq, type=MessageType.OP, contents={"edits": edits},
+        )
+
+    def ins(nid, parent, field, val):
+        return {"kind": "insert", "parent": parent, "field": field,
+                "anchor": None,
+                "content": [{"id": nid, "type": "n", "value": val}]}
+
+    log = [
+        op(1, 0, [ins("A", "", "a", 1)]),
+        op(2, 0, [ins("B", "", "a", 2)]),
+        op(3, 0, [{"kind": "move", "ids": ["B"], "parent": "A",
+                   "field": "kids", "anchor": None,
+                   "prev": [["B", "", "a", None]]}]),
+        op(4, 0, [{"kind": "remove", "ids": ["A"]}]),
+        op(5, 4, [ins("C", "", "a", 3)]),  # A expires -> B detached (limbo)
+        op(6, 4, [ins("D", "", "a", 4)]),
+        op(7, 4, [{"kind": "move", "ids": ["B"], "parent": "", "field": "a",
+                   "anchor": None,
+                   "prev": [["B", "A", "kids", None]]}]),  # the rescue
+    ]
+
+    live = SharedTree("t")
+    for m in log:
+        live.process(m, local=False)
+    final = live.summarize()
+    header = json.loads(final.blob_bytes("header"))
+    assert any(
+        n["id"] == "B" for n in header["fields"]["a"]
+    ), "rescued node must be visible again"
+
+    # mid-stream summary carries the limbo section; reload + tail converges
+    mid = SharedTree("t")
+    for m in log[:6]:
+        mid.process(m, local=False)
+    mid_summary = mid.summarize()
+    mid_obj = json.loads(mid_summary.blob_bytes("header"))
+    assert [n["id"] for n in mid_obj["limbo"]] == ["B"]
+    reloaded = SharedTree("t")
+    reloaded.load(mid_summary)
+    for m in log[6:]:
+        reloaded.process(m, local=False)
+    assert reloaded.summarize().digest() == final.digest()
+
+    # device: cold fold exact; warm fold from the limbo base falls back
+    [dev] = replay_tree_batch(
+        [TreeDocInput("t", ops=log, final_seq=7, final_msn=4)]
+    )
+    assert dev.digest() == final.digest()
+    stats = {}
+    [warm] = replay_tree_batch(
+        [TreeDocInput("t", ops=log[6:], base_summary=mid_summary,
+                      final_seq=7, final_msn=4)],
+        stats=stats,
+    )
+    assert warm.digest() == final.digest()
+    assert stats == {"fallback_docs": 1}
+
+
+def test_deep_tree_fuzz_device_parity():
+    """Deep tree fuzz (120 steps, 4 clients — the purge-race shape that
+    diverged before the limbo hardening; 400-seed sweeps ran clean
+    offline) with device parity and fallback accounting."""
+    for seed in (40007, 40045, 40060, 40100, 40200):
+        factory, trees, log, fs, fm = run_fuzz_doc(
+            seed, steps=120, n_clients=4
+        )
+        assert len({t.summarize().digest() for t in trees}) == 1
+        doc = TreeDocInput("tree", ops=log, final_seq=fs, final_msn=fm)
+        stats = {}
+        [device] = replay_tree_batch([doc], stats=stats)
+        assert device.digest() == trees[0].summarize().digest(), seed
+
+
+def test_summarize_wider_min_seq_emits_limbo():
+    """summarize(min_seq) beyond the channel's advanced window must surface
+    kept descendants of newly-expiring tombstones as limbo — identical to a
+    replica whose window actually advanced (review-found: the container
+    summarizes channels with ITS min_seq, which can exceed the channel's)."""
+    import json
+
+    from fluidframework_tpu.dds.tree import ROOT_ID, SharedTree
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def op(seq, min_seq, edits):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=min_seq, type=MessageType.OP, contents={"edits": edits},
+        )
+
+    log = [
+        op(1, 0, [{"kind": "insert", "parent": "", "field": "a",
+                   "anchor": None,
+                   "content": [{"id": "A", "type": "n", "value": 1}]}]),
+        op(2, 0, [{"kind": "insert", "parent": "", "field": "a",
+                   "anchor": None,
+                   "content": [{"id": "B", "type": "n", "value": 2}]}]),
+        op(3, 0, [{"kind": "move", "ids": ["B"], "parent": "A",
+                   "field": "kids", "anchor": None,
+                   "prev": [["B", "", "a", None]]}]),
+        op(4, 0, [{"kind": "remove", "ids": ["A"]}]),
+    ]
+    idle = SharedTree("t")
+    for m in log:
+        idle.process(m, local=False)  # window never advances (min_seq 0)
+    wide = idle.summarize(min_seq=4)  # container-wide MSN exceeds channel's
+    obj = json.loads(wide.blob_bytes("header"))
+    assert [n["id"] for n in obj.get("limbo", [])] == ["B"]
+
+    advanced = SharedTree("t")
+    for m in log[:3]:
+        advanced.process(m, local=False)
+    advanced.process(
+        SequencedMessage(seq=4, client_id="c0", client_seq=4, ref_seq=3,
+                         min_seq=0, type=MessageType.OP,
+                         contents={"edits": [{"kind": "remove",
+                                              "ids": ["A"]}]}),
+        local=False,
+    )
+    advanced.advance(5, 4)  # the purge actually runs
+    assert advanced.summarize(min_seq=4).digest() == wide.digest()
