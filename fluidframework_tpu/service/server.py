@@ -148,6 +148,7 @@ class OrderingServer:
 
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
+        self._catchup = None  # lazy CatchupService (the "catchup" method)
 
     # -- tenancy scoping -------------------------------------------------------
 
@@ -259,6 +260,42 @@ class OrderingServer:
                 params.get("target"),
             )
             return True
+        if method == "catchup":
+            # The north-star maintenance op in the deployed server shape:
+            # fold the named documents' op tails (or every document of the
+            # caller's namespace) into fresh summaries centrally, routing
+            # kernel-backed channels through the device (service.catchup).
+            # (_handle runs this method on an executor thread — the fold
+            # can take seconds and must not stall the event loop.)
+            from .catchup import CatchupService
+
+            if self._catchup is None:
+                self._catchup = CatchupService(service)
+            doc_ids = params.get("docs")
+            prefix = f"{session.tenant}/" if self.tenants is not None else ""
+            if doc_ids is not None:
+                doc_ids = [f"{prefix}{d}" for d in doc_ids]
+            else:
+                doc_ids = [d for d in service.doc_ids()
+                           if d.startswith(prefix)]
+            before = (self._catchup.device_docs, self._catchup.cpu_docs)
+            results = self._catchup.catch_up(doc_ids)
+            out = {}
+            for doc_id, (handle, seq) in results.items():
+                self._grant_tree(service.storage.read(handle),
+                                 session.tenant)
+                out[doc_id[len(prefix):]] = [handle, seq]
+            return {
+                "docs": out,
+                # Explicitly-requested documents the fold could not serve
+                # (unknown id, or nothing to fold from): callers must be
+                # able to tell success from a typo.
+                "skipped": sorted(
+                    d[len(prefix):] for d in doc_ids if d not in results
+                ),
+                "deviceDocs": self._catchup.device_docs - before[0],
+                "cpuDocs": self._catchup.cpu_docs - before[1],
+            }
         if method == "latest_summary":
             tree, ref_seq = service.storage.latest(
                 params["doc"], at_or_below=params.get("at_or_below")
@@ -318,10 +355,20 @@ class OrderingServer:
                                          f"{frame.get('v')}"}
                 else:
                     try:
-                        result = self._dispatch(
-                            session, frame.get("method"),
-                            frame.get("params", {}),
-                        )
+                        method = frame.get("method")
+                        params = frame.get("params", {})
+                        if method == "catchup":
+                            # Bulk device folds take seconds; running them
+                            # inline would stall every connection (all
+                            # tenants) until the fold — or a wedged
+                            # accelerator — returns.
+                            result = await asyncio.get_running_loop() \
+                                .run_in_executor(
+                                    None, self._dispatch, session,
+                                    method, params,
+                                )
+                        else:
+                            result = self._dispatch(session, method, params)
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
                                     "ok": True, "result": result}

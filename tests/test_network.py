@@ -449,3 +449,91 @@ def test_tenancy_shared_content_and_multi_instance():
         secret_handle
     for f in (acme, beta, acme2):
         f.close()
+
+
+def test_server_catchup_folds_documents_centrally():
+    """The "catchup" server method — the north-star path in the deployed
+    shape: the service folds documents' op tails into fresh summaries
+    centrally (device-routed for kernel channels), so loading clients
+    start from a fresh summary and replay nothing."""
+    srv = OrderingServer(port=0)
+    srv.start_in_thread()
+    factory = NetworkDocumentServiceFactory(port=srv.port)
+    try:
+        loader = Loader(factory)
+
+        def build(rt):
+            ds = rt.create_datastore("ds")
+            ds.create_channel("sequence-tpu", "text")
+
+        client = loader.create("doc", "alice", build)
+        text = client.runtime.get_datastore("ds").get_channel("text")
+        text.insert_text(0, "folded centrally")
+        client.drain()
+        head = factory.resolve("doc").delta_storage.head()
+        deadline = time.time() + 10
+        while time.time() < deadline and client.runtime.ref_seq != head:
+            client.drain()
+            time.sleep(0.02)
+        want = client.runtime.summarize().digest()
+
+        result = factory._rpc.request("catchup", {"docs": ["doc", "typo"]})
+        assert "doc" in result["docs"]
+        assert result["skipped"] == ["typo"]  # unknown ids are reported
+        handle, seq = result["docs"]["doc"]
+        assert seq == srv.service.endpoint("doc").head_seq
+        assert result["deviceDocs"] + result["cpuDocs"] == 1
+
+        # the uploaded summary IS the fresh catch-up state: a new client
+        # loads it and replays nothing
+        assert srv.service.storage.latest("doc")[0].digest() == handle
+        fresh = Loader(
+            NetworkDocumentServiceFactory(port=srv.port)
+        ).resolve("doc")
+        assert fresh.catchup_ops == 0
+        assert fresh.runtime.summarize().digest() == want
+    finally:
+        factory.close()
+
+
+def test_server_catchup_respects_tenancy():
+    """Tenant-scoped catchup: each tenant folds only its own namespace and
+    gains read grants on the produced summaries."""
+    srv = OrderingServer(port=0, tenants={"acme": "s3cret", "beta": "pw"})
+    srv.start_in_thread()
+    fa = NetworkDocumentServiceFactory(
+        port=srv.port, tenant="acme", secret="s3cret"
+    )
+    loader = Loader(fa)
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("map-tpu", "kv")
+
+    fb = None
+    try:
+        client = loader.create("doc", "alice", build)
+        client.runtime.get_datastore("ds").get_channel("kv").set("k", 1)
+        client.drain()
+
+        out = fa._rpc.request("catchup", {})  # no list: whole namespace
+        assert list(out["docs"]) == ["doc"]
+        handle, _seq = out["docs"]["doc"]
+        # the producing tenant can read the new summary...
+        assert fa._rpc.request(
+            "read_summary", {"handle": handle}
+        ) is not None
+        # ...a foreign tenant cannot
+        fb = NetworkDocumentServiceFactory(
+            port=srv.port, tenant="beta", secret="pw"
+        )
+        try:
+            fb._rpc.request("read_summary", {"handle": handle})
+            raise AssertionError("foreign tenant read a granted summary")
+        except Exception as exc:
+            assert "denied" in str(exc) or "unknown" in str(exc) or \
+                "Permission" in str(exc)
+    finally:
+        fa.close()
+        if fb is not None:
+            fb.close()
