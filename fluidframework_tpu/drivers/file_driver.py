@@ -89,21 +89,25 @@ class FileSummaryStorage(SummaryStorage):
 
     def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
                message: str = "") -> str:
-        handle = super().upload(doc_id, tree, ref_seq, message=message)
-        # Persist the commit the base class actually recorded (it is the
-        # new head) — never a parallel reconstruction that could diverge.
-        commit = self.read_commit(self.head(doc_id))
-        _append_jsonl(self._commits_path, {
-            "doc": commit.doc_id, "handle": commit.tree,
-            "refSeq": commit.ref_seq, "parent": commit.parent,
-            "message": commit.message,
-        })
-        return handle
+        with self._lock:  # chain update + durable record stay one unit
+            handle = super().upload(doc_id, tree, ref_seq, message=message)
+            # Persist the commit the base class actually recorded (it is
+            # the new head) — never a parallel reconstruction that could
+            # diverge.
+            commit = self.read_commit(self.head(doc_id))
+            _append_jsonl(self._commits_path, {
+                "doc": commit.doc_id, "handle": commit.tree,
+                "refSeq": commit.ref_seq, "parent": commit.parent,
+                "message": commit.message,
+            })
+            return handle
 
     def create_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
-        super().create_ref(doc_id, name, commit_digest)
-        _append_jsonl(self._refs_path,
-                      {"doc": doc_id, "ref": name, "commit": commit_digest})
+        with self._lock:
+            super().create_ref(doc_id, name, commit_digest)
+            _append_jsonl(self._refs_path,
+                          {"doc": doc_id, "ref": name,
+                           "commit": commit_digest})
 
     def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
         digest = super()._store(node)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Union, Optional
 
@@ -151,16 +152,24 @@ class SummaryStorage:
         self._refs: Dict[str, Dict[str, str]] = {}  # doc -> ref -> commit
         # (doc, tree, ref_seq) -> newest commit digest; O(1) ack stamping.
         self._commit_index: Dict[tuple, str] = {}
+        # Serializes the head read-modify-write of the commit chain: the
+        # server runs bulk catch-up uploads on an executor thread while
+        # client uploads ride the event loop — unsynchronized, whichever
+        # commit landed second would orphan the other off the chain.
+        # Re-entrant so subclass overrides can hold it across their whole
+        # persistence step.
+        self._lock = threading.RLock()
 
     def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
                message: str = "") -> str:
-        handle = self._store(tree)
-        commit = SummaryCommit(
-            doc_id=doc_id, tree=handle,
-            parent=self.head(doc_id), ref_seq=ref_seq, message=message,
-        )
-        self._record_commit(commit)
-        return handle
+        with self._lock:
+            handle = self._store(tree)
+            commit = SummaryCommit(
+                doc_id=doc_id, tree=handle,
+                parent=self.head(doc_id), ref_seq=ref_seq, message=message,
+            )
+            self._record_commit(commit)
+            return handle
 
     # -- commit/ref history chain ----------------------------------------------
 
@@ -189,17 +198,18 @@ class SummaryStorage:
         """Pin a named ref (tag/branch) at an existing commit.  ``main`` is
         derived from the upload chain and cannot be repointed — that keeps
         the persisted chain the single source of truth for the head."""
-        if name == self.DEFAULT_REF:
-            raise ValueError(f"{name!r} is maintained by upload()")
-        if commit_digest not in self._commit_objects:
-            raise KeyError(commit_digest)
-        if self._commit_objects[commit_digest].doc_id != doc_id:
-            raise ValueError(
-                f"commit {commit_digest} belongs to document "
-                f"{self._commit_objects[commit_digest].doc_id!r}, "
-                f"not {doc_id!r}"
-            )
-        self._set_ref(doc_id, name, commit_digest)
+        with self._lock:
+            if name == self.DEFAULT_REF:
+                raise ValueError(f"{name!r} is maintained by upload()")
+            if commit_digest not in self._commit_objects:
+                raise KeyError(commit_digest)
+            if self._commit_objects[commit_digest].doc_id != doc_id:
+                raise ValueError(
+                    f"commit {commit_digest} belongs to document "
+                    f"{self._commit_objects[commit_digest].doc_id!r}, "
+                    f"not {doc_id!r}"
+                )
+            self._set_ref(doc_id, name, commit_digest)
 
     def _walk(self, digest: Optional[str]):
         """Generator over the parent chain from ``digest``, newest first;

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..ops.batching import partition_replay
@@ -32,6 +33,14 @@ from ..runtime.container import ContainerRuntime
 from ..runtime.op_pipeline import decode_stream
 from ..runtime.registry import ChannelRegistry, default_registry
 from .orderer import LocalOrderingService
+
+def jax_profiler_trace(log_dir: str):
+    """``jax.profiler.trace`` context for one bulk fold (xprof); import is
+    deferred so the profiler never loads on the plain CPU path."""
+    import jax.profiler
+
+    return jax.profiler.trace(log_dir)
+
 
 STRING_TYPE = "sequence-tpu"
 MAP_TYPE = "map-tpu"
@@ -112,7 +121,14 @@ def flatten_channel_ops(
 
 
 class CatchupService:
-    """Scriptorium-fed bulk summarizer over (storage, oplog)."""
+    """Scriptorium-fed bulk summarizer over (storage, oplog).
+
+    ``catch_up`` calls are serialized process-wide (``_serial``): bulk
+    maintenance gains nothing from overlap, the device/cpu counters stay
+    consistent per call, and the optional JAX profiler trace (which allows
+    one active trace per process) can never nest."""
+
+    _serial = threading.RLock()
 
     def __init__(
         self,
@@ -137,20 +153,33 @@ class CatchupService:
         upload: bool = True,
     ) -> Dict[str, Tuple[str, int]]:
         """Fold each document's tail; returns {doc_id: (handle, seq)}.
-        Documents with no new ops keep their current summary handle."""
+        Documents with no new ops keep their current summary handle.
+
+        With the ``Catchup.ProfileDir`` config gate set (or
+        ``FLUID_TPU_CATCHUP_PROFILEDIR``), each bulk fold is wrapped in a
+        JAX profiler trace written there — the per-replay-batch xprof hook
+        of the telemetry design (SURVEY.md §5 tracing)."""
+        import contextlib
+
         from ..utils.telemetry import PerformanceEvent
 
-        device_before, cpu_before = self.device_docs, self.cpu_docs
-        host_before = self.host_channels
-        with PerformanceEvent.timed_exec(
-                self.mc.logger, "bulkCatchup") as perf:
-            results = self._catch_up(doc_ids, upload)
-            perf["extra"].update(
-                deviceDocs=self.device_docs - device_before,
-                cpuDocs=self.cpu_docs - cpu_before,
-                hostChannels=self.host_channels - host_before,
-                docs=len(results))
-        return results
+        profile_dir = self.mc.config.raw("Catchup.ProfileDir")
+        with CatchupService._serial:
+            tracer = (
+                jax_profiler_trace(str(profile_dir))
+                if profile_dir else contextlib.nullcontext()
+            )
+            device_before, cpu_before = self.device_docs, self.cpu_docs
+            host_before = self.host_channels
+            with tracer, PerformanceEvent.timed_exec(
+                    self.mc.logger, "bulkCatchup") as perf:
+                results = self._catch_up(doc_ids, upload)
+                perf["extra"].update(
+                    deviceDocs=self.device_docs - device_before,
+                    cpuDocs=self.cpu_docs - cpu_before,
+                    hostChannels=self.host_channels - host_before,
+                    docs=len(results))
+            return results
 
     def _catch_up(
         self,

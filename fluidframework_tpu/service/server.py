@@ -149,6 +149,7 @@ class OrderingServer:
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._catchup = None  # lazy CatchupService (the "catchup" method)
+        self._catchup_init = threading.Lock()  # executor threads race init
 
     # -- tenancy scoping -------------------------------------------------------
 
@@ -269,8 +270,9 @@ class OrderingServer:
             # can take seconds and must not stall the event loop.)
             from .catchup import CatchupService
 
-            if self._catchup is None:
-                self._catchup = CatchupService(service)
+            with self._catchup_init:
+                if self._catchup is None:
+                    self._catchup = CatchupService(service)
             doc_ids = params.get("docs")
             prefix = f"{session.tenant}/" if self.tenants is not None else ""
             if doc_ids is not None:
@@ -278,8 +280,15 @@ class OrderingServer:
             else:
                 doc_ids = [d for d in service.doc_ids()
                            if d.startswith(prefix)]
-            before = (self._catchup.device_docs, self._catchup.cpu_docs)
-            results = self._catchup.catch_up(doc_ids)
+            # Hold the catch-up serialization lock across the counter
+            # snapshot + fold, or a concurrent RPC's documents would leak
+            # into this response's deviceDocs/cpuDocs (the lock is
+            # re-entrant; catch_up acquires it again inside).
+            with CatchupService._serial:
+                before = (self._catchup.device_docs, self._catchup.cpu_docs)
+                results = self._catchup.catch_up(doc_ids)
+                counters = (self._catchup.device_docs - before[0],
+                            self._catchup.cpu_docs - before[1])
             out = {}
             for doc_id, (handle, seq) in results.items():
                 self._grant_tree(service.storage.read(handle),
@@ -293,8 +302,8 @@ class OrderingServer:
                 "skipped": sorted(
                     d[len(prefix):] for d in doc_ids if d not in results
                 ),
-                "deviceDocs": self._catchup.device_docs - before[0],
-                "cpuDocs": self._catchup.cpu_docs - before[1],
+                "deviceDocs": counters[0],
+                "cpuDocs": counters[1],
             }
         if method == "latest_summary":
             tree, ref_seq = service.storage.latest(

@@ -84,3 +84,52 @@ def test_monitoring_context_threads_through_loader():
     end = [e for e in sink.events
            if e["eventName"] == "catchup:bulkCatchup_end"][-1]
     assert end["docs"] == 1
+
+
+def test_catchup_profile_gate_writes_xprof_trace(tmp_path):
+    """The Catchup.ProfileDir config gate wraps each bulk fold in a JAX
+    profiler trace (the per-replay-batch xprof hook of the telemetry
+    design); without the gate, no profiler is ever loaded."""
+    import os
+
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.service.catchup import CatchupService
+    from fluidframework_tpu.utils.telemetry import (
+        ConfigProvider,
+        MonitoringContext,
+    )
+
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    rt = ContainerRuntime()
+    ds = rt.create_datastore("ds")
+    text = ds.create_channel("sequence-tpu", "t")
+    rt.connect(ep, "a")
+    rt.drain()
+    service.storage.upload("doc", rt.summarize(), rt.ref_seq)
+    text.insert_text(0, "profile me")
+    rt.drain()
+
+    prof_dir = str(tmp_path / "xprof")
+    mc = MonitoringContext(
+        config=ConfigProvider({"Catchup.ProfileDir": prof_dir})
+    )
+    svc = CatchupService(service, mc=mc)
+    out = svc.catch_up(["doc"])
+    assert "doc" in out
+    found = [
+        f for _dir, _dirs, files in os.walk(prof_dir) for f in files
+    ]
+    assert any(f.endswith(".xplane.pb") for f in found), found
+
+    # ungated: still folds, and the trace directory stays untouched
+    before = sorted(
+        f for _d, _ds, files in os.walk(prof_dir) for f in files
+    )
+    svc2 = CatchupService(service)
+    assert svc2.catch_up(["doc"]) is not None
+    after = sorted(
+        f for _d, _ds, files in os.walk(prof_dir) for f in files
+    )
+    assert after == before
