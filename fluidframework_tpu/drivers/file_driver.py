@@ -19,6 +19,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import threading
 from typing import Optional, Union
 
 from ..protocol.summary import (
@@ -113,8 +114,13 @@ class FileSummaryStorage(SummaryStorage):
         digest = super()._store(node)
         path = os.path.join(self._objects_dir, digest)
         if not os.path.exists(path):  # content-addressed: write-once
-            with open(path, "wb") as f:
+            # Atomic publish: executor-thread uploads run concurrently
+            # with event-loop reads of the same content-addressed object —
+            # a reader must never observe a partially-written file.
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
                 f.write(_serialize_node(node))
+            os.replace(tmp, path)
         return digest
 
     # -- lazy reads from disk (latest() inherits these via read()) -------------

@@ -101,9 +101,18 @@ def pack_map_batch(docs: Sequence[MapDocInput],
                 raise ValueError(f"unknown map op kind {kind!r}")
 
     floor = max(64, bucket_floor)
-    n = next_bucket(max(len(op_seq), 1), floor=floor)
-    m = next_bucket(max(len(clear_seq), 1), floor=floor)
-    g = next_bucket(max(len(keys), 1), floor=floor)
+
+    def bucket(count: int) -> int:
+        size = next_bucket(max(count, 1), floor=floor)
+        if bucket_floor > 1 and size % bucket_floor:
+            # Non-power-of-two mesh sizes (e.g. 5 devices) don't divide the
+            # pow2 ladder — round up so the flat axis always shards evenly.
+            size += bucket_floor - size % bucket_floor
+        return size
+
+    n = bucket(len(op_seq))
+    m = bucket(len(clear_seq))
+    g = bucket(len(keys))
 
     def pad(lst, size, fill):
         arr = np.full(size, fill, dtype=np.int32)

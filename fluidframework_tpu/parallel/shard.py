@@ -303,9 +303,15 @@ def replay_matrix_sharded(
     )
 
     def fold_batch(batch):
+        import math
+
         n_real = len(batch)
+        # Matrices pack TWO axis rows each: pad the doc count so 2·D is
+        # divisible by the mesh size for ANY size (odd meshes need D to be
+        # a multiple of the size itself).
+        doc_mult = mesh.size // math.gcd(mesh.size, 2)
         padded = _pad_docs(
-            batch, max(1, mesh.size // 2),
+            batch, max(1, doc_mult),
             lambda: MatrixDocInput(doc_id="\x00pad", ops=[]),
         )
         state, ops, meta = pack_matrix_batch(padded)

@@ -151,9 +151,14 @@ class CatchupService:
         self,
         doc_ids: Optional[Sequence[str]] = None,
         upload: bool = True,
+        stats: Optional[dict] = None,
     ) -> Dict[str, Tuple[str, int]]:
         """Fold each document's tail; returns {doc_id: (handle, seq)}.
         Documents with no new ops keep their current summary handle.
+        ``stats`` (optional dict) receives this call's own
+        ``deviceDocs``/``cpuDocs``/``hostChannels`` deltas, computed under
+        the serialization lock so concurrent callers' documents never leak
+        into each other's numbers.
 
         With the ``Catchup.ProfileDir`` config gate set (or
         ``FLUID_TPU_CATCHUP_PROFILEDIR``), each bulk fold is wrapped in a
@@ -174,11 +179,14 @@ class CatchupService:
             with tracer, PerformanceEvent.timed_exec(
                     self.mc.logger, "bulkCatchup") as perf:
                 results = self._catch_up(doc_ids, upload)
-                perf["extra"].update(
+                deltas = dict(
                     deviceDocs=self.device_docs - device_before,
                     cpuDocs=self.cpu_docs - cpu_before,
                     hostChannels=self.host_channels - host_before,
-                    docs=len(results))
+                )
+                perf["extra"].update(docs=len(results), **deltas)
+            if stats is not None:
+                stats.update(deltas)
             return results
 
     def _catch_up(

@@ -41,6 +41,12 @@ from ..protocol.wire import LEN as _LEN, MAX_FRAME, WIRE_VERSION, frame_bytes
 from .orderer import LocalOrderingService
 
 
+#: methods _handle runs on an executor thread instead of the event loop:
+#: bulk device folds and storage mutations that hold the commit-chain lock
+#: across (possibly file-backed) writes.
+OFFLOADED_METHODS = frozenset({"catchup", "upload_summary"})
+
+
 async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     try:
         header = await reader.readexactly(_LEN.size)
@@ -280,15 +286,8 @@ class OrderingServer:
             else:
                 doc_ids = [d for d in service.doc_ids()
                            if d.startswith(prefix)]
-            # Hold the catch-up serialization lock across the counter
-            # snapshot + fold, or a concurrent RPC's documents would leak
-            # into this response's deviceDocs/cpuDocs (the lock is
-            # re-entrant; catch_up acquires it again inside).
-            with CatchupService._serial:
-                before = (self._catchup.device_docs, self._catchup.cpu_docs)
-                results = self._catchup.catch_up(doc_ids)
-                counters = (self._catchup.device_docs - before[0],
-                            self._catchup.cpu_docs - before[1])
+            stats: dict = {}
+            results = self._catchup.catch_up(doc_ids, stats=stats)
             out = {}
             for doc_id, (handle, seq) in results.items():
                 self._grant_tree(service.storage.read(handle),
@@ -302,8 +301,8 @@ class OrderingServer:
                 "skipped": sorted(
                     d[len(prefix):] for d in doc_ids if d not in results
                 ),
-                "deviceDocs": counters[0],
-                "cpuDocs": counters[1],
+                "deviceDocs": stats.get("deviceDocs", 0),
+                "cpuDocs": stats.get("cpuDocs", 0),
             }
         if method == "latest_summary":
             tree, ref_seq = service.storage.latest(
@@ -366,11 +365,13 @@ class OrderingServer:
                     try:
                         method = frame.get("method")
                         params = frame.get("params", {})
-                        if method == "catchup":
-                            # Bulk device folds take seconds; running them
-                            # inline would stall every connection (all
-                            # tenants) until the fold — or a wedged
-                            # accelerator — returns.
+                        if method in OFFLOADED_METHODS:
+                            # Device folds take seconds and storage
+                            # mutations hold the commit-chain lock across
+                            # disk writes; running either inline would
+                            # stall every connection (all tenants) until
+                            # the work — or a wedged accelerator —
+                            # returns.
                             result = await asyncio.get_running_loop() \
                                 .run_in_executor(
                                     None, self._dispatch, session,
