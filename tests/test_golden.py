@@ -20,6 +20,7 @@ from fluidframework_tpu.protocol.summary import (
     tree_from_obj,
     tree_to_obj,
 )
+from fluidframework_tpu.dds.tree import SharedTree
 from fluidframework_tpu.runtime.container import ContainerRuntime
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
@@ -106,3 +107,40 @@ def test_newer_summary_wire_version_is_refused(golden):
     obj["v"] = SUMMARY_WIRE_VERSION + 1
     with pytest.raises(ValueError, match="newer than supported"):
         tree_from_obj(obj)
+
+
+# --- tree limbo format golden (round 3) --------------------------------------
+
+LIMBO_GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                            "tree_limbo_v1.json")
+
+
+@pytest.fixture(scope="module")
+def limbo_golden():
+    with open(LIMBO_GOLDEN) as f:
+        return json.load(f)
+
+
+def test_limbo_golden_reloads_byte_identically(limbo_golden):
+    """The committed limbo-carrying tree summary (a node whose enclosing
+    tombstone expired, still rescuable by id) must load and re-summarize
+    to the same bytes forever."""
+    tree = tree_from_obj(limbo_golden["summary"])
+    assert tree.digest() == limbo_golden["summary_digest"], (
+        "committed limbo summary bytes no longer reproduce their digest"
+    )
+    replica = SharedTree("t")
+    replica.load(tree)
+    assert replica._last_seq == limbo_golden["summary_seq"]
+    assert replica.summarize().digest() == limbo_golden["summary_digest"]
+
+
+def test_limbo_golden_tail_rescue_reaches_committed_digest(limbo_golden):
+    """Replaying the committed tail (the rescue move) on the reloaded
+    summary reaches the committed final digest — limbo nodes stay
+    addressable across summarize/reload."""
+    replica = SharedTree("t")
+    replica.load(tree_from_obj(limbo_golden["summary"]))
+    for msg_dict in limbo_golden["tail"]:
+        replica.process(SequencedMessage.from_dict(msg_dict), local=False)
+    assert replica.summarize().digest() == limbo_golden["final_digest"]
