@@ -226,7 +226,7 @@ class SharedMatrix(SharedObject):
         if count <= 0:
             return
         client = self._local_client()
-        group = SegmentGroup("insert")
+        group = SegmentGroup("insert", client=client)
         vec.tree.apply_insert(
             pos, vec.alloc(count), UNASSIGNED_SEQ, client,
             vec.tree.current_seq, group=group,
@@ -242,7 +242,7 @@ class SharedMatrix(SharedObject):
         if count <= 0:
             return
         client = self._local_client()
-        group = SegmentGroup("remove")
+        group = SegmentGroup("remove", client=client)
         vec.tree.apply_remove(
             start, start + count, UNASSIGNED_SEQ, client,
             vec.tree.current_seq, group=group,

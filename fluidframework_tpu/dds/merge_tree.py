@@ -613,6 +613,7 @@ class MergeTreeOracle:
         the now-known seq — the author's state converges with every remote
         replica's apply_obliterate."""
         self.pending_obliterates.discard(group)
+        mark_id = group.client if group.client is not None else client
         # Pristine pass-2 snapshot BEFORE the group pass promotes demoted
         # removers: promotion makes those segments read involved-invisible
         # and would collapse the zero-width position walk (same hazard the
@@ -623,10 +624,11 @@ class MergeTreeOracle:
         ]
         for seg in group.segments:
             if seg.removed_seq == UNASSIGNED_SEQ and \
-                    seg.removed_client == client:
+                    seg.removed_client == mark_id:
                 seg.removed_seq = seq
-            elif client in seg.pending_overlap:
-                seg.pending_overlap.discard(client)
+                seg.removed_client = client
+            elif mark_id in seg.pending_overlap:
+                seg.pending_overlap.discard(mark_id)
                 # A segment that joined the group via the arrival
                 # prediction and then lost to an earlier-sequenced remove
                 # is a ZERO-WIDTH slot to every remote (they stamp it,
@@ -678,6 +680,11 @@ class MergeTreeOracle:
         for seg in group.segments:
             if seg.insert_seq == UNASSIGNED_SEQ:
                 seg.insert_seq = seq
+                if client is not NO_CLIENT:
+                    # Attribution follows the WIRE copy: after a rehydrate
+                    # the sequenced copy carries the crashed session's
+                    # client id, and every remote recorded that id.
+                    seg.insert_client = client
                 # Obliterate-on-arrival, author side: remote replicas kill
                 # this insert via the neighbor rule the moment it arrives;
                 # the author's replica must reach the same verdict at ack.
@@ -695,12 +702,18 @@ class MergeTreeOracle:
             seg.pending_groups.remove(group)
 
     def ack_remove(self, group: SegmentGroup, seq: int, client: str) -> None:
+        # Pending marks carry the SUBMIT-time identity (group.client);
+        # the wire ack's client is the attribution every remote recorded —
+        # they differ after a rehydrate adoption.
+        mark_id = group.client if group.client is not None else client
         for seg in group.segments:
-            if seg.removed_seq == UNASSIGNED_SEQ and seg.removed_client == client:
+            if seg.removed_seq == UNASSIGNED_SEQ and \
+                    seg.removed_client == mark_id:
                 seg.removed_seq = seq
-            elif client in seg.pending_overlap:
+                seg.removed_client = client
+            elif mark_id in seg.pending_overlap:
                 # Our demoted remove is now sequenced: summary-visible.
-                seg.pending_overlap.discard(client)
+                seg.pending_overlap.discard(mark_id)
                 seg.overlap_removers.add(client)
             self._slide_refs(seg)
             seg.pending_groups.remove(group)

@@ -58,7 +58,7 @@ class SharedString(SharedObject):
         if not text:
             return
         client = self._local_client()
-        group = SegmentGroup("insert")
+        group = SegmentGroup("insert", client=client)
         self.tree.apply_insert(
             pos, text, UNASSIGNED_SEQ, client, self.tree.current_seq,
             props=props, group=group,
@@ -79,7 +79,7 @@ class SharedString(SharedObject):
             return
         client = self._local_client()
         removed = self.text[start:end]
-        group = SegmentGroup("remove")
+        group = SegmentGroup("remove", client=client)
         self.tree.apply_remove(
             start, end, UNASSIGNED_SEQ, client, self.tree.current_seq, group=group
         )
@@ -118,7 +118,7 @@ class SharedString(SharedObject):
         if start >= end or not props:
             return
         client = self._local_client()
-        group = SegmentGroup("annotate", props=props)
+        group = SegmentGroup("annotate", props=props, client=client)
         self.tree.apply_annotate(
             start, end, props, UNASSIGNED_SEQ, client, self.tree.current_seq,
             group=group,

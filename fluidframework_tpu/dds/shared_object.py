@@ -106,7 +106,7 @@ class SharedObject:
     #: regeneration (SharedString) or inherit the StaleOpError.
     REBASE_POSITION_FREE = False
 
-    def resubmit_pending(self) -> None:
+    def resubmit_pending(self, force_rebase: bool = False) -> None:
         """Reconnect path: re-send all unacked ops (same contents, fresh
         client_seqs).  Capability parity with PendingStateManager resubmit.
 
@@ -114,16 +114,24 @@ class SharedObject:
         were away, its original ``ref_seq`` can no longer be sent (remote
         zamboni may have compacted the state that view needs): the whole
         batch is rebased instead — regenerated against the current view
-        (the reference's merge-tree op regeneration on reconnect)."""
+        (the reference's merge-tree op regeneration on reconnect).
+
+        ``force_rebase`` is the REHYDRATE path: the session resubmits under
+        a NEW client id, so views pinned to the crashed session's refs are
+        id-bound lies (the old id's own sequenced ops would count there,
+        the new id's would not — fuzz-found divergence).  Rebasable
+        channels regenerate against the current view; others re-pin to the
+        current view (their documented reinterpretation semantics)."""
         if self._delta_connection is None:
             return
         pending = list(self._pending)
         self._pending.clear()
         min_seq = getattr(self._delta_connection, "min_seq", None)
-        if min_seq is not None and any(
+        stale = min_seq is not None and any(
             ref_seq is not None and ref_seq < min_seq
             for _cs, _c, _m, ref_seq in pending
-        ):
+        )
+        if stale or (force_rebase and self.can_rebase):
             try:
                 self._resubmit_rebased(pending)
             except StaleOpError:
@@ -134,7 +142,9 @@ class SharedObject:
                 raise
             return
         for _old_client_seq, contents, metadata, ref_seq in pending:
-            self._resubmit_core(contents, metadata, ref_seq)
+            self._resubmit_core(
+                contents, metadata, None if force_rebase else ref_seq
+            )
 
     @property
     def can_rebase(self) -> bool:

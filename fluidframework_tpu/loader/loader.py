@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..protocol.messages import MessageType, SequencedMessage
 from ..runtime.container import ContainerRuntime
+from ..runtime.op_pipeline import decode_stream as _decode_stream
 from ..runtime.registry import ChannelRegistry
 from ..utils.telemetry import MonitoringContext, PerformanceEvent
 from .delta_manager import ConnectionState, DeltaManager
@@ -126,12 +127,12 @@ class Container:
         idRanges roll back into the compressor for re-attachment."""
         self.runtime.discard_outbound()
 
-    def resubmit_pending(self) -> None:
+    def resubmit_pending(self, force_rebase: bool = False) -> None:
         """Re-issue every unacked op.  Meta-ops (ds/channel/blob attaches)
         first: their channels' ops must land on materialized targets."""
         self.runtime.resubmit_pending_runtime_ops()
         for ds in self.runtime.datastores.values():
-            ds.resubmit_pending()
+            ds.resubmit_pending(force_rebase=force_rebase)
 
     def close(self) -> None:
         self.delta_manager.close()
@@ -140,13 +141,30 @@ class Container:
     # -- pending local state (stashed ops) -------------------------------------
 
     def get_pending_ops(self) -> List[dict]:
-        """Unacked local channel ops in submission order."""
+        """Unacked local channel ops in submission order.  Each op records
+        the ``refSeq`` it was authored against: rehydrate re-applies it at
+        exactly that point of the tail replay, because remote ops sequenced
+        between authoring and the stash (e.g. removes shrinking a string)
+        make stash-point positions unresolvable (load-harness-found)."""
+        own_ids = sorted(
+            self.runtime._client_ids - self.runtime._adopted_ids
+        )
         pending = []
         for ds_id, ds in self.runtime.datastores.items():
             for channel_id, channel in ds.channels.items():
-                for client_seq, contents, _meta, _ref in channel._pending:
+                for client_seq, contents, _meta, ref in channel._pending:
                     pending.append({
                         "clientSeq": client_seq,
+                        "refSeq": ref,
+                        # Every wire identity this op may sequence under:
+                        # this session's own connection ids.  (Adopted
+                        # prior-generation identities need no aliases
+                        # here: transports submit synchronously, so a
+                        # prior generation's copy either sequenced before
+                        # our own rehydrate drained — acked then — or
+                        # never will.  An async transport would need
+                        # resubmit-time alias threading.)
+                        "aliases": [[cid, client_seq] for cid in own_ids],
                         "ds": ds_id,
                         "channel": channel_id,
                         "contents": contents,
@@ -243,28 +261,76 @@ class Loader:
         service = self.factory.resolve(doc_id)
         runtime = self._new_runtime()
 
-        # Rehydrating: the summary must not be newer than the stash point,
-        # or stashed position-carrying ops would re-apply against a state
-        # they were never created on.
+        # Rehydrating: the summary must not be newer than ANY replayed op's
+        # authoring view — each op re-applies at exactly its own refSeq
+        # during the tail replay (remote ops sequenced between authoring
+        # and the stash can shrink/shift position-carrying contents).  The
+        # replay set is the stash's pending ops PLUS the crashed session's
+        # own ops sequenced above the load point (their optimistic text
+        # was part of later ops' views), so the load point is a fixpoint:
+        # lowering it can expose own sequenced ops with still-earlier
+        # authoring refs.
         stash_ref = pending_state["refSeq"] if pending_state else None
-        summary, summary_seq = service.storage.latest(at_or_below=stash_ref)
-        if summary is None:
-            raise KeyError(f"document {doc_id!r} has no summary (never "
-                           f"attached)")
+        load_ref = stash_ref
+        if pending_state is not None:
+            refs = [p["refSeq"] for p in pending_state["pending"]
+                    if p.get("refSeq") is not None]
+            if refs:
+                load_ref = min([stash_ref] + refs)
+        old_ids = set(pending_state.get("clientIds", [])) \
+            if pending_state else set()
+        summary = None
+        converged = False
+        for _ in range(64):  # strictly-decreasing load_ref terminates
+            summary, summary_seq = service.storage.latest(
+                at_or_below=load_ref
+            )
+            if summary is None:
+                raise KeyError(f"document {doc_id!r} has no summary "
+                               f"(never attached)")
+            tail = service.delta_storage.get(from_seq=summary_seq)
+            if load_ref is None or not old_ids:
+                converged = True
+                break
+            while True:
+                own_refs = [
+                    sub.get("refSeq", msg.ref_seq)
+                    for msg, batch in _decode_stream(
+                        m for m in tail
+                        if m.client_id in old_ids
+                        and load_ref < m.seq <= stash_ref
+                        and m.type is MessageType.OP)
+                    for sub in batch["ops"] if "runtime" not in sub
+                ]
+                lower = min(own_refs, default=load_ref)
+                if lower >= load_ref:
+                    converged = True
+                    break
+                load_ref = lower
+                if load_ref < summary_seq:
+                    break  # need an older summary: refetch
+            if converged:
+                break
+        if not converged:
+            raise RuntimeError(
+                f"{doc_id}: rehydrate load point did not converge "
+                f"(load_ref {load_ref}); stash too deep to replay exactly"
+            )
         runtime.load(summary)
 
         container = Container(doc_id, runtime, DeltaManager(service))
 
-        # Catch-up replay: one fetch of the whole tail, split at the stash
-        # point.  THE hot loop the TPU catch-up service obsoletes when it
-        # keeps summaries fresh.
-        tail = service.delta_storage.get(from_seq=summary_seq)
-        pre_stash = [m for m in tail
-                     if stash_ref is None or m.seq <= stash_ref]
-        post_stash = tail[len(pre_stash):]
-        for msg in pre_stash:
+        # Catch-up replay: one fetch of the whole tail, split at the
+        # earliest replayed authoring point and at the stash point.  THE
+        # hot loop the TPU catch-up service obsoletes when it keeps
+        # summaries fresh.
+        pre = [m for m in tail if load_ref is None or m.seq <= load_ref]
+        mid = [m for m in tail
+               if load_ref is not None and load_ref < m.seq <= stash_ref]
+        post_stash = tail[len(pre) + len(mid):]
+        for msg in pre:
             runtime.process(msg)
-        container.catchup_ops = len(pre_stash)
+        container.catchup_ops = len(pre) + len(mid)
         container.delta_manager.note_delivered(runtime.ref_seq)
 
         if pending_state is not None and pending_state["pending"]:
@@ -281,12 +347,19 @@ class Loader:
                 sequenced = self._already_sequenced(pending_state,
                                                     post_stash)
                 old_ids = pending_state.get("clientIds", [])
+
+                def _cannot_rebase(p) -> bool:
+                    ds = runtime.datastores.get(p["ds"])
+                    ch = ds.channels.get(p["channel"]) if ds else None
+                    # A channel attaching in the mid tail isn't
+                    # materialized yet; its ops replay normally.
+                    return ch is not None and not ch.can_rebase
+
                 cannot = sorted({
                     p["channel"] for p in pending_state["pending"]
                     if not any((cid, p["clientSeq"]) in sequenced
                                for cid in old_ids)
-                    and not runtime.datastores[p["ds"]]
-                    .channels[p["channel"]].can_rebase
+                    and _cannot_rebase(p)
                 }) if stale_pending == "rebase" else []
                 if stale_pending == "drop":
                     pending_state = None
@@ -306,26 +379,39 @@ class Loader:
 
         if client_id is not None:
             # Connect first (channels need a live submit path), then re-apply
-            # stashed ops while the runtime is still positioned at the stash
-            # point — the remote tail beyond it is queued but undrained, so
-            # position-carrying contents resolve against the original view.
+            # stashed ops INTERLEAVED with the tail between their authoring
+            # points — each op resolves against exactly the view it was
+            # created on (earlier stashed ops re-applied on top as pending).
             container.runtime.connect(container.delta_manager, client_id)
             if pending_state is not None:
                 # Hold the auto-flush so the stashed re-submissions buffer in
                 # the outbox instead of hitting the wire: they are pinned to
-                # the stash-point view, which may lie below the live
-                # collaboration window.  Discard the buffered batch, catch up
-                # to head, and resubmit pending — ops go out pinned to an
-                # in-window view, regenerated (rebased) where the original
-                # view is stale.
+                # views that may lie below the live collaboration window.
+                # Discard the buffered batch, adopt the crashed session's
+                # client ids (ops of ours that DID sequence arrive in the
+                # post-stash tail as OUR acks — nacks are synchronous at
+                # submit, so the sequenced subset is always a clientSeq
+                # prefix and the ack FIFOs stay ordered), catch up to head,
+                # and resubmit what remains pending — ops go out pinned to
+                # an in-window view, regenerated (rebased) where the
+                # original view is stale.
+                aliases: dict = {}
+                runtime.adopt_stashed_session(
+                    pending_state.get("clientIds", []), aliases
+                )
                 runtime._batching += 1
                 try:
-                    self._apply_stashed(runtime, pending_state, post_stash)
+                    self._apply_stashed(runtime, pending_state, mid,
+                                        post_stash, stash_ref, aliases)
                 finally:
                     runtime._batching -= 1
+                container.delta_manager.note_delivered(runtime.ref_seq)
                 container.discard_outbound()
                 container.drain()
-                container.resubmit_pending()
+                # This session's id differs from the crashed one's, so
+                # old-view-pinned resubmission would lie about own-op
+                # visibility: always regenerate against the current view.
+                container.resubmit_pending(force_rebase=True)
             container.drain()
             container.runtime.flush()
         return container
@@ -353,25 +439,110 @@ class Loader:
         return sequenced
 
     def _apply_stashed(self, runtime: ContainerRuntime, pending_state: dict,
-                       post_stash_tail: List[SequencedMessage]) -> None:
-        """Re-apply stashed pending ops as fresh local mutations (optimistic
-        apply + submit) on exactly the state they were created against.
+                       mid_tail: List[SequencedMessage],
+                       post_stash_tail: List[SequencedMessage],
+                       stash_ref: int, aliases: Dict[tuple, int]) -> None:
+        """Re-apply the crashed session's ops as fresh local mutations
+        (optimistic apply + submit) on exactly the state each was created
+        against (the reference's PendingStateManager).
 
-        An op the old session submitted may already have been *sequenced* —
-        those arrive in the post-stash tail as ordinary remote ops (the new
-        client id makes them non-local), so re-applying their stashed
-        copies would double-apply: drop them (the reference's
-        PendingStateManager dedup)."""
+        The replay set is the stash's pending ops MERGED with the old
+        session's own ops already sequenced in the mid tail — the latter
+        were still pending when later ops were authored, so their
+        optimistic text is part of those ops' views.  The tail between the
+        load point and the stash point is applied incrementally, pausing
+        at each op's authoring ``refSeq``; sequenced own copies arriving
+        in the drain ack the re-applied ops through the (caller-adopted,
+        incrementally filled) ``aliases`` map."""
+        from ..runtime.op_pipeline import decode_stream
+
         old_ids = set(pending_state.get("clientIds", []))
-        already_sequenced = self._already_sequenced(
-            pending_state, post_stash_tail
-        )
-        for p in pending_state["pending"]:
-            if any((cid, p["clientSeq"]) in already_sequenced
-                   for cid in old_ids):
-                continue  # it made it to the log; the tail will apply it
-            ds = runtime.datastores[p["ds"]]
-            ds.channels[p["channel"]].apply_stashed_op(p["contents"])
+        if any(p.get("refSeq") is None for p in pending_state["pending"]):
+            # Legacy stash (no per-op authoring points): previous
+            # semantics — drop ops the tail will deliver, re-apply the
+            # rest at the stash point.  (No aliases: adopted copies apply
+            # as remote, exactly as before.)
+            sequenced = self._already_sequenced(pending_state,
+                                                post_stash_tail)
+            for msg in mid_tail:
+                runtime.process(msg)
+            for p in pending_state["pending"]:
+                if any((cid, p["clientSeq"]) in sequenced
+                       for cid in old_ids):
+                    continue
+                ds = runtime.datastores[p["ds"]]
+                ds.channels[p["channel"]].apply_stashed_op(p["contents"])
+            return
+
+        def chan(p):
+            ds = runtime.datastores.get(p["ds"])
+            return ds.channels.get(p["channel"]) if ds is not None else None
+
+        def replay_ref(p):
+            # Channels that cannot rebase (e.g. the matrix) keep the
+            # documented stash-point reinterpretation — re-applying at the
+            # fresh stash view is their recovery semantics, and it keeps
+            # their resubmission off the rebase path.  A channel whose
+            # attach op still rides the mid tail doesn't exist yet —
+            # treat it as rebasable (the apply step waits for the attach).
+            c = chan(p)
+            return p["refSeq"] if c is None or c.can_rebase else stash_ref
+
+        own_mid: List[dict] = []
+        for msg, batch in decode_stream(
+            m for m in mid_tail
+            if m.client_id in old_ids and m.type is MessageType.OP
+        ):
+            for sub in batch["ops"]:
+                if "runtime" in sub:
+                    continue
+                entry = {
+                    "clientSeq": sub["clientSeq"],
+                    "refSeq": sub.get("refSeq", msg.ref_seq),
+                    "ds": sub["ds"], "channel": sub["channel"],
+                    "contents": sub["contents"],
+                    "aliases": [[msg.client_id, sub["clientSeq"]]],
+                }
+                # A non-rebasable channel's own sequenced op would replay
+                # AFTER its wire copy drained (it defers to the stash
+                # point) — the copy already applied as remote, so
+                # re-applying would double-apply.  Skip it.  (A channel
+                # not yet materialized attaches in the mid tail: its ops
+                # replay normally.)
+                c = chan(entry)
+                if c is None or c.can_rebase:
+                    own_mid.append(entry)
+        ops = sorted(own_mid + list(pending_state["pending"]),
+                     key=lambda p: (replay_ref(p), p["clientSeq"]))
+        i = 0
+        for p in ops:
+            ref = replay_ref(p)
+            while i < len(mid_tail) and mid_tail[i].seq <= ref:
+                runtime.process(mid_tail[i])
+                i += 1
+            # The op's channel may be created by a dsAttach/channelAttach
+            # echo still ahead in the mid tail (the op was authored before
+            # the attach sequenced): drain forward until it materializes.
+            # No remote channel ops can precede the attach, so positions
+            # authored at the earlier ref stay exact.
+            while chan(p) is None and i < len(mid_tail):
+                runtime.process(mid_tail[i])
+                i += 1
+            channel = chan(p)
+            if channel is None:
+                raise KeyError(
+                    f"stashed op targets unknown channel "
+                    f"{p['ds']}/{p['channel']}"
+                )
+            channel.apply_stashed_op(p["contents"])
+            new_cs = channel._pending[-1][0]
+            for cid, cs in p.get(
+                "aliases", [[c, p["clientSeq"]] for c in old_ids]
+            ):
+                aliases[(cid, cs)] = new_cs
+        while i < len(mid_tail):
+            runtime.process(mid_tail[i])
+            i += 1
 
     def _wire(self, doc_id: str, runtime: ContainerRuntime, service,
               client_id: str) -> Container:

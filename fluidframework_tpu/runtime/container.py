@@ -100,6 +100,13 @@ class ContainerRuntime:
         self.min_seq = 0
         self._client_seq = 0      # runtime-level op counter (sub-op acks)
         self._client_ids: set = set()  # all ids this runtime has used
+        # Rehydrate adoption (the reference's PendingStateManager): wire
+        # copies of a crashed session's ops are OUR acks, but they carry
+        # that session's client ids and clientSeqs — this map translates
+        # (old client id, old clientSeq) to the clientSeq the re-applied
+        # op got in THIS runtime, so channel ack FIFOs match.
+        self._adopted_ids: set = set()
+        self._adopted_acks: Dict[tuple, int] = {}
         self._inbound: Deque[SequencedMessage] = collections.deque()
         self._outbox: List[dict] = []
         self._batching = 0
@@ -339,6 +346,23 @@ class ContainerRuntime:
             n += 1
         return n
 
+    def adopt_stashed_session(self, old_ids, aliases: Dict[tuple, int]
+                              ) -> None:
+        """Adopt a crashed session's identities: messages from ``old_ids``
+        become local, and ``aliases`` ((old client id, old clientSeq) ->
+        this runtime's clientSeq) routes their channel acks to the
+        re-applied ops.  ``aliases`` is held BY REFERENCE — the rehydrate
+        replay fills it incrementally while draining the tail, so copies
+        would miss entries."""
+        self._adopted_ids.update(old_ids)
+        self._client_ids.update(old_ids)
+        if self._adopted_acks:
+            # Repeated adoption: fold the existing entries INTO the new
+            # live dict and track that one — updating the old dict would
+            # snapshot `aliases` and miss entries the replay adds later.
+            aliases.update(self._adopted_acks)
+        self._adopted_acks = aliases
+
     def process(self, msg: SequencedMessage) -> None:
         if msg.seq <= self.ref_seq:
             return  # tail overlapping a loaded summary / duplicate delivery
@@ -397,13 +421,27 @@ class ContainerRuntime:
                     continue
                 ds = self.datastores.get(sub["ds"])
                 if ds is not None:
+                    sub_cs = sub["clientSeq"]
+                    sub_local = local
+                    if msg.client_id in self._adopted_ids:
+                        translated = self._adopted_acks.get(
+                            (msg.client_id, sub_cs)
+                        )
+                        if translated is None:
+                            # Adopted-session op with no re-applied
+                            # counterpart (shouldn't occur for channel
+                            # ops): apply as remote rather than tripping
+                            # an ack FIFO it was never entered into.
+                            sub_local = False
+                        else:
+                            sub_cs = translated
                     ds.process(
                         dataclasses.replace(
                             msg,
-                            client_seq=sub["clientSeq"],
+                            client_seq=sub_cs,
                             ref_seq=sub.get("refSeq", msg.ref_seq),
                         ),
-                        sub, local,
+                        sub, sub_local,
                     )
         elif msg.type in (MessageType.JOIN, MessageType.LEAVE):
             # Consensus-style channels react to quorum membership (held

@@ -134,9 +134,9 @@ class FluidDataStoreRuntime:
             if advance:
                 advance(seq, min_seq)
 
-    def resubmit_pending(self) -> None:
+    def resubmit_pending(self, force_rebase: bool = False) -> None:
         for channel in self.channels.values():
-            channel.resubmit_pending()
+            channel.resubmit_pending(force_rebase=force_rebase)
 
     # -- summaries -------------------------------------------------------------
 

@@ -616,3 +616,115 @@ def test_stale_stash_with_already_sequenced_matrix_op_loads():
     assert b2.runtime.get_datastore("ds").get_channel("grid") \
         .get_cell(0, 0) == "bob"
     assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+# --- rehydrate exactness under nacks + heavy faults (round 3) ----------------
+
+
+def _nack_stack(nack_every):
+    counter = {"n": 0}
+
+    def throttle(_cid):
+        counter["n"] += 1
+        if nack_every and counter["n"] % nack_every == 0:
+            return 0.0
+        return None
+
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.drivers.local_driver import (
+        LocalDocumentServiceFactory,
+    )
+
+    service = LocalOrderingService(throttle=throttle)
+    return service, Loader(LocalDocumentServiceFactory(service))
+
+
+def _text_build(rt):
+    ds = rt.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+
+
+def _pump(service, conts, rounds=16):
+    for _ in range(rounds):
+        for c in conts.values():
+            if c.delta_manager.state.value != "connected":
+                c.reconnect()
+            c.runtime.flush()
+            c.drain()
+        head = service.oplog.head("doc")
+        if all(c.runtime.ref_seq == head and not c.runtime._pending_wire
+               and not c.runtime._outbox for c in conts.values()):
+            return
+    raise AssertionError("never quiesced")
+
+
+def test_rehydrate_resubmit_regenerates_under_new_identity():
+    """Fuzz-minimized: a stashed op resubmitted after rehydrate rides a NEW
+    client id, so pinning it to the crashed session's ref would lie about
+    own-op visibility (the old id's sequenced inserts count in that view,
+    the new id's don't) — resubmission must regenerate against the current
+    view."""
+    service, loader = _nack_stack(nack_every=3)
+    a = loader.create("doc", "A", _text_build)
+    b = loader.resolve("doc", "B")
+    conts = {"A": a, "B": b}
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "abcd")
+    ta.insert_text(len(ta.text), "xx")
+    tb.insert_text(len(tb.text), "xx")
+    n = len(ta.text)
+    ta.remove_range(1, 3)
+    n = len(tb.text)
+    tb.remove_range(min(5, n - 1), min(n, min(5, n - 1) + 2))
+    stash = conts["B"].close_and_get_pending_state()
+    conts["B"] = loader.resolve("doc", "B1", pending_state=stash)
+    _pump(service, conts)
+    assert conts["A"].runtime.summarize().digest() == \
+        conts["B"].runtime.summarize().digest()
+
+
+def test_rehydrate_replays_own_sequenced_ops_at_their_refs():
+    """Fuzz-minimized: the crashed session's own ops SEQUENCED in the tail
+    were still pending when later stashed ops were authored — the load
+    point must drop to their authoring refs (a fixpoint) and the replay
+    must re-apply them as optimistic context, acked by their wire copies
+    through identity adoption."""
+    service, loader = _nack_stack(nack_every=3)
+    a = loader.create("doc", "A", _text_build)
+    b = loader.resolve("doc", "B")
+    c = loader.resolve("doc", "C")
+    conts = {"A": a, "B": b, "C": c}
+
+    def t(w):
+        return conts[w].runtime.get_datastore("ds").get_channel("text")
+
+    t("A").insert_text(0, "abcd")
+    for w in "ABC":
+        conts[w].drain()
+    t("B").insert_text(min(12, len(t("B").text)), "xx")
+    t("B").insert_text(min(10, len(t("B").text)), "y")
+    t("C").insert_text(min(8, len(t("C").text)), "y")
+    conts["B"].drain()
+    t("B").insert_text(min(8, len(t("B").text)), "y")
+    conts["B"].drain()
+    stash = conts["B"].close_and_get_pending_state()
+    conts["B"] = loader.resolve("doc", "B1", pending_state=stash)
+    _pump(service, conts)
+    digests = {x.runtime.summarize().digest() for x in conts.values()}
+    assert len(digests) == 1, {w: t(w).text for w in conts}
+
+
+def test_load_heavy_faults_with_nacks_and_stashes_converges():
+    """The load-harness shape that found the rehydrate divergences:
+    nack fault injection + disconnects + stash/rehydrate chains."""
+    from fluidframework_tpu.testing.load import LoadSpec, run_load
+
+    for seed in (4, 11, 13, 39):
+        result = run_load(LoadSpec(
+            seed=seed, clients=4, steps=250, nack_every=7,
+            disconnect_weight=0.12, stash_weight=0.08,
+            late_join_weight=0.04, edit_weight=0.55, sync_weight=0.21,
+        ))
+        assert len(result.summary_digest) == 64
+        assert result.rehydrates > 0
