@@ -279,6 +279,84 @@ class SharedMatrix(SharedObject):
         if not self.is_attached:
             self._policy = "fww"
 
+    def _handle_position(self, vec: PermutationVector, handle: int,
+                         allowed: set) -> Optional[int]:
+        """Current position of a permutation handle in the rebase view
+        (sequenced state + already-regenerated pending groups), or None if
+        its slot is gone from that view (sequenced-removed)."""
+        c = 0
+        for seg in vec.tree.segments:
+            if handle in seg.text:
+                if vec.tree.rebase_visible_len(seg, allowed) == 0:
+                    return None
+                return c + seg.text.index(handle)
+            c += vec.tree.rebase_visible_len(seg, allowed)
+        return None
+
+    def _resubmit_rebased(self, pending) -> None:
+        """Regenerate pending ops against the current view (removing the
+        former stash-and-rehydrate-only limitation): axis ops re-target
+        their permutation segments exactly as SharedString's merge-tree
+        regeneration does (segment identity), and setCell regenerates
+        row/col from its RESOLVED handles — dropped when either slot was
+        sequenced-removed (remote replicas would resolve the same
+        nothing)."""
+        client = self._local_client()
+        allowed_by_vec = {id(self.rows): set(), id(self.cols): set()}
+        for _old_client_seq, contents, meta, _ref_seq in pending:
+            kind = contents["kind"]
+            if kind in ("insertRows", "insertCols",
+                        "removeRows", "removeCols"):
+                vec = self.rows if kind.endswith("Rows") else self.cols
+                allowed = allowed_by_vec[id(vec)]
+                _tag, group = meta
+                segs = [s for s in vec.tree.segments
+                        if group in s.pending_groups]
+                for seg in segs:
+                    seg.pending_groups.remove(group)
+                    if group.kind == "insert":
+                        vec.tree.rebase_normalize(seg, allowed)
+                        pos = vec.tree.rebase_position(seg, allowed)
+                        op = {"kind": kind, "pos": pos,
+                              "count": len(seg.text)}
+                    else:  # remove
+                        if seg.removed_seq is not None \
+                                and seg.removed_seq != UNASSIGNED_SEQ:
+                            # A remote remove won while we were away.
+                            seg.pending_overlap.discard(client)
+                            continue
+                        start = vec.tree.rebase_position(seg, allowed)
+                        op = {"kind": kind, "start": start,
+                              "end": start + len(seg.text)}
+                    new_group = SegmentGroup(group.kind, client=client)
+                    new_group.add(seg)
+                    self._submit_local_op(op, ("group", new_group))
+                    allowed.add(new_group)
+            elif kind == "setCell":
+                _tag, rh, ch = meta
+                row = self._handle_position(self.rows, rh,
+                                            allowed_by_vec[id(self.rows)])
+                col = self._handle_position(self.cols, ch,
+                                            allowed_by_vec[id(self.cols)])
+                if row is None or col is None:
+                    # The cell's row/col is gone: drop, and release the
+                    # optimistic overlay entry its ack would have popped.
+                    entries = self._overlay.get((rh, ch))
+                    if entries:
+                        entries.pop(0)
+                        if not entries:
+                            self._overlay.pop((rh, ch), None)
+                    continue
+                self._submit_local_op(
+                    {"kind": "setCell", "row": row, "col": col,
+                     "value": contents["value"]},
+                    ("cell", rh, ch),
+                )
+            elif kind == "setPolicy":
+                self._submit_local_op(dict(contents), None)
+            else:
+                raise ValueError(f"unknown pending matrix op {kind!r}")
+
     def apply_stashed_op(self, contents) -> None:
         kind = contents["kind"]
         if kind in ("insertRows", "insertCols"):
@@ -316,7 +394,10 @@ class SharedMatrix(SharedObject):
             if local:
                 tag, group = meta
                 assert tag == "group"
-                vec.tree.ack_insert(group, msg.seq)
+                # The wire client id matters: after a rehydrate adoption
+                # the sequenced copy carries the crashed session's id,
+                # which every remote recorded as the insert attribution.
+                vec.tree.ack_insert(group, msg.seq, msg.client_id)
             else:
                 vec.tree.apply_insert(
                     op["pos"], vec.alloc(op["count"]), msg.seq, client,

@@ -478,15 +478,35 @@ class Loader:
             ds = runtime.datastores.get(p["ds"])
             return ds.channels.get(p["channel"]) if ds is not None else None
 
+        # Channels whose dsAttach/channelAttach echo still rides the mid
+        # tail don't exist at the load point: their ops' replay refs FLOOR
+        # at the attach seq (no remote channel op can precede the attach,
+        # so delaying to it is exact), keeping the main drain loop from
+        # overshooting later ops' authoring views.
+        attach_floor: Dict[tuple, int] = {}
+        for msg, batch in _decode_stream(
+            m for m in mid_tail if m.type is MessageType.OP
+        ):
+            for sub in batch["ops"]:
+                if sub.get("runtime") == "dsAttach":
+                    attach_floor[(sub["ds"], None)] = msg.seq
+                elif sub.get("runtime") == "channelAttach":
+                    attach_floor[(sub["ds"], sub["channel"])] = msg.seq
+
         def replay_ref(p):
-            # Channels that cannot rebase (e.g. the matrix) keep the
-            # documented stash-point reinterpretation — re-applying at the
-            # fresh stash view is their recovery semantics, and it keeps
-            # their resubmission off the rebase path.  A channel whose
-            # attach op still rides the mid tail doesn't exist yet —
-            # treat it as rebasable (the apply step waits for the attach).
+            # Channels that cannot rebase keep the documented stash-point
+            # reinterpretation — re-applying at the fresh stash view is
+            # their recovery semantics and keeps their resubmission off
+            # the rebase path.  (All built-in DDSes, including the matrix
+            # since its handle-based rebase landed, are rebasable and take
+            # the exact per-op path.)
             c = chan(p)
-            return p["refSeq"] if c is None or c.can_rebase else stash_ref
+            base = p["refSeq"] if c is None or c.can_rebase else stash_ref
+            return max(
+                base,
+                attach_floor.get((p["ds"], None), 0),
+                attach_floor.get((p["ds"], p["channel"]), 0),
+            )
 
         own_mid: List[dict] = []
         for msg, batch in decode_stream(
@@ -518,14 +538,6 @@ class Loader:
         for p in ops:
             ref = replay_ref(p)
             while i < len(mid_tail) and mid_tail[i].seq <= ref:
-                runtime.process(mid_tail[i])
-                i += 1
-            # The op's channel may be created by a dsAttach/channelAttach
-            # echo still ahead in the mid tail (the op was authored before
-            # the attach sequenced): drain forward until it materializes.
-            # No remote channel ops can precede the attach, so positions
-            # authored at the earlier ref stay exact.
-            while chan(p) is None and i < len(mid_tail):
                 runtime.process(mid_tail[i])
                 i += 1
             channel = chan(p)

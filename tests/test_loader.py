@@ -515,13 +515,10 @@ def test_rebase_register_write_keeps_unobserved_versions():
     assert reg(a).read("k") == "alice-v2"
 
 
-def test_stale_matrix_pending_stays_stashable_and_drop_recovers():
-    """A DDS that cannot rebase (SharedMatrix): reconnect raises
-    StaleOpError but the pending ops survive for stashing, and a truly
-    stale stash gets the actionable loader-level error before any
-    mutation; stale_pending='drop' recovers."""
-    from fluidframework_tpu.dds.shared_object import StaleOpError
-
+def test_stale_matrix_pending_rebases_at_rehydrate():
+    """SharedMatrix pending ops now REBASE (round 3): a stale stash's
+    setCell regenerates row/col from its resolved permutation handles at
+    rehydrate and converges — no StaleOpError, no drop needed."""
     def build(rt):
         ds = rt.create_datastore("ds")
         ds.create_channel("matrix-tpu", "grid")
@@ -542,22 +539,50 @@ def test_stale_matrix_pending_stays_stashable_and_drop_recovers():
     stash = b.close_and_get_pending_state()  # crash offline: stale refSeq
     _advance_window(a)
 
-    with pytest.raises(StaleOpError) as ei:
-        loader.resolve("doc", "bob2", pending_state=stash)
-    assert "grid" in str(ei.value) and "drop" in str(ei.value)
-
-    b2 = loader.resolve("doc", "bob2", pending_state=stash,
-                        stale_pending="drop")
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
     a.drain()
     b2.drain()
+    a.drain()
+    assert grid(b2).get_cell(0, 0) == "bob"
     assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
 
 
-def test_stale_matrix_reconnect_raise_keeps_pending_stashable():
-    """resubmit_pending restores the pending snapshot when the rebase path
-    raises, so close_and_get_pending_state still captures the ops."""
-    from fluidframework_tpu.dds.shared_object import StaleOpError
+def test_stale_matrix_setcell_on_removed_row_drops_cleanly():
+    """A rebased setCell whose ROW was removed while the client was away
+    drops (remote replicas would resolve the same nothing) and replicas
+    converge."""
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("matrix-tpu", "grid")
+        ds.create_channel("sequence-tpu", "text")
 
+    def grid(c):
+        return c.runtime.get_datastore("ds").get_channel("grid")
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    grid(a).insert_rows(0, 2)
+    grid(a).insert_cols(0, 2)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    b.disconnect()
+    grid(b).set_cell(0, 0, "bob")
+    stash = b.close_and_get_pending_state()
+    grid(a).remove_rows(0, 1)  # the cell's row dies while bob is away
+    _advance_window(a)
+
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    a.drain()
+    assert grid(b2).get_cell(0, 0) is None  # row 0 is now the old row 1
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+def test_stale_matrix_reconnect_rebases_pending():
+    """Reconnect with a stale matrix pending op now rebases it in place
+    (previously a StaleOpError requiring stash-and-rehydrate)."""
     def build(rt):
         ds = rt.create_datastore("ds")
         ds.create_channel("matrix-tpu", "grid")
@@ -574,18 +599,13 @@ def test_stale_matrix_reconnect_raise_keeps_pending_stashable():
     b.disconnect()
     b.runtime.get_datastore("ds").get_channel("grid").set_cell(0, 0, "bob")
     _advance_window(a)
-    with pytest.raises(StaleOpError):
-        b.reconnect()
-    stash = b.close_and_get_pending_state()
-    assert len(stash["pending"]) == 1
-    # The post-reconnect drain freshened the stash view: rehydrate works.
-    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    b.reconnect()
     a.drain()
-    b2.drain()
+    b.drain()
     a.drain()
-    assert b2.runtime.get_datastore("ds").get_channel("grid") \
+    assert b.runtime.get_datastore("ds").get_channel("grid") \
         .get_cell(0, 0) == "bob"
-    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+    assert a.runtime.summarize().digest() == b.runtime.summarize().digest()
 
 
 def test_stale_stash_with_already_sequenced_matrix_op_loads():
@@ -728,3 +748,31 @@ def test_load_heavy_faults_with_nacks_and_stashes_converges():
         ))
         assert len(result.summary_digest) == 64
         assert result.rehydrates > 0
+
+
+def test_rehydrate_matrix_insert_ack_keeps_wire_attribution():
+    """A stashed matrix insert_rows sequenced under the crashed session's
+    id and acked via adoption must keep the WIRE attribution (review-found:
+    the local ack path dropped the client id, leaving the new session's id
+    on the segment while remotes recorded the old one)."""
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("matrix-tpu", "grid")
+
+    service, _factory, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    g = a.runtime.get_datastore("ds").get_channel("grid")
+    g.insert_rows(0, 1)
+    g.insert_cols(0, 1)
+    a.drain()
+    b = loader.resolve("doc", "bob")
+    b.drain()
+    gb = b.runtime.get_datastore("ds").get_channel("grid")
+    gb.insert_rows(1, 2)   # submits; sequenced...
+    b.runtime.flush()
+    stash = b.close_and_get_pending_state()  # ...but the ack never drained
+    b2 = loader.resolve("doc", "bob2", pending_state=stash)
+    a.drain()
+    b2.drain()
+    a.drain()
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
