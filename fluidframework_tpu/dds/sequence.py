@@ -247,6 +247,26 @@ class SharedString(SharedObject):
         for seg in segs:
             seg.pending_groups.remove(group)
             if group.kind == "insert":
+                if seg.insert_seq == UNASSIGNED_SEQ and \
+                        seg.removed_seq is not None and \
+                        seg.removed_seq != UNASSIGNED_SEQ:
+                    # A predicted obliterate-kill judged at the OLD
+                    # position: the regenerated op goes out at a fresh
+                    # in-window ref where every stamp is already seen, so
+                    # it cannot be killed on arrival — clear the stale
+                    # verdict (and the copied killer stamp) before
+                    # re-placing (fuzz-found divergence).
+                    seg.ob_stamps.pop(seg.removed_seq, None)
+                    seg.removed_seq = None
+                    seg.removed_client = None
+                    if client in seg.pending_overlap:
+                        # The kill demoted our own pending removal of this
+                        # very text; restore the pending mark or the
+                        # regenerated remove/obliterate would never mark
+                        # the segment removed locally (review-found).
+                        seg.pending_overlap.discard(client)
+                        seg.removed_seq = UNASSIGNED_SEQ
+                        seg.removed_client = client
                 self.tree.rebase_normalize(seg, allowed)
                 pos = self.tree.rebase_position(seg, allowed)
                 op = {"kind": "insert", "pos": pos, "text": seg.text}

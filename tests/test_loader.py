@@ -776,3 +776,101 @@ def test_rehydrate_matrix_insert_ack_keeps_wire_attribution():
     b2.drain()
     a.drain()
     assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
+
+
+def test_rehydrate_clears_stale_predicted_obliterate_kill():
+    """Fuzz-minimized: a pending insert predicted-killed by a concurrent
+    obliterate at its OLD position must shed that verdict when rehydrate
+    regenerates it — the fresh in-window resubmission can never be killed
+    on arrival (every stamp is already seen), and remotes keep it alive."""
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.drivers.local_driver import (
+        LocalDocumentServiceFactory,
+    )
+
+    counter = {"n": 0}
+
+    def throttle(_cid):
+        counter["n"] += 1
+        return 0.0 if counter["n"] % 5 == 0 else None
+
+    service = LocalOrderingService(throttle=throttle)
+    loader = Loader(LocalDocumentServiceFactory(service))
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+
+    conts = {"A": loader.create("doc", "A", build),
+             "B": loader.resolve("doc", "B")}
+
+    def t(w):
+        return conts[w].runtime.get_datastore("ds").get_channel("text")
+
+    t("A").insert_text(0, "abcdef")
+    conts["B"].drain()
+    n = len(t("A").text)
+    s0 = min(6, n - 1)
+    t("A").remove_range(s0, min(n, s0 + 2))
+    t("A").insert_text(min(5, len(t("A").text)), "y")
+    n = len(t("A").text)
+    t("A").obliterate_range(1, min(n, 3))
+    t("A").insert_text(min(3, len(t("A").text)), "y")
+    n = len(t("B").text)
+    s0 = min(4, n - 1)
+    t("B").obliterate_range(s0, min(n, s0 + 2))
+    stash = conts["A"].close_and_get_pending_state()
+    conts["A"] = loader.resolve("doc", "A1", pending_state=stash)
+    for _ in range(16):
+        for c in conts.values():
+            if c.delta_manager.state.value != "connected":
+                c.reconnect()
+            c.runtime.flush()
+            c.drain()
+        head = service.oplog.head("doc")
+        if all(c.runtime.ref_seq == head and not c.runtime._pending_wire
+               and not c.runtime._outbox for c in conts.values()):
+            break
+    digests = {c.runtime.summarize().digest() for c in conts.values()}
+    assert len(digests) == 1, {w: t(w).text for w in conts}
+
+
+def test_rehydrate_restores_demoted_pending_remove_on_cleared_kill():
+    """Review-found: clearing a stale predicted-kill must restore a local
+    pending removal the kill had demoted, or the regenerated remove never
+    marks the segment removed locally while every remote applies it."""
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.drivers.local_driver import (
+        LocalDocumentServiceFactory,
+    )
+
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+
+    a = loader.create("doc", "A", build)
+    b = loader.resolve("doc", "B")
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "wxyz")
+    a.drain()
+    b.drain()
+    b.disconnect()
+    tb.insert_text(1, "abc")
+    tb.remove_range(1, 4)          # removes its own pending text
+    ta.obliterate_range(0, 3)      # concurrent kill over the slot
+    a.drain()
+    stash = b.close_and_get_pending_state()
+    b2 = loader.resolve("doc", "B2", pending_state=stash)
+    for _ in range(12):
+        for c in (a, b2):
+            if c.delta_manager.state.value != "connected":
+                c.reconnect()
+            c.runtime.flush()
+            c.drain()
+    t2 = b2.runtime.get_datastore("ds").get_channel("text")
+    assert ta.text == t2.text
+    assert a.runtime.summarize().digest() == b2.runtime.summarize().digest()
