@@ -139,6 +139,91 @@ def oracle_replay(doc):
     return replica
 
 
+METRIC_NAME = "sharedstring_catchup_replay_ops_per_sec"
+# Global wall-clock ceiling for the whole bench: past this a watchdog emits
+# the skip JSON and hard-exits, so a tunnel that wedges MID-run (observed:
+# np.asarray hanging indefinitely on d2h) still yields a parseable artifact.
+BENCH_DEADLINE_SEC = float(os.environ.get("BENCH_DEADLINE", "2700"))
+
+
+def _emit_skip(reason: str, detail: dict | None = None) -> None:
+    """The one JSON line for a run that could not produce a number.
+
+    Keeps the driver artifact parseable (VERDICT r3 item 2): rc=0, same
+    metric name, explicit ``skipped`` marker plus whatever diagnostics were
+    gathered before the failure."""
+    line = {
+        "metric": METRIC_NAME,
+        "value": None,
+        "unit": "ops/sec",
+        "vs_baseline": None,
+        "skipped": reason,
+    }
+    line.update(detail or {})
+    print(json.dumps(line), flush=True)
+
+
+def _backend_probe() -> dict:
+    """Timeboxed SUBPROCESS probe of backend init before the parent touches
+    jax: a wedged axon tunnel can hang ``jax.devices()`` indefinitely
+    (observed in prior sessions — BASELINE.md), and a parent-side hang is
+    unrecoverable.  The child inits the backend and runs one tiny jit; the
+    parent gets (ok, diagnostics) either way.
+
+    ``FF_BENCH_PLATFORM`` forces a platform via jax.config.update in BOTH
+    child and parent (the axon sitecustomize force-sets JAX_PLATFORMS at
+    interpreter startup, so the env var alone loses) — used by tests to
+    simulate an unavailable backend and by operators to run the bench on
+    cpu explicitly."""
+    import subprocess
+
+    code = (
+        "import os, time, jax\n"
+        "plat = os.environ.get('FF_BENCH_PLATFORM')\n"
+        "if plat: jax.config.update('jax_platforms', plat)\n"
+        "t0 = time.time()\n"
+        "devs = jax.devices()\n"
+        "t_init = time.time() - t0\n"
+        "import jax.numpy as jnp\n"
+        "t0 = time.time()\n"
+        "jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones((8,))))\n"
+        "t_exec = time.time() - t0\n"
+        "kind = getattr(devs[0], 'device_kind', '?').replace(' ', '_')\n"
+        "print('PROBE-OK %s %d %.2f %.2f %s' % "
+        "(devs[0].platform, len(devs), t_init, t_exec, kind))\n"
+    )
+    timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+        out = proc.stdout.strip().splitlines()
+        ok = proc.returncode == 0 and any(
+            ln.startswith("PROBE-OK") for ln in out
+        )
+        tail = (proc.stderr or proc.stdout)[-800:]
+    except subprocess.TimeoutExpired as e:
+        ok = False
+        tail = f"probe timed out after {timeout:.0f}s: " + str(
+            (e.stderr or e.stdout or b"")[-400:]
+        )
+    info = {"ok": ok, "probe_sec": round(time.time() - t0, 1)}
+    if ok:
+        fields = next(
+            ln for ln in out if ln.startswith("PROBE-OK")
+        ).split()
+        info.update(
+            platform=fields[1], n_devices=int(fields[2]),
+            init_sec=float(fields[3]), first_exec_sec=float(fields[4]),
+            device_kind=fields[5] if len(fields) > 5 else "?",
+        )
+    else:
+        info["error_tail"] = tail
+    return info
+
+
 def _forced_layout_canary() -> None:
     """Compile-and-fetch a TINY forced-layout program in a SUBPROCESS with
     a timeout before the warmup compiles the real one.  If the canary
@@ -152,8 +237,10 @@ def _forced_layout_canary() -> None:
     # Run BEFORE the parent touches the backend: on exclusive-ownership
     # TPU runtimes the subprocess must be able to acquire the device.
     code = (
-        "import jax, jax.numpy as jnp\n"
+        "import os, jax, jax.numpy as jnp\n"
         "import sys\n"
+        "plat = os.environ.get('FF_BENCH_PLATFORM')\n"
+        "if plat: jax.config.update('jax_platforms', plat)\n"
         "sys.exit(0) if jax.default_backend() == 'cpu' else None\n"
         "from jax.experimental.layout import Format, Layout\n"
         "from jax.sharding import SingleDeviceSharding\n"
@@ -177,6 +264,49 @@ def _forced_layout_canary() -> None:
         os.environ["FF_NO_FORCED_LAYOUT"] = "1"
         print("forced-layout canary FAILED; running without the "
               "layout-forced fetch", file=sys.stderr)
+
+
+# Peak single-chip HBM bandwidth by device kind (GB/s), for the roofline.
+# Source: public TPU spec sheets; unknown kinds fall back to v5e.
+HBM_GBPS = {
+    "TPU_v4": 1228.0,
+    "TPU_v5_lite": 819.0,
+    "TPU_v5e": 819.0,
+    "TPU_v5p": 2765.0,
+    "TPU_v5": 2765.0,
+    "TPU_v6_lite": 1640.0,
+    "TPU_v6e": 1640.0,
+}
+
+
+def roofline(S: int, K: int, device_kind: str) -> dict:
+    """HBM roofline for the merge-tree fold (VERDICT r3 item 5).
+
+    The scan's carried state per document is 12 int32 [S] columns plus an
+    [S, K] int32 props plane; each scan step (one applied op per doc under
+    vmap) must stream that state out of HBM and write it back at least
+    once — the op row itself is negligible.  So the OPTIMISTIC (perfect
+    XLA fusion into one read + one write pass per step) bytes-per-op is
+
+        bytes_per_op = 2 * S * (12 + K) * 4
+
+    and the bandwidth-bound rate is HBM_GBps / bytes_per_op.  The real
+    kernel makes several masked passes per step (two boundary splits each
+    shuffling every column, the visible-length prefix sums, the stamp
+    selects), so measured/bound below ~30% can still mean "fused about as
+    well as the pass structure allows"; the number's job is to separate a
+    kernel-shaped problem (low pct AND healthy link) from a link-shaped
+    one (VERDICT r3: 'fast or just correct' must be answerable)."""
+    gbps = HBM_GBPS.get(device_kind, 819.0)
+    bytes_per_op = 2 * S * (12 + K) * 4
+    return {
+        "S": S,
+        "props_plane_K": K,
+        "bytes_per_op_optimistic": bytes_per_op,
+        "hbm_GBps": gbps,
+        "device_kind": device_kind,
+        "bound_ops_per_sec": round(gbps * 1e9 / bytes_per_op, 1),
+    }
 
 
 def link_microbench() -> dict:
@@ -361,6 +491,63 @@ def run_e2e(docs):
 
 
 def main() -> None:
+    # --- survive a sick environment: probe the backend in a timeboxed
+    # subprocess BEFORE the parent touches jax; emit a parseable skip line
+    # instead of a stack trace when the tunnel is down (VERDICT r3 #2) ---
+    probe = _backend_probe()
+    if not probe["ok"]:
+        print(f"backend probe FAILED: {probe}", file=sys.stderr)
+        _emit_skip(
+            "backend-unavailable",
+            {"probe": {k: v for k, v in probe.items() if k != "ok"}},
+        )
+        return
+    print(f"backend probe: {probe}", file=sys.stderr)
+    if os.environ.get("FF_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["FF_BENCH_PLATFORM"])
+
+    # Watchdog: if the run exceeds the deadline (a tunnel that wedges
+    # mid-run hangs d2h fetches indefinitely), print the skip line and
+    # hard-exit so the driver still gets rc=0 + one JSON line.
+    def _deadline() -> None:
+        print(
+            f"BENCH DEADLINE ({BENCH_DEADLINE_SEC:.0f}s) exceeded — "
+            "emitting skip line and exiting", file=sys.stderr,
+        )
+        _emit_skip("deadline-exceeded", {"probe": probe,
+                                         "deadline_sec": BENCH_DEADLINE_SEC})
+        sys.stderr.flush()
+        os._exit(0)
+
+    watchdog = threading.Timer(BENCH_DEADLINE_SEC, _deadline)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        _run_bench(probe)
+    except AssertionError:
+        # A correctness failure (device summaries != oracle) is NOT an
+        # environmental skip: emit a parseable line with a distinct reason
+        # but exit nonzero so the driver cannot mistake it for a tunnel
+        # outage.
+        import traceback
+
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr)
+        _emit_skip("correctness-failure", {"probe": probe,
+                                           "error_tail": tb[-800:]})
+        sys.exit(1)
+    except Exception:  # env/runtime failure mid-run → parseable skip, rc=0
+        import traceback
+
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr)
+        _emit_skip("runtime-error", {"probe": probe,
+                                     "error_tail": tb[-800:]})
+    finally:
+        watchdog.cancel()
+
+
+def _run_bench(probe: dict) -> None:
     _forced_layout_canary()  # before ANY parent-side backend init
     t0 = time.time()
     docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
@@ -441,6 +628,19 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # --- HBM roofline: is the fold fast, or just correct? (only
+    # meaningful on a real TPU; the cpu backend has no pinned HBM figure)
+    roof = None
+    if probe.get("platform") in ("tpu", "axon"):
+        # K must be the PADDED props-plane width the scan actually carries
+        # (pack bucket-pads the key axis), not the logical key count.
+        k_padded = int(warm_state.props.shape[-1])
+        roof = roofline(S, k_padded, probe.get("device_kind", "?"))
+        roof["steady_fold_pct_of_bound"] = round(
+            100.0 * fold_ops_per_sec / roof["bound_ops_per_sec"], 2
+        )
+        print(f"roofline: {roof}", file=sys.stderr)
+
     # --- sanity: device bytes == oracle bytes on sampled docs ---
     sample = [docs[0], docs[7], docs[N_DOCS // 2]]
     for doc, dev_summary in zip(sample, replay_mergetree_batch(sample)):
@@ -456,7 +656,11 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "sharedstring_catchup_replay_ops_per_sec",
+                "metric": METRIC_NAME,
+                "backend": probe.get("platform", "unknown"),
+                "forced_layout_disabled": bool(
+                    os.environ.get("FF_NO_FORCED_LAYOUT")
+                ),
                 "value": round(e2e_ops_per_sec, 1),
                 "unit": "ops/sec",
                 "vs_baseline": round(e2e_ops_per_sec / cpu_ops_per_sec, 2),
@@ -465,6 +669,7 @@ def main() -> None:
                     fold_ops_per_sec / cpu_ops_per_sec, 2
                 ),
                 "cpu_baseline_ops_per_sec": round(cpu_ops_per_sec, 1),
+                "roofline": roof,
                 "link": link,
                 "stages_busy_sec": {
                     "pack": round(stage["pack"], 3),
