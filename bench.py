@@ -206,9 +206,10 @@ def _backend_probe() -> dict:
         tail = (proc.stderr or proc.stdout)[-800:]
     except subprocess.TimeoutExpired as e:
         ok = False
-        tail = f"probe timed out after {timeout:.0f}s: " + str(
-            (e.stderr or e.stdout or b"")[-400:]
-        )
+        raw = e.stderr or e.stdout or b""
+        if isinstance(raw, bytes):  # TimeoutExpired ignores text=True
+            raw = raw.decode("utf-8", errors="replace")
+        tail = f"probe timed out after {timeout:.0f}s: {raw[-400:]}"
     info = {"ok": ok, "probe_sec": round(time.time() - t0, 1)}
     if ok:
         fields = next(
@@ -536,13 +537,24 @@ def main() -> None:
         _emit_skip("correctness-failure", {"probe": probe,
                                            "error_tail": tb[-800:]})
         sys.exit(1)
-    except Exception:  # env/runtime failure mid-run → parseable skip, rc=0
+    except Exception as exc:
         import traceback
 
         tb = traceback.format_exc()
         print(tb, file=sys.stderr)
-        _emit_skip("runtime-error", {"probe": probe,
-                                     "error_tail": tb[-800:]})
+        # Environmental failures (a tunnel dying MID-run) skip with rc=0;
+        # anything else is a code bug in the bench and must exit nonzero,
+        # or a broken benchmark would read as a sick environment forever.
+        environmental = (
+            isinstance(exc, (OSError, TimeoutError, jax.errors.JaxRuntimeError))
+            or (isinstance(exc, RuntimeError)
+                and ("backend" in str(exc).lower()
+                     or "UNAVAILABLE" in str(exc)))
+        )
+        reason = "runtime-error" if environmental else "bench-bug"
+        _emit_skip(reason, {"probe": probe, "error_tail": tb[-800:]})
+        if not environmental:
+            sys.exit(1)
     finally:
         watchdog.cancel()
 

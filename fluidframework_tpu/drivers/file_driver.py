@@ -34,13 +34,25 @@ from .local_driver import LocalDocumentServiceFactory
 
 
 def _iter_jsonl(path: str):
+    """Yield records; a torn FINAL line (crash mid-append) is dropped so
+    the store reopens losing only the last record.  A torn line anywhere
+    else still raises — that is corruption, not a torn append."""
     if not os.path.exists(path):
         return
+    pending = None  # one-line lookahead keeps the read streaming
     with open(path, "r", encoding="utf-8") as f:
         for line in f:
             line = line.strip()
-            if line:
-                yield json.loads(line)
+            if not line:
+                continue
+            if pending is not None:
+                yield json.loads(pending)  # a torn NON-final line raises
+            pending = line
+    if pending is not None:
+        try:
+            yield json.loads(pending)
+        except json.JSONDecodeError:
+            return
 
 
 def _append_jsonl(path: str, rec: dict) -> None:

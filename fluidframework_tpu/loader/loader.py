@@ -281,7 +281,10 @@ class Loader:
             if pending_state else set()
         summary = None
         converged = False
-        for _ in range(64):  # strictly-decreasing load_ref terminates
+        # Loop to fixpoint: each refetch strictly lowers load_ref over a
+        # finite tail, so termination is structural — no iteration cap
+        # (ADVICE r3: a cap made legitimately deep convergent stashes fail).
+        while not converged:
             summary, summary_seq = service.storage.latest(
                 at_or_below=load_ref
             )
@@ -309,13 +312,6 @@ class Loader:
                 load_ref = lower
                 if load_ref < summary_seq:
                     break  # need an older summary: refetch
-            if converged:
-                break
-        if not converged:
-            raise RuntimeError(
-                f"{doc_id}: rehydrate load point did not converge "
-                f"(load_ref {load_ref}); stash too deep to replay exactly"
-            )
         runtime.load(summary)
 
         container = Container(doc_id, runtime, DeltaManager(service))
@@ -330,7 +326,10 @@ class Loader:
         post_stash = tail[len(pre) + len(mid):]
         for msg in pre:
             runtime.process(msg)
-        container.catchup_ops = len(pre) + len(mid)
+        # The mid tail counts as storage catch-up only where it is actually
+        # replayed by _apply_stashed below; on drop/no-stash paths it is
+        # delivered by the post-connect live drain instead (ADVICE r3).
+        container.catchup_ops = len(pre)
         container.delta_manager.note_delivered(runtime.ref_seq)
 
         if pending_state is not None and pending_state["pending"]:
@@ -405,6 +404,7 @@ class Loader:
                                         post_stash, stash_ref, aliases)
                 finally:
                     runtime._batching -= 1
+                container.catchup_ops += len(mid)
                 container.delta_manager.note_delivered(runtime.ref_seq)
                 container.discard_outbound()
                 container.drain()

@@ -15,6 +15,7 @@ state (exactly-once: ``replay`` never re-stamps).
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional
 
 from ..protocol.messages import RawOperation, SequencedMessage
@@ -193,14 +194,20 @@ class LocalOrderingService:
         #: summary eviction; entries are per-node and tiny.
         self.handle_tenants: Dict[str, set] = {}
         self._orderers: Dict[str, DocumentOrderer] = {}
+        #: guards handle_tenants and lazy orderer creation: the network
+        #: front door offloads catchup/upload_summary to executor THREADS
+        #: that mutate these maps concurrently with event-loop dispatches
+        #: (ADVICE r3) — GIL atomicity alone is not a contract.
+        self.state_lock = threading.RLock()
 
     def create_document(self, doc_id: str) -> DocumentEndpoint:
-        if doc_id in self._orderers:
-            raise ValueError(f"document {doc_id!r} already exists")
-        self._orderers[doc_id] = DocumentOrderer(
-            doc_id, self.oplog, self.storage, throttle=self.throttle
-        )
-        return DocumentEndpoint(self._orderers[doc_id])
+        with self.state_lock:
+            if doc_id in self._orderers:
+                raise ValueError(f"document {doc_id!r} already exists")
+            self._orderers[doc_id] = DocumentOrderer(
+                doc_id, self.oplog, self.storage, throttle=self.throttle
+            )
+            return DocumentEndpoint(self._orderers[doc_id])
 
     def has_document(self, doc_id: str) -> bool:
         return doc_id in self._orderers or self.oplog.head(doc_id) > 0
@@ -209,25 +216,31 @@ class LocalOrderingService:
         """Connect-or-recover: an existing orderer is reused; a document
         present only in the durable log (service restart) is recovered by
         replaying the log into a fresh orderer."""
-        orderer = self._orderers.get(doc_id)
+        with self.state_lock:
+            orderer = self._orderers.get(doc_id)
         if orderer is None:
             if self.oplog.head(doc_id) == 0:
                 raise KeyError(f"document {doc_id!r} does not exist")
-            orderer = DocumentOrderer.recover(
+            # Recover OUTSIDE the lock: a full log replay can take seconds
+            # and the lock must stay a dict-operations-only lock.  Two
+            # racing recoveries replay the same immutable log prefix; the
+            # first insert wins.
+            recovered = DocumentOrderer.recover(
                 doc_id, self.oplog, self.storage
             )
-            self._orderers[doc_id] = orderer
+            with self.state_lock:
+                orderer = self._orderers.setdefault(doc_id, recovered)
         return DocumentEndpoint(orderer)
 
     def doc_ids(self) -> List[str]:
-        ids = set(self._orderers) | set(self.oplog.doc_ids())
-        return sorted(ids)
+        with self.state_lock:
+            known = set(self._orderers)
+        return sorted(known | set(self.oplog.doc_ids()))
 
     def checkpoint(self) -> dict:
-        return {
-            doc_id: orderer.checkpoint()
-            for doc_id, orderer in sorted(self._orderers.items())
-        }
+        with self.state_lock:
+            snapshot = sorted(self._orderers.items())
+        return {doc_id: orderer.checkpoint() for doc_id, orderer in snapshot}
 
     @staticmethod
     def restore(

@@ -44,6 +44,11 @@ from .orderer import LocalOrderingService
 #: methods _handle runs on an executor thread instead of the event loop:
 #: bulk device folds and storage mutations that hold the commit-chain lock
 #: across (possibly file-backed) writes.
+#: Methods offloaded to executor threads.  Shared-state discipline: lazy
+#: endpoint/orderer creation and the handle-grant map are guarded by
+#: ``service.state_lock``; oplog READS during an offloaded fold rely on the
+#: append-only contract (ranged reads see a prefix that never mutates —
+#: a concurrent append only extends beyond the requested range).
 OFFLOADED_METHODS = frozenset({"catchup", "upload_summary"})
 
 
@@ -177,12 +182,17 @@ class OrderingServer:
                 for child in node.children.values():
                     walk(child)
 
-        walk(tree)
+        # Executor threads (OFFLOADED_METHODS) mutate the grant map
+        # concurrently with event-loop dispatches (ADVICE r3).
+        with self.service.state_lock:
+            walk(tree)
 
     def _check_readable(self, handle: str, tenant: Optional[str]) -> None:
         if self.tenants is None:
             return
-        if tenant not in self.service.handle_tenants.get(handle, ()):  # noqa
+        with self.service.state_lock:
+            granted = tenant in self.service.handle_tenants.get(handle, ())
+        if not granted:
             raise PermissionError("unknown handle for this tenant")
 
     def _check_incremental_refs(self, obj, tenant: Optional[str]) -> None:

@@ -154,6 +154,37 @@ def test_torn_store_reopens_without_dangling_refs(tmp_path):
     assert reopened.refs("doc")["ok"] == commits[0].digest()
 
 
+def test_torn_trailing_line_reopens_losing_only_last_record(tmp_path):
+    """A crash mid-append leaves a PARTIAL final line; the store must
+    reopen losing only that record (ADVICE r3), while a torn line earlier
+    in the file still raises (corruption, not a torn append)."""
+    import json
+    import os
+
+    import pytest
+
+    root = str(tmp_path / "store")
+    storage = FileSummaryStorage(root)
+    _fill(storage)
+    commits = storage.history("doc")
+    path = os.path.join(root, "commits.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"doc": "doc", "tree": "abc123", "trunca')  # no newline
+
+    reopened = FileSummaryStorage(root)  # must not raise
+    assert [c.digest() for c in reopened.history("doc")] == \
+        [c.digest() for c in commits]
+
+    # a torn MIDDLE line is corruption and must still fail loudly
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    lines.insert(1, '{"torn": tru')
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        FileSummaryStorage(root)
+
+
 def test_corrupt_chain_reports_missing_commit():
     import pytest
 
