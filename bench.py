@@ -545,8 +545,11 @@ def main() -> None:
         # Environmental failures (a tunnel dying MID-run) skip with rc=0;
         # anything else is a code bug in the bench and must exit nonzero,
         # or a broken benchmark would read as a sick environment forever.
+        # Narrow on purpose: FileNotFoundError/PermissionError etc. are
+        # OSError subclasses but indicate bench bugs, not a sick tunnel.
         environmental = (
-            isinstance(exc, (OSError, TimeoutError, jax.errors.JaxRuntimeError))
+            isinstance(exc, (ConnectionError, TimeoutError,
+                             jax.errors.JaxRuntimeError))
             or (isinstance(exc, RuntimeError)
                 and ("backend" in str(exc).lower()
                      or "UNAVAILABLE" in str(exc)))

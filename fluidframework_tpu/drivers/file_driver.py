@@ -28,31 +28,13 @@ from ..protocol.summary import (
     SummaryStorage,
     SummaryTree,
 )
+from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 from ..service.oplog import OpLog
 from ..service.orderer import LocalOrderingService
 from .local_driver import LocalDocumentServiceFactory
 
 
-def _iter_jsonl(path: str):
-    """Yield records; a torn FINAL line (crash mid-append) is dropped so
-    the store reopens losing only the last record.  A torn line anywhere
-    else still raises — that is corruption, not a torn append."""
-    if not os.path.exists(path):
-        return
-    pending = None  # one-line lookahead keeps the read streaming
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            if pending is not None:
-                yield json.loads(pending)  # a torn NON-final line raises
-            pending = line
-    if pending is not None:
-        try:
-            yield json.loads(pending)
-        except json.JSONDecodeError:
-            return
+_iter_jsonl = iter_jsonl_tolerant
 
 
 def _append_jsonl(path: str, rec: dict) -> None:
@@ -81,6 +63,11 @@ class FileSummaryStorage(SummaryStorage):
         self._commits_path = os.path.join(root, "commits.jsonl")
         self._refs_path = os.path.join(root, "refs.jsonl")
         os.makedirs(self._objects_dir, exist_ok=True)
+        # Repair crash-torn tails BEFORE appends resume: without this the
+        # next append merges onto a torn line, silently losing the new
+        # record on the following reopen (review r4 finding).
+        repair_jsonl_tail(self._commits_path)
+        repair_jsonl_tail(self._refs_path)
         for rec in _iter_jsonl(self._commits_path):
             # Rebuild the commit chain.  Old-format records carry no
             # "parent" field: chain them linearly onto the doc's rebuilt

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from ..protocol.messages import SequencedMessage
 from ..protocol.summary import canonical_json
+from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 
 
 class OpLog:
@@ -38,16 +39,16 @@ class OpLog:
         self._autoflush = autoflush
         self._file: Optional[io.TextIOWrapper] = None
         if path is not None:
-            if os.path.exists(path):
-                with open(path, "r", encoding="utf-8") as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        rec = json.loads(line)
-                        self._docs.setdefault(rec["doc"], []).append(
-                            SequencedMessage.from_dict(rec["msg"])
-                        )
+            # The op log is the highest-write-rate file in the store: a
+            # crash mid-append is likeliest here.  Repair the torn tail
+            # (losing only the unacked final record) before reading or
+            # appending, or the reopen would raise / the next append
+            # would merge onto the partial line.
+            repair_jsonl_tail(path)
+            for rec in iter_jsonl_tolerant(path):
+                self._docs.setdefault(rec["doc"], []).append(
+                    SequencedMessage.from_dict(rec["msg"])
+                )
             self._file = open(path, "a", encoding="utf-8")
 
     # -- write side (the scriptorium lambda) -----------------------------------

@@ -170,22 +170,26 @@ class OrderingServer:
         if tenant is None:
             return
         memo: dict = {}
-        grants = self.service.handle_tenants
+        digests: list = []
 
         def walk(node):
             from ..protocol.summary import SummaryTree
 
-            digest = node.digest(memo) if isinstance(node, SummaryTree) \
-                else node.digest()
-            grants.setdefault(digest, set()).add(tenant)
+            digests.append(node.digest(memo) if isinstance(node, SummaryTree)
+                           else node.digest())
             if isinstance(node, SummaryTree):
                 for child in node.children.values():
                     walk(child)
 
-        # Executor threads (OFFLOADED_METHODS) mutate the grant map
-        # concurrently with event-loop dispatches (ADVICE r3).
+        # Hash OUTSIDE the lock (digest() is pure over immutable nodes);
+        # the lock covers only the dict updates — executor threads
+        # (OFFLOADED_METHODS) mutate the grant map concurrently with
+        # event-loop dispatches (ADVICE r3).
+        walk(tree)
+        grants = self.service.handle_tenants
         with self.service.state_lock:
-            walk(tree)
+            for digest in digests:
+                grants.setdefault(digest, set()).add(tenant)
 
     def _check_readable(self, handle: str, tenant: Optional[str]) -> None:
         if self.tenants is None:

@@ -175,6 +175,17 @@ def test_torn_trailing_line_reopens_losing_only_last_record(tmp_path):
     assert [c.digest() for c in reopened.history("doc")] == \
         [c.digest() for c in commits]
 
+    # CRITICAL: reopen must have REPAIRED the torn tail, so an append
+    # cannot merge onto the partial line — the appended commit must
+    # survive the next reopen (review r4: without repair the ack'd
+    # upload silently vanished and a second append corrupted the store).
+    from fluidframework_tpu.protocol.summary import SummaryBlob, SummaryTree
+    tree = SummaryTree(children={"post": SummaryBlob(b"post-crash")})
+    reopened.upload("doc", tree, ref_seq=99)
+    reopened2 = FileSummaryStorage(root)
+    assert len(reopened2.history("doc")) == len(commits) + 1
+    assert reopened2.latest("doc")[0].digest() == tree.digest()
+
     # a torn MIDDLE line is corruption and must still fail loudly
     with open(path, "r", encoding="utf-8") as f:
         lines = f.read().splitlines()
@@ -183,6 +194,41 @@ def test_torn_trailing_line_reopens_losing_only_last_record(tmp_path):
         f.write("\n".join(lines) + "\n")
     with pytest.raises(json.JSONDecodeError):
         FileSummaryStorage(root)
+
+
+def test_oplog_torn_tail_reopens_and_appends_durably(tmp_path):
+    """The op log (highest write rate in the store) gets the same torn-
+    tail repair: reopen loses only the unacked final record, and the next
+    append lands on a clean line."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.service.oplog import OpLog
+
+    path = str(tmp_path / "ops.jsonl")
+
+    def op(seq):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=0, type=MessageType.OP, contents={"n": seq},
+        )
+
+    log = OpLog(path)
+    for seq in (1, 2, 3):
+        log.append("doc", op(seq))
+    log.flush()
+    log.close() if hasattr(log, "close") else None
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"doc": "doc", "msg": {"se')  # crash mid-append
+
+    log2 = OpLog(path)  # must not raise; torn record dropped
+    assert [m.seq for m in log2.get("doc")] == [1, 2, 3]
+    log2.append("doc", op(4))
+    log2.flush()
+
+    log3 = OpLog(path)
+    assert [m.seq for m in log3.get("doc")] == [1, 2, 3, 4]
 
 
 def test_corrupt_chain_reports_missing_commit():
