@@ -907,14 +907,27 @@ class MergeTreeOracle:
                 survivors.append(seg)
         self.segments = survivors
 
-    def normalized_records(self) -> List[dict]:
+    def normalized_records(self, return_keys: bool = False):
         """Canonical record list for summaries: sequenced state only, seqs at
         or below min_seq clamped to the universal epoch (0 / no client),
         window-expired tombstones dropped, adjacent identical runs merged.
         Both the oracle and the device kernel summary paths emit exactly this,
-        which is what makes byte-identity checkable."""
+        which is what makes byte-identity checkable.
+
+        ``return_keys=True`` additionally returns ATTRIBUTION KEYS — for
+        each emitted record whose seq got CLAMPED, the pre-clamp insert
+        seqs of its merged sub-runs as ``[record_idx, [[chars, seq], ...]]``
+        entries (seq 0 = unknown) — without touching the record bytes.
+        The clamp deliberately erases seqs from the body; the keys ride a
+        separate optional summary blob so attribution survives the window
+        (SURVEY §1 layer 8).  Per-sub-run lengths matter: a merged run can
+        span DIFFERENT authors, and one key per record would attribute one
+        user's text to another after a load (review r4)."""
         msn = self.min_seq
         records: List[dict] = []
+        # Per emitted record: [[chars, pre-clamp seq], ...] for clamped
+        # records, None for unclamped ones (their seq is in the body).
+        run_keys: List[Optional[List[list]]] = []
         for seg in self.segments:
             if seg.insert_seq == UNASSIGNED_SEQ:
                 continue  # pending local: not part of the sequenced state
@@ -957,9 +970,24 @@ class MergeTreeOracle:
                     and prev.get("p") == rec.get("p")
                 ):
                     prev["t"] += rec["t"]
+                    runs = run_keys[-1]
+                    if runs is not None:
+                        if runs[-1][1] == seg.insert_seq:
+                            runs[-1][0] += len(rec["t"])  # same author run
+                        else:
+                            runs.append([len(rec["t"]), seg.insert_seq])
                     continue
             records.append(rec)
-        return records
+            run_keys.append(
+                [[len(rec["t"]), seg.insert_seq]] if rec["s"] == 0 else None
+            )
+        if not return_keys:
+            return records
+        keys = [
+            [i, runs] for i, runs in enumerate(run_keys)
+            if runs is not None and any(seq for _chars, seq in runs)
+        ]
+        return records, keys
 
     def load_records(self, records: List[dict], seq: int, min_seq: int) -> None:
         self.segments = []

@@ -21,7 +21,19 @@ from typing import Any, Deque, Dict, Optional
 from ..protocol.messages import UNASSIGNED_SEQ, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from .intervals import IntervalCollection
-from .merge_tree import MergeTreeOracle, SegmentGroup, NO_CLIENT
+from .merge_tree import MergeTreeOracle, Segment, SegmentGroup, NO_CLIENT
+
+
+def _segment_like(seg: Segment, text: str, insert_seq: int) -> Segment:
+    """A copy of a loaded segment covering ``text`` with a restored insert
+    seq — used to split merged-run records back into per-author runs."""
+    piece = Segment(text, insert_seq, seg.insert_client,
+                    dict(seg.props) if seg.props else None)
+    piece.removed_seq = seg.removed_seq
+    piece.removed_client = seg.removed_client
+    piece.ob_stamps = dict(seg.ob_stamps)
+    piece.overlap_removers = set(seg.overlap_removers)
+    return piece
 from .shared_object import SharedObject
 
 
@@ -132,6 +144,29 @@ class SharedString(SharedObject):
         self._emit("sequenceDelta",
                    {"kind": "annotate", "start": start, "end": end,
                     "props": props}, local=True)
+
+    # -- attribution (SURVEY §1 layer 8) ---------------------------------------
+
+    def seq_at(self, pos: int) -> Optional[int]:
+        """Insert seq of the segment covering visible position ``pos`` in
+        the local view (None for out-of-range or a still-pending local
+        insert)."""
+        client = self._local_client()
+        ref_seq = self.tree.current_seq
+        c = 0
+        for seg in self.tree.segments:
+            v = self.tree._visible_len(seg, ref_seq, client)
+            if v and pos < c + v:
+                return None if seg.insert_seq == UNASSIGNED_SEQ \
+                    else seg.insert_seq
+            c += v
+        return None
+
+    def attribution_at(self, pos: int) -> Optional[dict]:
+        """Who inserted the character at ``pos``, and when:
+        ``{"user", "timestamp", "seq"}`` via the container attributor
+        (None when detached or unattributed)."""
+        return self._attribution(self.seq_at(pos))
 
     # -- interval collections (north-star config #3) ---------------------------
 
@@ -444,7 +479,19 @@ class SharedString(SharedObject):
         }
         tree = SummaryTree()
         tree.add_blob("header", canonical_json(header))
-        tree.add_blob("body", canonical_json(self.tree.normalized_records()))
+        if self._attributor is not None:
+            # Attribution-enabled containers: record the clamped records'
+            # pre-clamp insert seqs (per merged sub-run) in a SEPARATE
+            # blob (body bytes stay kernel-identical); load() restores the
+            # keys so attribution_at survives the window clamp.
+            records, keys = self.tree.normalized_records(return_keys=True)
+            tree.add_blob("body", canonical_json(records))
+            if keys:
+                tree.add_blob("attribution", canonical_json(keys))
+        else:
+            tree.add_blob(
+                "body", canonical_json(self.tree.normalized_records())
+            )
         intervals = {
             label: coll.summary_obj()
             for label, coll in sorted(self._interval_collections.items())
@@ -458,6 +505,28 @@ class SharedString(SharedObject):
         header = json.loads(summary.blob_bytes("header"))
         records = json.loads(summary.blob_bytes("body"))
         self.tree.load_records(records, header["seq"], header["minSeq"])
+        if "attribution" in summary.children:
+            # Restore pre-clamp insert seqs (semantically equivalent to the
+            # epoch clamp: a seq <= the loaded minSeq satisfies every
+            # visibility/expiry rule identically) so attribution_at keeps
+            # resolving on content below the window.  A record merged from
+            # multiple authors' runs is SPLIT back so each run carries its
+            # own seq — the clamped forms still match, so a re-summarize
+            # re-merges to identical body bytes.
+            keys = json.loads(summary.blob_bytes("attribution"))
+            for idx, runs in sorted(keys, reverse=True):
+                seg = self.tree.segments[idx]
+                if seg.insert_seq != 0:
+                    continue  # body already carried the seq
+                pieces, off = [], 0
+                for chars, seq in runs:
+                    piece = _segment_like(seg, seg.text[off:off + chars],
+                                          seq or 0)
+                    pieces.append(piece)
+                    off += chars
+                if off != len(seg.text):  # malformed keys: keep unsplit
+                    continue
+                self.tree.segments[idx:idx + 1] = pieces
         self._pending_groups.clear()
         self._interval_collections = {}
         try:

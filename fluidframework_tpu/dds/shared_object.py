@@ -41,6 +41,18 @@ class SharedObject:
     #: the ChannelFactory registry (the plugin boundary).
     TYPE: str = "shared-object"
 
+    #: container-level Attributor (seq -> user/timestamp), wired by the
+    #: datastore on attach; None when standalone (mocks, bare DDS tests).
+    _attributor = None
+
+    def _attribution(self, seq) -> "Optional[dict]":
+        """Resolve a seq stamp to ``{"user", "timestamp", "seq"}`` via the
+        container attributor; None when detached from a container or the
+        seq predates attribution (SURVEY §1 layer 8)."""
+        if self._attributor is None or seq is None:
+            return None
+        return self._attributor.get(seq)
+
     def __init__(self, object_id: str) -> None:
         self.id = object_id
         self.client_id: Optional[str] = None

@@ -557,6 +557,16 @@ class SharedTree(SharedObject):
         view = self.view
         return view.contains(node_id) and view.is_visible(node_id)
 
+    def attribution_of(self, node_id: str,
+                       kind: str = "insert") -> Optional[dict]:
+        """Who created (``kind='insert'``, incl. last move) or last wrote
+        the value of (``kind='value'``) a node, resolved through the
+        container attributor (SURVEY §1 layer 8); None when detached,
+        unattributed, or the stamp is still pending."""
+        node = self.view.node(node_id)
+        seq = node.insert_seq if kind == "insert" else node.value_seq
+        return self._attribution(seq if seq > 0 else None)
+
     def to_obj(self, node_id: str = ROOT_ID) -> Any:
         """Nested plain-object view of the visible tree (tests/debugging)."""
         view = self.view
@@ -815,6 +825,36 @@ class SharedTree(SharedObject):
         if limbo:
             root_obj["limbo"] = limbo
         tree.add_blob("header", canonical_json(root_obj))
+        if self._attributor is not None:
+            # Attribution-enabled containers: pre-clamp (insert, value)
+            # seqs per node in a SEPARATE blob — header bytes stay
+            # kernel-identical; load() restores them so attribution_of
+            # survives the window clamp (SURVEY §1 layer 8).  Keys cover
+            # only nodes the summary actually EMITS (a kept node under an
+            # expired-tombstone ancestor is dropped with its subtree and
+            # must not leave an orphan key).
+            emitted: set = set()
+
+            def collect(node_obj: dict) -> None:
+                emitted.add(node_obj["id"])
+                for children in node_obj.get("fields", {}).values():
+                    for child in children:
+                        collect(child)
+
+            for children in root_obj.get("fields", {}).values():
+                for child in children:
+                    collect(child)
+            for spec in root_obj.get("limbo", []):
+                collect(spec)
+            keys = {
+                nid: [n.insert_seq, n.value_seq]
+                for nid, n in sorted(self.seq_forest.nodes.items())
+                if nid in emitted
+                and (0 < n.insert_seq <= min_seq
+                     or 0 < n.value_seq <= min_seq)
+            }
+            if keys:
+                tree.add_blob("attribution", canonical_json(keys))
         return tree
 
     def _summary_fields(self, node_id: str, min_seq: int) -> dict:
@@ -867,6 +907,19 @@ class SharedTree(SharedObject):
         for spec in obj.get("limbo", []):
             self._load_node(spec, ROOT_ID, "")
             self.seq_forest.node(spec["id"]).parent = None  # detached
+        if "attribution" in summary.children:
+            # Restore pre-clamp seqs (equivalent under every visibility
+            # rule: a seq <= the loaded minSeq reads as universally
+            # visible either way).
+            for nid, (ins, val) in json.loads(
+                    summary.blob_bytes("attribution")).items():
+                n = self.seq_forest.nodes.get(nid)
+                if n is None:
+                    continue
+                if ins and n.insert_seq == 0:
+                    n.insert_seq = ins
+                if val and n.value_seq == 0:
+                    n.value_seq = val
         self.discard_pending()
         self._invalidate()
 

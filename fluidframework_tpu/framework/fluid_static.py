@@ -85,8 +85,13 @@ class FluidClient:
 
     def __init__(self, driver_factory,
                  registry: Optional[ChannelRegistry] = None,
-                 client_id_prefix: str = "client") -> None:
-        self.loader = Loader(driver_factory, registry)
+                 client_id_prefix: str = "client",
+                 runtime_options=None) -> None:
+        """``runtime_options`` (ContainerRuntimeOptions) reaches every
+        runtime this client creates — e.g. ``attribution=True`` stamps
+        created documents as attribution-enabled."""
+        self.loader = Loader(driver_factory, registry,
+                             runtime_options=runtime_options)
         self._prefix = client_id_prefix
 
     def _next_client_id(self) -> str:

@@ -194,13 +194,15 @@ class Loader:
 
     def __init__(self, factory,
                  registry: Optional[ChannelRegistry] = None,
-                 mc: Optional[MonitoringContext] = None) -> None:
+                 mc: Optional[MonitoringContext] = None,
+                 runtime_options=None) -> None:
         self.factory = factory
         self.registry = registry
+        self.runtime_options = runtime_options
         self.mc = (mc or MonitoringContext()).child("loader")
 
     def _new_runtime(self) -> ContainerRuntime:
-        return ContainerRuntime(self.registry)
+        return ContainerRuntime(self.registry, options=self.runtime_options)
 
     # -- create (attach flow) --------------------------------------------------
 

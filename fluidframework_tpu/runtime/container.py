@@ -33,6 +33,7 @@ import base64
 from ..protocol.messages import MessageType, RawOperation, SequencedMessage
 from ..protocol.quorum import QuorumProposals
 from ..protocol.summary import SummaryTree, canonical_json
+from .attributor import Attributor
 from .blobs import BlobManager
 from .datastore import FluidDataStoreRuntime
 from .gc import GarbageCollector, GCOptions
@@ -57,6 +58,11 @@ class ContainerRuntimeOptions:
     #: split encoded batches into chunks below this many bytes
     chunk_size: int = 768 * 1024
     gc: GCOptions = dataclasses.field(default_factory=GCOptions)
+    #: op attribution (SURVEY §1 layer 8, upstream enableRuntimeAttribution):
+    #: a DOCUMENT-level choice stamped into .metadata at creation so every
+    #: replica agrees — mixed on/off replicas would diverge on summary
+    #: bytes.  Loading adopts the document's stamp over this option.
+    attribution: bool = False
 
 
 class OrderedClientElection:
@@ -119,6 +125,12 @@ class ContainerRuntime:
         # Distributed id compression: locals mint free; creation ranges
         # ride outbound batches and finalize identically on every client.
         self.id_compressor = IdCompressor()
+        # Op attribution (SURVEY §1 layer 8): seq -> (user, timestamp),
+        # summarized columnar, resolved from DDS reads via seq stamps.
+        # Enabled per-DOCUMENT (options at create; the .metadata stamp on
+        # load) — see ContainerRuntimeOptions.attribution.
+        self.attribution_enabled = self.options.attribution
+        self.attributor = Attributor()
         self.blob_manager = BlobManager(self)
         self.gc = GarbageCollector(self, self.options.gc)
         self._chunks = ChunkReassembler()
@@ -378,6 +390,11 @@ class ContainerRuntime:
                 contents = self._chunks.feed(msg.client_id, contents)
             else:
                 contents = maybe_decompress(contents)
+        # Attribute AFTER chunk reassembly: only the final chunk's seq is
+        # ever stamped on DDS state — recording partial-chunk seqs would
+        # store rows nothing can resolve, in every future summary.
+        if self.attribution_enabled and contents is not None:
+            self.attributor.observe(msg)
         if msg.type is MessageType.OP and isinstance(contents, dict) \
                 and contents.get("type") == "groupedBatch":
             check_batch_version(contents)
@@ -516,15 +533,20 @@ class ContainerRuntime:
     SUMMARY_FORMAT_VERSION = 1
 
     @staticmethod
-    def container_metadata(seq: int, min_seq: int) -> dict:
+    def container_metadata(seq: int, min_seq: int,
+                           attribution: bool = False) -> dict:
         """The .metadata blob content — ONE construction point shared with
         the catch-up service (their root digests must stay identical)."""
-        return {"seq": seq, "minSeq": min_seq,
+        meta = {"seq": seq, "minSeq": min_seq,
                 "format": ContainerRuntime.SUMMARY_FORMAT_VERSION}
+        if attribution:
+            meta["attribution"] = True  # absent = off (legacy bytes stable)
+        return meta
 
     def summarize(self) -> SummaryTree:
         tree = SummaryTree()
-        meta = self.container_metadata(self.ref_seq, self.min_seq)
+        meta = self.container_metadata(self.ref_seq, self.min_seq,
+                                       attribution=self.attribution_enabled)
         tree.add_blob(".metadata", canonical_json(meta))
         # Protocol state: quorum membership + propose/accept state (new
         # pre-summary JOINs — the log below the summary is collectible).
@@ -537,6 +559,10 @@ class ContainerRuntime:
         tree.add_blob(
             ".idCompressor", canonical_json(self.id_compressor.serialize())
         )
+        if self.attribution_enabled:
+            tree.add_blob(
+                ".attribution", canonical_json(self.attributor.serialize())
+            )
         ds_summaries = {
             ds_id: self.datastores[ds_id].summarize(self.min_seq)
             for ds_id in sorted(self.datastores)
@@ -577,6 +603,15 @@ class ContainerRuntime:
             self.id_compressor = IdCompressor.deserialize(
                 json.loads(summary.blob_bytes(".idCompressor"))
             )
+        # The DOCUMENT decides attribution (metadata stamp beats local
+        # options — mixed on/off replicas would diverge on summary bytes).
+        # Missing blob = a pre-attribution or attribution-off summary:
+        # start empty (reads on older content return None, never lie).
+        self.attribution_enabled = bool(meta.get("attribution", False))
+        self.attributor = Attributor.deserialize(
+            json.loads(summary.blob_bytes(".attribution"))
+            if ".attribution" in summary.children else None
+        )
         if ".gc" in summary.children:
             self.gc.load_state(json.loads(summary.blob_bytes(".gc")))
         if ".blobs" in summary.children:

@@ -93,6 +93,14 @@ class FluidDataStoreRuntime:
         return self.channels[channel_id]
 
     def _connect_channel(self, channel: SharedObject) -> None:
+        if self._container is not None and \
+                getattr(self._container, "attribution_enabled", False):
+            # Attribution resolver: DDS reads translate their seq stamps
+            # (segment insert seqs, tree node seqs) to (user, timestamp)
+            # through the container-level attributor.  Only wired on
+            # attribution-enabled documents — the wiring also gates the
+            # channels' attribution summary blobs.
+            channel._attributor = self._container.attributor
         if self._container is not None and self._container.client_id:
             channel.connect(
                 ChannelDeltaConnection(self, channel.id),

@@ -252,6 +252,16 @@ class CatchupService:
         # GC/blob state must be trivially foldable host-side.
         if not _gc_state_empty(work.summary):
             return None
+        try:
+            meta = json.loads(work.summary.blob_bytes(".metadata"))
+        except KeyError:
+            meta = {}
+        if meta.get("attribution"):
+            # Attribution-enabled documents fold on the CPU path: the real
+            # runtime propagates the .metadata stamp, the folded seq table,
+            # and the channels' attribution-key blobs — the device export
+            # does not carry attribution keys (yet).
+            return None
         for _msg, batch in work.decoded:
             if any("runtime" in sub for sub in batch["ops"]):
                 return None  # blob/ds/channel attaches, sweeps: CPU path

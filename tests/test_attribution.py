@@ -1,0 +1,273 @@
+"""Op attribution (SURVEY §1 layer 8): seq -> (user, timestamp) recorded at
+the container runtime, serialized columnar into summaries, resolved from
+SharedString / SharedTree reads, surviving summarize/load round-trips."""
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime.attributor import Attributor
+from fluidframework_tpu.runtime.container import ContainerRuntimeOptions
+from fluidframework_tpu.service import LocalOrderingService
+
+
+def make_stack():
+    """Attribution is a per-DOCUMENT opt-in (upstream
+    enableRuntimeAttribution): creators pass the option; loaders adopt the
+    document's .metadata stamp regardless of their own options."""
+    service = LocalOrderingService()
+    return service, Loader(
+        LocalDocumentServiceFactory(service),
+        runtime_options=ContainerRuntimeOptions(attribution=True),
+    )
+
+
+def build(rt):
+    ds = rt.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+    ds.create_channel("tree-tpu", "tree")
+
+
+def test_attribution_resolves_users_on_string_reads():
+    _service, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    b = loader.resolve("doc", client_id="bob")
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+
+    ta.insert_text(0, "aaa")
+    a.runtime.flush()
+    a.drain(), b.drain()
+    tb.insert_text(3, "BBB")
+    b.runtime.flush()
+    a.drain(), b.drain()
+
+    assert ta.text == "aaaBBB"
+    attr_a = ta.attribution_at(0)
+    attr_b = ta.attribution_at(4)
+    assert attr_a["user"] == "alice"
+    assert attr_b["user"] == "bob"
+    # Sequencer clock is monotone: bob's edit is later.
+    assert attr_b["timestamp"] >= attr_a["timestamp"]
+    assert attr_b["seq"] > attr_a["seq"]
+    # Both replicas resolve identically.
+    assert tb.attribution_at(0) == attr_a
+    assert tb.attribution_at(4) == attr_b
+
+
+def test_attribution_survives_summary_load_round_trip():
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tree_a = a.runtime.get_datastore("ds").get_channel("tree")
+    ta.insert_text(0, "hello")
+    node_ids = tree_a.insert("", "a", 0, [tree_a.build("n", value=1)])
+    a.runtime.flush()
+    a.drain()
+    tree_a.set_value(node_ids[0], 42)
+    a.runtime.flush()
+    a.drain()
+
+    # Summarize at head: the catch-up client loads ONLY the summary (no
+    # tail replay below it), so any attribution it resolves came through
+    # the .attribution blob.
+    service.storage.upload("doc", a.runtime.summarize(),
+                           ref_seq=a.runtime.ref_seq)
+    c = loader.resolve("doc", client_id="carol")
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    tree_c = c.runtime.get_datastore("ds").get_channel("tree")
+    assert tc.text == "hello"
+    assert tc.attribution_at(2)["user"] == "alice"
+    nid = tree_c.children("", "a")[0]
+    assert tree_c.attribution_of(nid)["user"] == "alice"
+    value_attr = tree_c.attribution_of(nid, kind="value")
+    assert value_attr["user"] == "alice"
+    assert value_attr["seq"] > tree_c.attribution_of(nid)["seq"]
+
+
+def test_pending_local_insert_unattributed_until_ack():
+    _service, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "x")
+    # Not flushed/drained: the segment's insert seq is still UNASSIGNED.
+    assert ta.attribution_at(0) is None
+    a.runtime.flush()
+    a.drain()
+    assert ta.attribution_at(0)["user"] == "alice"
+
+
+def test_detached_channel_attribution_is_none():
+    from fluidframework_tpu.dds.sequence import SharedString
+
+    s = SharedString("standalone")
+    s.insert_text(0, "free")
+    assert s.attribution_at(0) is None
+
+
+def test_attributor_columnar_round_trip_and_idempotence():
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    att = Attributor()
+    for seq, client, ts in ((3, "a", 10), (5, "b", 11), (9, "a", 15)):
+        att.observe(SequencedMessage(
+            seq=seq, client_id=client, client_seq=seq, ref_seq=seq - 1,
+            min_seq=0, type=MessageType.OP, contents={}, timestamp=ts,
+        ))
+    # replay overlap is ignored; non-op and server messages are ignored
+    att.observe(SequencedMessage(
+        seq=9, client_id="c", client_seq=9, ref_seq=8, min_seq=0,
+        type=MessageType.OP, contents={}, timestamp=99.0,
+    ))
+    att.observe(SequencedMessage(
+        seq=10, client_id=None, client_seq=0, ref_seq=9, min_seq=0,
+        type=MessageType.OP, contents={}, timestamp=99.0,
+    ))
+    att.observe(SequencedMessage(
+        seq=11, client_id="a", client_seq=10, ref_seq=9, min_seq=0,
+        type=MessageType.JOIN, contents={"clientId": "a"}, timestamp=99.0,
+    ))
+    assert len(att) == 3
+    assert att.get(5) == {"user": "b", "timestamp": 11, "seq": 5}
+    assert att.get(4) is None
+
+    state = att.serialize()
+    # deltas keep the payload small ints
+    assert state["seqD"] == [3, 2, 4]
+    assert state["tsD"] == [10, 1, 4]
+    back = Attributor.deserialize(state)
+    assert back.get(3) == att.get(3)
+    assert back.get(9) == att.get(9)
+    assert Attributor.deserialize(None).get(3) is None
+
+
+def test_document_stamp_beats_loader_options():
+    """A loader WITHOUT the attribution option still adopts the document's
+    .metadata stamp — attribution is uniform per document, never mixed."""
+    service, loader_on = make_stack()
+    a = loader_on.create("doc", "alice", build)
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "hi")
+    a.runtime.flush()
+    a.drain()
+    service.storage.upload("doc", a.runtime.summarize(),
+                           ref_seq=a.runtime.ref_seq)
+
+    plain = Loader(LocalDocumentServiceFactory(service))  # no option
+    c = plain.resolve("doc", client_id="carol")
+    assert c.runtime.attribution_enabled
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    assert tc.attribution_at(0)["user"] == "alice"
+    # and its own summaries keep the stamp + blob
+    s = c.runtime.summarize()
+    import json
+    assert json.loads(s.blob_bytes(".metadata"))["attribution"] is True
+    assert ".attribution" in s.children
+
+
+def test_attribution_off_documents_emit_no_attribution_bytes():
+    """Legacy/off documents: byte-stable summaries — no .attribution blob,
+    no channel attribution blobs, no metadata stamp (the golden contract)."""
+    import json
+
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+    a = loader.create("doc", "alice", build)
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "plain")
+    a.runtime.flush()
+    a.drain()
+    s = a.runtime.summarize()
+    assert ".attribution" not in s.children
+    assert "attribution" not in json.loads(s.blob_bytes(".metadata"))
+    string_summary = s.get(".datastores").get("ds").get("text")
+    assert "attribution" not in string_summary.children
+    assert ta.attribution_at(0) is None
+
+
+def test_catchup_service_preserves_attribution():
+    """The bulk catch-up service routes attribution-enabled documents to
+    the CPU fold, whose composed summary preserves the stamp, the seq
+    table, and the channel key blobs — a client loading the service
+    summary still resolves attribution."""
+    import json
+
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "served")
+    a.runtime.flush()
+    a.drain()
+
+    svc = CatchupService(service)
+    svc.catch_up()
+    assert svc.cpu_docs == 1  # attribution doc routed to the CPU fold
+
+    tree, _seq = service.storage.latest("doc")
+    assert json.loads(tree.blob_bytes(".metadata"))["attribution"] is True
+    assert ".attribution" in tree.children
+
+    c = loader.resolve("doc", client_id="carol")
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    assert tc.attribution_at(0)["user"] == "alice"
+
+
+def test_merged_run_split_preserves_per_author_attribution():
+    """Two authors' adjacent text whose seqs fall below the window clamps
+    to identical records and MERGES in the summary body; the run-length
+    key blob must split it back on load so neither author's text reads as
+    the other's (review r4: one key per record mis-attributed the second
+    author)."""
+    import json
+
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build)
+    b = loader.resolve("doc", client_id="bob")
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+
+    ta.insert_text(0, "foo")
+    a.runtime.flush()
+    a.drain(), b.drain()
+    tb.insert_text(3, "bar")
+    b.runtime.flush()
+    a.drain(), b.drain()
+    # Advance the window past both inserts: later traffic from both
+    # clients raises the MSN above the first two seqs.
+    for k in range(3):
+        ta.insert_text(len(ta.text), ".")
+        a.runtime.flush()
+        a.drain(), b.drain()
+        tb.insert_text(len(tb.text), "!")
+        b.runtime.flush()
+        a.drain(), b.drain()
+    assert ta.text == tb.text
+
+    summary = a.runtime.summarize()
+    string_summary = summary.get(".datastores").get("ds").get("text")
+    body = json.loads(string_summary.blob_bytes("body"))
+    merged = [rec for rec in body if "foo" in rec["t"] and "bar" in rec["t"]]
+    assert merged, (
+        "test setup must produce a merged foo+bar record; body=%r" % body
+    )
+
+    service.storage.upload("doc", summary, ref_seq=a.runtime.ref_seq)
+    c = loader.resolve("doc", client_id="carol")
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    assert tc.text == ta.text
+    assert tc.attribution_at(0)["user"] == "alice"   # 'f' of foo
+    assert tc.attribution_at(2)["user"] == "alice"   # 'o' of foo
+    assert tc.attribution_at(3)["user"] == "bob"     # 'b' of bar
+    assert tc.attribution_at(5)["user"] == "bob"     # 'r' of bar
+    # and carol's own re-summarize reproduces alice's string BODY bytes
+    # exactly — the split runs re-merge under the clamp (the container
+    # digests legitimately differ by carol's own JOIN advancing the seq)
+    carol_string = c.runtime.summarize().get(".datastores").get("ds") \
+        .get("text")
+    assert carol_string.blob_bytes("body") == \
+        string_summary.blob_bytes("body")
+    assert carol_string.blob_bytes("attribution") == \
+        string_summary.blob_bytes("attribution")
