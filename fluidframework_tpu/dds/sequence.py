@@ -22,6 +22,7 @@ from ..protocol.messages import UNASSIGNED_SEQ, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from .intervals import IntervalCollection
 from .merge_tree import MergeTreeOracle, Segment, SegmentGroup, NO_CLIENT
+from .shared_object import SharedObject
 
 
 def _segment_like(seg: Segment, text: str, insert_seq: int) -> Segment:
@@ -34,7 +35,6 @@ def _segment_like(seg: Segment, text: str, insert_seq: int) -> Segment:
     piece.ob_stamps = dict(seg.ob_stamps)
     piece.overlap_removers = set(seg.overlap_removers)
     return piece
-from .shared_object import SharedObject
 
 
 class SharedString(SharedObject):

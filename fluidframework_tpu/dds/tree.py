@@ -563,7 +563,10 @@ class SharedTree(SharedObject):
         the value of (``kind='value'``) a node, resolved through the
         container attributor (SURVEY §1 layer 8); None when detached,
         unattributed, or the stamp is still pending."""
-        node = self.view.node(node_id)
+        view = self.view
+        if not view.contains(node_id):
+            return None  # stale/garbage id or window-dropped subtree
+        node = view.node(node_id)
         seq = node.insert_seq if kind == "insert" else node.value_seq
         return self._attribution(seq if seq > 0 else None)
 

@@ -459,7 +459,19 @@ def main(argv=None) -> None:
         help="persist the op log AND summary store under this directory "
              "(documents survive server restarts)",
     )
+    parser.add_argument(
+        "--platform", default=None,
+        help="pin the jax platform for the device catch-up path (e.g. "
+             "'cpu').  Must be applied before the first backend use: a "
+             "site-forced accelerator platform with an unhealthy tunnel "
+             "would HANG the catchup RPC, and the env var alone loses to "
+             "sitecustomize",
+    )
     args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     oplog = storage = None
     if args.dir:

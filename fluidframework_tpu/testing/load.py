@@ -195,3 +195,130 @@ def run_load(spec: LoadSpec) -> LoadResult:
             o.sequencer.nacks_issued for o in service._orderers.values()
         ),
     )
+
+
+# --- wire soak: many docs through the standalone server's catchup RPC --------
+
+
+def _soak_doc_name(i: int) -> str:
+    return f"soak{i:05d}"
+
+
+#: channel mix per doc index — all four kernel types cross the device path
+_SOAK_KINDS = ("string", "map", "matrix", "tree", "string+map")
+
+
+def _soak_build(kind: str):
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        if kind in ("string", "string+map"):
+            ds.create_channel("sequence-tpu", "text")
+        if kind in ("map", "string+map"):
+            ds.create_channel("map-tpu", "kv")
+        if kind == "matrix":
+            ds.create_channel("matrix-tpu", "mx")
+        if kind == "tree":
+            ds.create_channel("tree-tpu", "tr")
+
+    return build
+
+
+def _soak_edit(container, kind: str, rng: random.Random,
+               edits: int) -> None:
+    ds = container.runtime.get_datastore("ds")
+    for _ in range(edits):
+        if kind in ("string", "string+map"):
+            text = ds.get_channel("text")
+            n = len(text.text)
+            r = rng.random()
+            if n < 4 or r < 0.6:
+                text.insert_text(rng.randint(0, n),
+                                 rng.choice("abcdef") * rng.randint(1, 4))
+            elif r < 0.85 or kind == "string":
+                start = rng.randint(0, n - 2)
+                text.remove_range(start, min(n, start + 2))
+            else:
+                ds.get_channel("kv").set(f"k{rng.randint(0, 9)}",
+                                         rng.randint(0, 99))
+        elif kind == "map":
+            ds.get_channel("kv").set(f"k{rng.randint(0, 9)}",
+                                     rng.randint(0, 99))
+        elif kind == "matrix":
+            mx = ds.get_channel("mx")
+            if mx.row_count == 0 or mx.col_count == 0:
+                mx.insert_rows(0, 2)
+                mx.insert_cols(0, 2)
+            else:
+                mx.set_cell(rng.randrange(mx.row_count),
+                            rng.randrange(mx.col_count),
+                            rng.randint(0, 99))
+        else:  # tree
+            tr = ds.get_channel("tr")
+            kids = tr.children("", "a")
+            if not kids or rng.random() < 0.6:
+                tr.insert("", "a", rng.randint(0, len(kids)),
+                          [tr.build("n", value=rng.randint(0, 99))])
+            else:
+                tr.set_value(rng.choice(kids), rng.randint(0, 99))
+
+
+def wire_soak_worker(host: str, port: int, lo: int, hi: int,
+                     edits_per_doc: int, seed: int) -> Dict[str, str]:
+    """Seed docs [lo, hi) against a running standalone server over TCP;
+    returns {doc_id: expected summary digest} (the seeder's drained-to-head
+    summarize — what a post-catchup fresh load must reproduce)."""
+    import time
+
+    from ..drivers.network_driver import NetworkDocumentServiceFactory
+
+    factory = NetworkDocumentServiceFactory(host=host, port=port)
+    out: Dict[str, str] = {}
+    try:
+        loader = Loader(factory)
+        for i in range(lo, hi):
+            doc_id = _soak_doc_name(i)
+            kind = _SOAK_KINDS[i % len(_SOAK_KINDS)]
+            rng = random.Random(seed * 7919 + i)
+            c = loader.create(doc_id, f"seeder{i}", _soak_build(kind))
+            _soak_edit(c, kind, rng, edits_per_doc)
+            c.runtime.flush()
+            head = factory.resolve(doc_id).delta_storage.head()
+            deadline = time.time() + 30
+            while time.time() < deadline and c.runtime.ref_seq < head:
+                c.drain()
+                time.sleep(0.005)
+            c.drain()
+            c.close()  # LEAVE advances the head past the seeder's view...
+            # ...so the expected digest comes from a READ-ONLY load (no
+            # JOIN) at the quiesced head — exactly what a post-catchup
+            # fresh read-only load must reproduce byte-identically.
+            ro = loader.resolve(doc_id)
+            out[doc_id] = ro.runtime.summarize().digest()
+            ro.close()
+        return out
+    finally:
+        factory.close()
+
+
+def main() -> None:
+    """Subprocess entry: ``python -m fluidframework_tpu.testing.load
+    --wire-worker HOST PORT LO HI EDITS SEED`` prints one JSON object of
+    {doc_id: digest}."""
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--wire-worker", nargs=6, metavar=(
+        "HOST", "PORT", "LO", "HI", "EDITS", "SEED"))
+    args = p.parse_args()
+    if args.wire_worker:
+        host, port, lo, hi, edits, seed = args.wire_worker
+        digests = wire_soak_worker(host, int(port), int(lo), int(hi),
+                                   int(edits), int(seed))
+        json.dump(digests, sys.stdout)
+        sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    main()
