@@ -63,6 +63,25 @@ class FileSummaryStorage(SummaryStorage):
         self._commits_path = os.path.join(root, "commits.jsonl")
         self._refs_path = os.path.join(root, "refs.jsonl")
         os.makedirs(self._objects_dir, exist_ok=True)
+        # Persist the storage epoch: a reopened store keeps its generation;
+        # a wiped/recreated directory mints a new one (odsp EpochTracker).
+        # Written ATOMICALLY (temp + rename), and an empty file — a crash
+        # between create and write — is rewritten rather than silently
+        # minting a fresh epoch on every restart.
+        epoch_path = os.path.join(root, "epoch")
+        stored = ""
+        if os.path.exists(epoch_path):
+            with open(epoch_path, "r", encoding="utf-8") as f:
+                stored = f.read().strip()
+        if stored:
+            self.epoch = stored
+        else:
+            tmp_path = epoch_path + ".tmp"
+            with open(tmp_path, "w", encoding="utf-8") as f:
+                f.write(self.epoch)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_path, epoch_path)
         # Repair crash-torn tails BEFORE appends resume: without this the
         # next append merges onto a torn line, silently losing the new
         # record on the following reopen (review r4 finding).

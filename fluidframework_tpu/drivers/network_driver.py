@@ -34,6 +34,17 @@ class RpcError(RuntimeError):
     """Server-side error surfaced to the caller."""
 
 
+class EpochMismatchError(RpcError):
+    """The server's storage generation changed under us (store recreated):
+    every cached snapshot/delta this client holds is from a dead
+    generation and must not be mixed with the new one (odsp EpochTracker
+    capability).  Callers must reload the document from scratch."""
+
+    def __init__(self, message: str, server_epoch: Optional[str]) -> None:
+        super().__init__(message)
+        self.server_epoch = server_epoch
+
+
 class _RpcClient:
     """Shared framed-JSON socket with response routing + event dispatch."""
 
@@ -137,6 +148,11 @@ class _RpcClient:
                 raise NackError(nack.get("reason", "nacked"),
                                 retry_after=nack.get("retryAfter", 0.0),
                                 code=nack.get("code", "throttled"))
+            if frame.get("code") == "epochMismatch":
+                raise EpochMismatchError(
+                    frame.get("error", "storage epoch mismatch"),
+                    frame.get("epoch"),
+                )
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
 
@@ -278,21 +294,44 @@ class _RemoteStorage:
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
         self._snapshot_cache: "dict[str, SummaryTree]" = {}
+        #: storage generation this connection's caches are pinned to
+        #: (odsp EpochTracker): adopted from the first latest() response,
+        #: sent on every storage RPC thereafter — a recreated store
+        #: answers epochMismatch instead of silently serving a snapshot
+        #: our cached deltas/handles cannot be mixed with.
+        self._epoch: Optional[str] = None
 
     def _remember(self, handle: str, tree: SummaryTree) -> None:
         self._snapshot_cache[handle] = tree
         while len(self._snapshot_cache) > self.CACHE_LIMIT:
             self._snapshot_cache.pop(next(iter(self._snapshot_cache)))
 
+    def _epoch_request(self, method: str, params: dict):
+        if self._epoch is not None:
+            params["epoch"] = self._epoch
+        try:
+            return self._rpc.request(method, params)
+        except EpochMismatchError:
+            # Dead generation: everything cached is unusable.  Drop it all
+            # and re-raise loudly — the caller must reload from scratch.
+            self._snapshot_cache.clear()
+            self._last_uploaded = None
+            self._epoch = None
+            raise
+
     def latest(self, at_or_below: Optional[int] = None):
-        result = self._rpc.request(
+        result = self._epoch_request(
             "latest_summary",
             {"doc": self.doc_id, "at_or_below": at_or_below,
              "have": list(self._snapshot_cache)},
         )
         if result is None:
             return None, 0
+        if self._epoch is None:
+            self._epoch = result.get("epoch")
         handle = result.get("handle")
+        if handle is None:
+            return None, 0  # no summary yet — but the epoch is adopted
         if "summary" in result:
             tree = tree_from_obj(result["summary"])
             if handle:
@@ -308,21 +347,26 @@ class _RemoteStorage:
 
         obj = tree_to_incremental_obj(tree, self._last_uploaded)
         try:
-            handle = self._rpc.request(
+            result = self._epoch_request(
                 "upload_summary",
                 {"doc": self.doc_id, "summary": obj, "ref_seq": ref_seq},
             )
+        except EpochMismatchError:
+            raise  # dead generation: NEVER fall back to a full resend
         except RpcError:
             if self._last_uploaded is None:
                 raise
             # The server no longer has the base objects (restore/eviction):
             # resend in full and stop assuming the cache.
             self._last_uploaded = None
-            handle = self._rpc.request(
+            result = self._epoch_request(
                 "upload_summary",
                 {"doc": self.doc_id, "summary": tree_to_obj(tree),
                  "ref_seq": ref_seq},
             )
+        handle = result["handle"]
+        if self._epoch is None:
+            self._epoch = result.get("epoch")  # writer path adopts too
         self._last_uploaded = tree
         self._remember(handle, tree)
         return handle
@@ -331,7 +375,7 @@ class _RemoteStorage:
         cached = self._snapshot_cache.get(handle)
         if cached is not None:
             return cached
-        tree = tree_from_obj(self._rpc.request(
+        tree = tree_from_obj(self._epoch_request(
             "read_summary", {"handle": handle}
         ))
         self._remember(handle, tree)
@@ -341,7 +385,7 @@ class _RemoteStorage:
         """Partial snapshot fetch: one subtree/blob by path — the odsp
         snapshot-virtualization capability (bounded download for huge
         documents)."""
-        return tree_from_obj(self._rpc.request(
+        return tree_from_obj(self._epoch_request(
             "read_summary", {"handle": handle, "path": path}
         ))
 

@@ -147,6 +147,14 @@ class SummaryStorage:
     DEFAULT_REF = "main"
 
     def __init__(self) -> None:
+        import uuid
+
+        #: storage GENERATION token (odsp EpochTracker capability,
+        #: SURVEY §2.4): changes when the store is recreated; clients pin
+        #: it so cached snapshots/deltas from a previous generation can
+        #: never silently mix with a new one.  File-backed stores persist
+        #: it (restart = same epoch; a wiped/recreated dir = new epoch).
+        self.epoch: str = uuid.uuid4().hex
         self._objects: Dict[str, Union[SummaryTree, SummaryBlob]] = {}
         self._commit_objects: Dict[str, SummaryCommit] = {}
         self._refs: Dict[str, Dict[str, str]] = {}  # doc -> ref -> commit

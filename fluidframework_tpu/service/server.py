@@ -44,6 +44,20 @@ from .orderer import LocalOrderingService
 #: methods _handle runs on an executor thread instead of the event loop:
 #: bulk device folds and storage mutations that hold the commit-chain lock
 #: across (possibly file-backed) writes.
+class EpochMismatch(Exception):
+    """A storage request pinned to a DIFFERENT storage generation (odsp
+    EpochTracker capability): the client's cached snapshots/deltas came
+    from a store that no longer exists — fail loudly, never mix."""
+
+    def __init__(self, client_epoch: str, server_epoch: str) -> None:
+        super().__init__(
+            f"storage epoch mismatch: client pinned {client_epoch!r}, "
+            f"server is {server_epoch!r} (the store was recreated; cached "
+            f"state is from a dead generation)"
+        )
+        self.server_epoch = server_epoch
+
+
 #: Methods offloaded to executor threads.  Shared-state discipline: lazy
 #: endpoint/orderer creation and the handle-grant map are guarded by
 #: ``service.state_lock``; oplog READS during an offloaded fold rely on the
@@ -191,6 +205,12 @@ class OrderingServer:
             for digest in digests:
                 grants.setdefault(digest, set()).add(tenant)
 
+    def _check_epoch(self, params: dict) -> None:
+        client_epoch = params.get("epoch")
+        server_epoch = self.service.storage.epoch
+        if client_epoch is not None and client_epoch != server_epoch:
+            raise EpochMismatch(client_epoch, server_epoch)
+
     def _check_readable(self, handle: str, tenant: Optional[str]) -> None:
         if self.tenants is None:
             return
@@ -319,20 +339,28 @@ class OrderingServer:
                 "cpuDocs": stats.get("cpuDocs", 0),
             }
         if method == "latest_summary":
+            self._check_epoch(params)
+            epoch = service.storage.epoch
             tree, ref_seq = service.storage.latest(
                 params["doc"], at_or_below=params.get("at_or_below")
             )
             if tree is None:
-                return None
+                # Still carry the epoch: a CREATING client must adopt the
+                # generation before its first upload, or its caches go
+                # unpinned and the EpochTracker protection is inactive
+                # for the writer path (review r4).
+                return {"handle": None, "ref_seq": 0, "epoch": epoch}
             handle = tree.digest()
             self._grant_tree(tree, session.tenant)
             if handle in (params.get("have") or []):
                 # Client-side snapshot cache hit: the body never crosses
                 # the wire (odsp-driver caching capability).
-                return {"handle": handle, "ref_seq": ref_seq}
+                return {"handle": handle, "ref_seq": ref_seq,
+                        "epoch": epoch}
             return {"handle": handle, "summary": tree_to_obj(tree),
-                    "ref_seq": ref_seq}
+                    "ref_seq": ref_seq, "epoch": epoch}
         if method == "upload_summary":
+            self._check_epoch(params)
             # Incremental upload: {"h": ...} nodes resolve against the
             # server store (unchanged subtrees never cross the wire) —
             # but only handles this tenant may read (a foreign handle
@@ -342,8 +370,9 @@ class OrderingServer:
                 params["doc"], params["summary"], params["ref_seq"],
             )
             self._grant_tree(service.storage.read(handle), session.tenant)
-            return handle
+            return {"handle": handle, "epoch": service.storage.epoch}
         if method == "read_summary":
+            self._check_epoch(params)
             # Handles are content-addressed and global; scope reads to
             # granted tenants or snapshots would leak across tenants.
             self._check_readable(params["handle"], session.tenant)
@@ -396,6 +425,12 @@ class OrderingServer:
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
                                     "ok": True, "result": result}
+                    except EpochMismatch as em:
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": False, "error": str(em),
+                                    "code": "epochMismatch",
+                                    "epoch": em.server_epoch}
                     except NackError as nack:
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),

@@ -537,3 +537,99 @@ def test_server_catchup_respects_tenancy():
         fa.close()
         if fb is not None:
             fb.close()
+
+
+# --- odsp-parity epoch tracking (SURVEY §2.4 EpochTracker) --------------------
+
+
+def test_epoch_adopted_and_stable_across_server_restart(tmp_path):
+    """The storage epoch is a PERSISTED generation token: clients adopt it
+    from the first latest() and a clean restart over the same --dir keeps
+    it, so pinned requests keep working."""
+    from fluidframework_tpu.drivers.file_driver import FileSummaryStorage
+
+    store = str(tmp_path / "store")
+    s1 = FileSummaryStorage(store)
+    s2 = FileSummaryStorage(store)  # reopen: same generation
+    assert s1.epoch == s2.epoch
+
+
+def test_stale_epoch_partial_fetch_fails_loudly():
+    """A client whose caches are pinned to a dead storage generation must
+    get a LOUD epochMismatch on any storage RPC — never a silently served
+    snapshot its cached deltas/handles cannot be mixed with."""
+    from fluidframework_tpu.drivers.network_driver import (
+        EpochMismatchError,
+    )
+
+    srv = OrderingServer(port=0)
+    srv.start_in_thread()
+    factory = NetworkDocumentServiceFactory(port=srv.port)
+    try:
+        loader = Loader(factory)
+
+        def build(rt):
+            rt.create_datastore("ds").create_channel("sequence-tpu", "text")
+
+        c = loader.create("doc", "alice", build)
+        text = c.runtime.get_datastore("ds").get_channel("text")
+        text.insert_text(0, "generation one")
+        c.drain()
+        storage = factory.resolve("doc").storage
+        tree, _seq = storage.latest()          # adopt the epoch + cache
+        assert storage._epoch == srv.service.storage.epoch
+        handle = tree.digest()
+
+        # The store is RECREATED (document wiped and reseeded): new epoch.
+        from fluidframework_tpu.protocol.summary import SummaryStorage
+
+        fresh = SummaryStorage()
+        assert fresh.epoch != srv.service.storage.epoch
+        old_handles = dict(srv.service.handle_tenants)
+        srv.service.storage = fresh
+        srv.service.handle_tenants.update(old_handles)
+        seeder = Loader(NetworkDocumentServiceFactory(port=srv.port))
+        c2 = seeder.create("doc2", "bob", build)
+        c2.runtime.get_datastore("ds").get_channel("text") \
+            .insert_text(0, "generation two")
+        c2.drain()
+
+        # Every pinned storage RPC fails LOUDLY, and the caches are
+        # dropped so a reload starts clean.
+        with pytest.raises(EpochMismatchError):
+            storage.latest()
+        assert storage._epoch is None and not storage._snapshot_cache
+        # after the loud failure an UNPINNED request re-pins cleanly: the
+        # old generation's doc simply doesn't exist in the fresh store —
+        # a full reload is the only path forward, never cache mixing
+        tree_after, _ = storage.latest()
+        assert tree_after is None
+        assert handle not in storage._snapshot_cache
+    finally:
+        factory.close()
+
+
+def test_writer_path_adopts_epoch_on_upload():
+    """A creating client (no summary fetched yet) adopts the generation
+    from its first upload response, so its caches are pinned too."""
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+
+    srv = OrderingServer(port=0)
+    srv.start_in_thread()
+    factory = NetworkDocumentServiceFactory(port=srv.port)
+    try:
+        rt = ContainerRuntime()
+        rt.create_datastore("ds").create_channel("sequence-tpu", "t")
+        svc = factory.create_document("doc", rt.summarize())
+        storage = svc.storage
+        assert storage._epoch is None  # fresh connection: unpinned
+        storage.upload(rt.summarize(), ref_seq=0)
+        assert storage._epoch == srv.service.storage.epoch
+        # and the no-summary latest() on a brand-new doc pins as well
+        svc2 = factory.create_document("doc2", rt.summarize())
+        st2 = svc2.storage
+        st2._snapshot_cache.clear()
+        tree, _ = st2.latest()
+        assert st2._epoch == srv.service.storage.epoch
+    finally:
+        factory.close()

@@ -196,6 +196,13 @@ def test_wire_soak_1k_docs_through_catchup_rpc(tmp_path):
                 port = int(line.rsplit(":", 1)[-1].strip())
                 break
         assert port, "server did not report a port"
+        # Keep draining the merged stdout/stderr pipe: server logging
+        # under 1k-doc load could otherwise fill the OS pipe buffer and
+        # block the event loop (deadlocking the whole soak).
+        import threading
+
+        threading.Thread(target=lambda: [None for _ in srv.stdout],
+                         daemon=True).start()
 
         t0 = time.time()
         per = n_docs // procs
