@@ -61,7 +61,7 @@ from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", "10240"))
 OPS_PER_DOC = int(os.environ.get("BENCH_OPS", "96"))
-CPU_SAMPLE_DOCS = int(os.environ.get("BENCH_CPU_SAMPLE", "64"))
+CPU_SAMPLE_DOCS = int(os.environ.get("BENCH_CPU_SAMPLE", "256"))
 # Documents fold in fixed-size chunks: one compiled shape reused across
 # dispatches, bounded per-transfer sizes, and the dispatch/compute balance
 # measured best at 1024 docs/chunk on v5e (larger single batches degrade
@@ -146,21 +146,114 @@ METRIC_NAME = "sharedstring_catchup_replay_ops_per_sec"
 BENCH_DEADLINE_SEC = float(os.environ.get("BENCH_DEADLINE", "2700"))
 
 
-def _emit_skip(reason: str, detail: dict | None = None) -> None:
+def _emit_skip(reason: str, detail: dict | None = None,
+               metric: str = METRIC_NAME,
+               base: dict | None = None) -> None:
     """The one JSON line for a run that could not produce a number.
 
     Keeps the driver artifact parseable (VERDICT r3 item 2): rc=0, same
     metric name, explicit ``skipped`` marker plus whatever diagnostics were
     gathered before the failure."""
-    line = {
-        "metric": METRIC_NAME,
-        "value": None,
-        "unit": "ops/sec",
-        "vs_baseline": None,
-        "skipped": reason,
-    }
+    line = {"metric": metric}
+    line.update(base if base is not None
+                else {"value": None, "unit": "ops/sec",
+                      "vs_baseline": None})
+    line["skipped"] = reason
     line.update(detail or {})
     print(json.dumps(line), flush=True)
+
+
+def run_hardened(metric: str, run_fn, deadline: float,
+                 skip_base: dict | None = None) -> None:
+    """Environment-hardened bench entry shared by bench.py and
+    tools/bench_configs.py: exactly ONE JSON line reaches stdout, always.
+
+    - dead backend → ``skipped: backend-unavailable``, rc 0;
+    - wall-clock past ``deadline`` (mid-run tunnel wedge) → watchdog emits
+      ``skipped: deadline-exceeded`` and hard-exits 0;
+    - AssertionError (byte-identity broken) → ``correctness-failure``,
+      rc 1 — a wrong-bytes run must never read as a sick environment;
+    - other exceptions → ``runtime-error`` rc 0 when environmental
+      (connection/jax/backend), else ``bench-bug`` rc 1.
+
+    ``run_fn(probe) -> dict`` RETURNS the success line's payload instead
+    of printing it: emission happens here under one lock shared with the
+    watchdog, so a late-firing timer can never double-print or flip a
+    nonzero exit into 0."""
+    probe = _backend_probe()
+    if not probe["ok"]:
+        print(f"backend probe FAILED: {probe}", file=sys.stderr)
+        _emit_skip(
+            "backend-unavailable",
+            {"probe": {k: v for k, v in probe.items() if k != "ok"}},
+            metric=metric, base=skip_base,
+        )
+        return
+    print(f"backend probe: {probe}", file=sys.stderr)
+    if os.environ.get("FF_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["FF_BENCH_PLATFORM"])
+
+    lock = threading.Lock()
+    spoken = [False]
+
+    def _say(fn) -> bool:
+        """Run one emission exactly once across main thread + watchdog."""
+        with lock:
+            if spoken[0]:
+                return False
+            spoken[0] = True
+            fn()
+            return True
+
+    def _deadline() -> None:
+        if _say(lambda: _emit_skip(
+                "deadline-exceeded",
+                {"probe": probe, "deadline_sec": deadline},
+                metric=metric, base=skip_base)):
+            print(f"BENCH DEADLINE ({deadline:.0f}s) exceeded", file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(0)
+
+    watchdog = threading.Timer(deadline, _deadline)
+    watchdog.daemon = True
+    watchdog.start()
+    rc = 0
+    try:
+        result = run_fn(probe)
+        _say(lambda: print(json.dumps(result), flush=True))
+    except AssertionError:
+        import traceback
+
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr)
+        if _say(lambda: _emit_skip(
+                "correctness-failure", {"probe": probe,
+                                        "error_tail": tb[-800:]},
+                metric=metric, base=skip_base)):
+            rc = 1
+    except Exception as exc:
+        import traceback
+
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr)
+        # Narrow on purpose: FileNotFoundError/PermissionError etc. are
+        # OSError subclasses but indicate bench bugs, not a sick tunnel.
+        environmental = (
+            isinstance(exc, (ConnectionError, TimeoutError,
+                             jax.errors.JaxRuntimeError))
+            or (isinstance(exc, RuntimeError)
+                and ("backend" in str(exc).lower()
+                     or "UNAVAILABLE" in str(exc)))
+        )
+        reason = "runtime-error" if environmental else "bench-bug"
+        if _say(lambda: _emit_skip(reason, {"probe": probe,
+                                            "error_tail": tb[-800:]},
+                                   metric=metric, base=skip_base)):
+            rc = 0 if environmental else 1
+    finally:
+        watchdog.cancel()
+    if rc:
+        sys.exit(rc)
 
 
 def _backend_probe() -> dict:
@@ -492,77 +585,10 @@ def run_e2e(docs):
 
 
 def main() -> None:
-    # --- survive a sick environment: probe the backend in a timeboxed
-    # subprocess BEFORE the parent touches jax; emit a parseable skip line
-    # instead of a stack trace when the tunnel is down (VERDICT r3 #2) ---
-    probe = _backend_probe()
-    if not probe["ok"]:
-        print(f"backend probe FAILED: {probe}", file=sys.stderr)
-        _emit_skip(
-            "backend-unavailable",
-            {"probe": {k: v for k, v in probe.items() if k != "ok"}},
-        )
-        return
-    print(f"backend probe: {probe}", file=sys.stderr)
-    if os.environ.get("FF_BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["FF_BENCH_PLATFORM"])
-
-    # Watchdog: if the run exceeds the deadline (a tunnel that wedges
-    # mid-run hangs d2h fetches indefinitely), print the skip line and
-    # hard-exit so the driver still gets rc=0 + one JSON line.
-    def _deadline() -> None:
-        print(
-            f"BENCH DEADLINE ({BENCH_DEADLINE_SEC:.0f}s) exceeded — "
-            "emitting skip line and exiting", file=sys.stderr,
-        )
-        _emit_skip("deadline-exceeded", {"probe": probe,
-                                         "deadline_sec": BENCH_DEADLINE_SEC})
-        sys.stderr.flush()
-        os._exit(0)
-
-    watchdog = threading.Timer(BENCH_DEADLINE_SEC, _deadline)
-    watchdog.daemon = True
-    watchdog.start()
-    try:
-        _run_bench(probe)
-    except AssertionError:
-        # A correctness failure (device summaries != oracle) is NOT an
-        # environmental skip: emit a parseable line with a distinct reason
-        # but exit nonzero so the driver cannot mistake it for a tunnel
-        # outage.
-        import traceback
-
-        tb = traceback.format_exc()
-        print(tb, file=sys.stderr)
-        _emit_skip("correctness-failure", {"probe": probe,
-                                           "error_tail": tb[-800:]})
-        sys.exit(1)
-    except Exception as exc:
-        import traceback
-
-        tb = traceback.format_exc()
-        print(tb, file=sys.stderr)
-        # Environmental failures (a tunnel dying MID-run) skip with rc=0;
-        # anything else is a code bug in the bench and must exit nonzero,
-        # or a broken benchmark would read as a sick environment forever.
-        # Narrow on purpose: FileNotFoundError/PermissionError etc. are
-        # OSError subclasses but indicate bench bugs, not a sick tunnel.
-        environmental = (
-            isinstance(exc, (ConnectionError, TimeoutError,
-                             jax.errors.JaxRuntimeError))
-            or (isinstance(exc, RuntimeError)
-                and ("backend" in str(exc).lower()
-                     or "UNAVAILABLE" in str(exc)))
-        )
-        reason = "runtime-error" if environmental else "bench-bug"
-        _emit_skip(reason, {"probe": probe, "error_tail": tb[-800:]})
-        if not environmental:
-            sys.exit(1)
-    finally:
-        watchdog.cancel()
+    run_hardened(METRIC_NAME, _run_bench, BENCH_DEADLINE_SEC)
 
 
-def _run_bench(probe: dict) -> None:
+def _run_bench(probe: dict) -> dict:
     _forced_layout_canary()  # before ANY parent-side backend init
     t0 = time.time()
     docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
@@ -668,37 +694,35 @@ def _run_bench(probe: dict) -> None:
         oracle_replay(docs[-1]).summarize().digest()
     print("sanity: device summaries byte-identical to oracle", file=sys.stderr)
 
-    print(
-        json.dumps(
-            {
-                "metric": METRIC_NAME,
-                "backend": probe.get("platform", "unknown"),
-                "forced_layout_disabled": bool(
-                    os.environ.get("FF_NO_FORCED_LAYOUT")
-                ),
-                "value": round(e2e_ops_per_sec, 1),
-                "unit": "ops/sec",
-                "vs_baseline": round(e2e_ops_per_sec / cpu_ops_per_sec, 2),
-                "steady_fold_ops_per_sec": round(fold_ops_per_sec, 1),
-                "steady_fold_vs_baseline": round(
-                    fold_ops_per_sec / cpu_ops_per_sec, 2
-                ),
-                "cpu_baseline_ops_per_sec": round(cpu_ops_per_sec, 1),
-                "roofline": roof,
-                "link": link,
-                "stages_busy_sec": {
-                    "pack": round(stage["pack"], 3),
-                    "fold_dispatch": round(stage["dispatch"], 3),
-                    "download": round(stage["download"], 3),
-                    "extract_summarize": round(stage["extract"], 3),
-                },
-                "end_to_end_sec": round(e2e_time, 3),
-                "oracle_fallback_docs": fallbacks,
-                "n_docs": N_DOCS,
-                "ops_per_doc": OPS_PER_DOC,
-            }
-        )
-    )
+    # Returned (not printed): run_hardened emits exactly one line under
+    # its watchdog lock.
+    return {
+        "metric": METRIC_NAME,
+        "backend": probe.get("platform", "unknown"),
+        "forced_layout_disabled": bool(
+            os.environ.get("FF_NO_FORCED_LAYOUT")
+        ),
+        "value": round(e2e_ops_per_sec, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(e2e_ops_per_sec / cpu_ops_per_sec, 2),
+        "steady_fold_ops_per_sec": round(fold_ops_per_sec, 1),
+        "steady_fold_vs_baseline": round(
+            fold_ops_per_sec / cpu_ops_per_sec, 2
+        ),
+        "cpu_baseline_ops_per_sec": round(cpu_ops_per_sec, 1),
+        "roofline": roof,
+        "link": link,
+        "stages_busy_sec": {
+            "pack": round(stage["pack"], 3),
+            "fold_dispatch": round(stage["dispatch"], 3),
+            "download": round(stage["download"], 3),
+            "extract_summarize": round(stage["extract"], 3),
+        },
+        "end_to_end_sec": round(e2e_time, 3),
+        "oracle_fallback_docs": fallbacks,
+        "n_docs": N_DOCS,
+        "ops_per_doc": OPS_PER_DOC,
+    }
 
 
 if __name__ == "__main__":

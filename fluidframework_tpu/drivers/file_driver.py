@@ -82,6 +82,14 @@ class FileSummaryStorage(SummaryStorage):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp_path, epoch_path)
+            # fsync the DIRECTORY too: the rename itself must be durable,
+            # or a crash could lose the epoch file and a reopen would mint
+            # a new generation for a store whose data survived.
+            dfd = os.open(root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         # Repair crash-torn tails BEFORE appends resume: without this the
         # next append merges onto a torn line, silently losing the new
         # record on the following reopen (review r4 finding).

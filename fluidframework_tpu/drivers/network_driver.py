@@ -59,6 +59,12 @@ class _RpcClient:
         self._events: queue.Queue = queue.Queue()
         self._handlers: Dict[str, List[Callable[[dict], None]]] = {}
         self._closed = False
+        #: storage generation this CONNECTION is pinned to (odsp
+        #: EpochTracker): adopted from the first storage response and then
+        #: attached to EVERY doc/storage request — deltas, submits, and
+        #: catchup included, not just the summary RPCs, so op-stream
+        #: generation mixing fails loudly too.
+        self.epoch: Optional[str] = None
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._dispatcher = threading.Thread(
@@ -125,6 +131,8 @@ class _RpcClient:
             with self._pending_lock:
                 self._pending.pop(rid, None)
             raise RpcError("connection lost")
+        if self.epoch is not None and method not in ("auth", "ping"):
+            params = {**params, "epoch": self.epoch}
         frame = frame_bytes(
             {"v": WIRE_VERSION, "id": rid, "method": method,
              "params": params}
@@ -294,12 +302,18 @@ class _RemoteStorage:
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
         self._snapshot_cache: "dict[str, SummaryTree]" = {}
-        #: storage generation this connection's caches are pinned to
-        #: (odsp EpochTracker): adopted from the first latest() response,
-        #: sent on every storage RPC thereafter — a recreated store
-        #: answers epochMismatch instead of silently serving a snapshot
-        #: our cached deltas/handles cannot be mixed with.
-        self._epoch: Optional[str] = None
+
+    @property
+    def _epoch(self) -> Optional[str]:
+        """The pin lives on the shared _RpcClient so EVERY RPC on this
+        connection (deltas/submit/catchup too) carries it — a recreated
+        store answers epochMismatch instead of silently serving state our
+        cached snapshots/deltas cannot be mixed with."""
+        return self._rpc.epoch
+
+    @_epoch.setter
+    def _epoch(self, value: Optional[str]) -> None:
+        self._rpc.epoch = value
 
     def _remember(self, handle: str, tree: SummaryTree) -> None:
         self._snapshot_cache[handle] = tree
@@ -307,8 +321,6 @@ class _RemoteStorage:
             self._snapshot_cache.pop(next(iter(self._snapshot_cache)))
 
     def _epoch_request(self, method: str, params: dict):
-        if self._epoch is not None:
-            params["epoch"] = self._epoch
         try:
             return self._rpc.request(method, params)
         except EpochMismatchError:

@@ -41,9 +41,6 @@ from ..protocol.wire import LEN as _LEN, MAX_FRAME, WIRE_VERSION, frame_bytes
 from .orderer import LocalOrderingService
 
 
-#: methods _handle runs on an executor thread instead of the event loop:
-#: bulk device folds and storage mutations that hold the commit-chain lock
-#: across (possibly file-backed) writes.
 class EpochMismatch(Exception):
     """A storage request pinned to a DIFFERENT storage generation (odsp
     EpochTracker capability): the client's cached snapshots/deltas came
@@ -245,6 +242,10 @@ class OrderingServer:
             return True
         if method == "ping":
             return "pong"
+        # Generation check for EVERY doc/storage method in one place —
+        # deltas, submits, and catchup included, not just the summary RPCs
+        # (review r4: op-stream generation mixing must fail loudly too).
+        self._check_epoch(params)
         client_doc = params.get("doc")
         if self.tenants is not None:
             if session.tenant is None:
@@ -339,7 +340,6 @@ class OrderingServer:
                 "cpuDocs": stats.get("cpuDocs", 0),
             }
         if method == "latest_summary":
-            self._check_epoch(params)
             epoch = service.storage.epoch
             tree, ref_seq = service.storage.latest(
                 params["doc"], at_or_below=params.get("at_or_below")
@@ -360,7 +360,6 @@ class OrderingServer:
             return {"handle": handle, "summary": tree_to_obj(tree),
                     "ref_seq": ref_seq, "epoch": epoch}
         if method == "upload_summary":
-            self._check_epoch(params)
             # Incremental upload: {"h": ...} nodes resolve against the
             # server store (unchanged subtrees never cross the wire) —
             # but only handles this tenant may read (a foreign handle
@@ -372,7 +371,6 @@ class OrderingServer:
             self._grant_tree(service.storage.read(handle), session.tenant)
             return {"handle": handle, "epoch": service.storage.epoch}
         if method == "read_summary":
-            self._check_epoch(params)
             # Handles are content-addressed and global; scope reads to
             # granted tenants or snapshots would leak across tenants.
             self._check_readable(params["handle"], session.tenant)

@@ -594,8 +594,11 @@ def test_stale_epoch_partial_fetch_fails_loudly():
             .insert_text(0, "generation two")
         c2.drain()
 
-        # Every pinned storage RPC fails LOUDLY, and the caches are
-        # dropped so a reload starts clean.
+        # Every pinned RPC fails LOUDLY — including the OP-STREAM path
+        # (deltas ride the same pinned connection), not just storage —
+        # and the storage caches are dropped so a reload starts clean.
+        with pytest.raises(EpochMismatchError):
+            factory.resolve("doc").delta_storage.get(0)
         with pytest.raises(EpochMismatchError):
             storage.latest()
         assert storage._epoch is None and not storage._snapshot_cache
