@@ -65,6 +65,11 @@ class _RpcClient:
         #: catchup included, not just the summary RPCs, so op-stream
         #: generation mixing fails loudly too.
         self.epoch: Optional[str] = None
+        #: invalidation callbacks (one per _RemoteStorage on this socket):
+        #: an epochMismatch observed on ANY RPC — deltas, submits,
+        #: discovery, storage — drops EVERY instance's caches and the pin,
+        #: centrally, before the error propagates.
+        self._epoch_listeners: List[Callable[[], None]] = []
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._dispatcher = threading.Thread(
@@ -157,6 +162,12 @@ class _RpcClient:
                                 retry_after=nack.get("retryAfter", 0.0),
                                 code=nack.get("code", "throttled"))
             if frame.get("code") == "epochMismatch":
+                # Dead generation: unpin and drop EVERY cache riding this
+                # connection before anyone can retry unpinned against the
+                # new generation with stale state still live.
+                self.epoch = None
+                for invalidate in self._epoch_listeners:
+                    invalidate()
                 raise EpochMismatchError(
                     frame.get("error", "storage epoch mismatch"),
                     frame.get("epoch"),
@@ -302,6 +313,11 @@ class _RemoteStorage:
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
         self._snapshot_cache: "dict[str, SummaryTree]" = {}
+        rpc._epoch_listeners.append(self._drop_caches)
+
+    def _drop_caches(self) -> None:
+        self._snapshot_cache.clear()
+        self._last_uploaded = None
 
     @property
     def _epoch(self) -> Optional[str]:
@@ -320,19 +336,11 @@ class _RemoteStorage:
         while len(self._snapshot_cache) > self.CACHE_LIMIT:
             self._snapshot_cache.pop(next(iter(self._snapshot_cache)))
 
-    def _epoch_request(self, method: str, params: dict):
-        try:
-            return self._rpc.request(method, params)
-        except EpochMismatchError:
-            # Dead generation: everything cached is unusable.  Drop it all
-            # and re-raise loudly — the caller must reload from scratch.
-            self._snapshot_cache.clear()
-            self._last_uploaded = None
-            self._epoch = None
-            raise
-
     def latest(self, at_or_below: Optional[int] = None):
-        result = self._epoch_request(
+        # Epoch mismatch handling is CENTRAL (_RpcClient drops every
+        # instance's caches + the pin before raising), so storage methods
+        # just let EpochMismatchError propagate loudly.
+        result = self._rpc.request(
             "latest_summary",
             {"doc": self.doc_id, "at_or_below": at_or_below,
              "have": list(self._snapshot_cache)},
@@ -359,7 +367,7 @@ class _RemoteStorage:
 
         obj = tree_to_incremental_obj(tree, self._last_uploaded)
         try:
-            result = self._epoch_request(
+            result = self._rpc.request(
                 "upload_summary",
                 {"doc": self.doc_id, "summary": obj, "ref_seq": ref_seq},
             )
@@ -371,7 +379,7 @@ class _RemoteStorage:
             # The server no longer has the base objects (restore/eviction):
             # resend in full and stop assuming the cache.
             self._last_uploaded = None
-            result = self._epoch_request(
+            result = self._rpc.request(
                 "upload_summary",
                 {"doc": self.doc_id, "summary": tree_to_obj(tree),
                  "ref_seq": ref_seq},
@@ -387,7 +395,7 @@ class _RemoteStorage:
         cached = self._snapshot_cache.get(handle)
         if cached is not None:
             return cached
-        tree = tree_from_obj(self._epoch_request(
+        tree = tree_from_obj(self._rpc.request(
             "read_summary", {"handle": handle}
         ))
         self._remember(handle, tree)
@@ -397,7 +405,7 @@ class _RemoteStorage:
         """Partial snapshot fetch: one subtree/blob by path — the odsp
         snapshot-virtualization capability (bounded download for huge
         documents)."""
-        return tree_from_obj(self._epoch_request(
+        return tree_from_obj(self._rpc.request(
             "read_summary", {"handle": handle, "path": path}
         ))
 

@@ -487,14 +487,32 @@ def _fetch_format():
         return None
 
 
+def _fold_fn(mode: str):
+    """The batch fold: the lax.scan path by default; the Pallas
+    VMEM-resident kernel (ops/pallas_fold.py) when FF_PALLAS_FOLD selects
+    it — per-doc state stays on-chip across the whole tail instead of
+    round-tripping HBM every op step (SURVEY §7 hard-part #4).  The pallas
+    import stays inside the branches: the default scan path must not
+    depend on jax.experimental.pallas importability."""
+    if mode in ("tpu", "interpret"):
+        from .pallas_fold import replay_vmapped_pallas
+
+        interpret = mode == "interpret"
+        return lambda state, ops: replay_vmapped_pallas(
+            state, ops, interpret=interpret)
+    return replay_vmapped
+
+
 @functools.lru_cache(maxsize=None)
-def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True):
+def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
+                    fold_mode: str = ""):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch."""
+    fold = _fold_fn(fold_mode)
 
     def f(ops, doc_base):
         return _export_state(
-            replay_vmapped(_cold_start(ops, S), ops), doc_base, i16, ob_rows
+            fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows
         )
 
     fmt = _fetch_format()
@@ -502,12 +520,12 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True):
 
 
 @functools.lru_cache(maxsize=None)
-def _export_warm_fn(i16: bool, ob_rows: bool = True):
+def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = ""):
     """Compiled warm-start (base state uploaded) fold+export."""
+    fold = _fold_fn(fold_mode)
 
     def f(state, ops, doc_base):
-        return _export_state(replay_vmapped(state, ops), doc_base, i16,
-                             ob_rows)
+        return _export_state(fold(state, ops), doc_base, i16, ob_rows)
 
     fmt = _fetch_format()
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
@@ -519,13 +537,16 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     the fused export buffer handle, int16 when the chunk qualifies.  Pass
     ``state=None`` for all-cold chunks (initial state built in-graph — no
     zero upload)."""
+    from .pallas_fold import pallas_fold_mode
+
     i16 = bool(meta.get("i16_ok"))
     ob_rows = bool(meta.get("ob_rows", True))
+    mode = pallas_fold_mode()
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
     if state is None:
-        return _export_cold_fn(int(S), i16, ob_rows)(ops, doc_base)
-    return _export_warm_fn(i16, ob_rows)(state, ops, doc_base)
+        return _export_cold_fn(int(S), i16, ob_rows, mode)(ops, doc_base)
+    return _export_warm_fn(i16, ob_rows, mode)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:

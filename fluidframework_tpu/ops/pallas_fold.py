@@ -1,0 +1,348 @@
+"""Pallas TPU kernel for the merge-tree op-fold (SURVEY §7 hard-part #4).
+
+The XLA ``lax.scan`` fold streams the whole carried state — 12 int32
+``[S]`` columns plus an ``[S, K]`` props plane per document — through HBM
+on every op step: ~``2 * S * (12+K) * 4`` bytes per applied op, the
+roofline bench.py reports against.  A document's entire state is tiny
+(S=256, K=1: ~13 KB), so the TPU-native shape is ONE kernel instance per
+document that loads the state into VMEM once, folds every op of the tail
+with a ``fori_loop``, and writes the final state back once: HBM traffic
+drops from O(T x state) to O(state + ops) and the fold leaves the
+bandwidth roofline entirely.
+
+Semantics are a faithful port of ``mergetree_kernel._apply_op`` /
+``_split_at`` (the canonical scan step), restated Mosaic-conservatively:
+
+- every gather is a roll+select (the step's shifts are shift-right-by-one
+  above an index) or a masked one-hot reduction (single-slot reads);
+- prefix sums are an unrolled Hillis-Steele ladder of masked rolls;
+- first/nearest-slot searches are min/max reductions over masked iotas;
+- all iotas are 2D (``broadcasted_iota``), state rows are ``(1, S)``.
+
+Exact-parity tests (tests/test_pallas_fold.py) pin this port to the
+canonical step on directed + fuzz streams, byte-identical through the
+summary extraction.  CI runs the kernel in interpret mode (pure jax, any
+backend); on real TPU the compiled path is gated behind
+``FF_PALLAS_FOLD=1`` until a healthy-tunnel window lets it be measured
+(BASELINE.md round-4 status).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .mergetree_kernel import (
+    K_ANNOTATE,
+    K_INSERT,
+    K_OBLITERATE,
+    K_REMOVE,
+    MTOps,
+    MTState,
+    NOT_REMOVED,
+    PROP_ABSENT,
+    PROP_NOT_TOUCHED,
+)
+
+_OP_FIELDS = ("kind", "seq", "client", "ref_seq", "min_seq", "a", "b",
+              "tstart", "tlen")
+_COL_FIELDS = ("tstart", "tlen", "ins_seq", "ins_client", "rem_seq",
+               "rem_client", "rem2_seq", "rem2_client", "ob1_seq",
+               "ob1_client", "ob2_seq", "ob2_client")
+
+
+def _iota(S: int) -> jnp.ndarray:
+    return jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+
+
+def _excl_cumsum(v: jnp.ndarray, S: int) -> jnp.ndarray:
+    """Exclusive prefix sum over lanes as a Hillis-Steele ladder of
+    masked rolls (statically unrolled; no native cumsum needed)."""
+    slot = _iota(S)
+    x = v
+    d = 1
+    while d < S:
+        x = x + jnp.where(slot >= d, jnp.roll(x, d, axis=1), 0)
+        d *= 2
+    return x - v
+
+
+def _at(f: jnp.ndarray, slot: jnp.ndarray, idx, valid, default):
+    """f[idx] as a masked one-hot reduction (no gather): exact when
+    ``valid`` (idx names a real slot), ``default`` otherwise."""
+    hit = jnp.sum(jnp.where(slot == idx, f, 0))
+    return jnp.where(valid, hit, jnp.int32(default))
+
+
+def _shift_up_from(f: jnp.ndarray, slot: jnp.ndarray, idx) -> jnp.ndarray:
+    """moved[i] = f[i] for i <= idx else f[i-1] — the pool shift-right a
+    split/insert performs, as roll+select."""
+    return jnp.where(slot <= idx, f, jnp.roll(f, 1, axis=1))
+
+
+def _visible(cols: dict, n, ref_seq, client, S: int) -> jnp.ndarray:
+    slot = _iota(S)
+    active = slot < n
+    ins_vis = (cols["ins_seq"] <= ref_seq) | (cols["ins_client"] == client)
+    rem_vis = (
+        (cols["rem_seq"] <= ref_seq)
+        | (cols["rem_client"] == client)
+        | (cols["rem2_client"] == client)
+    )
+    return jnp.where(active & ins_vis & ~rem_vis, cols["tlen"], 0)
+
+
+def _split_at(cols, props, n, char_pos, ref_seq, client, enable, S):
+    """Port of mergetree_kernel._split_at on (1, S) rows."""
+    slot = _iota(S)
+    v = _visible(cols, n, ref_seq, client, S)
+    cum = _excl_cumsum(v, S)
+    inside = (cum < char_pos) & (char_pos < cum + v)
+    first = jnp.min(jnp.where(inside, slot, S))
+    do = enable & (first < S)
+    idx = first  # unique when present; gated by ``do`` below
+    off = char_pos - _at(cum, slot, idx, do, 0)
+
+    new_cols = {f: _shift_up_from(cols[f], slot, idx) for f in _COL_FIELDS}
+    is_left = slot == idx
+    is_right = slot == idx + 1
+    tlen = new_cols["tlen"]
+    new_cols["tlen"] = jnp.where(
+        is_left, off, jnp.where(is_right, tlen - off, tlen))
+    new_cols["tstart"] = jnp.where(
+        is_right, new_cols["tstart"] + off, new_cols["tstart"])
+    new_props = jnp.where(slot[..., None] <= idx, props,
+                          jnp.roll(props, 1, axis=1))
+
+    cols = {f: jnp.where(do, new_cols[f], cols[f]) for f in _COL_FIELDS}
+    props = jnp.where(do, new_props, props)
+    n = jnp.where(do, n + 1, n)
+    return cols, props, n
+
+
+def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
+    """Port of mergetree_kernel._apply_op on (1, S)/(1, S, K) rows.
+    ``op`` is a dict of scalars; ``pvals`` is the op's (K,) prop values."""
+    ref_seq, client = op["ref_seq"], op["client"]
+    is_ins = op["kind"] == K_INSERT
+    is_rem = op["kind"] == K_REMOVE
+    is_ann = op["kind"] == K_ANNOTATE
+    is_obl = op["kind"] == K_OBLITERATE
+    is_rangey = is_rem | is_ann | is_obl
+
+    cols, props, n = _split_at(cols, props, n, op["a"], ref_seq, client,
+                               is_ins | is_rangey, S)
+    cols, props, n = _split_at(cols, props, n, op["b"], ref_seq, client,
+                               is_rangey, S)
+
+    v = _visible(cols, n, ref_seq, client, S)
+    cum = _excl_cumsum(v, S)
+    slot = _iota(S)
+    active = slot < n
+    msn = op["min_seq"]
+    ob1_live = (cols["ob1_seq"] != NOT_REMOVED) & (cols["ob1_seq"] > msn)
+    ob2_live = (cols["ob2_seq"] != NOT_REMOVED) & (cols["ob2_seq"] > msn)
+    expired = (
+        (cols["rem_seq"] != NOT_REMOVED) & (cols["rem_seq"] <= msn)
+        & (cols["ins_seq"] <= msn) & ~ob1_live & ~ob2_live
+    )
+
+    # --- insert: tie-break = first slot with cum >= pos.
+    can = (cum >= op["a"]) & active
+    jfirst = jnp.min(jnp.where(can, slot, S))
+    j = jnp.where(jfirst < S, jfirst, n)
+
+    # Obliterate-on-arrival neighbor rule.
+    present = active & ~expired
+    left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1))
+    right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S))
+    has_left = left_idx >= 0
+    has_right = right_idx < S
+    l1s = _at(cols["ob1_seq"], slot, left_idx, has_left, NOT_REMOVED)
+    l2s = _at(cols["ob2_seq"], slot, left_idx, has_left, NOT_REMOVED)
+    l1c = _at(cols["ob1_client"], slot, left_idx, has_left, NOT_REMOVED)
+    l2c = _at(cols["ob2_client"], slot, left_idx, has_left, NOT_REMOVED)
+    r1s = _at(cols["ob1_seq"], slot, right_idx, has_right, NOT_REMOVED)
+    r2s = _at(cols["ob2_seq"], slot, right_idx, has_right, NOT_REMOVED)
+
+    def killer_of(ls, lc):
+        shared = (ls != NOT_REMOVED) & ((ls == r1s) | (ls == r2s))
+        ok = shared & (ls > ref_seq) & (lc != client)
+        return jnp.where(ok, ls, jnp.int32(NOT_REMOVED)), lc
+
+    k1s, k1c = killer_of(l1s, l1c)
+    k2s, k2c = killer_of(l2s, l2c)
+    kill_seq = jnp.minimum(k1s, k2s)
+    kill_client = jnp.where(k1s <= k2s, k1c, k2c)
+    killed = kill_seq != NOT_REMOVED
+
+    def shifted(f, newval):
+        return jnp.where(slot == j, newval, _shift_up_from(f, slot, j))
+
+    ins_cols = {
+        "tstart": shifted(cols["tstart"], op["tstart"]),
+        "tlen": shifted(cols["tlen"], op["tlen"]),
+        "ins_seq": shifted(cols["ins_seq"], op["seq"]),
+        "ins_client": shifted(cols["ins_client"], client),
+        "rem_seq": shifted(cols["rem_seq"],
+                           jnp.where(killed, kill_seq, NOT_REMOVED)),
+        "rem_client": shifted(cols["rem_client"],
+                              jnp.where(killed, kill_client, -1)),
+        "rem2_seq": shifted(cols["rem2_seq"], NOT_REMOVED),
+        "rem2_client": shifted(cols["rem2_client"], -1),
+        "ob1_seq": shifted(cols["ob1_seq"],
+                           jnp.where(killed, kill_seq, NOT_REMOVED)),
+        "ob1_client": shifted(cols["ob1_client"],
+                              jnp.where(killed, kill_client, -1)),
+        "ob2_seq": shifted(cols["ob2_seq"], NOT_REMOVED),
+        "ob2_client": shifted(cols["ob2_client"], -1),
+    }
+    ins_pvals = jnp.where(pvals == PROP_NOT_TOUCHED, PROP_ABSENT, pvals)
+    ins_props = jnp.where(
+        (slot == j)[..., None],
+        ins_pvals[None, None, :],
+        jnp.where(slot[..., None] <= j, props, jnp.roll(props, 1, axis=1)),
+    )
+    cols = {f: jnp.where(is_ins, ins_cols[f], cols[f]) for f in _COL_FIELDS}
+    props = jnp.where(is_ins, ins_props, props)
+    n = jnp.where(is_ins, n + 1, n)
+
+    # --- remove / annotate / obliterate over [a, b) in the view.
+    covered = (cum >= op["a"]) & (cum + v <= op["b"]) & (v > 0) & active
+
+    is_rem_like = is_rem | is_obl
+    first_win = covered & (cols["rem_seq"] == NOT_REMOVED) & is_rem_like
+    again = covered & (cols["rem_seq"] != NOT_REMOVED) & is_rem_like
+    second = again & (cols["rem2_seq"] == NOT_REMOVED)
+    third = again & (cols["rem2_seq"] != NOT_REMOVED)
+    obl_zero = active & ~expired & (v == 0) \
+        & (cum > op["a"]) & (cum < op["b"]) & is_obl
+    obl_zero_alive = obl_zero & (cols["rem_seq"] == NOT_REMOVED)
+    first_win = first_win | obl_zero_alive
+    stamp = (covered & is_obl) | obl_zero
+    to_ob1 = stamp & (cols["ob1_seq"] == NOT_REMOVED)
+    to_ob2 = stamp & ~to_ob1 & (cols["ob2_seq"] == NOT_REMOVED) \
+        & (cols["ob1_seq"] != op["seq"])
+    ob_over = stamp & (cols["ob1_seq"] != NOT_REMOVED) \
+        & (cols["ob2_seq"] != NOT_REMOVED) \
+        & (cols["ob1_seq"] != op["seq"]) & (cols["ob2_seq"] != op["seq"])
+    cols = dict(
+        cols,
+        rem_seq=jnp.where(first_win, op["seq"], cols["rem_seq"]),
+        rem_client=jnp.where(first_win, client, cols["rem_client"]),
+        rem2_seq=jnp.where(second, op["seq"], cols["rem2_seq"]),
+        rem2_client=jnp.where(second, client, cols["rem2_client"]),
+        ob1_seq=jnp.where(to_ob1, op["seq"], cols["ob1_seq"]),
+        ob1_client=jnp.where(to_ob1, client, cols["ob1_client"]),
+        ob2_seq=jnp.where(to_ob2, op["seq"], cols["ob2_seq"]),
+        ob2_client=jnp.where(to_ob2, client, cols["ob2_client"]),
+    )
+    overflow = overflow | jnp.any(third) | jnp.any(ob_over)
+
+    touch = (pvals != PROP_NOT_TOUCHED)[None, None, :] \
+        & (covered & is_ann)[..., None]
+    props = jnp.where(touch, jnp.broadcast_to(pvals, props.shape), props)
+    return cols, props, n, overflow
+
+
+def _fold_kernel(S: int, K: int, T: int, *refs):
+    """One document per grid step: state lives in VMEM values across the
+    whole tail."""
+    op_refs = refs[:len(_OP_FIELDS)]
+    pvals_ref = refs[len(_OP_FIELDS)]
+    in_cols = refs[len(_OP_FIELDS) + 1:len(_OP_FIELDS) + 1 + len(_COL_FIELDS)]
+    in_props, in_n, in_over = refs[len(_OP_FIELDS) + 1 + len(_COL_FIELDS):
+                                   len(_OP_FIELDS) + 4 + len(_COL_FIELDS)]
+    outs = refs[len(_OP_FIELDS) + 4 + len(_COL_FIELDS):]
+
+    cols = {f: r[...] for f, r in zip(_COL_FIELDS, in_cols)}
+    props = in_props[...]
+    n = in_n[0, 0]
+    overflow = in_over[0, 0] != 0
+
+    def body(t, carry):
+        cols, props, n, overflow = carry
+        op = {f: r[0, t] for f, r in zip(_OP_FIELDS, op_refs)}
+        pvals = pvals_ref[0, t, :]
+        return _apply_op_rows(cols, props, n, overflow, op, pvals, S, K)
+
+    cols, props, n, overflow = jax.lax.fori_loop(
+        0, T, body, (cols, props, n, overflow))
+
+    for f, r in zip(_COL_FIELDS, outs):
+        r[...] = cols[f]
+    outs[len(_COL_FIELDS)][...] = props
+    outs[len(_COL_FIELDS) + 1][0, 0] = n
+    outs[len(_COL_FIELDS) + 2][0, 0] = overflow.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def replay_vmapped_pallas(state: MTState, ops: MTOps,
+                          interpret: bool = True) -> MTState:
+    """Drop-in replacement for ``replay_vmapped``: same (state, ops)
+    pytrees in, same final MTState out — the fold itself runs as one
+    Pallas program instance per document with VMEM-resident state."""
+    D, S = state.tstart.shape
+    K = state.props.shape[-1]
+    T = ops.kind.shape[1]
+
+    row = pl.BlockSpec((1, S), lambda d: (d, 0))
+    op_row = pl.BlockSpec((1, T), lambda d: (d, 0))
+    props_blk = pl.BlockSpec((1, S, K), lambda d: (d, 0, 0))
+    pvals_blk = pl.BlockSpec((1, T, K), lambda d: (d, 0, 0))
+    scalar = pl.BlockSpec((1, 1), lambda d: (d, 0))
+
+    in_specs = (
+        [op_row] * len(_OP_FIELDS) + [pvals_blk]
+        + [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
+    )
+    out_specs = [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
+    out_shape = (
+        [jax.ShapeDtypeStruct((D, S), jnp.int32)] * len(_COL_FIELDS)
+        + [jax.ShapeDtypeStruct((D, S, K), jnp.int32),
+           jax.ShapeDtypeStruct((D, 1), jnp.int32),
+           jax.ShapeDtypeStruct((D, 1), jnp.int32)]
+    )
+
+    inputs = (
+        [getattr(ops, f).astype(jnp.int32) for f in _OP_FIELDS]
+        + [ops.pvals.astype(jnp.int32)]
+        + [getattr(state, f).astype(jnp.int32) for f in _COL_FIELDS]
+        + [state.props.astype(jnp.int32),
+           state.n.astype(jnp.int32).reshape(D, 1),
+           state.overflow.astype(jnp.int32).reshape(D, 1)]
+    )
+
+    outs = pl.pallas_call(
+        functools.partial(_fold_kernel, S, K, T),
+        grid=(D,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+
+    cols = dict(zip(_COL_FIELDS, outs[:len(_COL_FIELDS)]))
+    return MTState(
+        **cols,
+        props=outs[len(_COL_FIELDS)],
+        n=outs[len(_COL_FIELDS) + 1].reshape(D),
+        overflow=outs[len(_COL_FIELDS) + 2].reshape(D).astype(bool),
+    )
+
+
+def pallas_fold_mode() -> str:
+    """''/off (default), 'interpret', or 'tpu' (compiled Mosaic — gate it
+    until measured on a healthy tunnel)."""
+    import os
+
+    mode = os.environ.get("FF_PALLAS_FOLD", "").lower()
+    if mode in ("1", "tpu", "on"):
+        return "tpu"
+    if mode == "interpret":
+        return "interpret"
+    return ""

@@ -575,7 +575,8 @@ def test_stale_epoch_partial_fetch_fails_loudly():
         text = c.runtime.get_datastore("ds").get_channel("text")
         text.insert_text(0, "generation one")
         c.drain()
-        storage = factory.resolve("doc").storage
+        svc_pinned = factory.resolve("doc")  # resolved while gen-1 lives
+        storage = svc_pinned.storage
         tree, _seq = storage.latest()          # adopt the epoch + cache
         assert storage._epoch == srv.service.storage.epoch
         handle = tree.digest()
@@ -595,10 +596,17 @@ def test_stale_epoch_partial_fetch_fails_loudly():
         c2.drain()
 
         # Every pinned RPC fails LOUDLY — including the OP-STREAM path
-        # (deltas ride the same pinned connection), not just storage —
-        # and the storage caches are dropped so a reload starts clean.
+        # itself: svc_pinned was resolved while gen-1 lived, so the raise
+        # below comes from the actual deltas RPC, not discovery.  The
+        # mismatch drops EVERY cache on the connection (central
+        # invalidation at the rpc client), so the pin AND the snapshot
+        # cache are gone after the FIRST loud failure, whichever path
+        # observed it.
         with pytest.raises(EpochMismatchError):
-            factory.resolve("doc").delta_storage.get(0)
+            svc_pinned.delta_storage.get(0)
+        assert storage._epoch is None and not storage._snapshot_cache
+        # restore the pin to prove storage paths fail loudly too
+        storage._epoch = "stale-" + fresh.epoch
         with pytest.raises(EpochMismatchError):
             storage.latest()
         assert storage._epoch is None and not storage._snapshot_cache
