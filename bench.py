@@ -626,7 +626,10 @@ def _run_bench(probe: dict) -> dict:
     warm_time = time.time() - t0
     print(
         f"compile+first fold {warm_time:.1f}s "
-        f"(S={S}, i16={'yes' if warm_meta['i16_ok'] else 'no'})",
+        f"(S={S}, i16={'yes' if warm_meta['i16_ok'] else 'no'}, "
+        f"i8={'yes' if warm_meta.get('i8_ok') else 'no'}, "
+        f"ob_rows={'yes' if warm_meta.get('ob_rows', True) else 'ELIDED'}, "
+        f"ov_rows={'yes' if warm_meta.get('ov_rows', True) else 'ELIDED'})",
         file=sys.stderr,
     )
 

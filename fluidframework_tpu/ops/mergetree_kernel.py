@@ -372,23 +372,46 @@ EXPORT_SLOT_FIELDS = (
 NON_OB_SLOT_FIELDS = EXPORT_SLOT_FIELDS[:8]
 #: the obliterate rows elided from such exports, with their sentinel fills
 OB_SLOT_FIELDS = EXPORT_SLOT_FIELDS[8:]
-#: rows holding seqs with the NOT_REMOVED sentinel (i16 remap set)
+#: the overlap-remover rows, elided (``meta["ov_rows"]`` False) when the
+#: chunk provably cannot produce a second remover: every op rides a fully
+#: sequential view (ref_seq == seq-1 — an already-removed slot is never
+#: visible, so ``second`` can't fire) and no base record carries "ro"
+OV_SLOT_FIELDS = ("rem2_seq", "rem2_client")
+#: rows holding seqs with the NOT_REMOVED sentinel (narrow remap set)
 SENTINEL_SEQ_FIELDS = ("rem_seq", "rem2_seq", "ob1_seq", "ob2_seq")
 I16_NOT_REMOVED = np.int16(np.iinfo(np.int16).max)
 I16_LIMIT = int(np.iinfo(np.int16).max) - 1  # strict value bound for i16_ok
+#: int8 pair-packing (``meta["i8_ok"]``): when every exported value other
+#: than tstart/misc fits in a signed byte, pairs of slot/prop rows pack
+#: into one int16 lane each — byte rows halve on the wire.
+I8_NOT_REMOVED = np.int32(127)
+I8_LIMIT = 126
+
+
+def _export_fields(ob_rows: bool, ov_rows: bool):
+    fields = list(EXPORT_SLOT_FIELDS if ob_rows else NON_OB_SLOT_FIELDS)
+    if not ov_rows:
+        fields = [f for f in fields if f not in OV_SLOT_FIELDS]
+    return fields
 
 
 def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
-                  i16: bool = False, ob_rows: bool = True) -> jnp.ndarray:
-    """[D, 13+K, S] fused view of everything summary extraction and interval
-    replay need from the final device state (int32, or int16 when ``i16``
-    with per-doc-rebased tstart and remapped NOT_REMOVED sentinels).
+                  i16: bool = False, ob_rows: bool = True,
+                  ov_rows: bool = True, i8: bool = False) -> jnp.ndarray:
+    """[D, rows, S] fused view of everything summary extraction and
+    interval replay need from the final device state (int32, or int16 when
+    ``i16`` with per-doc-rebased tstart and remapped NOT_REMOVED
+    sentinels).
 
-    With ``ob_rows=False`` (the chunk provably contains no obliterate ops
-    or base stamps — pack-time fact) the four obliterate rows are elided
-    from the transfer entirely; ``widen_export`` reinserts their sentinel
-    values host-side.  That is 4 of 12 slot rows off the device→host
-    fetch, the pipeline's measured bottleneck."""
+    Transfer-shrinking layouts, each undone host-side by ``widen_export``
+    (the device→host fetch is the pipeline's measured bottleneck):
+    - ``ob_rows=False``: the four obliterate rows elided (no obliterate
+      ops or base stamps in the chunk — pack-time fact);
+    - ``ov_rows=False``: the two overlap-remover rows elided (fully
+      sequential views + no base "ro" — a second remover cannot occur);
+    - ``i8``: every byte-sized row pairs into one int16 lane
+      (``(a & 0xFF) << 8 | (b & 0xFF)``) — tstart and misc stay 16-bit."""
+    i8 = i8 and i16  # byte packing presupposes the int16 transforms
     D, S = final.tlen.shape
     K = final.props.shape[2]
     slot = jnp.arange(S)[None, :]
@@ -405,18 +428,26 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
     # ``widen_export`` (and export bytes are deterministic).
     tstart = jnp.where(active, final.tstart, 0)
     named = {"tstart": tstart}
-    fields = EXPORT_SLOT_FIELDS if ob_rows else NON_OB_SLOT_FIELDS
+    fields = _export_fields(ob_rows, ov_rows)
     if i16:
         named["tstart"] = jnp.where(active, tstart - doc_base[:, None], 0)
+        sentinel = I8_NOT_REMOVED if i8 else jnp.int32(I16_NOT_REMOVED)
         for f in SENTINEL_SEQ_FIELDS:
             if f not in fields:
                 continue
             val = getattr(final, f)
-            named[f] = jnp.where(
-                val == NOT_REMOVED, jnp.int32(I16_NOT_REMOVED), val
-            )
+            named[f] = jnp.where(val == NOT_REMOVED, sentinel, val)
     rows = [named.get(f, getattr(final, f)) for f in fields]
     rows += [final.props[:, :, k] for k in range(K)]
+    if i8:
+        byte_rows = rows[1:]
+        if len(byte_rows) % 2:
+            byte_rows.append(jnp.zeros((D, S), jnp.int32))
+        packed = [
+            ((byte_rows[i] & 0xFF) << 8) | (byte_rows[i + 1] & 0xFF)
+            for i in range(0, len(byte_rows), 2)
+        ]
+        rows = [rows[0]] + packed
     rows.append(misc)
     out = jnp.stack(rows, axis=1)
     return out.astype(jnp.int16) if i16 else out
@@ -424,23 +455,41 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
 
 def widen_export(export_np: np.ndarray,
                  doc_base: Optional[np.ndarray],
-                 ob_rows: bool = True) -> np.ndarray:
+                 ob_rows: bool = True, ov_rows: bool = True,
+                 i8: bool = False,
+                 n_props: Optional[int] = None) -> np.ndarray:
     """Undo the export transfer transforms host-side, always returning the
-    CANONICAL full int32 layout: widen int16 to int32, restore NOT_REMOVED
-    sentinels, re-add per-doc arena bases, and — for obliterate-free
-    exports (``ob_rows=False``) — reinsert the four elided obliterate rows
-    with their sentinel fills.  Full-layout int32 buffers pass through
-    untouched."""
-    fields = EXPORT_SLOT_FIELDS if ob_rows else NON_OB_SLOT_FIELDS
+    CANONICAL full int32 layout: unpack int8 pairs (``i8`` — needs
+    ``n_props``, the padded props-plane width), widen int16 to int32,
+    restore NOT_REMOVED sentinels, re-add per-doc arena bases, and
+    reinsert elided obliterate/overlap rows with their sentinel fills.
+    Full-layout int32 buffers pass through untouched."""
+    fields = _export_fields(ob_rows, ov_rows)
     if export_np.dtype == np.int32:
         out = export_np
     else:
-        out = export_np.astype(np.int32)
+        if i8:
+            # Unpack byte pairs back into the (elided) int16-equivalent
+            # row layout: [tstart, byte rows..., misc] in field order.
+            assert n_props is not None, "i8 widen needs the props width"
+            u = export_np.astype(np.uint16)
+            n_bytes = len(fields) - 1 + n_props
+            rows = [export_np[:, 0, :].astype(np.int32)]
+            for i in range(n_bytes):
+                pair = u[:, 1 + i // 2, :]
+                half = (pair >> 8) if i % 2 == 0 else (pair & 0xFF)
+                rows.append(half.astype(np.uint8).astype(np.int8)
+                            .astype(np.int32))
+            rows.append(export_np[:, -1, :].astype(np.int32))
+            out = np.stack(rows, axis=1)
+        else:
+            out = export_np.astype(np.int32)
+        sentinel = int(I8_NOT_REMOVED) if i8 else int(I16_NOT_REMOVED)
         for f in SENTINEL_SEQ_FIELDS:
             if f not in fields:
                 continue
             row = out[:, fields.index(f), :]
-            row[row == int(I16_NOT_REMOVED)] = NOT_REMOVED
+            row[row == sentinel] = NOT_REMOVED
         if doc_base is not None:
             # Re-add the per-doc arena base to live slots only (slots
             # beyond n were zeroed on device and must stay zero to match
@@ -450,16 +499,20 @@ def widen_export(export_np: np.ndarray,
             out[:, 0, :] += np.where(
                 active, np.asarray(doc_base, np.int32)[:, None], 0
             )
-    if not ob_rows:
-        D, _R, S = out.shape
-        n_ob = len(OB_SLOT_FIELDS)
-        filler = np.empty((D, n_ob, S), np.int32)
-        for i, f in enumerate(OB_SLOT_FIELDS):
+    def reinsert(buf, fill_fields, split):
+        D, _R, S = buf.shape
+        filler = np.empty((D, len(fill_fields), S), np.int32)
+        for i, f in enumerate(fill_fields):
             filler[:, i, :] = NOT_REMOVED if f.endswith("_seq") else -1
-        split = len(NON_OB_SLOT_FIELDS)
-        out = np.concatenate(
-            [out[:, :split], filler, out[:, split:]], axis=1
+        return np.concatenate(
+            [buf[:, :split], filler, buf[:, split:]], axis=1
         )
+
+    if not ov_rows:
+        out = reinsert(out, OV_SLOT_FIELDS,
+                       fields.index("rem_client") + 1)  # rem2 slots next
+    if not ob_rows:
+        out = reinsert(out, OB_SLOT_FIELDS, len(NON_OB_SLOT_FIELDS))
     return out
 
 
@@ -505,14 +558,16 @@ def _fold_fn(mode: str):
 
 @functools.lru_cache(maxsize=None)
 def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
-                    fold_mode: str = ""):
+                    fold_mode: str = "", ov_rows: bool = True,
+                    i8: bool = False):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch."""
     fold = _fold_fn(fold_mode)
 
     def f(ops, doc_base):
         return _export_state(
-            fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows
+            fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows,
+            ov_rows, i8,
         )
 
     fmt = _fetch_format()
@@ -520,33 +575,59 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
 
 
 @functools.lru_cache(maxsize=None)
-def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = ""):
+def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
+                    ov_rows: bool = True, i8: bool = False):
     """Compiled warm-start (base state uploaded) fold+export."""
     fold = _fold_fn(fold_mode)
 
     def f(state, ops, doc_base):
-        return _export_state(fold(state, ops), doc_base, i16, ob_rows)
+        return _export_state(fold(state, ops), doc_base, i16, ob_rows,
+                             ov_rows, i8)
 
     fmt = _fetch_format()
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
+def export_layout_rows(meta: dict) -> int:
+    """Row count of the transfer buffer replay_export emits for this
+    packed chunk's layout facts (elisions + byte packing)."""
+    _i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    fields = _export_fields(ob_rows, ov_rows)
+    K = meta.get("props_K", 1)
+    if i8:
+        n_bytes = len(fields) - 1 + K
+        return 1 + (n_bytes + 1) // 2 + 1
+    return len(fields) + K + 1
+
+
+def _export_flags(meta: dict):
+    i16 = bool(meta.get("i16_ok"))
+    return (
+        i16,
+        bool(meta.get("ob_rows", True)),
+        bool(meta.get("ov_rows", True)),
+        i16 and bool(meta.get("i8_ok")),
+    )
+
+
 def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
                   S: Optional[int] = None) -> jnp.ndarray:
     """Dispatch the fold+export for a packed chunk (async); the result is
-    the fused export buffer handle, int16 when the chunk qualifies.  Pass
-    ``state=None`` for all-cold chunks (initial state built in-graph — no
-    zero upload)."""
+    the fused export buffer handle, int16 when the chunk qualifies (with
+    obliterate/overlap row elision and int8 pair-packing per the pack-time
+    layout facts).  Pass ``state=None`` for all-cold chunks (initial state
+    built in-graph — no zero upload)."""
     from .pallas_fold import pallas_fold_mode
 
-    i16 = bool(meta.get("i16_ok"))
-    ob_rows = bool(meta.get("ob_rows", True))
+    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
     mode = pallas_fold_mode()
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
     if state is None:
-        return _export_cold_fn(int(S), i16, ob_rows, mode)(ops, doc_base)
-    return _export_warm_fn(i16, ob_rows, mode)(state, ops, doc_base)
+        return _export_cold_fn(int(S), i16, ob_rows, mode, ov_rows,
+                               i8)(ops, doc_base)
+    return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8)(state, ops,
+                                                            doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:
@@ -702,6 +783,8 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
 
     doc_base = np.zeros((D,), np.int32)
     base_has_ob = False
+    base_has_ro = False
+    base_max_tlen = 0
     for d, doc in enumerate(docs):
         pack = doc_packs[d]
         doc_base[d] = len(arena)
@@ -727,8 +810,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                     st["ob2_client"][d, s] = pack.client_idx(ob[1][1])
                 if len(ob) > 2:
                     pack.needs_fallback = True  # device tracks two stamps
+            base_max_tlen = max(base_max_tlen, len(rec["t"]))
             ro = rec.get("ro", [])
             if ro:
+                base_has_ro = True
                 # Second-remover slot is exact for one overlap remover; the
                 # base summary doesn't carry overlap seqs, but any value
                 # below the base seq is faithful (it sequenced before every
@@ -821,12 +906,32 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         max((d.final_seq for d in docs), default=0),
         max((d.base_seq for d in docs), default=0),
     )
+    max_clients = max((len(p.clients) for p in doc_packs), default=0)
     i16_ok = (
         max_seq < I16_LIMIT
         and max_doc_chars < I16_LIMIT
         and S < I16_LIMIT
         and len(values) < I16_LIMIT
-        and max((len(p.clients) for p in doc_packs), default=0) < I16_LIMIT
+        and max_clients < I16_LIMIT
+    )
+    # int8 pair-packing eligibility: every byte-row value (seqs incl. the
+    # remapped sentinel, client/prop ids, segment lengths) fits a signed
+    # byte.  tstart/misc stay 16-bit, so only the byte rows bound this.
+    real_ops = op["kind"] != K_NOOP
+    max_tlen = max(int(op["tlen"].max(initial=0)), base_max_tlen)
+    i8_ok = (
+        i16_ok
+        and max_seq < I8_LIMIT
+        and max_tlen < I8_LIMIT
+        and len(values) < I8_LIMIT
+        and max_clients < I8_LIMIT
+    )
+    # Overlap-remover rows are live only if a second remover can occur:
+    # an op authored against a LAGGING view (ref_seq < seq-1 — an
+    # already-removed slot can still be visible to it), or a base record
+    # carrying overlap removers.  Fully sequential chunks elide them.
+    sequential = not bool(
+        (real_ops & (op["ref_seq"] != op["seq"] - 1)).any()
     )
     meta = {
         "doc_packs": doc_packs,
@@ -836,10 +941,13 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "docs": docs,
         "doc_base": doc_base,
         "i16_ok": i16_ok,
+        "i8_ok": i8_ok,
+        "props_K": K,
         # Export the 4 obliterate rows only when the chunk can touch them
         # (a pack-time fact: an obliterate op anywhere — including C++-
         # filled binary rows, which land in op["kind"] — or a base stamp).
         "ob_rows": base_has_ob or bool((op["kind"] == K_OBLITERATE).any()),
+        "ov_rows": base_has_ro or not sequential,
     }
     return MTState(**st), MTOps(**op), meta
 
@@ -1028,8 +1136,10 @@ def summaries_from_export(meta, export_np: np.ndarray,
 
     docs = meta["docs"]
     D = len(docs)
+    _i16, ob_rows_f, ov_rows_f, i8_f = _export_flags(meta)
     export_np = widen_export(export_np, meta.get("doc_base"),
-                             ob_rows=meta.get("ob_rows", True))
+                             ob_rows=ob_rows_f, ov_rows=ov_rows_f,
+                             i8=i8_f, n_props=meta.get("props_K"))
     state_np = state_dict_from_export(export_np)
     skip = np.zeros(D, np.uint8)
     for d in range(D):

@@ -213,10 +213,23 @@ def test_export_widths_agree_and_widen_roundtrips():
     ex32 = np.asarray(replay_export(None, ops, meta32, S=S))
     assert ex32.dtype == np.int32
     ob = meta["ob_rows"]
-    np.testing.assert_array_equal(
-        widen_export(ex16, meta["doc_base"], ob_rows=ob),
-        widen_export(ex32, None, ob_rows=ob),
-    )
+    from fluidframework_tpu.ops.mergetree_kernel import _export_flags
+
+    _i, ob_f, ov_f, i8_f = _export_flags(meta)
+    w16 = widen_export(ex16, meta["doc_base"], ob_rows=ob_f, ov_rows=ov_f,
+                       i8=i8_f, n_props=meta["props_K"])
+    w32 = widen_export(ex32, None, ob_rows=ob_f, ov_rows=ov_f)
+    if i8_f:
+        # Bit-equality holds for the slots extraction reads ([0, n) per
+        # doc); beyond n the int8 pack truncates dead-slot garbage to 8
+        # bits, so the widths legitimately differ there.
+        n = w32[:, -1, 0]
+        for d in range(w32.shape[0]):
+            np.testing.assert_array_equal(
+                w16[d, :, :n[d]], w32[d, :, :n[d]], err_msg=f"doc {d}"
+            )
+    else:
+        np.testing.assert_array_equal(w16, w32)
     d16 = [s.digest() for s in summaries_from_export(meta, ex16)]
     d32 = [s.digest() for s in summaries_from_export(meta32, ex32)]
     assert d16 == d32
@@ -252,11 +265,16 @@ def test_obliterate_rows_elided_when_chunk_has_none():
              op(2, {"kind": "remove", "start": 1, "end": 3})],
         final_seq=2, final_msn=0,
     )
+    from fluidframework_tpu.ops.mergetree_kernel import export_layout_rows
+
     state, ops, meta = pack_mergetree_batch([plain])
     assert meta["ob_rows"] is False
-    K = len(meta["prop_keys"]) if meta["prop_keys"] else 1
+    assert meta["ov_rows"] is False  # sequential: rem2 rows elided too
     ex = np.asarray(replay_export(None, ops, meta, S=state.tstart.shape[1]))
-    assert ex.shape[1] == len(NON_OB_SLOT_FIELDS) + K + 1
+    assert ex.shape[1] == export_layout_rows(meta)
+    # elisions + byte packing really shrink the buffer vs the full layout
+    full_rows = len(EXPORT_SLOT_FIELDS) + meta["props_K"] + 1
+    assert ex.shape[1] < full_rows - 4
     [summary] = summaries_from_export(meta, ex)
     replica = SharedString("plain")
     for msg in plain.ops:
@@ -274,8 +292,7 @@ def test_obliterate_rows_elided_when_chunk_has_none():
     ex2 = np.asarray(
         replay_export(None, ops2, meta2, S=state2.tstart.shape[1])
     )
-    K2 = len(meta2["prop_keys"]) if meta2["prop_keys"] else 1
-    assert ex2.shape[1] == len(EXPORT_SLOT_FIELDS) + K2 + 1
+    assert ex2.shape[1] == export_layout_rows(meta2)
     [summary2] = summaries_from_export(meta2, ex2)
     replica2 = SharedString("ob")
     for msg in obd.ops:

@@ -53,11 +53,13 @@ def test_pallas_fold_matches_scan_on_bench_workload():
     # (same flags replay_export derives from the packed meta)
     import jax.numpy as jnp
 
-    i16 = bool(meta.get("i16_ok"))
-    ob_rows = bool(meta.get("ob_rows", True))
+    from fluidframework_tpu.ops.mergetree_kernel import _export_flags
+
+    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((len(docs),), jnp.int32)
-    export = np.asarray(_export_state(final, doc_base, i16, ob_rows))
+    export = np.asarray(
+        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8))
     summaries = summaries_from_export(meta, export)
     for doc, summary in zip(docs[:6], summaries[:6]):
         assert summary.digest() == \
