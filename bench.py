@@ -47,6 +47,7 @@ from fluidframework_tpu.dds.sequence import SharedString
 from fluidframework_tpu.ops.interning import Interner
 from fluidframework_tpu.ops.mergetree_kernel import (
     MergeTreeDocInput,
+    export_to_numpy,
     pack_mergetree_batch,
     replay_export,
     replay_mergetree_batch,
@@ -550,7 +551,7 @@ def run_e2e(docs):
                     break
                 meta, ex = item
                 t0 = time.time()
-                arr = np.asarray(ex)  # the D2H link RPC
+                arr = export_to_numpy(ex)  # the D2H link RPC(s)
                 stage["download"] += time.time() - t0
                 if not put(downloaded, (meta, arr)):
                     break
@@ -607,6 +608,10 @@ def run_e2e(docs):
     except BaseException as e:
         errors.append(e)
         abort.set()
+        # An extraction error must not wait out the queued window on pool
+        # shutdown (mirrors the packer's cancel-before-exit discipline).
+        for f in futures:
+            f.cancel()
         raise
     finally:
         if errors:

@@ -448,30 +448,50 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
             for i in range(0, len(byte_rows), 2)
         ]
         rows = [rows[0]] + packed
+        # The misc values (n, overflow, live_len) ride a SEPARATE tiny
+        # [D, 4] int32 output instead of a full S-column row — one less
+        # row off the dominant fetch; widen_export stitches the canonical
+        # misc row back host-side.
+        out = jnp.stack(rows, axis=1).astype(jnp.int16)
+        return out, misc[:, :4]
     rows.append(misc)
     out = jnp.stack(rows, axis=1)
     return out.astype(jnp.int16) if i16 else out
 
 
-def widen_export(export_np: np.ndarray,
+def export_to_numpy(export):
+    """Fetch an export handle to numpy — the i8 layout is a
+    ``(slot_rows, misc)`` pair of device buffers; other layouts a single
+    fused buffer."""
+    if isinstance(export, tuple):
+        return tuple(np.asarray(x) for x in export)
+    return np.asarray(export)
+
+
+def widen_export(export_np,
                  doc_base: Optional[np.ndarray],
                  ob_rows: bool = True, ov_rows: bool = True,
                  i8: bool = False,
                  n_props: Optional[int] = None) -> np.ndarray:
     """Undo the export transfer transforms host-side, always returning the
-    CANONICAL full int32 layout: unpack int8 pairs (``i8`` — needs
-    ``n_props``, the padded props-plane width), widen int16 to int32,
-    restore NOT_REMOVED sentinels, re-add per-doc arena bases, and
-    reinsert elided obliterate/overlap rows with their sentinel fills.
-    Full-layout int32 buffers pass through untouched."""
+    CANONICAL full int32 layout: unpack int8 pairs and stitch the separate
+    misc output back into a row (``i8`` — needs ``n_props``, the padded
+    props-plane width), widen int16 to int32, restore NOT_REMOVED
+    sentinels, re-add per-doc arena bases, and reinsert elided
+    obliterate/overlap rows with their sentinel fills.  Full-layout int32
+    buffers pass through untouched."""
+    misc_np = None
+    if isinstance(export_np, tuple):
+        export_np, misc_np = export_np
     fields = _export_fields(ob_rows, ov_rows)
     if export_np.dtype == np.int32:
         out = export_np
     else:
         if i8:
             # Unpack byte pairs back into the (elided) int16-equivalent
-            # row layout: [tstart, byte rows..., misc] in field order.
+            # row layout: [tstart, byte rows...] + the stitched misc row.
             assert n_props is not None, "i8 widen needs the props width"
+            assert misc_np is not None, "i8 widen needs the misc output"
             u = export_np.astype(np.uint16)
             n_bytes = len(fields) - 1 + n_props
             rows = [export_np[:, 0, :].astype(np.int32)]
@@ -480,7 +500,10 @@ def widen_export(export_np: np.ndarray,
                 half = (pair >> 8) if i % 2 == 0 else (pair & 0xFF)
                 rows.append(half.astype(np.uint8).astype(np.int8)
                             .astype(np.int32))
-            rows.append(export_np[:, -1, :].astype(np.int32))
+            D, _R, S = export_np.shape
+            misc_row = np.zeros((D, S), np.int32)
+            misc_row[:, :misc_np.shape[1]] = misc_np
+            rows.append(misc_row)
             out = np.stack(rows, axis=1)
         else:
             out = export_np.astype(np.int32)
@@ -540,6 +563,20 @@ def _fetch_format():
         return None
 
 
+def _out_shardings_for(i8: bool):
+    """out_shardings matching the export's output structure: the fused 3-D
+    buffer gets the forced row-major Format; the tiny [D, 4] misc output
+    (i8 layouts only) gets a 2-D one."""
+    fmt = _fetch_format()
+    if fmt is None:
+        return None
+    if not i8:
+        return fmt
+    from jax.experimental.layout import Format, Layout
+
+    return (fmt, Format(Layout(major_to_minor=(0, 1)), fmt.sharding))
+
+
 def _fold_fn(mode: str):
     """The batch fold: the lax.scan path by default; the Pallas
     VMEM-resident kernel (ops/pallas_fold.py) when FF_PALLAS_FOLD selects
@@ -570,7 +607,7 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
             ov_rows, i8,
         )
 
-    fmt = _fetch_format()
+    fmt = _out_shardings_for(i8)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
@@ -584,7 +621,7 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
                              ov_rows, i8)
 
-    fmt = _fetch_format()
+    fmt = _out_shardings_for(i8)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
@@ -596,7 +633,7 @@ def export_layout_rows(meta: dict) -> int:
     K = meta.get("props_K", 1)
     if i8:
         n_bytes = len(fields) - 1 + K
-        return 1 + (n_bytes + 1) // 2 + 1
+        return 1 + (n_bytes + 1) // 2  # misc rides the separate output
     return len(fields) + K + 1
 
 
@@ -1213,7 +1250,8 @@ def replay_mergetree_batch(
             export = replay_export(None, ops, meta, S=state.tstart.shape[1])
         else:
             export = replay_export(state, ops, meta)
-        return summaries_from_export(meta, np.asarray(export), stats=stats)
+        return summaries_from_export(meta, export_to_numpy(export),
+                                     stats=stats)
 
     return partition_replay(
         docs, known_oracle_fallback, oracle_fallback_summary, fold_batch,

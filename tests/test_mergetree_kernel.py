@@ -207,12 +207,14 @@ def test_export_widths_agree_and_widen_roundtrips():
     S = state.tstart.shape[1]
     assert meta["i16_ok"], "small fuzz batch must qualify for int16 export"
 
-    ex16 = np.asarray(replay_export(None, ops, meta, S=S))
-    assert ex16.dtype == np.int16
+    from fluidframework_tpu.ops.mergetree_kernel import export_to_numpy
+
+    ex16 = export_to_numpy(replay_export(None, ops, meta, S=S))
+    slots16 = ex16[0] if isinstance(ex16, tuple) else ex16
+    assert slots16.dtype == np.int16
     meta32 = dict(meta, i16_ok=False)
-    ex32 = np.asarray(replay_export(None, ops, meta32, S=S))
+    ex32 = export_to_numpy(replay_export(None, ops, meta32, S=S))
     assert ex32.dtype == np.int32
-    ob = meta["ob_rows"]
     from fluidframework_tpu.ops.mergetree_kernel import _export_flags
 
     _i, ob_f, ov_f, i8_f = _export_flags(meta)
@@ -270,11 +272,17 @@ def test_obliterate_rows_elided_when_chunk_has_none():
     state, ops, meta = pack_mergetree_batch([plain])
     assert meta["ob_rows"] is False
     assert meta["ov_rows"] is False  # sequential: rem2 rows elided too
-    ex = np.asarray(replay_export(None, ops, meta, S=state.tstart.shape[1]))
-    assert ex.shape[1] == export_layout_rows(meta)
+    from fluidframework_tpu.ops.mergetree_kernel import export_to_numpy
+
+    assert meta["i8_ok"], "fixture must qualify for the i8 layout"
+    ex = export_to_numpy(replay_export(None, ops, meta, S=state.tstart.shape[1]))
+    # i8 layouts return (slot_rows, misc) — the misc row left the buffer
+    slots, misc = ex
+    assert slots.shape[1] == export_layout_rows(meta)
+    assert misc.shape == (1, 4) and misc.dtype == np.int32
     # elisions + byte packing really shrink the buffer vs the full layout
     full_rows = len(EXPORT_SLOT_FIELDS) + meta["props_K"] + 1
-    assert ex.shape[1] < full_rows - 4
+    assert slots.shape[1] < full_rows - 5
     [summary] = summaries_from_export(meta, ex)
     replica = SharedString("plain")
     for msg in plain.ops:
@@ -289,10 +297,11 @@ def test_obliterate_rows_elided_when_chunk_has_none():
     )
     state2, ops2, meta2 = pack_mergetree_batch([obd])
     assert meta2["ob_rows"] is True
-    ex2 = np.asarray(
+    ex2 = export_to_numpy(
         replay_export(None, ops2, meta2, S=state2.tstart.shape[1])
     )
-    assert ex2.shape[1] == export_layout_rows(meta2)
+    slots2 = ex2[0] if isinstance(ex2, tuple) else ex2
+    assert slots2.shape[1] == export_layout_rows(meta2)
     [summary2] = summaries_from_export(meta2, ex2)
     replica2 = SharedString("ob")
     for msg in obd.ops:

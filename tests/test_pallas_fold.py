@@ -53,12 +53,15 @@ def test_pallas_fold_matches_scan_on_bench_workload():
     # (same flags replay_export derives from the packed meta)
     import jax.numpy as jnp
 
-    from fluidframework_tpu.ops.mergetree_kernel import _export_flags
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        _export_flags,
+        export_to_numpy,
+    )
 
     i16, ob_rows, ov_rows, i8 = _export_flags(meta)
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((len(docs),), jnp.int32)
-    export = np.asarray(
+    export = export_to_numpy(
         _export_state(final, doc_base, i16, ob_rows, ov_rows, i8))
     summaries = summaries_from_export(meta, export)
     for doc, summary in zip(docs[:6], summaries[:6]):

@@ -53,7 +53,9 @@ def test_pack_stage_within_budget(packed_chunk):
 
 def test_extract_stage_within_budget(packed_chunk):
     _docs, state, ops, meta = packed_chunk
-    export = np.asarray(
+    from fluidframework_tpu.ops.mergetree_kernel import export_to_numpy
+
+    export = export_to_numpy(
         replay_export(None, ops, meta, S=state.tstart.shape[1])
     )
     summaries_from_export(meta, export)  # warm (library load etc.)
