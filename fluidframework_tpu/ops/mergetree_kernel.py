@@ -151,8 +151,19 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
     return jax.tree.map(lambda new, old: jnp.where(do, new, old), out, state)
 
 
-def _apply_op(state: MTState, op) -> MTState:
-    """One sequenced op — the scan step."""
+def _apply_op(state: MTState, op, sequential: bool = False,
+              has_ob: bool = True) -> MTState:
+    """One sequenced op — the scan step.
+
+    ``sequential`` / ``has_ob`` are COMPILE-TIME chunk facts (the same
+    pack-time predicates that drive the export row elisions): a fully
+    sequential chunk (every ref_seq == seq-1) can never arrival-kill an
+    insert (no stamp exceeds any op's ref — base stamps included, since
+    they are <= base_seq <= every tail ref), and an obliterate-free chunk
+    never stamps — so the arrival-kill scan and the stamping block trace
+    away instead of running masked-dead every step.  (The second-remover
+    bookkeeping always runs; its impossibility on sequential chunks only
+    drives the ov_rows EXPORT elision.)"""
     S = state.tlen.shape[0]
     ref_seq, client = op.ref_seq, op.client
     is_ins = op.kind == K_INSERT
@@ -186,37 +197,46 @@ def _apply_op(state: MTState, op) -> MTState:
     j = jnp.where(can.any(), jnp.argmax(can), state.n)
     src = jnp.where(slot <= j, slot, slot - 1)
 
-    # Obliterate-on-arrival (see dds/merge_tree.py docstring): the insert
-    # dies iff its pool neighbors share a stamp the inserter had not seen
-    # from another client; the EARLIEST shared stamp is the remover.
-    # Neighbors = nearest NON-EXPIRED slots around the tie-break index.
-    present = active & ~expired
-    left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1))
-    right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S))
+    if sequential or not has_ob:
+        # No stamp can exceed a sequential op's ref (and without
+        # obliterates there are no stamps at all): arrival kills are
+        # structurally impossible — the whole neighbor scan traces away.
+        kill_seq = jnp.int32(NOT_REMOVED)
+        kill_client = jnp.int32(-1)
+        killed = jnp.bool_(False)
+    else:
+        # Obliterate-on-arrival (see dds/merge_tree.py docstring): the
+        # insert dies iff its pool neighbors share a stamp the inserter
+        # had not seen from another client; the EARLIEST shared stamp is
+        # the remover.  Neighbors = nearest NON-EXPIRED slots around the
+        # tie-break index.
+        present = active & ~expired
+        left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1))
+        right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S))
 
-    def stamp_at(f, idx, valid):
-        return jnp.where(valid, f[jnp.clip(idx, 0, S - 1)],
-                         jnp.int32(NOT_REMOVED))
+        def stamp_at(f, idx, valid):
+            return jnp.where(valid, f[jnp.clip(idx, 0, S - 1)],
+                             jnp.int32(NOT_REMOVED))
 
-    has_left = left_idx >= 0
-    has_right = right_idx < S
-    l1s = stamp_at(state.ob1_seq, left_idx, has_left)
-    l2s = stamp_at(state.ob2_seq, left_idx, has_left)
-    l1c = stamp_at(state.ob1_client, left_idx, has_left)
-    l2c = stamp_at(state.ob2_client, left_idx, has_left)
-    r1s = stamp_at(state.ob1_seq, right_idx, has_right)
-    r2s = stamp_at(state.ob2_seq, right_idx, has_right)
+        has_left = left_idx >= 0
+        has_right = right_idx < S
+        l1s = stamp_at(state.ob1_seq, left_idx, has_left)
+        l2s = stamp_at(state.ob2_seq, left_idx, has_left)
+        l1c = stamp_at(state.ob1_client, left_idx, has_left)
+        l2c = stamp_at(state.ob2_client, left_idx, has_left)
+        r1s = stamp_at(state.ob1_seq, right_idx, has_right)
+        r2s = stamp_at(state.ob2_seq, right_idx, has_right)
 
-    def killer_of(ls, lc):
-        shared = (ls != NOT_REMOVED) & ((ls == r1s) | (ls == r2s))
-        ok = shared & (ls > ref_seq) & (lc != client)
-        return jnp.where(ok, ls, jnp.int32(NOT_REMOVED)), lc
+        def killer_of(ls, lc):
+            shared = (ls != NOT_REMOVED) & ((ls == r1s) | (ls == r2s))
+            ok = shared & (ls > ref_seq) & (lc != client)
+            return jnp.where(ok, ls, jnp.int32(NOT_REMOVED)), lc
 
-    k1s, k1c = killer_of(l1s, l1c)
-    k2s, k2c = killer_of(l2s, l2c)
-    kill_seq = jnp.minimum(k1s, k2s)
-    kill_client = jnp.where(k1s <= k2s, k1c, k2c)
-    killed = kill_seq != NOT_REMOVED
+        k1s, k1c = killer_of(l1s, l1c)
+        k2s, k2c = killer_of(l2s, l2c)
+        kill_seq = jnp.minimum(k1s, k2s)
+        kill_client = jnp.where(k1s <= k2s, k1c, k2c)
+        killed = kill_seq != NOT_REMOVED
 
     def shifted(f, newval):
         moved = jnp.take(f, src, axis=0)
@@ -263,31 +283,36 @@ def _apply_op(state: MTState, op) -> MTState:
     again = covered & (state.rem_seq != NOT_REMOVED) & is_rem_like
     second = again & (state.rem2_seq == NOT_REMOVED)
     third = again & (state.rem2_seq != NOT_REMOVED)
-    # Obliterate additionally stamps zero-width slots strictly inside the
-    # range: tombstones (stamp only) and invisible concurrent inserts
-    # (remove + stamp) — the oracle's zero-width pass.  Two stamp slots;
-    # a third distinct obliterate on one slot overflows to the oracle.
-    obl_zero = active & ~expired & (v == 0) \
-        & (cum > op.a) & (cum < op.b) & is_obl
-    obl_zero_alive = obl_zero & (state.rem_seq == NOT_REMOVED)
-    first_win = first_win | obl_zero_alive
-    stamp = (covered & is_obl) | obl_zero
-    to_ob1 = stamp & (state.ob1_seq == NOT_REMOVED)
-    to_ob2 = stamp & ~to_ob1 & (state.ob2_seq == NOT_REMOVED) \
-        & (state.ob1_seq != op.seq)
-    ob_over = stamp & (state.ob1_seq != NOT_REMOVED) \
-        & (state.ob2_seq != NOT_REMOVED) \
-        & (state.ob1_seq != op.seq) & (state.ob2_seq != op.seq)
+    if has_ob:
+        # Obliterate additionally stamps zero-width slots strictly inside
+        # the range: tombstones (stamp only) and invisible concurrent
+        # inserts (remove + stamp) — the oracle's zero-width pass.  Two
+        # stamp slots; a third distinct obliterate on one slot overflows
+        # to the oracle.
+        obl_zero = active & ~expired & (v == 0) \
+            & (cum > op.a) & (cum < op.b) & is_obl
+        obl_zero_alive = obl_zero & (state.rem_seq == NOT_REMOVED)
+        first_win = first_win | obl_zero_alive
+        stamp = (covered & is_obl) | obl_zero
+        to_ob1 = stamp & (state.ob1_seq == NOT_REMOVED)
+        to_ob2 = stamp & ~to_ob1 & (state.ob2_seq == NOT_REMOVED) \
+            & (state.ob1_seq != op.seq)
+        ob_over = stamp & (state.ob1_seq != NOT_REMOVED) \
+            & (state.ob2_seq != NOT_REMOVED) \
+            & (state.ob1_seq != op.seq) & (state.ob2_seq != op.seq)
+        state = state._replace(
+            ob1_seq=jnp.where(to_ob1, op.seq, state.ob1_seq),
+            ob1_client=jnp.where(to_ob1, client, state.ob1_client),
+            ob2_seq=jnp.where(to_ob2, op.seq, state.ob2_seq),
+            ob2_client=jnp.where(to_ob2, client, state.ob2_client),
+            overflow=state.overflow | ob_over.any(),
+        )
     state = state._replace(
         rem_seq=jnp.where(first_win, op.seq, state.rem_seq),
         rem_client=jnp.where(first_win, client, state.rem_client),
         rem2_seq=jnp.where(second, op.seq, state.rem2_seq),
         rem2_client=jnp.where(second, client, state.rem2_client),
-        ob1_seq=jnp.where(to_ob1, op.seq, state.ob1_seq),
-        ob1_client=jnp.where(to_ob1, client, state.ob1_client),
-        ob2_seq=jnp.where(to_ob2, op.seq, state.ob2_seq),
-        ob2_client=jnp.where(to_ob2, client, state.ob2_client),
-        overflow=state.overflow | third.any() | ob_over.any(),
+        overflow=state.overflow | third.any(),
     )
 
     touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] & (covered & is_ann)[:, None]
@@ -298,20 +323,27 @@ def _apply_op(state: MTState, op) -> MTState:
     return state
 
 
-def replay_scan(state: MTState, ops: MTOps) -> MTState:
-    """Pure single-document op-fold (no jit): scan the op stream."""
+def replay_scan(state: MTState, ops: MTOps, sequential: bool = False,
+                has_ob: bool = True) -> MTState:
+    """Pure single-document op-fold (no jit): scan the op stream.
+    ``sequential``/``has_ob`` are compile-time chunk facts (see
+    ``_apply_op``); the defaults are the full semantics."""
 
     def step(carry, op):
-        return _apply_op(carry, op), None
+        return _apply_op(carry, op, sequential, has_ob), None
 
     final, _ = jax.lax.scan(step, state, ops)
     return final
 
 
-#: vmapped over the document axis — the unit the parallel/ package shards.
-replay_vmapped = jax.vmap(replay_scan)
+def replay_vmapped(state: MTState, ops: MTOps, sequential: bool = False,
+                   has_ob: bool = True) -> MTState:
+    """Vmapped over the document axis — the unit the parallel/ package
+    shards."""
+    return jax.vmap(
+        lambda s, o: replay_scan(s, o, sequential, has_ob)
+    )(state, ops)
 
-_replay_batch = jax.jit(replay_vmapped)
 
 
 def _cold_start(ops: "MTOps", S: int) -> "MTState":
@@ -577,8 +609,9 @@ def _out_shardings_for(i8: bool):
     return (fmt, Format(Layout(major_to_minor=(0, 1)), fmt.sharding))
 
 
-def _fold_fn(mode: str):
-    """The batch fold: the lax.scan path by default; the Pallas
+def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True):
+    """The batch fold: the lax.scan path by default (specialized at
+    compile time by the chunk facts — see ``_apply_op``); the Pallas
     VMEM-resident kernel (ops/pallas_fold.py) when FF_PALLAS_FOLD selects
     it — per-doc state stays on-chip across the whole tail instead of
     round-tripping HBM every op step (SURVEY §7 hard-part #4).  The pallas
@@ -590,16 +623,16 @@ def _fold_fn(mode: str):
         interpret = mode == "interpret"
         return lambda state, ops: replay_vmapped_pallas(
             state, ops, interpret=interpret)
-    return replay_vmapped
+    return lambda state, ops: replay_vmapped(state, ops, sequential, has_ob)
 
 
 @functools.lru_cache(maxsize=None)
 def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
                     fold_mode: str = "", ov_rows: bool = True,
-                    i8: bool = False):
+                    i8: bool = False, sequential: bool = False):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch."""
-    fold = _fold_fn(fold_mode)
+    fold = _fold_fn(fold_mode, sequential, ob_rows)
 
     def f(ops, doc_base):
         return _export_state(
@@ -613,9 +646,10 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
 
 @functools.lru_cache(maxsize=None)
 def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
-                    ov_rows: bool = True, i8: bool = False):
+                    ov_rows: bool = True, i8: bool = False,
+                    sequential: bool = False):
     """Compiled warm-start (base state uploaded) fold+export."""
-    fold = _fold_fn(fold_mode)
+    fold = _fold_fn(fold_mode, sequential, ob_rows)
 
     def f(state, ops, doc_base):
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
@@ -660,11 +694,14 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     mode = pallas_fold_mode()
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
+    # The pallas fold ignores the chunk facts — normalize so mixed
+    # workloads don't compile duplicate executables per cache key.
+    sequential = bool(meta.get("sequential")) and mode == ""
     if state is None:
         return _export_cold_fn(int(S), i16, ob_rows, mode, ov_rows,
-                               i8)(ops, doc_base)
-    return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8)(state, ops,
-                                                            doc_base)
+                               i8, sequential)(ops, doc_base)
+    return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8,
+                           sequential)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:
@@ -985,6 +1022,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         # filled binary rows, which land in op["kind"] — or a base stamp).
         "ob_rows": base_has_ob or bool((op["kind"] == K_OBLITERATE).any()),
         "ov_rows": base_has_ro or not sequential,
+        # Compile-time fold specialization (see _apply_op): base stamps
+        # cannot exceed any sequential tail ref, so ``sequential`` alone
+        # licenses the arrival-kill skip even on warm docs.
+        "sequential": sequential,
     }
     return MTState(**st), MTOps(**op), meta
 

@@ -380,3 +380,53 @@ def test_mergetree_kernel_obliterate_warm_start():
     )
     [summary] = replay_mergetree_batch([doc])
     assert summary.digest() == replicas[0].summarize().digest()
+
+
+def test_sequential_tail_over_stamped_base_skips_kills_correctly():
+    """The fold's sequential fast path skips the arrival-kill scan even
+    when the BASE summary carries obliterate stamps (a stamp seq <=
+    base_seq <= every sequential tail ref can never kill).  Pin that
+    claim against the oracle: warm doc, in-window base ob stamps, strictly
+    sequential tail with inserts landing between stamped slots."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.mergetree_kernel import pack_mergetree_batch
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def op(seq, contents):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=0, type=MessageType.OP, contents=contents,
+        )
+
+    # Build the base via the oracle: insert then obliterate the middle —
+    # the summary retains stamped tombstones in-window.
+    base_replica = SharedString("wb")
+    for msg in (op(1, {"kind": "insert", "pos": 0, "text": "abcdef"}),
+                op(2, {"kind": "obliterate", "start": 1, "end": 5})):
+        base_replica.process(msg, local=False)
+    base_summary = base_replica.summarize()
+    base_records = json.loads(base_summary.blob_bytes("body"))
+    assert any("ob" in rec for rec in base_records), \
+        "base must carry obliterate stamps for this test to bite"
+
+    tail = [op(3, {"kind": "insert", "pos": 1, "text": "XY"}),
+            op(4, {"kind": "remove", "start": 0, "end": 1})]
+    doc = MergeTreeDocInput(
+        doc_id="wb", ops=tail, base_records=base_records,
+        base_seq=2, base_msn=0, final_seq=4, final_msn=0,
+    )
+    _s, _o, meta = pack_mergetree_batch([doc])
+    assert meta["sequential"] and meta["ob_rows"], (
+        "fixture must hit the sequential fast path WITH base stamps")
+
+    [summary] = replay_mergetree_batch([doc])
+    resumed = SharedString("wb")
+    resumed.load(base_summary)
+    for msg in tail:
+        resumed.process(msg, local=False)
+    resumed.advance(4, 0)
+    assert summary.digest() == resumed.summarize().digest()
