@@ -146,6 +146,12 @@ def oracle_replay(doc):
 
 
 METRIC_NAME = "sharedstring_catchup_replay_ops_per_sec"
+# Coarse progress marker the run updates as it goes; the deadline watchdog
+# embeds it in the skip JSON so a wedge DURING the byte-identity
+# verification is distinguishable from a wedge during transfers (a skip
+# that interrupted verification must not read as a clean environmental
+# skip — ADVICE r4).
+CURRENT_PHASE = {"phase": "init"}
 # Global wall-clock ceiling for the whole bench: past this a watchdog emits
 # the skip JSON and hard-exits, so a tunnel that wedges MID-run (observed:
 # np.asarray hanging indefinitely on d2h) still yields a parseable artifact.
@@ -214,7 +220,8 @@ def run_hardened(metric: str, run_fn, deadline: float,
     def _deadline() -> None:
         if _say(lambda: _emit_skip(
                 "deadline-exceeded",
-                {"probe": probe, "deadline_sec": deadline},
+                {"probe": probe, "deadline_sec": deadline,
+                 "phase_at_deadline": CURRENT_PHASE["phase"]},
                 metric=metric, base=skip_base)):
             print(f"BENCH DEADLINE ({deadline:.0f}s) exceeded", file=sys.stderr)
             sys.stderr.flush()
@@ -244,12 +251,15 @@ def run_hardened(metric: str, run_fn, deadline: float,
         print(tb, file=sys.stderr)
         # Narrow on purpose: FileNotFoundError/PermissionError etc. are
         # OSError subclasses but indicate bench bugs, not a sick tunnel.
+        # Classification is TYPE-based (ADVICE r4: a genuine bench bug
+        # whose message merely mentions 'backend' must not read as a sick
+        # environment); the one RuntimeError carve-out is jax's own
+        # backend-init failure, matched on its known prefix.
         environmental = (
             isinstance(exc, (ConnectionError, TimeoutError,
                              jax.errors.JaxRuntimeError))
             or (isinstance(exc, RuntimeError)
-                and ("backend" in str(exc).lower()
-                     or "UNAVAILABLE" in str(exc)))
+                and str(exc).startswith("Unable to initialize backend"))
         )
         reason = "runtime-error" if environmental else "bench-bug"
         if _say(lambda: _emit_skip(reason, {"probe": probe,
@@ -628,6 +638,7 @@ def main() -> None:
 
 
 def _run_bench(probe: dict) -> dict:
+    CURRENT_PHASE["phase"] = "generate"
     _forced_layout_canary()  # before ANY parent-side backend init
     t0 = time.time()
     docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
@@ -641,6 +652,7 @@ def _run_bench(probe: dict) -> dict:
 
     # --- CPU oracle baseline (the 1x denominator; definition pinned in
     # BASELINE.md: per-op SharedString.process over the same streams) ---
+    CURRENT_PHASE["phase"] = "oracle"
     t0 = time.time()
     for doc in docs[:CPU_SAMPLE_DOCS]:
         oracle_replay(doc)
@@ -653,11 +665,13 @@ def _run_bench(probe: dict) -> dict:
     )
 
     # --- link microbenchmark (attributes the fold-vs-e2e gap) ---
+    CURRENT_PHASE["phase"] = "link-microbench"
     link = link_microbench()
     print(f"link: {link}", file=sys.stderr)
 
     # --- warm the compile cache outside the timed run (a fresh process
     # pays XLA compilation once; steady service operation does not) ---
+    CURRENT_PHASE["phase"] = "warm-compile"
     warm_state, warm_ops, warm_meta = pack_mergetree_batch(docs[:CHUNK_DOCS])
     S = warm_state.tstart.shape[1]
     t0 = time.time()
@@ -674,6 +688,7 @@ def _run_bench(probe: dict) -> dict:
 
     # --- HONEST END-TO-END: raw streams → host-side canonical summaries,
     # stages pipelined (see run_e2e) ---
+    CURRENT_PHASE["phase"] = "e2e"
     summaries, stats, stage, e2e_time, packed_chunks = run_e2e(docs)
     assert len(summaries) == N_DOCS
     e2e_ops_per_sec = total_ops / e2e_time
@@ -689,6 +704,7 @@ def _run_bench(probe: dict) -> dict:
     # --- steady-state device fold: inputs uploaded once (device-resident,
     # reusing the e2e run's pack work), export computed but not fetched —
     # the saturated-device rate ---
+    CURRENT_PHASE["phase"] = "steady-fold"
     resident = []
     for ops, meta, s in packed_chunks:
         ops_dev = jax.device_put(ops)
@@ -725,6 +741,7 @@ def _run_bench(probe: dict) -> dict:
         print(f"roofline: {roof}", file=sys.stderr)
 
     # --- sanity: device bytes == oracle bytes on sampled docs ---
+    CURRENT_PHASE["phase"] = "verify-bytes"
     sample = [docs[0], docs[7], docs[N_DOCS // 2]]
     for doc, dev_summary in zip(sample, replay_mergetree_batch(sample)):
         assert dev_summary.digest() == oracle_replay(doc).summarize().digest(), (
@@ -735,6 +752,7 @@ def _run_bench(probe: dict) -> dict:
     assert summaries[-1].digest() == \
         oracle_replay(docs[-1]).summarize().digest()
     print("sanity: device summaries byte-identical to oracle", file=sys.stderr)
+    CURRENT_PHASE["phase"] = "done"
 
     # Returned (not printed): run_hardened emits exactly one line under
     # its watchdog lock.

@@ -23,6 +23,7 @@ import json
 import queue
 import socket
 import threading
+import weakref
 from typing import Callable, Dict, List, Optional
 
 from ..protocol.messages import NackError, RawOperation, SequencedMessage
@@ -68,8 +69,10 @@ class _RpcClient:
         #: invalidation callbacks (one per _RemoteStorage on this socket):
         #: an epochMismatch observed on ANY RPC — deltas, submits,
         #: discovery, storage — drops EVERY instance's caches and the pin,
-        #: centrally, before the error propagates.
-        self._epoch_listeners: List[Callable[[], None]] = []
+        #: centrally, before the error propagates.  Held as WEAK method refs
+        #: so a long-lived shared connection does not pin every per-doc
+        #: storage instance (and its snapshot cache) forever (ADVICE r4).
+        self._epoch_listeners: List["weakref.WeakMethod"] = []
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._dispatcher = threading.Thread(
@@ -166,8 +169,15 @@ class _RpcClient:
                 # connection before anyone can retry unpinned against the
                 # new generation with stale state still live.
                 self.epoch = None
-                for invalidate in self._epoch_listeners:
-                    invalidate()
+                for ref in list(self._epoch_listeners):
+                    invalidate = ref()
+                    if invalidate is None:  # storage instance collected
+                        try:
+                            self._epoch_listeners.remove(ref)
+                        except ValueError:
+                            pass  # concurrent mismatch already pruned it
+                    else:
+                        invalidate()
                 raise EpochMismatchError(
                     frame.get("error", "storage epoch mismatch"),
                     frame.get("epoch"),
@@ -313,7 +323,7 @@ class _RemoteStorage:
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
         self._snapshot_cache: "dict[str, SummaryTree]" = {}
-        rpc._epoch_listeners.append(self._drop_caches)
+        rpc._epoch_listeners.append(weakref.WeakMethod(self._drop_caches))
 
     def _drop_caches(self) -> None:
         self._snapshot_cache.clear()

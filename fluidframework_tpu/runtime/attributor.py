@@ -13,6 +13,16 @@ integer timestamps.  For the common sequential-editing case every column
 delta is a small non-negative int, so the canonical-JSON blob stays
 compact at tens of thousands of ops — and the columns are exactly the
 arrays a future device-side attribution join would upload.
+
+ACCEPTED v1 LIMITATION (ADVICE r4): the table grows one row per sequenced
+op for the document's lifetime and is re-serialized whole into every
+summary.  Sound pruning must drop only rows no DDS attribution key can
+still reference — which requires a deterministic referenced-seq census
+across every datastore, replicated bit-identically by the catch-up
+service's summary builder (summaries must stay byte-identical across
+replicas and the service).  Until that census exists, attribution-enabled
+documents pay O(lifetime ops) summary bytes (a few bytes/op after delta
+encoding); the option defaults off.
 """
 
 from __future__ import annotations

@@ -210,7 +210,9 @@ class LocalOrderingService:
             return DocumentEndpoint(self._orderers[doc_id])
 
     def has_document(self, doc_id: str) -> bool:
-        return doc_id in self._orderers or self.oplog.head(doc_id) > 0
+        with self.state_lock:  # executor threads mutate the map (ADVICE r4)
+            known = doc_id in self._orderers
+        return known or self.oplog.head(doc_id) > 0
 
     def endpoint(self, doc_id: str) -> DocumentEndpoint:
         """Connect-or-recover: an existing orderer is reused; a document

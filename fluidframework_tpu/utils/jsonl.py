@@ -36,9 +36,18 @@ def repair_jsonl_tail(path: str) -> bool:
     last = tail[nl + 1:]
     if not last.strip():
         return False  # clean EOF (trailing newline present)
-    try:
-        json.loads(last.decode("utf-8"))
-    except (UnicodeDecodeError, ValueError):
+    # Records are canonical-JSON OBJECTS (the writers' contract), so a
+    # sealable final line must start with '{' AND parse: a torn prefix of
+    # an object can never parse, while a torn scalar/array could (e.g.
+    # '1234' torn from '123456') — without the prefix check that fragment
+    # would be sealed as a valid record (ADVICE r4).
+    complete = last.lstrip().startswith(b"{")
+    if complete:
+        try:
+            json.loads(last.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            complete = False
+    if not complete:
         with open(path, "r+b") as f:
             f.truncate(size - len(last))
         return True
@@ -64,6 +73,8 @@ def iter_jsonl_tolerant(path: str):
                 yield json.loads(pending)  # a torn NON-final line raises
             pending = line
     if pending is not None:
+        if not pending.startswith("{"):
+            return  # torn fragment of an object record (objects-only contract)
         try:
             yield json.loads(pending)
         except json.JSONDecodeError:
