@@ -1,12 +1,17 @@
 """beastTest-style soak (SURVEY.md §4: merge-tree's large randomized
 text-edit soak, the shape BASELINE config #1 names).
 
-One document, thousands of sequenced random edits (inserts, removes,
-annotates, obliterates), periodically window-advanced — replayed through
-the CPU oracle AND the device kernel, asserting byte-identical summaries
-at several checkpoints along the way and at the end.
+Multiple clients drive one document through thousands of random edits
+(inserts, removes, annotates, obliterates) via the mock factory with
+RANDOM PARTIAL DELIVERY, so sequenced ops carry genuinely lagged refs —
+the generator tracks per-client sequenced views instead of faking
+``ref = seq - 1`` (VERDICT r4 weak #2: the old soak's concurrency knob
+was dead code).  The resulting log replays through the CPU oracle, the
+device kernel, and the Pallas-interpret fold with byte-identical
+summaries asserted at checkpoints and at the end.
 """
 
+import json
 import random
 
 from fluidframework_tpu.dds.sequence import SharedString
@@ -14,76 +19,111 @@ from fluidframework_tpu.ops.mergetree_kernel import (
     MergeTreeDocInput,
     replay_mergetree_batch,
 )
-from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
+from fluidframework_tpu.testing.mocks import (
+    MockContainerRuntimeFactory,
+    channel_log,
+)
 
 ALPHABET = "abcdefghijklmnopqrstuvwxyz "
 
+#: the soak is only a concurrency soak if a real fraction of structural
+#: ops were authored against a lagged view (VERDICT r4 item 5)
+MIN_LAGGED_FRACTION = 0.30
 
-def _beast_ops(seed: int, n_ops: int, obliterate: bool):
+
+def _beast_log(seed: int, n_ops: int, obliterate: bool, n_clients: int = 4):
+    """Drive ``n_clients`` SharedString replicas through ``n_ops`` random
+    local edits with random partial delivery; returns the sequenced
+    channel log (genuine concurrent refs) after asserting the live
+    replicas converged."""
     rng = random.Random(seed)
-    ops, length, msn = [], 0, 0
-    for i in range(n_ops):
-        seq = i + 1
-        client = f"client{i % 5}"
-        # concurrency: refs lag up to 8 behind the head
-        ref = max(msn, seq - 1 - rng.randint(0, 8))
+    factory = MockContainerRuntimeFactory()
+    replicas = []
+    for i in range(n_clients):
+        client = factory.create_client(f"client{i}")
+        replicas.append(client.attach(SharedString("beast")))
+
+    for _ in range(n_ops):
+        replica = replicas[rng.randrange(n_clients)]
+        n = len(replica)
         r = rng.random()
-        # positions resolve in the SEQUENCED view at ref... generating
-        # valid concurrent positions requires view tracking; keep refs
-        # sequential for structural ops and spice with window advances.
-        ref = seq - 1
-        if rng.random() < 0.02:
-            msn = min(seq - 1, msn + rng.randint(1, 6))
-        if r < 0.55 or length < 6:
-            pos = rng.randint(0, length)
+        if r < 0.55 or n < 6:
+            pos = rng.randint(0, n)
             text = "".join(rng.choice(ALPHABET)
                            for _ in range(rng.randint(1, 12)))
-            contents = {"kind": "insert", "pos": pos, "text": text}
-            length += len(text)
+            replica.insert_text(pos, text)
         elif r < 0.75:
-            start = rng.randint(0, length - 2)
-            end = min(length, start + rng.randint(1, 10))
-            contents = {"kind": "remove", "start": start, "end": end}
-            length -= end - start
+            start = rng.randint(0, n - 2)
+            replica.remove_range(start, min(n, start + rng.randint(1, 10)))
         elif obliterate and r < 0.85:
-            start = rng.randint(0, length - 2)
-            end = min(length, start + rng.randint(1, 10))
-            contents = {"kind": "obliterate", "start": start, "end": end}
-            length -= end - start
+            start = rng.randint(0, n - 2)
+            replica.obliterate_range(
+                start, min(n, start + rng.randint(1, 10)))
         else:
-            start = rng.randint(0, length - 2)
-            end = min(length, start + rng.randint(1, 10))
-            contents = {"kind": "annotate", "start": start, "end": end,
-                        "props": {rng.choice("xyz"): rng.randint(0, 4)}}
-        ops.append(SequencedMessage(
-            seq=seq, client_id=client, client_seq=seq, ref_seq=ref,
-            min_seq=msn, type=MessageType.OP, contents=contents,
-        ))
-    return ops
+            start = rng.randint(0, n - 2)
+            end = min(n, start + rng.randint(1, 10))
+            replica.annotate_range(
+                start, end, {rng.choice("xyz"): rng.randint(0, 4)})
+        # Random partial delivery keeps a backlog alive, so concurrent
+        # submissions genuinely lag the head; occasional full syncs +
+        # MSN advances exercise zamboni mid-soak.
+        if rng.random() < 0.22 and factory.pending_count:
+            factory.process_some_messages(
+                rng.randint(1, max(1, factory.pending_count // 2)))
+        if rng.random() < 0.01:
+            factory.process_all_messages()
+            factory.advance_min_seq()
+    factory.process_all_messages()
+    digests = {r.summarize().digest() for r in replicas}
+    assert len(digests) == 1, f"live replicas diverged (seed={seed})"
+    log = channel_log(factory, "beast")
+    assert len(log) == n_ops
+    return log, replicas[0]
 
 
-def _checkpoint_digests(ops, points):
-    """Oracle digests at each checkpoint prefix."""
+def _lagged_fraction(log) -> float:
+    structural = [m for m in log
+                  if m.contents.get("kind") in
+                  ("insert", "remove", "obliterate")]
+    lagged = [m for m in structural if m.ref_seq < m.seq - 1]
+    return len(lagged) / max(1, len(structural))
+
+
+def _oracle_digests(log, points):
+    """Fresh catch-up oracle digests at each checkpoint prefix."""
     replica = SharedString("beast")
     digests = {}
     it = iter(points)
     nxt = next(it, None)
-    for msg in ops:
+    for msg in log:
         replica.process(msg, local=False)
-        if nxt is not None and msg.seq == nxt:
+        if nxt is not None and msg.seq >= nxt:
             digests[nxt] = replica.summarize().digest()
             nxt = next(it, None)
     return digests, replica
 
 
+def _checkpoints(log, n_points):
+    """Checkpoint SEQS at evenly spaced log positions (seqs are not
+    contiguous: join messages and other clients' interleavings consume
+    sequence numbers too)."""
+    idxs = [len(log) * (i + 1) // n_points - 1 for i in range(n_points)]
+    return [log[i].seq for i in idxs]
+
+
 def test_beast_soak_oracle_vs_kernel():
     N = 3000
-    points = [500, 1500, N]
-    for seed, obliterate in ((1, False), (2, True)):
-        ops = _beast_ops(seed, N, obliterate)
-        digests, replica = _checkpoint_digests(ops, points)
+    for seed, obliterate in ((11, False), (12, True)):
+        log, live = _beast_log(seed, N, obliterate)
+        frac = _lagged_fraction(log)
+        assert frac >= MIN_LAGGED_FRACTION, (
+            f"seed={seed}: only {frac:.0%} of structural ops lagged — "
+            f"the soak is not exercising concurrency"
+        )
+        points = _checkpoints(log, 3)
+        digests, replica = _oracle_digests(log, points)
         for point in points:
-            prefix = [m for m in ops if m.seq <= point]
+            prefix = [m for m in log if m.seq <= point]
             doc = MergeTreeDocInput(
                 doc_id="beast", ops=prefix, final_seq=point,
                 final_msn=max(m.min_seq for m in prefix),
@@ -96,21 +136,59 @@ def test_beast_soak_oracle_vs_kernel():
         assert len(replica.text) > 200  # the soak built a real document
 
 
+def test_beast_soak_pallas_interpret():
+    """The genuinely-concurrent log through the Pallas-interpret fold:
+    byte-identical summaries vs the fresh oracle.  A shorter prefix than
+    the scan soak — interpret mode runs the step loop in Python — but the
+    SAME generator, so arrival kills / overlap removers / lagged
+    annotates all appear."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        _export_flags,
+        _export_state,
+        export_to_numpy,
+        pack_mergetree_batch,
+        summaries_from_export,
+    )
+    from fluidframework_tpu.ops.pallas_fold import replay_vmapped_pallas
+
+    N = 700
+    log, _live = _beast_log(21, N, obliterate=True)
+    assert _lagged_fraction(log) >= MIN_LAGGED_FRACTION
+    digests, _ = _oracle_digests(log, [log[-1].seq])
+    doc = MergeTreeDocInput(
+        doc_id="beast", ops=log, final_seq=log[-1].seq,
+        final_msn=max(m.min_seq for m in log),
+    )
+    state, ops, meta = pack_mergetree_batch([doc])
+    final = replay_vmapped_pallas(state, ops, interpret=True)
+    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
+        jnp.zeros((1,), jnp.int32)
+    export = export_to_numpy(
+        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8))
+    [summary] = summaries_from_export(meta, export)
+    assert summary.digest() == digests[log[-1].seq], (
+        "pallas-interpret summary != oracle on the concurrent soak"
+    )
+
+
 def test_beast_warm_restart_chain():
-    """Catch-up chaining under soak: summarize at N/3 and 2N/3, re-enter
-    each summary as the next leg's base — byte-identical to the one-shot
-    fold at the end."""
-    import json
-
+    """Catch-up chaining under the concurrent soak: summarize at N/3 and
+    2N/3, re-enter each summary as the next leg's base — byte-identical
+    to the one-shot fold at the end."""
     N = 1800
-    ops = _beast_ops(7, N, obliterate=True)
-    digests, _ = _checkpoint_digests(ops, [N])
+    log, _live = _beast_log(17, N, obliterate=True)
+    assert _lagged_fraction(log) >= MIN_LAGGED_FRACTION
+    final_point = log[-1].seq
+    digests, _ = _oracle_digests(log, [final_point])
 
-    legs = [(0, N // 3), (N // 3, 2 * N // 3), (2 * N // 3, N)]
+    cuts = [0] + _checkpoints(log, 3)
     base_records, base_seq, base_msn = None, 0, 0
     summary = None
-    for lo, hi in legs:
-        leg_ops = [m for m in ops if lo < m.seq <= hi]
+    for lo, hi in zip(cuts, cuts[1:]):
+        leg_ops = [m for m in log if lo < m.seq <= hi]
         doc = MergeTreeDocInput(
             doc_id="beast", ops=leg_ops,
             base_records=base_records, base_seq=base_seq, base_msn=base_msn,
@@ -120,4 +198,4 @@ def test_beast_warm_restart_chain():
         base_records = json.loads(summary.blob_bytes("body"))
         header = json.loads(summary.blob_bytes("header"))
         base_seq, base_msn = header["seq"], header["minSeq"]
-    assert summary.digest() == digests[N]
+    assert summary.digest() == digests[final_point]
