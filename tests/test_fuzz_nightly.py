@@ -1,0 +1,228 @@
+"""Nightly fuzz tier — the long, env-gated campaign (VERDICT r4 item 6).
+
+The inline fuzz suite (test_fuzz.py) is breadth at ~30-op scale; this tier
+is the same convergence harness scaled to hundreds of rounds x many seeds
+x MIXED specs, with oracle-vs-kernel digest asserts on every generated
+log, warm reloads mid-stream, and a loader-level stash/rehydrate campaign.
+
+Gated off by default (CI latency); run it with e.g.:
+
+    FF_FUZZ_ROUNDS=150 FF_FUZZ_SEEDS=100 \
+        python -m pytest tests/test_fuzz_nightly.py -q
+
+- ``FF_FUZZ_ROUNDS`` (required): rounds per seed for the DDS campaign.
+- ``FF_FUZZ_SEEDS`` (default 100): seed count.
+
+Any divergence prints its seed; minimize by re-running that seed alone
+and shrinking ROUNDS, then pin the shrunken log as a directed test.
+The round-5 documented run is recorded in BASELINE.md (§nightly fuzz).
+"""
+
+import os
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.ops.map_kernel import MapDocInput, replay_map_batch
+from fluidframework_tpu.ops.matrix_kernel import (
+    MatrixDocInput,
+    replay_matrix_batch,
+)
+from fluidframework_tpu.ops.mergetree_kernel import (
+    MergeTreeDocInput,
+    replay_mergetree_batch,
+)
+from fluidframework_tpu.testing.fuzz import (
+    DirectoryFuzzSpec,
+    MapFuzzSpec,
+    MatrixFuzzSpec,
+    StringFuzzSpec,
+    run_fuzz,
+)
+from fluidframework_tpu.testing.mocks import channel_log
+
+ROUNDS = int(os.environ.get("FF_FUZZ_ROUNDS", "0"))
+SEEDS = int(os.environ.get("FF_FUZZ_SEEDS", "100"))
+
+pytestmark = pytest.mark.skipif(
+    ROUNDS <= 0,
+    reason="nightly fuzz tier: set FF_FUZZ_ROUNDS (e.g. 150)",
+)
+
+
+def _spec_for(seed: int):
+    """Deterministic mixed-spec schedule: every string feature combination
+    appears across the seed range, plus map/directory/matrix legs."""
+    r = seed % 10
+    if r < 5:  # half the seeds hammer the merge tree (the riskiest kernel)
+        return "string", StringFuzzSpec(
+            annotate=True,
+            intervals=(seed % 2 == 0),
+            obliterate=(seed % 3 != 0),
+        )
+    if r < 6:
+        return "map", MapFuzzSpec()
+    if r < 7:
+        return "directory", DirectoryFuzzSpec()
+    return "matrix", MatrixFuzzSpec(fww=(seed % 4 == 3))
+
+
+def _warm_reload_hook(kind, spec, rng):
+    """on_sync hook: occasionally summarize a replica and attach a FRESH
+    client loaded from that summary mid-stream (warm reload) — it must
+    converge with the veterans from then on."""
+    joined = []
+
+    def hook(factory, replicas):
+        if len(replicas) >= 7 or rng.random() > 0.25:
+            return
+        summary = replicas[0].summarize()
+        fresh = spec.create(replicas[0].id)
+        fresh.load(summary)
+        client = factory.create_client(f"warm{len(joined)}")
+        replicas.append(client.attach(fresh))
+        joined.append(client.client_id)
+
+    return hook
+
+
+def _kernel_parity(kind, log, oracle_digest, final_seq, final_msn):
+    """Oracle-vs-kernel digest assert on the campaign's generated log —
+    the device path must agree with the CPU oracle on every stream the
+    fuzzer can produce (string / map / matrix kernels; directory folds
+    host-side only).  ``final_seq``/``final_msn`` are the CONTAINER head
+    window (what the catch-up service passes), not the last channel op's."""
+    if not log:
+        return
+    if kind == "string":
+        [s] = replay_mergetree_batch([MergeTreeDocInput(
+            doc_id="fuzz", ops=log, final_seq=final_seq,
+            final_msn=final_msn,
+        )])
+    elif kind == "map":
+        [s] = replay_map_batch([MapDocInput(doc_id="fuzz", ops=log)])
+    elif kind == "matrix":
+        [s] = replay_matrix_batch([MatrixDocInput(
+            doc_id="fuzz", ops=log, final_seq=final_seq,
+            final_msn=final_msn,
+        )])
+    else:
+        return  # directory: no device kernel (host-side by design)
+    assert s.digest() == oracle_digest, f"{kind}: kernel != oracle"
+
+
+@pytest.mark.parametrize("seed", range(SEEDS))
+def test_nightly_dds_campaign(seed):
+    kind, spec = _spec_for(seed)
+    rng = random.Random(seed * 31 + 7)
+    n_clients = 3 + seed % 3
+    rounds = ROUNDS if kind == "string" else max(20, ROUNDS // 2)
+    replicas, factory = run_fuzz(
+        spec,
+        seed=90_000 + seed,
+        n_clients=n_clients,
+        rounds=rounds,
+        sync_every=2 + seed % 7,
+        on_sync=_warm_reload_hook(kind, spec, rng),
+    )
+    # Fresh catch-up oracle over the sequenced log == the live replicas
+    # (convergence already asserted inside run_fuzz), then the kernel.
+    # The fresh replay must end at the CONTAINER head window (live
+    # replicas advanced past trailing JOINs / MSN ticks the channel log
+    # does not carry).
+    log = channel_log(factory, "fuzz")
+    if not log:
+        return
+    head_seq = factory.sequencer.seq
+    head_msn = factory.sequencer.min_seq
+    oracle = spec.create("fuzz")
+    for m in log:
+        oracle.process(m, local=False)
+    advance = getattr(oracle, "advance", None)
+    if advance is not None:
+        advance(head_seq, head_msn)
+    oracle_digest = oracle.summarize().digest()
+    assert oracle_digest == replicas[0].summarize().digest(), (
+        f"seed={seed}: fresh catch-up != live replica"
+    )
+    _kernel_parity(kind, log, oracle_digest, head_seq, head_msn)
+
+
+# --- loader-level stash / rehydrate campaign ---------------------------------
+
+
+def _build_doc(runtime):
+    ds = runtime.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+    ds.create_channel("map-tpu", "meta")
+
+
+def _random_edit(rng, container):
+    ds = container.runtime.get_datastore("ds")
+    text = ds.get_channel("text")
+    n = len(text.text)
+    r = rng.random()
+    if r < 0.5 or n < 4:
+        text.insert_text(rng.randint(0, n),
+                         "".join(rng.choice("abcdef ")
+                                 for _ in range(rng.randint(1, 6))))
+    elif r < 0.7:
+        start = rng.randint(0, n - 2)
+        text.remove_range(start, min(n, start + rng.randint(1, 5)))
+    elif r < 0.85:
+        start = rng.randint(0, n - 2)
+        text.annotate_range(start, min(n, start + rng.randint(1, 5)),
+                            {"w": rng.randint(0, 3)})
+    else:
+        ds.get_channel("meta").set(f"k{rng.randint(0, 5)}", rng.randint(0, 99))
+
+
+@pytest.mark.parametrize("seed", range(max(4, SEEDS // 8)))
+def test_nightly_stash_rehydrate_campaign(seed):
+    """Seeded loader sessions: two clients edit with random drains; the
+    second client repeatedly closes with UNACKED pending ops and
+    rehydrates into a new session (exact stash round-trip); periodic
+    central catch-up folds must match the live replicas byte-for-byte."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    rng = random.Random(5_000 + seed)
+    service = LocalOrderingService()
+    loader = Loader(LocalDocumentServiceFactory(service))
+    a = loader.create("doc", "alice", _build_doc)
+    b = loader.resolve("doc", "bob0")
+    generation = 0
+    for step in range(ROUNDS):
+        _random_edit(rng, a if rng.random() < 0.5 else b)
+        if rng.random() < 0.4:
+            a.drain()
+        if rng.random() < 0.4:
+            b.drain()
+        if rng.random() < 0.06:
+            # stash bob mid-flight (possibly with pending ops) and
+            # rehydrate into a fresh session
+            stash = b.close_and_get_pending_state()
+            generation += 1
+            b = loader.resolve("doc", f"bob{generation}",
+                               pending_state=stash)
+        if rng.random() < 0.05:
+            CatchupService(service).catch_up()
+    for c in (a, b):
+        c.drain()
+    # let both replicas fold every sequenced op (incl. the other's JOINs)
+    head = service.endpoint("doc").head_seq
+    for _ in range(64):
+        a.drain()
+        b.drain()
+        if a.runtime.ref_seq == b.runtime.ref_seq == head:
+            break
+    assert a.runtime.ref_seq == b.runtime.ref_seq == head
+    da = a.runtime.summarize().digest()
+    assert da == b.runtime.summarize().digest(), f"seed={seed}: diverged"
+    # a fresh catch-up load (central fold + empty tail) agrees too
+    CatchupService(service).catch_up()
+    fresh = loader.resolve("doc", client_id=None)
+    assert fresh.runtime.summarize().digest() == da
