@@ -376,6 +376,98 @@ def _forced_layout_canary() -> None:
               "layout-forced fetch", file=sys.stderr)
 
 
+def _pallas_canary() -> dict | None:
+    """First-Mosaic-compile measurement of the Pallas VMEM-resident fold
+    (VERDICT r4 item 2), in a SUBPROCESS before the parent touches the
+    backend (exclusive-ownership TPU runtimes) so a Mosaic crash or hang
+    can never take the main bench down with it.  Returns a dict for the
+    JSON line: compile outcome, fold rates (pallas vs scan, same chunk),
+    and array parity — or the captured error."""
+    import subprocess
+
+    if os.environ.get("FF_NO_PALLAS_CANARY"):
+        return None
+    code = r"""
+import json, os, sys, time
+import jax
+plat = os.environ.get('FF_BENCH_PLATFORM')
+if plat: jax.config.update('jax_platforms', plat)
+if jax.default_backend() == 'cpu':
+    print(json.dumps({'skipped': 'cpu-backend'})); sys.exit(0)
+import numpy as np
+import bench
+from fluidframework_tpu.ops.mergetree_kernel import (
+    pack_mergetree_batch, replay_vmapped)
+from fluidframework_tpu.ops.pallas_fold import replay_vmapped_pallas
+D, OPS = 1024, 96
+docs = [bench.synth_doc(i, OPS) for i in range(D)]
+state, ops, meta = pack_mergetree_batch(docs)
+out = {'docs': D, 'ops_per_doc': OPS, 'S': int(state.tstart.shape[1])}
+scan = jax.jit(replay_vmapped)
+t0 = time.time()
+final_scan = scan(state, ops)
+jax.block_until_ready(final_scan)
+out['scan_compile_sec'] = round(time.time() - t0, 1)
+best = float('inf')
+for _ in range(3):
+    t0 = time.time()
+    jax.block_until_ready(scan(state, ops))
+    best = min(best, time.time() - t0)
+out['scan_fold_ops_per_sec'] = round(D * OPS / best, 1)
+try:
+    t0 = time.time()
+    final_p = replay_vmapped_pallas(state, ops, interpret=False)
+    jax.block_until_ready(final_p)
+    out['mosaic_compile_ok'] = True
+    out['pallas_compile_sec'] = round(time.time() - t0, 1)
+except Exception:
+    import traceback
+    out['mosaic_compile_ok'] = False
+    out['error_tail'] = traceback.format_exc()[-800:]
+    print(json.dumps(out)); sys.exit(0)
+best = float('inf')
+for _ in range(3):
+    t0 = time.time()
+    jax.block_until_ready(replay_vmapped_pallas(state, ops, interpret=False))
+    best = min(best, time.time() - t0)
+out['pallas_fold_ops_per_sec'] = round(D * OPS / best, 1)
+n = np.asarray(final_scan.n)
+slot = np.arange(final_scan.tstart.shape[1])[None, :]
+mask = slot < n[:, None]
+parity = bool(np.array_equal(n, np.asarray(final_p.n)))
+for field in final_scan._fields:
+    if field in ('n', 'overflow'):
+        continue
+    av = np.asarray(getattr(final_scan, field))
+    bv = np.asarray(getattr(final_p, field))
+    m = mask[:, :, None] if av.ndim == 3 else mask
+    if not np.array_equal(np.where(m, av, 0), np.where(m, bv, 0)):
+        parity = False
+        out.setdefault('parity_mismatch_fields', []).append(field)
+out['parity_ok'] = parity
+print(json.dumps(out))
+"""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=float(os.environ.get("FF_PALLAS_CANARY_TIMEOUT", "420")),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        result = json.loads(lines[-1]) if lines else {
+            "error": f"no output rc={proc.returncode}",
+            "error_tail": (proc.stderr or "")[-800:],
+        }
+    except subprocess.TimeoutExpired:
+        result = {"error": "timeout (Mosaic compile or run wedged)"}
+    except (json.JSONDecodeError, ValueError) as exc:
+        result = {"error": f"unparseable canary output: {exc}"}
+    result["canary_sec"] = round(time.time() - t0, 1)
+    print(f"pallas canary: {result}", file=sys.stderr)
+    return result
+
+
 # Peak single-chip HBM bandwidth by device kind (GB/s), for the roofline.
 # Source: public TPU spec sheets; unknown kinds fall back to v5e.
 HBM_GBPS = {
@@ -638,8 +730,15 @@ def main() -> None:
 
 
 def _run_bench(probe: dict) -> dict:
+    # Both canaries run as subprocesses BEFORE any parent-side backend
+    # init (exclusive-ownership TPU runtimes).
+    CURRENT_PHASE["phase"] = "pallas-canary"
+    pallas = (
+        _pallas_canary()
+        if probe.get("platform") in ("tpu", "axon") else None
+    )
     CURRENT_PHASE["phase"] = "generate"
-    _forced_layout_canary()  # before ANY parent-side backend init
+    _forced_layout_canary()
     t0 = time.time()
     docs = [synth_doc(d, OPS_PER_DOC) for d in range(N_DOCS)]
     total_ops = N_DOCS * OPS_PER_DOC
@@ -771,6 +870,7 @@ def _run_bench(probe: dict) -> dict:
         ),
         "cpu_baseline_ops_per_sec": round(cpu_ops_per_sec, 1),
         "roofline": roof,
+        "pallas": pallas,
         "link": link,
         "stages_busy_sec": {
             "pack": round(stage["pack"], 3),

@@ -749,6 +749,13 @@ class MergeTreeDocInput:
     binary_clients: Optional[Sequence[str]] = None
     binary_prop_keys: Optional[Sequence[str]] = None
     binary_values: Optional[Sequence[Any]] = None
+    #: attribution-enabled document (SURVEY §1 layer 8): the summary gains
+    #: an "attribution" blob of pre-clamp insert seqs per merged sub-run
+    #: (byte-identical to SharedString.summarize with an attributor).  The
+    #: export already carries pre-clamp ins_seq — clamping is host-side —
+    #: so this is pure extraction work; such docs take the Python record
+    #: path (the C++ extractor emits bodies only).
+    attribution: bool = False
 
 
 class _DocPack:
@@ -1030,8 +1037,15 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     return MTState(**st), MTOps(**op), meta
 
 
-def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
-    """Device state → the oracle's normalized record list (host side)."""
+def _extract_records(meta, state_np: dict, d: int,
+                     return_keys: bool = False):
+    """Device state → the oracle's normalized record list (host side).
+
+    ``return_keys=True`` additionally returns the ATTRIBUTION KEYS,
+    mirroring ``MergeTreeOracle.normalized_records(return_keys=True)``:
+    for each emitted record whose seq got clamped, the pre-clamp insert
+    seqs of its merged sub-runs as ``[record_idx, [[chars, seq], ...]]``
+    (the export's ins_seq column is pre-clamp — clamping happens here)."""
     doc = meta["docs"][d]
     pack = meta["doc_packs"][d]
     arena: TextArena = meta["arena"]
@@ -1039,6 +1053,7 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
     values: Interner = meta["values"]
     msn = doc.final_msn
     records: List[dict] = []
+    run_keys: List[Optional[list]] = []
     n = int(state_np["n"][d])
     for s in range(n):
         rs = int(state_np["rem_seq"][d, s])
@@ -1094,9 +1109,24 @@ def _extract_records(meta, state_np: dict, d: int) -> List[dict]:
                 and prev.get("p") == rec.get("p")
             ):
                 prev["t"] += rec["t"]
+                runs = run_keys[-1]
+                if runs is not None:
+                    if runs[-1][1] == ins_seq:
+                        runs[-1][0] += len(rec["t"])  # same author run
+                    else:
+                        runs.append([len(rec["t"]), ins_seq])
                 continue
         records.append(rec)
-    return records
+        run_keys.append(
+            [[len(rec["t"]), ins_seq]] if rec["s"] == 0 else None
+        )
+    if not return_keys:
+        return records
+    keys = [
+        [i, runs] for i, runs in enumerate(run_keys)
+        if runs is not None and any(seq for _chars, seq in runs)
+    ]
+    return records, keys
 
 
 def known_oracle_fallback(doc: MergeTreeDocInput) -> bool:
@@ -1147,6 +1177,13 @@ def oracle_fallback_summary(doc: MergeTreeDocInput) -> SummaryTree:
     from ..dds.sequence import SharedString
 
     replica = SharedString(doc.doc_id)
+    if doc.attribution:
+        # Attribution-enabled docs must emit their keys blob on fallback
+        # too (summarize keys on the flag alone; table reads are container
+        # state, not needed here).
+        from ..runtime.attributor import Attributor
+
+        replica._attributor = Attributor()
     if doc.base_records is not None:
         replica.tree.load_records(doc.base_records, doc.base_seq, doc.base_msn)
         for label, obj in (doc.base_intervals or {}).items():
@@ -1175,7 +1212,12 @@ def summary_from_state(meta, state_np: dict, d: int,
     pack = meta["doc_packs"][d]
     if pack.needs_fallback or bool(state_np["overflow"][d]):
         return oracle_fallback_summary(doc)
-    records = _extract_records(meta, state_np, d)
+    keys = None
+    if doc.attribution:
+        records, keys = _extract_records(meta, state_np, d,
+                                         return_keys=True)
+    else:
+        records = _extract_records(meta, state_np, d)
     if length is None:
         length = sum(
             int(state_np["tlen"][d, s])
@@ -1186,6 +1228,8 @@ def summary_from_state(meta, state_np: dict, d: int,
     tree = SummaryTree()
     tree.add_blob("header", canonical_json(header))
     tree.add_blob("body", canonical_json(records))
+    if keys:
+        tree.add_blob("attribution", canonical_json(keys))
     if pack.interval_ops or doc.base_intervals:
         view = FinalStateView(state_np, d, int(NOT_REMOVED))
         intervals = replay_intervals(
@@ -1229,11 +1273,19 @@ def summaries_from_export(meta, export_np: np.ndarray,
         stats["device_docs"] = stats.get("device_docs", 0) + D - n_skip
     msn = np.asarray([doc.final_msn for doc in docs], np.int32)
     arena_text = meta["arena"].finalize()
+    # Attribution docs take the Python record path below (their key blob
+    # needs the pre-clamp seqs alongside the merge boundaries), so the
+    # C++ pass must not extract their bodies just to discard them —
+    # body_skip extends the fallback skip WITHOUT polluting the stats.
+    body_skip = skip.copy()
+    for d in range(D):
+        if docs[d].attribution:
+            body_skip[d] = 1
     bodies = extract_bodies(
         np.ascontiguousarray(export_np, np.int32), arena_text,
         [list(meta["doc_packs"][d].clients.values) for d in range(D)],
         meta["prop_keys"], list(meta["values"].values),
-        msn, skip, int(NOT_REMOVED),
+        msn, body_skip, int(NOT_REMOVED),
     )
     out: List[SummaryTree] = []
     for d, doc in enumerate(docs):
@@ -1248,7 +1300,16 @@ def summaries_from_export(meta, export_np: np.ndarray,
         }
         tree = SummaryTree()
         tree.add_blob("header", canonical_json(header))
-        if bodies is not None:
+        if doc.attribution:
+            # Attribution docs take the Python record path (pinned
+            # bit-identical to the C++ bodies): the keys blob needs the
+            # pre-clamp seqs alongside the merge boundaries.
+            records, keys = _extract_records(meta, state_np, d,
+                                             return_keys=True)
+            tree.add_blob("body", canonical_json(records))
+            if keys:
+                tree.add_blob("attribution", canonical_json(keys))
+        elif bodies is not None:
             tree.add_blob("body", bodies[d])
         else:
             tree.add_blob(

@@ -100,6 +100,10 @@ class _DocWork:
     plan: Optional[List[tuple]] = None
     # decoded (msg, batch) pairs — chunk/compression resolved once
     decoded: Optional[list] = None
+    # attribution-enabled document (prior .metadata stamp): the device
+    # fold must add the container .attribution table and the string
+    # channels' key blobs.
+    attribution: bool = False
 
 
 def flatten_channel_ops(
@@ -285,12 +289,7 @@ class CatchupService:
             meta = json.loads(work.summary.blob_bytes(".metadata"))
         except KeyError:
             meta = {}
-        if meta.get("attribution"):
-            # Attribution-enabled documents fold on the CPU path: the real
-            # runtime propagates the .metadata stamp, the folded seq table,
-            # and the channels' attribution-key blobs — the device export
-            # does not carry attribution keys (yet).
-            return None
+        attribution = bool(meta.get("attribution"))
         for _msg, batch in work.decoded:
             if any("runtime" in sub for sub in batch["ops"]):
                 return None  # blob/ds/channel attaches, sweeps: CPU path
@@ -316,7 +315,21 @@ class CatchupService:
                 if channel_tree.digest() == _empty_digest(
                         self.registry, type_name):
                     channel_tree = None  # cold fold
+                if attribution:
+                    if type_name == TREE_TYPE:
+                        # Tree attribution keys are not device-extracted
+                        # (id-addressed forest keys differ from the string
+                        # run-length shape): CPU path.
+                        return None
+                    if type_name == STRING_TYPE and channel_tree is not None \
+                            and "attribution" in channel_tree.children:
+                        # Warm base carrying pre-clamp keys: restoring them
+                        # into the pack (the oracle's load-split) is not
+                        # implemented — CPU path keeps byte parity.
+                        return None
                 plan.append((ds_id, channel_id, type_name, channel_tree))
+        if plan:
+            work.attribution = attribution
         return plan or None
 
     @staticmethod
@@ -400,6 +413,7 @@ class CatchupService:
                     string_in.append(MergeTreeDocInput(
                         doc_id=cid, ops=ops, final_seq=final_seq,
                         final_msn=final_msn,
+                        attribution=work.attribution,
                         **self._string_base_kwargs(channel_tree),
                     ))
                 elif type_name == MAP_TYPE:
@@ -465,7 +479,10 @@ class CatchupService:
             tree.add_blob(
                 ".metadata",
                 canonical_json(
-                    ContainerRuntime.container_metadata(final_seq, final_msn)
+                    ContainerRuntime.container_metadata(
+                        final_seq, final_msn,
+                        attribution=work.attribution,
+                    )
                 ),
             )
             tree.add_blob(
@@ -475,6 +492,11 @@ class CatchupService:
                 ".idCompressor",
                 canonical_json(self._fold_id_compressor(work)),
             )
+            if work.attribution:
+                tree.add_blob(
+                    ".attribution",
+                    canonical_json(self._fold_attribution(work)),
+                )
             # Eligibility guaranteed nothing becomes unreferenced and no
             # blobs exist: the folded gc/blob state is the empty state.
             from ..runtime.gc import GarbageCollector
@@ -505,6 +527,37 @@ class CatchupService:
                 ds_tree.children[ds_id] = sub
             out.append(tree)
         return out
+
+    def _fold_attribution(self, work: _DocWork) -> dict:
+        """Replicate the runtime's attribution recording over the tail on
+        top of the prior summary's table (container.py: observe AFTER
+        chunk reassembly — only the final chunk's seq is ever stamped —
+        and only when contents resolved non-None)."""
+        from ..runtime.attributor import Attributor
+        from ..runtime.op_pipeline import ChunkReassembler, maybe_decompress
+
+        try:
+            prior = json.loads(work.summary.blob_bytes(".attribution"))
+        except KeyError:
+            prior = None
+        attr = Attributor.deserialize(prior)
+        chunks = ChunkReassembler()
+        for msg in work.tail:
+            contents = msg.contents
+            if msg.type is MessageType.OP and isinstance(contents, dict):
+                if contents.get("type") == "chunk":
+                    contents = chunks.feed(msg.client_id, contents)
+                else:
+                    contents = maybe_decompress(contents)
+            elif msg.type is MessageType.LEAVE:
+                # The runtime drops a departed client's partial chunk
+                # train (container.py LEAVE handling); a later same-id
+                # chunk must not complete it here either, or the device
+                # and CPU folds would stamp different tables.
+                chunks.drop(msg.contents["clientId"])
+            if contents is not None:
+                attr.observe(msg)
+        return attr.serialize()
 
     def _fold_id_compressor(self, work: _DocWork) -> dict:
         """Replicate the runtime's sequenced id-range finalization for the

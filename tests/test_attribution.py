@@ -271,3 +271,144 @@ def test_merged_run_split_preserves_per_author_attribution():
         string_summary.blob_bytes("body")
     assert carol_string.blob_bytes("attribution") == \
         string_summary.blob_bytes("attribution")
+
+
+def build_string_only(rt):
+    ds = rt.create_datastore("ds")
+    ds.create_channel("sequence-tpu", "text")
+
+
+def test_catchup_device_path_preserves_attribution():
+    """String-only attribution documents fold on the DEVICE path (round 5:
+    the export carries pre-clamp ins_seq, so the extractor emits the key
+    blob; the container table folds host-side) — byte-identical to the CPU
+    container fold, and a loading client still resolves attribution."""
+    import json
+
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build_string_only)
+    b = loader.resolve("doc", client_id="bob")
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "foo")
+    a.runtime.flush()
+    a.drain(), b.drain()
+    tb.insert_text(3, "bar")
+    b.runtime.flush()
+    a.drain(), b.drain()
+    # Window advance past both inserts: the device extractor must emit
+    # run-length keys for the clamped, author-merged record.
+    for _k in range(3):
+        ta.insert_text(len(ta.text), ".")
+        a.runtime.flush()
+        a.drain(), b.drain()
+        tb.insert_text(len(tb.text), "!")
+        b.runtime.flush()
+        a.drain(), b.drain()
+
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None  # force the container fold
+    cpu_results = cpu.catch_up(upload=False)
+    assert cpu.cpu_docs == 1
+
+    dev = CatchupService(service)
+    dev_results = dev.catch_up(upload=False)
+    assert dev.device_docs == 1 and dev.cpu_docs == 0, (
+        dev.device_docs, dev.cpu_docs)
+    assert dev_results == cpu_results, (
+        "device attribution fold != container fold")
+
+    # upload for real and load: attribution resolves through the service
+    # summary, per-author across the merged run
+    dev2 = CatchupService(service)
+    dev2.catch_up()
+    tree, _seq = service.storage.latest("doc")
+    assert json.loads(tree.blob_bytes(".metadata"))["attribution"] is True
+    assert ".attribution" in tree.children
+    string_summary = tree.get(".datastores").get("ds").get("text")
+    assert "attribution" in string_summary.children
+
+    c = loader.resolve("doc", client_id="carol")
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    assert tc.attribution_at(0)["user"] == "alice"
+    assert tc.attribution_at(3)["user"] == "bob"
+
+
+def test_catchup_device_attribution_fallback_doc_keeps_keys():
+    """A known-fallback doc (interval ops + obliterate) inside an
+    attribution document still emits its keys blob through the oracle
+    escape hatch."""
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        MergeTreeDocInput,
+        oracle_fallback_summary,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def msg(seq, client, contents, min_seq=0):
+        return SequencedMessage(
+            seq=seq, client_id=client, client_seq=seq, ref_seq=seq - 1,
+            min_seq=min_seq, type=MessageType.OP, contents=contents,
+        )
+
+    ops = [
+        msg(1, "alice", {"kind": "insert", "pos": 0, "text": "abcdef"}),
+        msg(2, "bob", {"kind": "obliterate", "start": 4, "end": 6}),
+        msg(3, "alice", {"kind": "intervalAdd", "label": "c",
+                         "id": "iv0", "start": 0, "end": 2, "props": {}},
+            min_seq=2),
+        msg(4, "bob", {"kind": "insert", "pos": 2, "text": "zz"},
+            min_seq=3),
+    ]
+    doc = MergeTreeDocInput(doc_id="fb", ops=ops, final_seq=4, final_msn=3,
+                            attribution=True)
+    summary = oracle_fallback_summary(doc)
+    assert "attribution" in summary.children, (
+        "fallback summary lost the attribution keys blob"
+    )
+
+
+def test_kernel_attribution_parity_direct():
+    """replay_mergetree_batch(attribution=True) == the oracle with an
+    attributor, byte-for-byte, across a window clamp that merges two
+    authors' runs."""
+    from fluidframework_tpu.dds.sequence import SharedString
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        MergeTreeDocInput,
+        replay_mergetree_batch,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def msg(seq, client, contents, min_seq=0):
+        return SequencedMessage(
+            seq=seq, client_id=client, client_seq=seq, ref_seq=seq - 1,
+            min_seq=min_seq, type=MessageType.OP, contents=contents,
+        )
+
+    ops = [
+        msg(1, "alice", {"kind": "insert", "pos": 0, "text": "foo"}),
+        msg(2, "bob", {"kind": "insert", "pos": 3, "text": "bar"}),
+        msg(3, "alice", {"kind": "insert", "pos": 6, "text": "."},
+            min_seq=2),
+        msg(4, "bob", {"kind": "insert", "pos": 7, "text": "!"},
+            min_seq=3),
+    ]
+    oracle = SharedString("doc")
+    oracle._attributor = Attributor()
+    for m in ops:
+        oracle.process(m, local=False)
+    want = oracle.summarize()
+    assert "attribution" in want.children  # the clamp produced keys
+
+    [got] = replay_mergetree_batch([MergeTreeDocInput(
+        doc_id="doc", ops=ops, final_seq=4, final_msn=3, attribution=True,
+    )])
+    assert got.digest() == want.digest()
+    assert got.blob_bytes("attribution") == want.blob_bytes("attribution")
