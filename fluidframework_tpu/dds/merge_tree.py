@@ -996,6 +996,35 @@ class MergeTreeOracle:
         ]
         return records, keys
 
+    @staticmethod
+    def split_records_by_attribution_keys(records: List[dict],
+                                          keys: List[list]) -> List[dict]:
+        """Split merged-run records back per author, restoring pre-clamp
+        insert seqs from an "attribution" blob (``[idx, [[chars, seq],
+        ...]]`` entries) — IN PLACE, returning ``records``.
+
+        Semantically equivalent to the epoch clamp (a restored seq <= the
+        loaded minSeq satisfies every visibility/expiry rule identically),
+        and a re-summarize re-merges to identical body bytes.  THE single
+        implementation shared by ``SharedString.load`` and the catch-up
+        service's warm-base pack — byte parity across the CPU and device
+        folds depends on these never diverging (review r5)."""
+        for idx, runs in sorted(keys, reverse=True):
+            rec = records[idx]
+            if rec["s"] != 0:
+                continue  # body already carried the seq
+            pieces, off = [], 0
+            for chars, seq in runs:
+                piece = dict(rec)
+                piece["t"] = rec["t"][off:off + chars]
+                piece["s"] = seq or 0
+                pieces.append(piece)
+                off += chars
+            if off != len(rec["t"]):
+                continue  # malformed keys: keep unsplit
+            records[idx:idx + 1] = pieces
+        return records
+
     def load_records(self, records: List[dict], seq: int, min_seq: int) -> None:
         self.segments = []
         for rec in records:

@@ -21,20 +21,8 @@ from typing import Any, Deque, Dict, Optional
 from ..protocol.messages import UNASSIGNED_SEQ, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from .intervals import IntervalCollection
-from .merge_tree import MergeTreeOracle, Segment, SegmentGroup, NO_CLIENT
+from .merge_tree import MergeTreeOracle, SegmentGroup, NO_CLIENT
 from .shared_object import SharedObject
-
-
-def _segment_like(seg: Segment, text: str, insert_seq: int) -> Segment:
-    """A copy of a loaded segment covering ``text`` with a restored insert
-    seq — used to split merged-run records back into per-author runs."""
-    piece = Segment(text, insert_seq, seg.insert_client,
-                    dict(seg.props) if seg.props else None)
-    piece.removed_seq = seg.removed_seq
-    piece.removed_client = seg.removed_client
-    piece.ob_stamps = dict(seg.ob_stamps)
-    piece.overlap_removers = set(seg.overlap_removers)
-    return piece
 
 
 class SharedString(SharedObject):
@@ -504,29 +492,14 @@ class SharedString(SharedObject):
     def load(self, summary: SummaryTree) -> None:
         header = json.loads(summary.blob_bytes("header"))
         records = json.loads(summary.blob_bytes("body"))
-        self.tree.load_records(records, header["seq"], header["minSeq"])
         if "attribution" in summary.children:
-            # Restore pre-clamp insert seqs (semantically equivalent to the
-            # epoch clamp: a seq <= the loaded minSeq satisfies every
-            # visibility/expiry rule identically) so attribution_at keeps
-            # resolving on content below the window.  A record merged from
-            # multiple authors' runs is SPLIT back so each run carries its
-            # own seq — the clamped forms still match, so a re-summarize
-            # re-merges to identical body bytes.
-            keys = json.loads(summary.blob_bytes("attribution"))
-            for idx, runs in sorted(keys, reverse=True):
-                seg = self.tree.segments[idx]
-                if seg.insert_seq != 0:
-                    continue  # body already carried the seq
-                pieces, off = [], 0
-                for chars, seq in runs:
-                    piece = _segment_like(seg, seg.text[off:off + chars],
-                                          seq or 0)
-                    pieces.append(piece)
-                    off += chars
-                if off != len(seg.text):  # malformed keys: keep unsplit
-                    continue
-                self.tree.segments[idx:idx + 1] = pieces
+            # Restore pre-clamp insert seqs so attribution_at keeps
+            # resolving on content below the window — the ONE shared
+            # splitter (the catch-up warm-base pack uses it too).
+            MergeTreeOracle.split_records_by_attribution_keys(
+                records, json.loads(summary.blob_bytes("attribution"))
+            )
+        self.tree.load_records(records, header["seq"], header["minSeq"])
         self._pending_groups.clear()
         self._interval_collections = {}
         try:

@@ -51,6 +51,29 @@ FIELD_START = None
 ROOT_ID = ""
 
 
+def restore_attribution_seqs(keys: Dict[str, list], get_seqs,
+                             put_seqs) -> None:
+    """Warm-restore of pre-clamp (insert, value) seqs from a summary's
+    "attribution" blob: fill ONLY zero seqs (the body already carried
+    nonzero ones), skip unknown node ids.  ``get_seqs(nid)`` returns
+    ``(ins, val)`` or None; ``put_seqs(nid, ins, val)`` writes back.
+
+    THE single implementation shared by ``SharedTree.load`` and the
+    catch-up service's warm-base pack (ops/tree_kernel.py) — byte parity
+    across the CPU and device folds depends on these never diverging
+    (review r5)."""
+    for nid, (ins, val) in keys.items():
+        cur = get_seqs(nid)
+        if cur is None:
+            continue
+        cur_ins, cur_val = cur
+        put_seqs(
+            nid,
+            ins if (ins and cur_ins == 0) else cur_ins,
+            val if (val and cur_val == 0) else cur_val,
+        )
+
+
 # ---------------------------------------------------------------------------
 # Schema (SchemaFactory-lite)
 # ---------------------------------------------------------------------------
@@ -911,18 +934,20 @@ class SharedTree(SharedObject):
             self._load_node(spec, ROOT_ID, "")
             self.seq_forest.node(spec["id"]).parent = None  # detached
         if "attribution" in summary.children:
-            # Restore pre-clamp seqs (equivalent under every visibility
-            # rule: a seq <= the loaded minSeq reads as universally
-            # visible either way).
-            for nid, (ins, val) in json.loads(
-                    summary.blob_bytes("attribution")).items():
+            # Restore pre-clamp seqs via the ONE shared helper (the
+            # catch-up service's warm-base pack uses it too).
+            def get_seqs(nid):
                 n = self.seq_forest.nodes.get(nid)
-                if n is None:
-                    continue
-                if ins and n.insert_seq == 0:
-                    n.insert_seq = ins
-                if val and n.value_seq == 0:
-                    n.value_seq = val
+                return None if n is None else (n.insert_seq, n.value_seq)
+
+            def put_seqs(nid, ins, val):
+                n = self.seq_forest.nodes[nid]
+                n.insert_seq, n.value_seq = ins, val
+
+            restore_attribution_seqs(
+                json.loads(summary.blob_bytes("attribution")),
+                get_seqs, put_seqs,
+            )
         self.discard_pending()
         self._invalidate()
 

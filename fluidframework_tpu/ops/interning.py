@@ -57,6 +57,9 @@ class Interner:
     def lookup(self, idx: int) -> Any:
         return self.values[idx]
 
+    def __contains__(self, value: Any) -> bool:
+        return self._hashable(value) in self._by_key
+
     def __len__(self) -> int:
         return len(self.values)
 

@@ -238,6 +238,12 @@ class TreeDocInput:
     base_summary: Optional[SummaryTree] = None
     final_seq: int = 0
     final_msn: int = 0
+    #: attribution-enabled document (SURVEY §1 layer 8): the summary gains
+    #: an "attribution" blob of pre-clamp (insert, value) seqs per emitted
+    #: node.  The device state carries raw seqs — clamping is host-side —
+    #: and the pack restores a warm base's keys, so this is extraction
+    #: work only.
+    attribution: bool = False
 
 
 class _DocPack:
@@ -374,6 +380,28 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
             for chs in obj.get("fields", {}).values():
                 for ch in chs:
                     fix_seqs(ch)
+            if "attribution" in doc.base_summary.children:
+                # Warm base carrying pre-clamp keys: restore them via the
+                # ONE shared helper (SharedTree.load uses it too), so
+                # re-summarizing regenerates identical keys.
+                from ..dds.tree import restore_attribution_seqs
+
+                def get_seqs(nid):
+                    if nid not in pack.node_ids:
+                        return None
+                    row = node_rows.get(pack.node(nid))
+                    return None if row is None else (
+                        row["insert_seq"], row["value_seq"])
+
+                def put_seqs(nid, ins, val):
+                    row = node_rows[pack.node(nid)]
+                    row["insert_seq"], row["value_seq"] = ins, val
+
+                restore_attribution_seqs(
+                    json.loads(
+                        doc.base_summary.blob_bytes("attribution")),
+                    get_seqs, put_seqs,
+                )
 
         # Host-exact removal times (first remover wins; base tombstones
         # count) — they decide, per edit, whether the oracle had already
@@ -567,6 +595,12 @@ def oracle_fallback_summary(doc: TreeDocInput) -> SummaryTree:
     from ..dds.tree import SharedTree
 
     replica = SharedTree(doc.doc_id)
+    if doc.attribution:
+        # Attribution-enabled docs must emit their keys blob on fallback
+        # too (summarize keys on the flag alone).
+        from ..runtime.attributor import Attributor
+
+        replica._attributor = Attributor()
     if doc.base_summary is not None:
         replica.load(doc.base_summary)
     for msg in doc.ops:
@@ -671,6 +705,30 @@ def summary_from_state(meta, state_np: dict, d: int,
         root_obj["limbo"] = [node_obj(i) for i in limbo_idxs]
     tree = SummaryTree()
     tree.add_blob("header", canonical_json(root_obj))
+    if doc.attribution:
+        # Mirror SharedTree.summarize's key emission: pre-clamp (insert,
+        # value) seqs for every EMITTED node whose seq the header clamped
+        # (the state rows are pre-clamp; node_obj clamps at emission).
+        emitted: List[int] = []
+
+        def collect(node_o: dict) -> None:
+            emitted.append(pack.node(node_o["id"]))
+            for children in node_o.get("fields", {}).values():
+                for child in children:
+                    collect(child)
+
+        for children in root_obj.get("fields", {}).values():
+            for child in children:
+                collect(child)
+        for spec in root_obj.get("limbo", []):
+            collect(spec)
+        keys = {
+            pack.node_ids.values[i]: [int(ins_seq[i]), int(val_seq[i])]
+            for i in emitted
+            if 0 < int(ins_seq[i]) <= msn or 0 < int(val_seq[i]) <= msn
+        }
+        if keys:
+            tree.add_blob("attribution", canonical_json(keys))
     return tree
 
 

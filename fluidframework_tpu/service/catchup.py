@@ -315,18 +315,6 @@ class CatchupService:
                 if channel_tree.digest() == _empty_digest(
                         self.registry, type_name):
                     channel_tree = None  # cold fold
-                if attribution:
-                    if type_name == TREE_TYPE:
-                        # Tree attribution keys are not device-extracted
-                        # (id-addressed forest keys differ from the string
-                        # run-length shape): CPU path.
-                        return None
-                    if type_name == STRING_TYPE and channel_tree is not None \
-                            and "attribution" in channel_tree.children:
-                        # Warm base carrying pre-clamp keys: restoring them
-                        # into the pack (the oracle's load-split) is not
-                        # implemented — CPU path keeps byte parity.
-                        return None
                 plan.append((ds_id, channel_id, type_name, channel_tree))
         if plan:
             work.attribution = attribution
@@ -338,6 +326,15 @@ class CatchupService:
             return {}
         header = json.loads(channel_tree.blob_bytes("header"))
         records = json.loads(channel_tree.blob_bytes("body"))
+        if "attribution" in channel_tree.children:
+            # Warm base carrying pre-clamp keys: the ONE shared splitter
+            # (SharedString.load uses it too), so the re-summarize
+            # regenerates identical body AND keys.
+            from ..dds.merge_tree import MergeTreeOracle
+
+            MergeTreeOracle.split_records_by_attribution_keys(
+                records, json.loads(channel_tree.blob_bytes("attribution"))
+            )
         try:
             intervals = json.loads(channel_tree.blob_bytes("intervals"))
         except KeyError:
@@ -435,6 +432,7 @@ class CatchupService:
                     tree_in.append(TreeDocInput(
                         doc_id=cid, ops=ops, base_summary=channel_tree,
                         final_seq=final_seq, final_msn=final_msn,
+                        attribution=work.attribution,
                     ))
         mesh = self._resolve_mesh()
         if mesh is not None:

@@ -187,10 +187,11 @@ def test_attribution_off_documents_emit_no_attribution_bytes():
 
 
 def test_catchup_service_preserves_attribution():
-    """The bulk catch-up service routes attribution-enabled documents to
-    the CPU fold, whose composed summary preserves the stamp, the seq
-    table, and the channel key blobs — a client loading the service
-    summary still resolves attribution."""
+    """The bulk catch-up service folds attribution-enabled documents on
+    the DEVICE path (round 5; string + tree channels both emit their key
+    blobs from the export, the container table folds over the tail) —
+    byte-identical to the CPU container fold, and a client loading the
+    service summary still resolves attribution."""
     import json
 
     from fluidframework_tpu.service.catchup import CatchupService
@@ -202,9 +203,17 @@ def test_catchup_service_preserves_attribution():
     a.runtime.flush()
     a.drain()
 
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None  # force the container fold
+    cpu_results = cpu.catch_up(upload=False)
+    assert cpu.cpu_docs == 1
+
     svc = CatchupService(service)
+    dev_results = svc.catch_up(upload=False)
+    assert svc.device_docs == 1 and svc.cpu_docs == 0
+    assert dev_results == cpu_results, (
+        "device attribution fold != container fold (string+tree doc)")
     svc.catch_up()
-    assert svc.cpu_docs == 1  # attribution doc routed to the CPU fold
 
     tree, _seq = service.storage.latest("doc")
     assert json.loads(tree.blob_bytes(".metadata"))["attribution"] is True
@@ -412,3 +421,111 @@ def test_kernel_attribution_parity_direct():
     )])
     assert got.digest() == want.digest()
     assert got.blob_bytes("attribution") == want.blob_bytes("attribution")
+
+
+def test_catchup_device_tree_attribution_with_window_clamp():
+    """Tree-channel attribution through the device fold across a window
+    clamp: the kernel's key blob (pre-clamp insert/value seqs per emitted
+    node) must match the container fold byte-for-byte, and a fresh client
+    resolves authors for clamped nodes."""
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    def build_tree_only(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("tree-tpu", "tree")
+
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build_tree_only)
+    b = loader.resolve("doc", client_id="bob")
+    tra = a.runtime.get_datastore("ds").get_channel("tree")
+    trb = b.runtime.get_datastore("ds").get_channel("tree")
+    tra.insert("", "items", 0, [{"id": "n0", "type": "t", "value": 1}])
+    a.runtime.flush()
+    a.drain(), b.drain()
+    trb.set_value("n0", 2)
+    trb.insert("", "items", 1, [{"id": "n1", "type": "t", "value": 7}])
+    b.runtime.flush()
+    a.drain(), b.drain()
+    # Advance the window past those edits so the summary clamps them.
+    for k in range(3):
+        tra.set_value("n1", 10 + k)
+        a.runtime.flush()
+        a.drain(), b.drain()
+        trb.set_value("n1", 20 + k)
+        b.runtime.flush()
+        a.drain(), b.drain()
+
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None
+    cpu_results = cpu.catch_up(upload=False)
+    assert cpu.cpu_docs == 1
+
+    dev = CatchupService(service)
+    dev_results = dev.catch_up(upload=False)
+    assert dev.device_docs == 1 and dev.cpu_docs == 0
+    assert dev_results == cpu_results, (
+        "device tree attribution fold != container fold")
+
+    dev.catch_up()
+    c = loader.resolve("doc", client_id="carol")
+    trc = c.runtime.get_datastore("ds").get_channel("tree")
+    assert trc.attribution_of("n0")["user"] == "alice"
+    assert trc.attribution_of("n1")["user"] == "bob"
+
+
+def test_catchup_device_warm_string_attribution_base():
+    """A WARM catch-up whose base summary already carries a string key
+    blob: the pack splits the merged base records back (the oracle's
+    load-split), so the device re-fold regenerates identical body and
+    keys over the new tail."""
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    service, loader = make_stack()
+    a = loader.create("doc", "alice", build_string_only)
+    b = loader.resolve("doc", client_id="bob")
+    ta = a.runtime.get_datastore("ds").get_channel("text")
+    tb = b.runtime.get_datastore("ds").get_channel("text")
+    ta.insert_text(0, "foo")
+    a.runtime.flush()
+    a.drain(), b.drain()
+    tb.insert_text(3, "bar")
+    b.runtime.flush()
+    a.drain(), b.drain()
+    for _k in range(3):  # clamp both authors' inserts below the window
+        ta.insert_text(len(ta.text), ".")
+        a.runtime.flush()
+        a.drain(), b.drain()
+        tb.insert_text(len(tb.text), "!")
+        b.runtime.flush()
+        a.drain(), b.drain()
+
+    # First catch-up: produces the keyed base summary.
+    first = CatchupService(service)
+    first.catch_up()
+    assert first.device_docs == 1
+    base_tree, _seq = service.storage.latest("doc")
+    assert "attribution" in base_tree.get(".datastores").get("ds") \
+        .get("text").children
+
+    # New tail on top of the keyed base.
+    ta.insert_text(0, "warm:")
+    a.runtime.flush()
+    a.drain(), b.drain()
+
+    cpu = CatchupService(service)
+    cpu._device_plan = lambda w: None
+    cpu_results = cpu.catch_up(upload=False)
+    assert cpu.cpu_docs == 1
+
+    dev = CatchupService(service)
+    dev_results = dev.catch_up(upload=False)
+    assert dev.device_docs == 1 and dev.cpu_docs == 0
+    assert dev_results == cpu_results, (
+        "warm keyed-base device fold != container fold")
+
+    dev.catch_up()
+    c = loader.resolve("doc", client_id="carol")
+    tc = c.runtime.get_datastore("ds").get_channel("text")
+    assert tc.text.startswith("warm:")
+    assert tc.attribution_at(5)["user"] == "alice"   # 'f' of foo
+    assert tc.attribution_at(8)["user"] == "bob"     # 'b' of bar
