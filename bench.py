@@ -768,27 +768,57 @@ def _run_bench(probe: dict) -> dict:
     link = link_microbench()
     print(f"link: {link}", file=sys.stderr)
 
+    # --- fact-homogeneous chunk schedule: group annotate-free docs
+    # together so their chunks fold with the props plane traced away
+    # (has_props chunk fact, ~20% fold speedup on the 70% pure-text
+    # volume).  A service-side BATCHING choice, not a workload change —
+    # the oracle denominator above sampled the original pinned order.
+    docs_sched = sorted(docs, key=lambda d: d.binary_prop_keys is not None)
+
     # --- warm the compile cache outside the timed run (a fresh process
-    # pays XLA compilation once; steady service operation does not) ---
+    # pays XLA compilation once; steady service operation does not).
+    # Warm slices are ALIGNED TO THE E2E CHUNK GRID and cover every fact
+    # signature the schedule can produce: the first chunk (props-free
+    # majority), the group-boundary chunk (mixed when the pure count is
+    # not a chunk multiple — without warming it, its executable would
+    # compile INSIDE the timed e2e), and the last chunk (props group).
     CURRENT_PHASE["phase"] = "warm-compile"
-    warm_state, warm_ops, warm_meta = pack_mergetree_batch(docs[:CHUNK_DOCS])
-    S = warm_state.tstart.shape[1]
-    t0 = time.time()
-    jax.block_until_ready(replay_export(None, warm_ops, warm_meta, S=S))
-    warm_time = time.time() - t0
-    print(
-        f"compile+first fold {warm_time:.1f}s "
-        f"(S={S}, i16={'yes' if warm_meta['i16_ok'] else 'no'}, "
-        f"i8={'yes' if warm_meta.get('i8_ok') else 'no'}, "
-        f"ob_rows={'yes' if warm_meta.get('ob_rows', True) else 'ELIDED'}, "
-        f"ov_rows={'yes' if warm_meta.get('ov_rows', True) else 'ELIDED'})",
-        file=sys.stderr,
-    )
+    starts = list(range(0, len(docs_sched), CHUNK_DOCS))
+    n_pure = sum(1 for d in docs_sched if d.binary_prop_keys is None)
+    boundary = min((n_pure // CHUNK_DOCS) * CHUNK_DOCS, starts[-1])
+    S = None
+    roof_k_eff = roof_group = None
+    for lo in sorted({0, boundary, starts[-1]}):
+        warm_docs = docs_sched[lo:lo + CHUNK_DOCS]
+        warm_state, warm_ops, warm_meta = pack_mergetree_batch(warm_docs)
+        s_warm = warm_state.tstart.shape[1]
+        if S is None:
+            # Roofline pins the FIRST chunk's shape — the majority group
+            # (props-free chunks stream no props plane: effective K = 0).
+            S = s_warm
+            carried = bool(warm_meta.get("has_props", True))
+            roof_k_eff = int(warm_state.props.shape[-1]) if carried else 0
+            roof_group = "props-carried" if carried else "props-free"
+        t0 = time.time()
+        jax.block_until_ready(
+            replay_export(None, warm_ops, warm_meta, S=s_warm)
+        )
+        warm_time = time.time() - t0
+        print(
+            f"compile+first fold {warm_time:.1f}s "
+            f"(chunk@{lo}, S={s_warm}, "
+            f"i16={'yes' if warm_meta['i16_ok'] else 'no'}, "
+            f"i8={'yes' if warm_meta.get('i8_ok') else 'no'}, "
+            f"ob_rows={'yes' if warm_meta.get('ob_rows', True) else 'ELIDED'}, "
+            f"ov_rows={'yes' if warm_meta.get('ov_rows', True) else 'ELIDED'}, "
+            f"props={'carried' if warm_meta.get('has_props', True) else 'ELIDED'})",
+            file=sys.stderr,
+        )
 
     # --- HONEST END-TO-END: raw streams → host-side canonical summaries,
     # stages pipelined (see run_e2e) ---
     CURRENT_PHASE["phase"] = "e2e"
-    summaries, stats, stage, e2e_time, packed_chunks = run_e2e(docs)
+    summaries, stats, stage, e2e_time, packed_chunks = run_e2e(docs_sched)
     assert len(summaries) == N_DOCS
     e2e_ops_per_sec = total_ops / e2e_time
     fallbacks = stats.get("fallback_docs", 0)
@@ -830,10 +860,12 @@ def _run_bench(probe: dict) -> dict:
     # meaningful on a real TPU; the cpu backend has no pinned HBM figure)
     roof = None
     if probe.get("platform") in ("tpu", "axon"):
-        # K must be the PADDED props-plane width the scan actually carries
-        # (pack bucket-pads the key axis), not the logical key count.
-        k_padded = int(warm_state.props.shape[-1])
-        roof = roofline(S, k_padded, probe.get("device_kind", "?"))
+        # (S, K) pinned together from the FIRST warm chunk — the majority
+        # fact-group — so the bound describes a configuration that really
+        # executes (K is the PADDED carried width; 0 when the props plane
+        # is traced away on props-free chunks).
+        roof = roofline(S, roof_k_eff, probe.get("device_kind", "?"))
+        roof["group"] = roof_group
         roof["steady_fold_pct_of_bound"] = round(
             100.0 * fold_ops_per_sec / roof["bound_ops_per_sec"], 2
         )
@@ -846,10 +878,11 @@ def _run_bench(probe: dict) -> dict:
         assert dev_summary.digest() == oracle_replay(doc).summarize().digest(), (
             f"bench sanity: {doc.doc_id} device summary != oracle"
         )
-    # and against the end-to-end pipeline output
-    assert summaries[0].digest() == oracle_replay(docs[0]).summarize().digest()
+    # and against the end-to-end pipeline output (chunk-scheduled order)
+    assert summaries[0].digest() == \
+        oracle_replay(docs_sched[0]).summarize().digest()
     assert summaries[-1].digest() == \
-        oracle_replay(docs[-1]).summarize().digest()
+        oracle_replay(docs_sched[-1]).summarize().digest()
     print("sanity: device summaries byte-identical to oracle", file=sys.stderr)
     CURRENT_PHASE["phase"] = "done"
 

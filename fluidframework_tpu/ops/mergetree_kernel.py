@@ -152,10 +152,11 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
 
 
 def _apply_op(state: MTState, op, sequential: bool = False,
-              has_ob: bool = True) -> MTState:
+              has_ob: bool = True, has_props: bool = True) -> MTState:
     """One sequenced op — the scan step.
 
-    ``sequential`` / ``has_ob`` are COMPILE-TIME chunk facts (the same
+    ``sequential`` / ``has_ob`` / ``has_props`` are COMPILE-TIME chunk
+    facts (the same
     pack-time predicates that drive the export row elisions): a fully
     sequential chunk (every ref_seq == seq-1) can never arrival-kill an
     insert (no stamp exceeds any op's ref — base stamps included, since
@@ -163,7 +164,10 @@ def _apply_op(state: MTState, op, sequential: bool = False,
     never stamps — so the arrival-kill scan and the stamping block trace
     away instead of running masked-dead every step.  (The second-remover
     bookkeeping always runs; its impossibility on sequential chunks only
-    drives the ov_rows EXPORT elision.)"""
+    drives the ov_rows EXPORT elision.)  A chunk with NO property keys
+    anywhere (no annotate ops, no base props — pack's interner is empty)
+    keeps its constant PROP_ABSENT plane untouched: the per-op [S, K]
+    plane shift and the annotate write trace away."""
     S = state.tlen.shape[0]
     ref_seq, client = op.ref_seq, op.client
     is_ins = op.kind == K_INSERT
@@ -261,10 +265,12 @@ def _apply_op(state: MTState, op, sequential: bool = False,
                            jnp.where(killed, kill_client, -1)),
         ob2_seq=shifted(state.ob2_seq, NOT_REMOVED),
         ob2_client=shifted(state.ob2_client, -1),
+        # A constant PROP_ABSENT plane is shift-invariant: skip the
+        # gather+where entirely on props-free chunks.
         props=shifted(
             state.props,
             jnp.where(op.pvals == PROP_NOT_TOUCHED, PROP_ABSENT, op.pvals),
-        ),
+        ) if has_props else state.props,
         n=state.n + 1,
         overflow=state.overflow,
     )
@@ -315,33 +321,36 @@ def _apply_op(state: MTState, op, sequential: bool = False,
         overflow=state.overflow | third.any(),
     )
 
-    touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] & (covered & is_ann)[:, None]
-    state = state._replace(
-        props=jnp.where(touch, jnp.broadcast_to(op.pvals, state.props.shape),
-                        state.props)
-    )
+    if has_props:
+        touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] \
+            & (covered & is_ann)[:, None]
+        state = state._replace(
+            props=jnp.where(
+                touch, jnp.broadcast_to(op.pvals, state.props.shape),
+                state.props)
+        )
     return state
 
 
 def replay_scan(state: MTState, ops: MTOps, sequential: bool = False,
-                has_ob: bool = True) -> MTState:
+                has_ob: bool = True, has_props: bool = True) -> MTState:
     """Pure single-document op-fold (no jit): scan the op stream.
-    ``sequential``/``has_ob`` are compile-time chunk facts (see
-    ``_apply_op``); the defaults are the full semantics."""
+    ``sequential``/``has_ob``/``has_props`` are compile-time chunk facts
+    (see ``_apply_op``); the defaults are the full semantics."""
 
     def step(carry, op):
-        return _apply_op(carry, op, sequential, has_ob), None
+        return _apply_op(carry, op, sequential, has_ob, has_props), None
 
     final, _ = jax.lax.scan(step, state, ops)
     return final
 
 
 def replay_vmapped(state: MTState, ops: MTOps, sequential: bool = False,
-                   has_ob: bool = True) -> MTState:
+                   has_ob: bool = True, has_props: bool = True) -> MTState:
     """Vmapped over the document axis — the unit the parallel/ package
     shards."""
     return jax.vmap(
-        lambda s, o: replay_scan(s, o, sequential, has_ob)
+        lambda s, o: replay_scan(s, o, sequential, has_ob, has_props)
     )(state, ops)
 
 
@@ -429,7 +438,8 @@ def _export_fields(ob_rows: bool, ov_rows: bool):
 
 def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
                   i16: bool = False, ob_rows: bool = True,
-                  ov_rows: bool = True, i8: bool = False) -> jnp.ndarray:
+                  ov_rows: bool = True, i8: bool = False,
+                  props_rows: bool = True) -> jnp.ndarray:
     """[D, rows, S] fused view of everything summary extraction and
     interval replay need from the final device state (int32, or int16 when
     ``i16`` with per-doc-rebased tstart and remapped NOT_REMOVED
@@ -441,6 +451,8 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
       ops or base stamps in the chunk — pack-time fact);
     - ``ov_rows=False``: the two overlap-remover rows elided (fully
       sequential views + no base "ro" — a second remover cannot occur);
+    - ``props_rows=False``: the K props-plane rows elided (props-free
+      chunk — the plane is constant PROP_ABSENT);
     - ``i8``: every byte-sized row pairs into one int16 lane
       (``(a & 0xFF) << 8 | (b & 0xFF)``) — tstart and misc stay 16-bit."""
     i8 = i8 and i16  # byte packing presupposes the int16 transforms
@@ -470,7 +482,8 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
             val = getattr(final, f)
             named[f] = jnp.where(val == NOT_REMOVED, sentinel, val)
     rows = [named.get(f, getattr(final, f)) for f in fields]
-    rows += [final.props[:, :, k] for k in range(K)]
+    if props_rows:
+        rows += [final.props[:, :, k] for k in range(K)]
     if i8:
         byte_rows = rows[1:]
         if len(byte_rows) % 2:
@@ -504,14 +517,15 @@ def widen_export(export_np,
                  doc_base: Optional[np.ndarray],
                  ob_rows: bool = True, ov_rows: bool = True,
                  i8: bool = False,
-                 n_props: Optional[int] = None) -> np.ndarray:
+                 n_props: Optional[int] = None,
+                 props_rows: bool = True) -> np.ndarray:
     """Undo the export transfer transforms host-side, always returning the
     CANONICAL full int32 layout: unpack int8 pairs and stitch the separate
     misc output back into a row (``i8`` — needs ``n_props``, the padded
     props-plane width), widen int16 to int32, restore NOT_REMOVED
     sentinels, re-add per-doc arena bases, and reinsert elided
-    obliterate/overlap rows with their sentinel fills.  Full-layout int32
-    buffers pass through untouched."""
+    obliterate/overlap/props rows with their sentinel fills.  Full-layout
+    int32 buffers pass through untouched."""
     misc_np = None
     if isinstance(export_np, tuple):
         export_np, misc_np = export_np
@@ -525,7 +539,7 @@ def widen_export(export_np,
             assert n_props is not None, "i8 widen needs the props width"
             assert misc_np is not None, "i8 widen needs the misc output"
             u = export_np.astype(np.uint16)
-            n_bytes = len(fields) - 1 + n_props
+            n_bytes = len(fields) - 1 + (n_props if props_rows else 0)
             rows = [export_np[:, 0, :].astype(np.int32)]
             for i in range(n_bytes):
                 pair = u[:, 1 + i // 2, :]
@@ -563,6 +577,12 @@ def widen_export(export_np,
             [buf[:, :split], filler, buf[:, split:]], axis=1
         )
 
+    if not props_rows:
+        # Reinsert the constant PROP_ABSENT plane rows before the misc row.
+        assert n_props is not None, "props-row reinsert needs the width"
+        D, _R, S = out.shape
+        filler = np.full((D, n_props, S), PROP_ABSENT, np.int32)
+        out = np.concatenate([out[:, :-1], filler, out[:, -1:]], axis=1)
     if not ov_rows:
         out = reinsert(out, OV_SLOT_FIELDS,
                        fields.index("rem_client") + 1)  # rem2 slots next
@@ -609,7 +629,8 @@ def _out_shardings_for(i8: bool):
     return (fmt, Format(Layout(major_to_minor=(0, 1)), fmt.sharding))
 
 
-def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True):
+def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
+             has_props: bool = True):
     """The batch fold: the lax.scan path by default (specialized at
     compile time by the chunk facts — see ``_apply_op``); the Pallas
     VMEM-resident kernel (ops/pallas_fold.py) when FF_PALLAS_FOLD selects
@@ -623,21 +644,23 @@ def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True):
         interpret = mode == "interpret"
         return lambda state, ops: replay_vmapped_pallas(
             state, ops, interpret=interpret)
-    return lambda state, ops: replay_vmapped(state, ops, sequential, has_ob)
+    return lambda state, ops: replay_vmapped(state, ops, sequential,
+                                             has_ob, has_props)
 
 
 @functools.lru_cache(maxsize=None)
 def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
                     fold_mode: str = "", ov_rows: bool = True,
-                    i8: bool = False, sequential: bool = False):
+                    i8: bool = False, sequential: bool = False,
+                    has_props: bool = True):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch."""
-    fold = _fold_fn(fold_mode, sequential, ob_rows)
+    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props)
 
     def f(ops, doc_base):
         return _export_state(
             fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows,
-            ov_rows, i8,
+            ov_rows, i8, props_rows=has_props,
         )
 
     fmt = _out_shardings_for(i8)
@@ -647,13 +670,13 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
 @functools.lru_cache(maxsize=None)
 def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
                     ov_rows: bool = True, i8: bool = False,
-                    sequential: bool = False):
+                    sequential: bool = False, has_props: bool = True):
     """Compiled warm-start (base state uploaded) fold+export."""
-    fold = _fold_fn(fold_mode, sequential, ob_rows)
+    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props)
 
     def f(state, ops, doc_base):
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
-                             ov_rows, i8)
+                             ov_rows, i8, props_rows=has_props)
 
     fmt = _out_shardings_for(i8)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
@@ -662,9 +685,9 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
 def export_layout_rows(meta: dict) -> int:
     """Row count of the transfer buffer replay_export emits for this
     packed chunk's layout facts (elisions + byte packing)."""
-    _i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    _i16, ob_rows, ov_rows, i8, props_rows = _export_flags(meta)
     fields = _export_fields(ob_rows, ov_rows)
-    K = meta.get("props_K", 1)
+    K = meta.get("props_K", 1) if props_rows else 0
     if i8:
         n_bytes = len(fields) - 1 + K
         return 1 + (n_bytes + 1) // 2  # misc rides the separate output
@@ -672,12 +695,19 @@ def export_layout_rows(meta: dict) -> int:
 
 
 def _export_flags(meta: dict):
+    """The transfer-layout facts BOTH sides of the export handshake use
+    (dispatch builds the buffer, extraction widens it) — one derivation
+    point so they can never disagree.  The pallas fold ignores the chunk
+    facts, so its mode forces the props rows back on at both ends."""
+    from .pallas_fold import pallas_fold_mode
+
     i16 = bool(meta.get("i16_ok"))
     return (
         i16,
         bool(meta.get("ob_rows", True)),
         bool(meta.get("ov_rows", True)),
         i16 and bool(meta.get("i8_ok")),
+        bool(meta.get("has_props", True)) or pallas_fold_mode() != "",
     )
 
 
@@ -690,18 +720,20 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     built in-graph — no zero upload)."""
     from .pallas_fold import pallas_fold_mode
 
-    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
     mode = pallas_fold_mode()
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
     # The pallas fold ignores the chunk facts — normalize so mixed
-    # workloads don't compile duplicate executables per cache key.
+    # workloads don't compile duplicate executables per cache key
+    # (has_props is already mode-normalized inside _export_flags, the
+    # shared dispatch/extraction derivation point).
     sequential = bool(meta.get("sequential")) and mode == ""
     if state is None:
         return _export_cold_fn(int(S), i16, ob_rows, mode, ov_rows,
-                               i8, sequential)(ops, doc_base)
+                               i8, sequential, has_props)(ops, doc_base)
     return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8,
-                           sequential)(state, ops, doc_base)
+                           sequential, has_props)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:
@@ -1029,6 +1061,10 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         # filled binary rows, which land in op["kind"] — or a base stamp).
         "ob_rows": base_has_ob or bool((op["kind"] == K_OBLITERATE).any()),
         "ov_rows": base_has_ro or not sequential,
+        # Props-free chunk (no annotate ops, no base props — the interner
+        # saw no keys from ANY source): the plane stays constant, the
+        # per-op plane shift traces away.
+        "has_props": len(prop_keys) > 0,
         # Compile-time fold specialization (see _apply_op): base stamps
         # cannot exceed any sequential tail ref, so ``sequential`` alone
         # licenses the arrival-kill skip even on warm docs.
@@ -1258,10 +1294,11 @@ def summaries_from_export(meta, export_np: np.ndarray,
 
     docs = meta["docs"]
     D = len(docs)
-    _i16, ob_rows_f, ov_rows_f, i8_f = _export_flags(meta)
+    _i16, ob_rows_f, ov_rows_f, i8_f, props_rows_f = _export_flags(meta)
     export_np = widen_export(export_np, meta.get("doc_base"),
                              ob_rows=ob_rows_f, ov_rows=ov_rows_f,
-                             i8=i8_f, n_props=meta.get("props_K"))
+                             i8=i8_f, n_props=meta.get("props_K"),
+                             props_rows=props_rows_f)
     state_np = state_dict_from_export(export_np)
     skip = np.zeros(D, np.uint8)
     for d in range(D):

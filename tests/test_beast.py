@@ -163,11 +163,12 @@ def test_beast_soak_pallas_interpret():
     )
     state, ops, meta = pack_mergetree_batch([doc])
     final = replay_vmapped_pallas(state, ops, interpret=True)
-    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    i16, ob_rows, ov_rows, i8, props_rows = _export_flags(meta)
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((1,), jnp.int32)
     export = export_to_numpy(
-        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8))
+        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8,
+                      props_rows=props_rows))
     [summary] = summaries_from_export(meta, export)
     assert summary.digest() == digests[log[-1].seq], (
         "pallas-interpret summary != oracle on the concurrent soak"

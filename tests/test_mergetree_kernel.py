@@ -217,10 +217,11 @@ def test_export_widths_agree_and_widen_roundtrips():
     assert ex32.dtype == np.int32
     from fluidframework_tpu.ops.mergetree_kernel import _export_flags
 
-    _i, ob_f, ov_f, i8_f = _export_flags(meta)
+    _i, ob_f, ov_f, i8_f, props_f = _export_flags(meta)
     w16 = widen_export(ex16, meta["doc_base"], ob_rows=ob_f, ov_rows=ov_f,
-                       i8=i8_f, n_props=meta["props_K"])
-    w32 = widen_export(ex32, None, ob_rows=ob_f, ov_rows=ov_f)
+                       i8=i8_f, n_props=meta["props_K"], props_rows=props_f)
+    w32 = widen_export(ex32, None, ob_rows=ob_f, ov_rows=ov_f,
+                       n_props=meta["props_K"], props_rows=props_f)
     if i8_f:
         # Bit-equality holds for the slots extraction reads ([0, n) per
         # doc); beyond n the int8 pack truncates dead-slot garbage to 8

@@ -58,11 +58,12 @@ def test_pallas_fold_matches_scan_on_bench_workload():
         export_to_numpy,
     )
 
-    i16, ob_rows, ov_rows, i8 = _export_flags(meta)
+    i16, ob_rows, ov_rows, i8, props_rows = _export_flags(meta)
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((len(docs),), jnp.int32)
     export = export_to_numpy(
-        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8))
+        _export_state(final, doc_base, i16, ob_rows, ov_rows, i8,
+                      props_rows=props_rows))
     summaries = summaries_from_export(meta, export)
     for doc, summary in zip(docs[:6], summaries[:6]):
         assert summary.digest() == \
