@@ -109,10 +109,18 @@ def _excl_cumsum(v: jnp.ndarray) -> jnp.ndarray:
     return jnp.cumsum(v) - v
 
 
-def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
+def _split_at(state: MTState, char_pos, ref_seq, client, enable,
+              has_ob: bool = True, has_ov: bool = True,
+              has_props: bool = True) -> MTState:
     """Split the segment that ``char_pos`` falls strictly inside of (in the
     op's view), shifting the pool right by one.  No-op when the position
-    lands on a boundary or ``enable`` is false."""
+    lands on a boundary or ``enable`` is false.
+
+    Constant planes are SHIFT-INVARIANT, so the chunk facts skip their
+    shuffles outright: ob-free chunks never write the four ob columns
+    (they stay NOT_REMOVED/-1), second-remover-free chunks (fully
+    sequential views + no base "ro") never write rem2, props-free chunks
+    never write the [S, K] plane."""
     S = state.tlen.shape[0]
     v = _visible_len(state, ref_seq, client)
     cum = _excl_cumsum(v)
@@ -138,13 +146,14 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
         ins_client=shift(state.ins_client),
         rem_seq=shift(state.rem_seq),
         rem_client=shift(state.rem_client),
-        rem2_seq=shift(state.rem2_seq),
-        rem2_client=shift(state.rem2_client),
-        ob1_seq=shift(state.ob1_seq),
-        ob1_client=shift(state.ob1_client),
-        ob2_seq=shift(state.ob2_seq),
-        ob2_client=shift(state.ob2_client),
-        props=shift(state.props),
+        rem2_seq=shift(state.rem2_seq) if has_ov else state.rem2_seq,
+        rem2_client=shift(state.rem2_client) if has_ov
+        else state.rem2_client,
+        ob1_seq=shift(state.ob1_seq) if has_ob else state.ob1_seq,
+        ob1_client=shift(state.ob1_client) if has_ob else state.ob1_client,
+        ob2_seq=shift(state.ob2_seq) if has_ob else state.ob2_seq,
+        ob2_client=shift(state.ob2_client) if has_ob else state.ob2_client,
+        props=shift(state.props) if has_props else state.props,
         n=state.n + 1,
         overflow=state.overflow,
     )
@@ -152,22 +161,27 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable) -> MTState:
 
 
 def _apply_op(state: MTState, op, sequential: bool = False,
-              has_ob: bool = True, has_props: bool = True) -> MTState:
+              has_ob: bool = True, has_props: bool = True,
+              has_ov: bool = True) -> MTState:
     """One sequenced op — the scan step.
 
-    ``sequential`` / ``has_ob`` / ``has_props`` are COMPILE-TIME chunk
-    facts (the same
+    ``sequential`` / ``has_ob`` / ``has_props`` / ``has_ov`` are
+    COMPILE-TIME chunk facts (the same
     pack-time predicates that drive the export row elisions): a fully
     sequential chunk (every ref_seq == seq-1) can never arrival-kill an
     insert (no stamp exceeds any op's ref — base stamps included, since
     they are <= base_seq <= every tail ref), and an obliterate-free chunk
     never stamps — so the arrival-kill scan and the stamping block trace
-    away instead of running masked-dead every step.  (The second-remover
-    bookkeeping always runs; its impossibility on sequential chunks only
-    drives the ov_rows EXPORT elision.)  A chunk with NO property keys
+    away instead of running masked-dead every step.  A chunk with NO
+    property keys
     anywhere (no annotate ops, no base props — pack's interner is empty)
     keeps its constant PROP_ABSENT plane untouched: the per-op [S, K]
-    plane shift and the annotate write trace away."""
+    plane shift and the annotate write trace away.  ``has_ov=False``
+    (the ov_rows export predicate: fully sequential views + no base
+    "ro", so a second remover cannot occur — a sequential remove can
+    never even target an already-removed segment, it is invisible in the
+    remover's view) keeps the two rem2 planes constant: their shifts and
+    the second/third-remover writes trace away."""
     S = state.tlen.shape[0]
     ref_seq, client = op.ref_seq, op.client
     is_ins = op.kind == K_INSERT
@@ -177,8 +191,10 @@ def _apply_op(state: MTState, op, sequential: bool = False,
     is_rangey = is_rem | is_ann | is_obl
 
     # Boundary splits (shared by all op kinds).
-    state = _split_at(state, op.a, ref_seq, client, is_ins | is_rangey)
-    state = _split_at(state, op.b, ref_seq, client, is_rangey)
+    state = _split_at(state, op.a, ref_seq, client, is_ins | is_rangey,
+                      has_ob, has_ov, has_props)
+    state = _split_at(state, op.b, ref_seq, client, is_rangey,
+                      has_ob, has_ov, has_props)
 
     v = _visible_len(state, ref_seq, client)
     cum = _excl_cumsum(v)
@@ -257,14 +273,22 @@ def _apply_op(state: MTState, op, sequential: bool = False,
                         jnp.where(killed, kill_seq, NOT_REMOVED)),
         rem_client=shifted(state.rem_client,
                            jnp.where(killed, kill_client, -1)),
-        rem2_seq=shifted(state.rem2_seq, NOT_REMOVED),
-        rem2_client=shifted(state.rem2_client, -1),
+        # Constant planes are shift-invariant (new slots get the same
+        # constant): skip their gathers under the facts.
+        rem2_seq=shifted(state.rem2_seq, NOT_REMOVED) if has_ov
+        else state.rem2_seq,
+        rem2_client=shifted(state.rem2_client, -1) if has_ov
+        else state.rem2_client,
         ob1_seq=shifted(state.ob1_seq,
-                        jnp.where(killed, kill_seq, NOT_REMOVED)),
+                        jnp.where(killed, kill_seq, NOT_REMOVED))
+        if has_ob else state.ob1_seq,
         ob1_client=shifted(state.ob1_client,
-                           jnp.where(killed, kill_client, -1)),
-        ob2_seq=shifted(state.ob2_seq, NOT_REMOVED),
-        ob2_client=shifted(state.ob2_client, -1),
+                           jnp.where(killed, kill_client, -1))
+        if has_ob else state.ob1_client,
+        ob2_seq=shifted(state.ob2_seq, NOT_REMOVED) if has_ob
+        else state.ob2_seq,
+        ob2_client=shifted(state.ob2_client, -1) if has_ob
+        else state.ob2_client,
         # A constant PROP_ABSENT plane is shift-invariant: skip the
         # gather+where entirely on props-free chunks.
         props=shifted(
@@ -316,10 +340,17 @@ def _apply_op(state: MTState, op, sequential: bool = False,
     state = state._replace(
         rem_seq=jnp.where(first_win, op.seq, state.rem_seq),
         rem_client=jnp.where(first_win, client, state.rem_client),
-        rem2_seq=jnp.where(second, op.seq, state.rem2_seq),
-        rem2_client=jnp.where(second, client, state.rem2_client),
-        overflow=state.overflow | third.any(),
     )
+    if has_ov:
+        # Sequential view + no base "ro" (has_ov=False): a remove or
+        # obliterate can never target an already-removed segment
+        # (invisible to its author), so `second`/`third` are structurally
+        # false — rem2 stays constant and these writes trace away.
+        state = state._replace(
+            rem2_seq=jnp.where(second, op.seq, state.rem2_seq),
+            rem2_client=jnp.where(second, client, state.rem2_client),
+            overflow=state.overflow | third.any(),
+        )
 
     if has_props:
         touch = (op.pvals != PROP_NOT_TOUCHED)[None, :] \
@@ -333,24 +364,29 @@ def _apply_op(state: MTState, op, sequential: bool = False,
 
 
 def replay_scan(state: MTState, ops: MTOps, sequential: bool = False,
-                has_ob: bool = True, has_props: bool = True) -> MTState:
+                has_ob: bool = True, has_props: bool = True,
+                has_ov: bool = True) -> MTState:
     """Pure single-document op-fold (no jit): scan the op stream.
-    ``sequential``/``has_ob``/``has_props`` are compile-time chunk facts
-    (see ``_apply_op``); the defaults are the full semantics."""
+    ``sequential``/``has_ob``/``has_props``/``has_ov`` are compile-time
+    chunk facts (see ``_apply_op``); the defaults are the full
+    semantics."""
 
     def step(carry, op):
-        return _apply_op(carry, op, sequential, has_ob, has_props), None
+        return _apply_op(carry, op, sequential, has_ob, has_props,
+                         has_ov), None
 
     final, _ = jax.lax.scan(step, state, ops)
     return final
 
 
 def replay_vmapped(state: MTState, ops: MTOps, sequential: bool = False,
-                   has_ob: bool = True, has_props: bool = True) -> MTState:
+                   has_ob: bool = True, has_props: bool = True,
+                   has_ov: bool = True) -> MTState:
     """Vmapped over the document axis — the unit the parallel/ package
     shards."""
     return jax.vmap(
-        lambda s, o: replay_scan(s, o, sequential, has_ob, has_props)
+        lambda s, o: replay_scan(s, o, sequential, has_ob, has_props,
+                                 has_ov)
     )(state, ops)
 
 
@@ -630,7 +666,7 @@ def _out_shardings_for(i8: bool):
 
 
 def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
-             has_props: bool = True):
+             has_props: bool = True, has_ov: bool = True):
     """The batch fold: the lax.scan path by default (specialized at
     compile time by the chunk facts — see ``_apply_op``); the Pallas
     VMEM-resident kernel (ops/pallas_fold.py) when FF_PALLAS_FOLD selects
@@ -645,7 +681,7 @@ def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
         return lambda state, ops: replay_vmapped_pallas(
             state, ops, interpret=interpret)
     return lambda state, ops: replay_vmapped(state, ops, sequential,
-                                             has_ob, has_props)
+                                             has_ob, has_props, has_ov)
 
 
 @functools.lru_cache(maxsize=None)
@@ -654,8 +690,10 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
                     i8: bool = False, sequential: bool = False,
                     has_props: bool = True):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
-    its output laid out for a line-rate fetch."""
-    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props)
+    its output laid out for a line-rate fetch.  ``ob_rows``/``ov_rows``
+    double as the fold facts (has_ob/has_ov): the export elides exactly
+    the planes the fold provably never writes."""
+    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(ops, doc_base):
         return _export_state(
@@ -672,7 +710,7 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
                     ov_rows: bool = True, i8: bool = False,
                     sequential: bool = False, has_props: bool = True):
     """Compiled warm-start (base state uploaded) fold+export."""
-    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props)
+    fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(state, ops, doc_base):
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
