@@ -143,6 +143,50 @@ class DirectoryFuzzSpec(FuzzSpec):
         return dds._root.summary_obj()
 
 
+class RegisterFuzzSpec(FuzzSpec):
+    """Consensus register collection: concurrent writes to a small key
+    pool — version lists and winners must converge."""
+
+    KEYS = [f"r{i}" for i in range(4)]
+
+    def create(self, object_id: str) -> SharedObject:
+        from ..dds.consensus import ConsensusRegisterCollection
+
+        return ConsensusRegisterCollection(object_id)
+
+    def random_op(self, rng: random.Random, dds) -> None:
+        dds.write(rng.choice(self.KEYS), rng.randint(0, 99))
+
+    def observable(self, dds):
+        return {k: dds.read_versions(k) for k in sorted(dds.keys())}
+
+
+class QueueFuzzSpec(FuzzSpec):
+    """Consensus queue: adds racing acquire/complete/release — held items
+    and remaining queue contents must converge (the acquire order is the
+    total order, so every replica agrees who holds what)."""
+
+    def create(self, object_id: str) -> SharedObject:
+        from ..dds.consensus import ConsensusQueue
+
+        return ConsensusQueue(object_id)
+
+    def random_op(self, rng: random.Random, dds) -> None:
+        r = rng.random()
+        held = sorted(dds.held_by_me)
+        if r < 0.45 or (len(dds) == 0 and not held):
+            dds.add(rng.randint(0, 999))
+        elif r < 0.75 and len(dds):
+            dds.acquire()
+        elif held and r < 0.9:
+            dds.complete(rng.choice(held))
+        elif held:
+            dds.release(rng.choice(held))
+
+    def observable(self, dds):
+        return (dds.items, sorted(dds.held_by_me))
+
+
 class MatrixFuzzSpec(FuzzSpec):
     """Random row/col structure edits + cell writes; optional FWW switch."""
 

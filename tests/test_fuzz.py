@@ -11,6 +11,8 @@ from fluidframework_tpu.testing.fuzz import (
     DirectoryFuzzSpec,
     MapFuzzSpec,
     MatrixFuzzSpec,
+    QueueFuzzSpec,
+    RegisterFuzzSpec,
     StringFuzzSpec,
     run_fuzz,
 )
@@ -44,3 +46,13 @@ def test_fuzz_matrix(seed):
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_matrix_fww(seed):
     run_fuzz(MatrixFuzzSpec(fww=True), seed=500 + seed, n_clients=3, rounds=30)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_consensus_registers(seed):
+    run_fuzz(RegisterFuzzSpec(), seed=700 + seed, n_clients=4, rounds=30)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_consensus_queue(seed):
+    run_fuzz(QueueFuzzSpec(), seed=800 + seed, n_clients=3, rounds=30)
