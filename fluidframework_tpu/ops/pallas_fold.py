@@ -251,7 +251,14 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
 
 def _fold_kernel(S: int, K: int, T: int, *refs):
     """One document per grid step: state lives in VMEM values across the
-    whole tail."""
+    whole tail.
+
+    Every ref carries a leading unit axis (block shape ``(1, 1, ...)``
+    over a ``(D, 1, ...)`` array) so the block's last two dims EQUAL the
+    array's — Mosaic's block-mapping rule rejects a ``(1, S)`` block
+    over ``(D, S)`` (sublane dim 1 is neither divisible by 8 nor equal
+    to D).  ``r[0]`` strips the unit axis back to the ``(1, S)`` /
+    ``(1, S, K)`` row shapes the step math is written in."""
     op_refs = refs[:len(_OP_FIELDS)]
     pvals_ref = refs[len(_OP_FIELDS)]
     in_cols = refs[len(_OP_FIELDS) + 1:len(_OP_FIELDS) + 1 + len(_COL_FIELDS)]
@@ -259,25 +266,25 @@ def _fold_kernel(S: int, K: int, T: int, *refs):
                                    len(_OP_FIELDS) + 4 + len(_COL_FIELDS)]
     outs = refs[len(_OP_FIELDS) + 4 + len(_COL_FIELDS):]
 
-    cols = {f: r[...] for f, r in zip(_COL_FIELDS, in_cols)}
-    props = in_props[...]
-    n = in_n[0, 0]
-    overflow = in_over[0, 0] != 0
+    cols = {f: r[0] for f, r in zip(_COL_FIELDS, in_cols)}
+    props = in_props[0]
+    n = in_n[0, 0, 0]
+    overflow = in_over[0, 0, 0] != 0
 
     def body(t, carry):
         cols, props, n, overflow = carry
-        op = {f: r[0, t] for f, r in zip(_OP_FIELDS, op_refs)}
-        pvals = pvals_ref[0, t, :]
+        op = {f: r[0, 0, t] for f, r in zip(_OP_FIELDS, op_refs)}
+        pvals = pvals_ref[0, 0, t, :]
         return _apply_op_rows(cols, props, n, overflow, op, pvals, S, K)
 
     cols, props, n, overflow = jax.lax.fori_loop(
         0, T, body, (cols, props, n, overflow))
 
     for f, r in zip(_COL_FIELDS, outs):
-        r[...] = cols[f]
-    outs[len(_COL_FIELDS)][...] = props
-    outs[len(_COL_FIELDS) + 1][0, 0] = n
-    outs[len(_COL_FIELDS) + 2][0, 0] = overflow.astype(jnp.int32)
+        r[0] = cols[f]
+    outs[len(_COL_FIELDS)][0] = props
+    outs[len(_COL_FIELDS) + 1][0, 0, 0] = n
+    outs[len(_COL_FIELDS) + 2][0, 0, 0] = overflow.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -290,11 +297,15 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
     K = state.props.shape[-1]
     T = ops.kind.shape[1]
 
-    row = pl.BlockSpec((1, S), lambda d: (d, 0))
-    op_row = pl.BlockSpec((1, T), lambda d: (d, 0))
-    props_blk = pl.BlockSpec((1, S, K), lambda d: (d, 0, 0))
-    pvals_blk = pl.BlockSpec((1, T, K), lambda d: (d, 0, 0))
-    scalar = pl.BlockSpec((1, 1), lambda d: (d, 0))
+    # A leading unit axis on every operand makes each block's last two
+    # dims EQUAL the array's (Mosaic's alternative to the 8/128
+    # divisibility rule) while the grid still walks one document per
+    # step.  Shapes: (D, 1, X) with block (1, 1, X).
+    row = pl.BlockSpec((1, 1, S), lambda d: (d, 0, 0))
+    op_row = pl.BlockSpec((1, 1, T), lambda d: (d, 0, 0))
+    props_blk = pl.BlockSpec((1, 1, S, K), lambda d: (d, 0, 0, 0))
+    pvals_blk = pl.BlockSpec((1, 1, T, K), lambda d: (d, 0, 0, 0))
+    scalar = pl.BlockSpec((1, 1, 1), lambda d: (d, 0, 0))
 
     in_specs = (
         [op_row] * len(_OP_FIELDS) + [pvals_blk]
@@ -302,19 +313,21 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
     )
     out_specs = [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
     out_shape = (
-        [jax.ShapeDtypeStruct((D, S), jnp.int32)] * len(_COL_FIELDS)
-        + [jax.ShapeDtypeStruct((D, S, K), jnp.int32),
-           jax.ShapeDtypeStruct((D, 1), jnp.int32),
-           jax.ShapeDtypeStruct((D, 1), jnp.int32)]
+        [jax.ShapeDtypeStruct((D, 1, S), jnp.int32)] * len(_COL_FIELDS)
+        + [jax.ShapeDtypeStruct((D, 1, S, K), jnp.int32),
+           jax.ShapeDtypeStruct((D, 1, 1), jnp.int32),
+           jax.ShapeDtypeStruct((D, 1, 1), jnp.int32)]
     )
 
     inputs = (
-        [getattr(ops, f).astype(jnp.int32) for f in _OP_FIELDS]
-        + [ops.pvals.astype(jnp.int32)]
-        + [getattr(state, f).astype(jnp.int32) for f in _COL_FIELDS]
-        + [state.props.astype(jnp.int32),
-           state.n.astype(jnp.int32).reshape(D, 1),
-           state.overflow.astype(jnp.int32).reshape(D, 1)]
+        [getattr(ops, f).astype(jnp.int32).reshape(D, 1, T)
+         for f in _OP_FIELDS]
+        + [ops.pvals.astype(jnp.int32).reshape(D, 1, T, K)]
+        + [getattr(state, f).astype(jnp.int32).reshape(D, 1, S)
+           for f in _COL_FIELDS]
+        + [state.props.astype(jnp.int32).reshape(D, 1, S, K),
+           state.n.astype(jnp.int32).reshape(D, 1, 1),
+           state.overflow.astype(jnp.int32).reshape(D, 1, 1)]
     )
 
     outs = pl.pallas_call(
@@ -326,10 +339,11 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
         interpret=interpret,
     )(*inputs)
 
-    cols = dict(zip(_COL_FIELDS, outs[:len(_COL_FIELDS)]))
+    cols = {f: o.reshape(D, S)
+            for f, o in zip(_COL_FIELDS, outs[:len(_COL_FIELDS)])}
     return MTState(
         **cols,
-        props=outs[len(_COL_FIELDS)],
+        props=outs[len(_COL_FIELDS)].reshape(D, S, K),
         n=outs[len(_COL_FIELDS) + 1].reshape(D),
         overflow=outs[len(_COL_FIELDS) + 2].reshape(D).astype(bool),
     )
