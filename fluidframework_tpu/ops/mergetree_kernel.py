@@ -696,6 +696,7 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(ops, doc_base):
+        ops = _widen_ops(ops, doc_base)
         return _export_state(
             fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows,
             ov_rows, i8, props_rows=has_props,
@@ -713,6 +714,7 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(state, ops, doc_base):
+        ops = _widen_ops(ops, doc_base)
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
                              ov_rows, i8, props_rows=has_props)
 
@@ -749,6 +751,83 @@ def _export_flags(meta: dict):
     )
 
 
+#: upload-side narrow dtypes (h2d transfer encoding — see
+#: ``narrow_ops_for_upload``); per-field, chosen once so the jit cache
+#: sees exactly two op-stream signatures (all-int32 or this).
+_UPLOAD_NARROW_DTYPES = {
+    "kind": np.int8, "client": np.int8,
+    "seq": np.int16, "ref_seq": np.int16, "min_seq": np.int16,
+    "a": np.int16, "b": np.int16, "tstart": np.int16, "tlen": np.int16,
+    "pvals": np.int16,
+}
+
+
+def narrow_ops_for_upload(ops: MTOps, meta: dict) -> MTOps:
+    """Narrow a packed op stream for the h2d link: int32 → int16 rows
+    (int8 for kind/client), with insert ``tstart`` rebased per document
+    (``tstart - doc_base[d]`` — a doc's arena spans are contiguous, the
+    same transform the int16 EXPORT layout applies on the way down).
+    The device widens in-graph (``_widen_ops``), so this is purely a
+    transfer encoding: ~55% off the op-stream upload, the h2d leg of the
+    link-bound pipeline (BASELINE.md round-5: with the fold at ~2 ms,
+    e2e is host+link).
+
+    Applies only when the chunk's ``i16_ok`` value-bound fact holds AND
+    a direct bounds re-check of every field passes (belt and braces —
+    any violation falls back to the wide upload, never corrupts);
+    device-resident or already-narrow streams pass through unchanged.
+    ``FF_UPLOAD_NARROW=0`` disables."""
+    import os
+
+    if (not meta.get("i16_ok")
+            or not isinstance(ops.kind, np.ndarray)
+            or ops.seq.dtype != np.int32
+            or os.environ.get("FF_UPLOAD_NARROW", "1") == "0"):
+        return ops
+    doc_base = np.asarray(meta["doc_base"], np.int32)
+    is_ins = ops.kind == K_INSERT
+    # Non-insert rows must carry tstart == 0 (pack invariant; the fold
+    # reads op tstart only under is_ins) for the rebase to round-trip.
+    if int(np.abs(np.where(is_ins, 0, ops.tstart)).max(initial=0)) != 0:
+        return ops
+    rebased = np.where(is_ins, ops.tstart - doc_base[:, None], 0)
+    narrow = {"tstart": rebased}
+    for f in MTOps._fields:
+        if f != "tstart":
+            narrow[f] = getattr(ops, f)
+    for f, dt in _UPLOAD_NARROW_DTYPES.items():
+        info = np.iinfo(dt)
+        v = narrow[f]
+        if not (int(v.min(initial=0)) >= info.min
+                and int(v.max(initial=0)) <= info.max):
+            return ops  # bounds re-check failed → wide upload
+    return MTOps(**{f: narrow[f].astype(_UPLOAD_NARROW_DTYPES[f])
+                    for f in MTOps._fields})
+
+
+def _widen_ops(ops: MTOps, doc_base: jnp.ndarray) -> MTOps:
+    """In-graph inverse of ``narrow_ops_for_upload`` (identity on wide
+    streams): one fused cast per field plus the insert-tstart un-rebase.
+    Runs first inside the jitted fold+export wrappers, so both upload
+    widths share one jit entry (the cache keys on input avals).
+
+    The un-rebase applies ONLY to the exact encoding the narrower emits
+    (int16 seq rows) — any other non-int32 stream was never rebased, so
+    silently 'widening' it would corrupt every insert's arena offset;
+    refuse loudly instead."""
+    if ops.seq.dtype == jnp.int32:
+        return ops
+    if ops.seq.dtype != jnp.int16:
+        raise TypeError(
+            f"op stream has seq dtype {ops.seq.dtype}; expected int32 "
+            f"(wide) or the int16 narrow_ops_for_upload encoding"
+        )
+    w = {f: getattr(ops, f).astype(jnp.int32) for f in MTOps._fields}
+    w["tstart"] = jnp.where(w["kind"] == K_INSERT,
+                            w["tstart"] + doc_base[:, None], 0)
+    return MTOps(**w)
+
+
 def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
                   S: Optional[int] = None) -> jnp.ndarray:
     """Dispatch the fold+export for a packed chunk (async); the result is
@@ -762,6 +841,7 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     mode = pallas_fold_mode()
     doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
+    ops = narrow_ops_for_upload(ops, meta)  # h2d transfer encoding
     # The pallas fold ignores the chunk facts — normalize so mixed
     # workloads don't compile duplicate executables per cache key
     # (has_props is already mode-normalized inside _export_flags, the

@@ -1,0 +1,140 @@
+"""Upload-side narrow transfer encoding (h2d leg of the link-bound
+pipeline): ``narrow_ops_for_upload`` + in-graph ``_widen_ops`` must be an
+exact round trip — the fold and export are byte-identical whether the op
+stream rides the wire as int32 or as the narrowed int16/int8 layout
+(BASELINE.md round-5: with the device fold at ~2 ms, e2e is host+link,
+so halving the op-stream upload is a first-order lever)."""
+
+import numpy as np
+import pytest
+
+import bench
+from fluidframework_tpu.ops.mergetree_kernel import (
+    MergeTreeDocInput,
+    MTOps,
+    _UPLOAD_NARROW_DTYPES,
+    export_to_numpy,
+    narrow_ops_for_upload,
+    pack_mergetree_batch,
+    replay_export,
+)
+from fluidframework_tpu.testing.fuzz import StringFuzzSpec, run_fuzz
+from fluidframework_tpu.testing.mocks import channel_log
+
+
+def _export_bytes(state, ops, meta, S):
+    ex = export_to_numpy(replay_export(state, ops, meta, S=S))
+    leaves = ex if isinstance(ex, tuple) else (ex,)
+    return tuple(leaf.tobytes() for leaf in leaves)
+
+
+def _narrow_vs_wide(docs, monkeypatch, warm=False):
+    """Pin narrow-vs-wide export byte identity through the dispatch
+    path — cold by default, or the warm (base-state) path production
+    ``replay_mergetree_batch`` takes for catch-up chunks."""
+    state, ops, meta = pack_mergetree_batch(docs)
+    S = state.tstart.shape[1]
+    assert meta["i16_ok"]
+    narrow = narrow_ops_for_upload(ops, meta)
+    assert narrow.seq.dtype == np.int16 and narrow.kind.dtype == np.int8
+    saved = sum(np.asarray(x).nbytes for x in ops) - \
+        sum(np.asarray(x).nbytes for x in narrow)
+    assert saved > 0
+    st = state if warm else None
+    # The dispatch path narrows internally; pin both encodings' bytes.
+    with_narrow = _export_bytes(st, ops, meta, S)
+    monkeypatch.setenv("FF_UPLOAD_NARROW", "0")
+    wide = _export_bytes(st, ops, meta, S)
+    assert with_narrow == wide
+
+
+def test_narrow_roundtrip_on_bench_workload(monkeypatch):
+    _narrow_vs_wide([bench.synth_doc(i, 48) for i in range(24)], monkeypatch)
+
+
+def test_narrow_roundtrip_on_fuzz_logs(monkeypatch):
+    docs = []
+    for seed in (210, 211, 212):
+        _r, factory = run_fuzz(StringFuzzSpec(annotate=True), seed=seed,
+                               n_clients=3, rounds=8, sync_every=2)
+        docs.append(MergeTreeDocInput(
+            doc_id=f"n{seed}", ops=channel_log(factory, "fuzz"),
+            final_seq=factory.sequencer.seq,
+            final_msn=factory.sequencer.min_seq,
+        ))
+    _narrow_vs_wide(docs, monkeypatch)
+
+
+def test_narrow_roundtrip_on_warm_base_state_path(monkeypatch):
+    """The warm (_export_warm_fn) path: catch-up chunks with base
+    summaries carry state-relative arena offsets alongside the rebased
+    op tstart — the un-rebase must interact correctly with both."""
+    import json as _json
+
+    from fluidframework_tpu.dds import SharedString
+
+    docs = []
+    for seed in (220, 221):
+        _r, factory = run_fuzz(StringFuzzSpec(), seed=seed, n_clients=3,
+                               rounds=12)
+        full_ops = channel_log(factory, "fuzz")
+        mid_seq = full_ops[len(full_ops) // 2].seq
+        partial = SharedString("fuzz")
+        for msg in full_ops:
+            if msg.seq <= mid_seq:
+                partial.process(msg, local=False)
+        base_records = _json.loads(partial.summarize().blob_bytes("body"))
+        docs.append(MergeTreeDocInput(
+            doc_id=f"warm{seed}",
+            ops=[m for m in full_ops if m.seq > mid_seq],
+            base_records=base_records,
+            final_seq=factory.sequencer.seq,
+            final_msn=factory.sequencer.min_seq,
+        ))
+    _narrow_vs_wide(docs, monkeypatch, warm=True)
+
+
+def test_widen_refuses_unknown_dtype():
+    """A non-int32, non-narrow stream must be refused loudly — silently
+    un-rebasing a never-rebased stream corrupts arena offsets."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.mergetree_kernel import _widen_ops
+
+    docs = [bench.synth_doc(i, 16) for i in range(2)]
+    _state, ops, _meta = pack_mergetree_batch(docs)
+    # int8 seq: a dtype the narrower never emits for seq (x64 mode is
+    # off, so int64 would silently truncate back to int32 here).
+    bad = MTOps(*(jnp.asarray(np.asarray(x), jnp.int8)
+                  if f == "seq" else jnp.asarray(np.asarray(x))
+                  for f, x in zip(MTOps._fields, ops)))
+    with pytest.raises(TypeError, match="seq dtype"):
+        _widen_ops(bad, jnp.zeros((2,), jnp.int32))
+
+
+def test_narrow_skips_non_qualifying_and_device_streams():
+    docs = [bench.synth_doc(i, 32) for i in range(4)]
+    state, ops, meta = pack_mergetree_batch(docs)
+    # not i16_ok → identity (same objects, no copies)
+    wide = narrow_ops_for_upload(ops, dict(meta, i16_ok=False))
+    assert wide.seq is ops.seq
+    # already-narrow stream → identity
+    narrow = narrow_ops_for_upload(ops, meta)
+    again = narrow_ops_for_upload(narrow, meta)
+    assert again.seq is narrow.seq
+
+
+def test_narrow_bounds_recheck_falls_back_to_wide():
+    """A stream violating a narrow dtype's range (despite i16_ok being
+    claimed) must pass through wide, never truncate."""
+    docs = [bench.synth_doc(i, 32) for i in range(4)]
+    _state, ops, meta = pack_mergetree_batch(docs)
+    bad_client = np.array(ops.client)
+    bad_client[0, 0] = 1000  # exceeds the int8 client row
+    bad = ops._replace(client=bad_client)
+    out = narrow_ops_for_upload(bad, meta)
+    assert out.client.dtype == np.int32 and out.seq is bad.seq
+
+
+def test_narrow_dtype_table_covers_every_op_field():
+    assert set(_UPLOAD_NARROW_DTYPES) == set(MTOps._fields)

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 TPU window catcher: probe the axon tunnel on a loop; in the FIRST
+# healthy window run the full measurement chain (bench.py on the
+# single-device-thread pipeline, a legacy-pipeline A/B, the five-config
+# table), each timeboxed, artifacts to window_artifacts/.  The operator
+# (or the next session) commits what lands.  Status: window_artifacts/status.log
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p window_artifacts
+log() { echo "$(date -u +%H:%M:%S) $*" >> window_artifacts/status.log; }
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    log "HEALTHY — starting measurement chain"
+    timeout 580 python bench.py > window_artifacts/bench_sdt.json 2> window_artifacts/bench_sdt.err
+    log "bench sdt rc=$? $(head -c 120 window_artifacts/bench_sdt.json)"
+    BENCH_E2E_PIPELINE=legacy timeout 580 python bench.py > window_artifacts/bench_legacy.json 2> window_artifacts/bench_legacy.err
+    log "bench legacy rc=$?"
+    timeout 580 python tools/bench_configs.py > window_artifacts/bench_configs.json 2> window_artifacts/bench_configs.err
+    log "configs rc=$?"
+    touch window_artifacts/CHAIN_DONE
+    log "chain complete"
+    exit 0
+  else
+    log "WEDGED"
+  fi
+  sleep 150
+done
