@@ -714,6 +714,7 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(state, ops, doc_base):
+        state = _widen_state(state, doc_base)
         ops = _widen_ops(ops, doc_base)
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
                              ov_rows, i8, props_rows=has_props)
@@ -805,6 +806,81 @@ def narrow_ops_for_upload(ops: MTOps, meta: dict) -> MTOps:
                     for f in MTOps._fields})
 
 
+def narrow_state_for_upload(state: MTState, meta: dict) -> MTState:
+    """Narrow a warm chunk's base state for the h2d link — the catch-up
+    service's snapshot+tail shape uploads 13 ``(D, S)`` int32 planes per
+    chunk, the dominant upload for warm chunks.  int32 → int16 with the
+    NOT_REMOVED sentinel remapped (the inverse the device applies is the
+    same transform the i16 export layout already round-trips) and slot
+    ``tstart`` rebased per doc for live slots (dead slots are zero by the
+    pack invariant, re-checked here).  ``props`` (value ids ≥ -1) and
+    ``n`` narrow unconditionally under the same bound; ``overflow`` stays
+    bool.  Any bounds violation falls back to the wide upload."""
+    import os
+
+    if (not meta.get("i16_ok")
+            or not isinstance(state.tstart, np.ndarray)
+            or state.ins_seq.dtype != np.int32
+            or os.environ.get("FF_UPLOAD_NARROW", "1") == "0"):
+        return state
+    doc_base = np.asarray(meta["doc_base"], np.int32)
+    S = state.tstart.shape[1]
+    live = np.arange(S, dtype=np.int32)[None, :] < state.n[:, None]
+    if int(np.abs(np.where(live, 0, state.tstart)).max(initial=0)) != 0:
+        return state  # dead slots must be zero for the rebase round trip
+    info = np.iinfo(np.int16)
+    narrow = {}
+    for f in EXPORT_SLOT_FIELDS:  # the 12 slot planes, export's own list
+        v = getattr(state, f)
+        if f == "tstart":
+            v = np.where(live, v - doc_base[:, None], 0)
+        elif f in SENTINEL_SEQ_FIELDS:
+            # Real values must stay STRICTLY below the remapped sentinel
+            # (I16_LIMIT, the same bound i16_ok is defined against) — a
+            # genuine 32767 would widen back as NOT_REMOVED and
+            # resurrect a removed segment.
+            reals = np.where(v == NOT_REMOVED, 0, v)
+            if int(reals.max(initial=0)) > I16_LIMIT:
+                return state
+            v = np.where(v == NOT_REMOVED, np.int32(I16_NOT_REMOVED), v)
+        if not (info.min <= int(v.min(initial=0))
+                and int(v.max(initial=0)) <= info.max):
+            return state
+        narrow[f] = v.astype(np.int16)
+    if not (int(state.props.min(initial=0)) >= info.min
+            and int(state.props.max(initial=0)) <= info.max
+            and int(state.n.max(initial=0)) <= info.max):
+        return state
+    return MTState(
+        **narrow,
+        props=state.props.astype(np.int16),
+        n=state.n.astype(np.int16),
+        overflow=state.overflow,
+    )
+
+
+def _widen_state(state: MTState, doc_base: jnp.ndarray) -> MTState:
+    """In-graph inverse of ``narrow_state_for_upload`` (identity on wide
+    states); refuses unknown encodings loudly like ``_widen_ops``."""
+    if state.ins_seq.dtype == jnp.int32:
+        return state
+    if state.ins_seq.dtype != jnp.int16:
+        raise TypeError(
+            f"state has ins_seq dtype {state.ins_seq.dtype}; expected "
+            f"int32 (wide) or the int16 narrow_state_for_upload encoding"
+        )
+    w = {f: getattr(state, f).astype(jnp.int32)
+         for f in EXPORT_SLOT_FIELDS}
+    n = state.n.astype(jnp.int32)
+    S = state.tstart.shape[1]
+    live = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) < n[:, None]
+    w["tstart"] = jnp.where(live, w["tstart"] + doc_base[:, None], 0)
+    for f in SENTINEL_SEQ_FIELDS:
+        w[f] = jnp.where(w[f] == int(I16_NOT_REMOVED), NOT_REMOVED, w[f])
+    return MTState(**w, props=state.props.astype(jnp.int32), n=n,
+                   overflow=state.overflow)
+
+
 def _widen_ops(ops: MTOps, doc_base: jnp.ndarray) -> MTOps:
     """In-graph inverse of ``narrow_ops_for_upload`` (identity on wide
     streams): one fused cast per field plus the insert-tstart un-rebase.
@@ -850,6 +926,7 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     if state is None:
         return _export_cold_fn(int(S), i16, ob_rows, mode, ov_rows,
                                i8, sequential, has_props)(ops, doc_base)
+    state = narrow_state_for_upload(state, meta)
     return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8,
                            sequential, has_props)(state, ops, doc_base)
 

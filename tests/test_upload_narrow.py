@@ -65,33 +65,78 @@ def test_narrow_roundtrip_on_fuzz_logs(monkeypatch):
     _narrow_vs_wide(docs, monkeypatch)
 
 
-def test_narrow_roundtrip_on_warm_base_state_path(monkeypatch):
-    """The warm (_export_warm_fn) path: catch-up chunks with base
-    summaries carry state-relative arena offsets alongside the rebased
-    op tstart — the un-rebase must interact correctly with both."""
+def _warm_doc(seed, rounds=12):
+    """A snapshot+tail MergeTreeDocInput: fuzz a session, summarize at
+    the midpoint, return the base records + remaining tail — the
+    flagship warm catch-up shape."""
     import json as _json
 
     from fluidframework_tpu.dds import SharedString
 
-    docs = []
-    for seed in (220, 221):
-        _r, factory = run_fuzz(StringFuzzSpec(), seed=seed, n_clients=3,
-                               rounds=12)
-        full_ops = channel_log(factory, "fuzz")
-        mid_seq = full_ops[len(full_ops) // 2].seq
-        partial = SharedString("fuzz")
-        for msg in full_ops:
-            if msg.seq <= mid_seq:
-                partial.process(msg, local=False)
-        base_records = _json.loads(partial.summarize().blob_bytes("body"))
-        docs.append(MergeTreeDocInput(
-            doc_id=f"warm{seed}",
-            ops=[m for m in full_ops if m.seq > mid_seq],
-            base_records=base_records,
-            final_seq=factory.sequencer.seq,
-            final_msn=factory.sequencer.min_seq,
-        ))
-    _narrow_vs_wide(docs, monkeypatch, warm=True)
+    _r, factory = run_fuzz(StringFuzzSpec(), seed=seed, n_clients=3,
+                           rounds=rounds)
+    full_ops = channel_log(factory, "fuzz")
+    mid_seq = full_ops[len(full_ops) // 2].seq
+    partial = SharedString("fuzz")
+    for msg in full_ops:
+        if msg.seq <= mid_seq:
+            partial.process(msg, local=False)
+    base_records = _json.loads(partial.summarize().blob_bytes("body"))
+    return MergeTreeDocInput(
+        doc_id=f"warm{seed}",
+        ops=[m for m in full_ops if m.seq > mid_seq],
+        base_records=base_records,
+        final_seq=factory.sequencer.seq,
+        final_msn=factory.sequencer.min_seq,
+    )
+
+
+def test_narrow_roundtrip_on_warm_base_state_path(monkeypatch):
+    """The warm (_export_warm_fn) path: catch-up chunks with base
+    summaries carry state-relative arena offsets alongside the rebased
+    op tstart — the un-rebase must interact correctly with both."""
+    _narrow_vs_wide([_warm_doc(s) for s in (220, 221)], monkeypatch,
+                    warm=True)
+
+
+def test_narrow_state_roundtrip_exact():
+    """narrow_state_for_upload → _widen_state reproduces the packed base
+    state array-for-array (sentinel remap + live-slot tstart rebase)."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        _widen_state,
+        narrow_state_for_upload,
+    )
+
+    state, _ops, meta = pack_mergetree_batch([_warm_doc(230)])
+    narrow = narrow_state_for_upload(state, meta)
+    assert narrow.ins_seq.dtype == np.int16, "warm chunk should narrow"
+    widened = _widen_state(narrow, jnp.asarray(meta["doc_base"]))
+    for f in state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(widened, f)), np.asarray(getattr(state, f)),
+            err_msg=f)
+
+
+def test_narrow_state_sentinel_collision_falls_back():
+    """A genuine seq of 32767 (the remapped sentinel's value) in a
+    sentinel plane must force the wide upload — narrowing it would widen
+    back as NOT_REMOVED and resurrect a removed segment."""
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        narrow_state_for_upload,
+    )
+
+    state, _ops, meta = pack_mergetree_batch([_warm_doc(231)])
+    assert meta["i16_ok"]
+    bad_rem = np.array(state.rem_seq)
+    d = 0
+    live = int(state.n[d])
+    assert live > 0
+    bad_rem[d, 0] = 32767  # == I16_NOT_REMOVED, but a "real" value here
+    bad = state._replace(rem_seq=bad_rem)
+    out = narrow_state_for_upload(bad, meta)
+    assert out.rem_seq.dtype == np.int32 and out.ins_seq is bad.ins_seq
 
 
 def test_widen_refuses_unknown_dtype():
