@@ -511,6 +511,23 @@ def roofline(S: int, K: int, device_kind: str) -> dict:
     }
 
 
+def exec_latency_probe() -> float:
+    """Best-of-3 trivial-program round trip — re-run AFTER the e2e to
+    detect the axon client's persistent degraded mode (BASELINE.md
+    round 5: post-e2e exec latency jumped 0.1 ms → 70-90 ms under the
+    legacy pipeline's concurrent fetch+dispatch; the A/B between
+    pipelines is decided by this number)."""
+    tiny = jax.jit(lambda x: x * 2)
+    h = jax.device_put(np.zeros((1,), np.int32))
+    jax.block_until_ready(tiny(h))  # compile/warm outside the timing
+    lat = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(tiny(h))
+        lat = min(lat, time.time() - t0)
+    return lat
+
+
 def link_microbench() -> dict:
     """Measure the host↔device link in-run: per-RPC latency (best of 3
     one-element round trips) and MB/s each way on a 16MB default-layout
@@ -533,13 +550,7 @@ def link_microbench() -> dict:
     # (dispatch/executor degradation) from a sick TRANSFER path when the
     # fold rate collapses — without this the two are indistinguishable in
     # the stage breakdown.
-    tiny = jax.jit(lambda x: x * 2)
-    jax.block_until_ready(tiny(h))  # compile outside the timing
-    lat_exec = float("inf")
-    for _ in range(3):
-        t0 = time.time()
-        jax.block_until_ready(tiny(h))
-        lat_exec = min(lat_exec, time.time() - t0)
+    lat_exec = exec_latency_probe()
     t0 = time.time()
     hb = jax.device_put(big)
     jax.block_until_ready(hb)
@@ -551,7 +562,7 @@ def link_microbench() -> dict:
     return {
         "rpc_latency_up_s": round(lat_up, 4),
         "rpc_latency_down_s": round(lat_down, 4),
-        "exec_latency_s": round(lat_exec, 4),
+        "exec_latency_s": round(lat_exec, 6),
         "h2d_MBps": round(mb / max(up - lat_up, up * 0.2, 1e-9), 1),
         "d2h_MBps": round(mb / max(down - lat_down, down * 0.2, 1e-9), 1),
     }
@@ -949,6 +960,9 @@ def _run_bench(probe: dict) -> dict:
     # stages pipelined (see run_e2e) ---
     CURRENT_PHASE["phase"] = "e2e"
     summaries, stats, stage, e2e_time, packed_chunks = run_e2e(docs_sched)
+    # Did the e2e flip the client into the degraded mode?  (The sdt
+    # pipeline exists to prevent this; the legacy A/B run shows it.)
+    link["exec_latency_after_e2e_s"] = round(exec_latency_probe(), 6)
     assert len(summaries) == N_DOCS
     e2e_ops_per_sec = total_ops / e2e_time
     fallbacks = stats.get("fallback_docs", 0)
