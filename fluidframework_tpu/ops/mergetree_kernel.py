@@ -689,15 +689,29 @@ def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
                                              has_ob, has_props, has_ov)
 
 
+def _export_out(i8: bool, sharding=None):
+    """out_shardings for an export jit: the forced fetch layout when the
+    backend supports layouts (carried on ``sharding`` when given — the
+    mesh path), else the bare sharding, else None."""
+    fmt = _out_shardings_for(i8, sharding)
+    if fmt is not None:
+        return fmt
+    if sharding is None:
+        return None
+    return (sharding, sharding) if i8 else sharding
+
+
 @functools.lru_cache(maxsize=None)
 def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
                     fold_mode: str = "", ov_rows: bool = True,
                     i8: bool = False, sequential: bool = False,
-                    has_props: bool = True):
+                    has_props: bool = True, out_sharding=None):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch.  ``ob_rows``/``ov_rows``
     double as the fold facts (has_ob/has_ov): the export elides exactly
-    the planes the fold provably never writes."""
+    the planes the fold provably never writes.  ``out_sharding`` (a
+    NamedSharding) builds the mesh-sharded variant of the same pipeline —
+    ONE derivation point for single-chip and multi-chip exports."""
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(ops, doc_base):
@@ -707,15 +721,17 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
             ov_rows, i8, props_rows=has_props,
         )
 
-    fmt = _out_shardings_for(i8)
+    fmt = _export_out(i8, out_sharding)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
 @functools.lru_cache(maxsize=None)
 def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
                     ov_rows: bool = True, i8: bool = False,
-                    sequential: bool = False, has_props: bool = True):
-    """Compiled warm-start (base state uploaded) fold+export."""
+                    sequential: bool = False, has_props: bool = True,
+                    out_sharding=None):
+    """Compiled warm-start (base state uploaded) fold+export; see
+    ``_export_cold_fn`` for ``out_sharding``."""
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(state, ops, doc_base):
@@ -724,7 +740,7 @@ def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
         return _export_state(fold(state, ops), doc_base, i16, ob_rows,
                              ov_rows, i8, props_rows=has_props)
 
-    fmt = _out_shardings_for(i8)
+    fmt = _export_out(i8, out_sharding)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
@@ -1098,6 +1114,11 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
     base_has_ob = False
     base_has_ro = False
     base_max_tlen = 0
+    # One raw-pointer packer per chunk: base addresses captured once, no
+    # per-doc ndarray marshalling (see native_pack.ChunkPacker).
+    from .native_pack import chunk_packer, pack_doc_row
+
+    packer = chunk_packer(op) if binary_counts else None
     for d, doc in enumerate(docs):
         pack = doc_packs[d]
         doc_base[d] = len(arena)
@@ -1143,8 +1164,6 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             # Native fast path: C++ fills this doc's rows in one pass,
             # translating encoder-local property ids to the batch-global
             # intern spaces via the maps.
-            from .native_pack import pack_doc_row
-
             for client in (doc.binary_clients or []):
                 pack.client_idx(client)
             key_map = val_map = None
@@ -1158,14 +1177,19 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
                     [values.intern(v) for v in doc.binary_values],
                     np.int32,
                 )
-            row = {key: op[key][d]
-                   for key in ("kind", "seq", "client", "ref_seq",
-                               "min_seq", "a", "b", "tstart", "tlen",
-                               "pvals")}
             doc_bytes = bytearray()
-            pack_doc_row(doc.binary_ops, row, K, len(arena), doc_bytes,
-                         text_bytes=binary_counts[d][1],
-                         key_map=key_map, val_map=val_map)
+            if packer is not None:
+                packer.pack(doc.binary_ops, d, len(arena), doc_bytes,
+                            text_bytes=binary_counts[d][1],
+                            key_map=key_map, val_map=val_map)
+            else:
+                row = {key: op[key][d]
+                       for key in ("kind", "seq", "client", "ref_seq",
+                                   "min_seq", "a", "b", "tstart", "tlen",
+                                   "pvals")}
+                pack_doc_row(doc.binary_ops, row, K, len(arena), doc_bytes,
+                             text_bytes=binary_counts[d][1],
+                             key_map=key_map, val_map=val_map)
             arena.append(doc_bytes.decode("utf-8"))
             continue
 

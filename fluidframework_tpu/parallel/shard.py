@@ -35,12 +35,9 @@ from ..ops.mergetree_kernel import (
     MTState,
     MergeTreeDocInput,
     NOT_REMOVED,
+    _export_cold_fn,
     _export_flags,
-    _export_state,
-    _fold_fn,
-    _cold_start,
-    _widen_ops,
-    _widen_state,
+    _export_warm_fn,
     export_to_numpy,
     known_oracle_fallback,
     narrow_ops_for_upload,
@@ -159,45 +156,26 @@ def _shard_put(mesh: Mesh, tree):
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), shard), tree)
 
 
-@functools.lru_cache(maxsize=None)
 def sharded_export_step(mesh: Mesh, S: int, i16: bool, ob_rows: bool,
                         ov_rows: bool, i8: bool, sequential: bool,
                         has_props: bool, warm: bool):
-    """Mesh-sharded fold+EXPORT (cached per mesh × chunk-fact
-    signature): the multi-chip twin of ``_export_cold_fn`` /
-    ``_export_warm_fn``.  The step widens narrow uploads in-graph, folds
-    with the same compile-time chunk-fact specialization as the
-    single-chip path, and emits the fused transfer buffer doc-sharded —
-    so the mesh path fetches the SAME ~10×-smaller export the
-    single-chip path does (instead of 13 full int32 state planes) and
-    the host extraction (``summaries_from_export``) is shared verbatim.
-    The fold and export are per-doc elementwise along the doc axis: no
-    collective is inserted; each chip folds and encodes its shard."""
+    """Mesh-sharded fold+EXPORT: the SAME cached builders as the
+    single-chip path (``_export_cold_fn`` / ``_export_warm_fn``) with
+    the doc-sharded placement threaded through as ``out_sharding`` — one
+    derivation point, so the mesh path can never drift from the
+    single-chip export pipeline.  The step widens narrow uploads
+    in-graph, folds with the chunk-fact specialization, and emits the
+    fused transfer buffer doc-sharded (~10× less d2h than the 13 full
+    int32 state planes it replaced), with the forced row-major fetch
+    layout where the backend supports layouts.  The fold and export are
+    per-doc elementwise along the doc axis: no collective is inserted;
+    each chip folds and encodes its shard."""
     shard = NamedSharding(mesh, _doc_spec(mesh))
-    fold = _fold_fn("", sequential, ob_rows, has_props, ov_rows)
-
-    def _cold(ops: MTOps, doc_base):
-        wide = _widen_ops(ops, doc_base)
-        return _export_state(fold(_cold_start(wide, S), wide), doc_base,
-                             i16, ob_rows, ov_rows, i8,
-                             props_rows=has_props)
-
-    def _warm(state: MTState, ops: MTOps, doc_base):
-        wide_s = _widen_state(state, doc_base)
-        wide = _widen_ops(ops, doc_base)
-        return _export_state(fold(wide_s, wide), doc_base, i16, ob_rows,
-                             ov_rows, i8, props_rows=has_props)
-
-    # Same forced row-major fetch layout as the single-chip twins (the
-    # jit-chosen layout degrades the tunneled d2h ~20×), carried on the
-    # doc-sharded placement; plain sharding where layouts are
-    # unsupported (CPU mesh tests).
-    from ..ops.mergetree_kernel import _out_shardings_for
-
-    out = _out_shardings_for(i8, sharding=shard)
-    if out is None:
-        out = (shard, shard) if i8 else shard  # (slot_rows, misc) on i8
-    return jax.jit(_warm if warm else _cold, out_shardings=out)
+    if warm:
+        return _export_warm_fn(i16, ob_rows, "", ov_rows, i8, sequential,
+                               has_props, out_sharding=shard)
+    return _export_cold_fn(S, i16, ob_rows, "", ov_rows, i8, sequential,
+                           has_props, out_sharding=shard)
 
 
 def replay_mergetree_sharded(

@@ -220,3 +220,36 @@ def test_native_extract_bodies_byte_identity_hostile_text():
         replica.advance(doc.final_seq, doc.final_msn)
         oracle = replica.summarize()
         assert dev.digest() == oracle.digest(), doc.doc_id
+
+
+def test_chunk_packer_matches_per_doc_path(monkeypatch):
+    """The per-chunk raw-pointer packer (base addr + d*row_bytes, shared
+    scratch) fills bit-identical rows to the per-doc ndpointer path it
+    replaced on the hot loop."""
+
+    if load_library() is None:
+        pytest.skip("liboppack unavailable")
+
+    def build():
+        docs = []
+        for d in range(5):
+            ops = synth_ops(300 + d, 40 + d, unicode_text=(d % 2 == 0))
+            clients = Interner()
+            blob = encode_string_ops(ops, clients)
+            docs.append(MergeTreeDocInput(
+                doc_id=f"doc{d}", ops=[], binary_ops=blob,
+                binary_clients=list(clients.values),
+                final_seq=len(ops), final_msn=0))
+        return pack_mergetree_batch(docs)
+
+    st_fast, op_fast, meta_fast = build()
+    # pack_mergetree_batch re-imports chunk_packer per call, so patching
+    # the module attribute reroutes the second build to the per-doc path.
+    import fluidframework_tpu.ops.native_pack as npk
+    monkeypatch.setattr(npk, "chunk_packer", lambda op: None)
+    st_slow, op_slow, meta_slow = build()
+    for name in op_fast._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(op_fast, name)),
+            np.asarray(getattr(op_slow, name)), err_msg=name)
+    assert meta_fast["arena"].finalize() == meta_slow["arena"].finalize()
