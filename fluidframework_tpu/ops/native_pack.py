@@ -474,10 +474,20 @@ def extract_bodies(
             offs[i + 1] = offs[i] + len(tok)
         return b"".join(tokens), offs
 
+    def json_str(s) -> bytes:
+        # Fast path for the overwhelmingly common simple client id: no
+        # char needing JSON escaping (quote, backslash, controls) and
+        # pure ASCII — byte-equal to canonical_json then.  Anything else
+        # takes the canonical serializer.
+        if isinstance(s, str) and s.isascii() and '"' not in s \
+                and "\\" not in s and (not s or min(s) >= " "):
+            return b'"%s"' % s.encode()
+        return canonical_json(s)
+
     client_tokens: List[bytes] = []
     doc_start = np.zeros(D + 1, np.int32)
     for d, clients in enumerate(doc_clients):
-        client_tokens.extend(canonical_json(c) for c in clients)
+        client_tokens.extend(json_str(c) for c in clients)
         doc_start[d + 1] = len(client_tokens)
     client_blob, client_offs = flatten(client_tokens)
 
@@ -509,7 +519,7 @@ def extract_bodies(
     cap = max(len(arena_bytes) * 2 + D * 64 + int(export_np.shape[2]) * D * 8,
               1 << 16)
     for _attempt in range(3):
-        out = np.zeros(cap, np.uint8)
+        out = np.empty(cap, np.uint8)  # C++ writes [0, out_offs[D])
         rc = lib.oppack_extract(
             export_np, D, F, S, K,
             arena_bytes, len(arena_bytes), len(arena_text),
@@ -520,7 +530,7 @@ def extract_bodies(
             out, cap, out_offs,
         )
         if rc == 0:
-            buf = out.tobytes()
+            buf = out[:out_offs[D]].tobytes()  # copy used extent only
             return [
                 buf[out_offs[d]:out_offs[d + 1]] for d in range(D)
             ]

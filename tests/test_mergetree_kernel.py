@@ -431,3 +431,15 @@ def test_sequential_tail_over_stamped_base_skips_kills_correctly():
         resumed.process(msg, local=False)
     resumed.advance(4, 0)
     assert summary.digest() == resumed.summarize().digest()
+
+
+def test_header_fast_format_matches_canonical_json():
+    """The hand-formatted header blob must stay byte-equal to
+    canonical_json for every value shape the header can carry."""
+    from fluidframework_tpu.protocol.summary import canonical_json
+
+    for length, min_seq, seq in [(0, 0, 0), (7, 3, 12), (32766, 1, 983040),
+                                 (123456789, 98765, 2**31 - 1)]:
+        fast = b'{"length":%d,"minSeq":%d,"seq":%d}' % (length, min_seq, seq)
+        assert fast == canonical_json(
+            {"seq": seq, "minSeq": min_seq, "length": length})
