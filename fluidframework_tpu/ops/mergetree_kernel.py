@@ -549,6 +549,89 @@ def export_to_numpy(export):
     return np.asarray(export)
 
 
+def _widen_desc(ob_rows: bool, ov_rows: bool, i8: bool, props_rows: bool,
+                n_props: int):
+    """The per-canonical-row descriptor table oppack_widen consumes:
+    [mode, arg, fill, flags] × (13 + K) rows.  Mirrors widen_export's
+    field order exactly (same _export_fields derivation)."""
+    fields = _export_fields(ob_rows, ov_rows)
+
+    def src_of(f: str):
+        if not i8:
+            return 1, fields.index(f)                       # ROW16
+        if f == "tstart":
+            return 1, 0                                     # 16-bit lane
+        i = fields.index(f) - 1                             # byte index
+        return 2, (1 + i // 2) * 2 + (i % 2)                # PAIR8
+
+    desc = []
+    for f in EXPORT_SLOT_FIELDS:
+        if f in fields:
+            mode, arg = src_of(f)
+            flags = (1 if f in SENTINEL_SEQ_FIELDS else 0) \
+                | (2 if f == "tstart" else 0)
+            desc.append((mode, arg, 0, flags))
+        else:
+            fill = int(NOT_REMOVED) if f.endswith("_seq") else -1
+            desc.append((0, 0, fill, 0))
+    for k in range(n_props):
+        if props_rows:
+            if i8:
+                i = len(fields) - 1 + k
+                desc.append((2, (1 + i // 2) * 2 + (i % 2), 0, 0))
+            else:
+                desc.append((1, len(fields) + k, 0, 0))
+        else:
+            desc.append((0, 0, int(PROP_ABSENT), 0))
+    if i8:
+        desc.append((3, 0, 0, 0))                           # stitched misc
+    else:
+        n_src = len(fields) + (n_props if props_rows else 0) + 1
+        desc.append((1, n_src - 1, 0, 0))                   # misc row
+    return np.asarray(desc, np.int32).reshape(-1)
+
+
+def widen_export_native(export_np, doc_base, ob_rows: bool, ov_rows: bool,
+                        i8: bool, n_props: int, props_rows: bool):
+    """C++ single-pass widen of a narrow export buffer to the canonical
+    [D, 13+K, S] int32 layout — byte-identical to ``widen_export``
+    (pinned by tests), ~10× faster on the extraction hot path.  Returns
+    None when inapplicable (already int32, or no native library)."""
+    from .native_pack import load_library
+
+    misc_np = None
+    if isinstance(export_np, tuple):
+        export_np, misc_np = export_np
+    if export_np.dtype != np.int16:
+        return None
+    lib = load_library()
+    if lib is None:
+        return None
+    D, R_src, S = export_np.shape
+    desc = _widen_desc(ob_rows, ov_rows, i8, props_rows, n_props)
+    R_canon = len(desc) // 4
+    dst = np.empty((D, R_canon, S), np.int32)
+    src = np.ascontiguousarray(export_np, np.int16)
+    if i8:
+        assert misc_np is not None, "i8 widen needs the misc output"
+        misc = np.ascontiguousarray(misc_np, np.int16)
+        misc_ptr, misc_cols = misc.ctypes.data, misc.shape[1]
+    else:
+        misc = None
+        misc_ptr, misc_cols = None, 0
+    base = None if doc_base is None else \
+        np.ascontiguousarray(doc_base, np.int32)
+    sentinel_src = int(I8_NOT_REMOVED) if i8 else int(I16_NOT_REMOVED)
+    rc = lib.oppack_widen(
+        src, D, S, R_src, R_canon, misc_ptr, misc_cols, desc,
+        None if base is None else base.ctypes.data,
+        sentinel_src, int(NOT_REMOVED), dst,
+    )
+    if rc != 0:
+        raise ValueError("oppack_widen: malformed narrow export")
+    return dst
+
+
 def widen_export(export_np,
                  doc_base: Optional[np.ndarray],
                  ob_rows: bool = True, ov_rows: bool = True,
@@ -1477,10 +1560,14 @@ def summaries_from_export(meta, export_np: np.ndarray,
     docs = meta["docs"]
     D = len(docs)
     _i16, ob_rows_f, ov_rows_f, i8_f, props_rows_f = _export_flags(meta)
-    export_np = widen_export(export_np, meta.get("doc_base"),
-                             ob_rows=ob_rows_f, ov_rows=ov_rows_f,
-                             i8=i8_f, n_props=meta.get("props_K"),
-                             props_rows=props_rows_f)
+    widened = widen_export_native(
+        export_np, meta.get("doc_base"), ob_rows_f, ov_rows_f, i8_f,
+        meta.get("props_K"), props_rows_f)
+    export_np = widened if widened is not None else widen_export(
+        export_np, meta.get("doc_base"),
+        ob_rows=ob_rows_f, ov_rows=ov_rows_f,
+        i8=i8_f, n_props=meta.get("props_K"),
+        props_rows=props_rows_f)
     state_np = state_dict_from_export(export_np)
     skip = np.zeros(D, np.uint8)
     for d in range(D):

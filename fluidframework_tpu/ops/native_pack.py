@@ -222,6 +222,17 @@ def load_library() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # out_offs
     ]
+    lib.oppack_widen.restype = ctypes.c_int32
+    lib.oppack_widen.argtypes = [
+        np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS"),  # src
+        ctypes.c_int32, ctypes.c_int32,                          # D, S
+        ctypes.c_int32, ctypes.c_int32,                          # R_src/canon
+        ctypes.c_void_p, ctypes.c_int32,                         # misc, cols
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # desc
+        ctypes.c_void_p,                                         # doc_base
+        ctypes.c_int32, ctypes.c_int32,                          # sentinels
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # dst
+    ]
     _lib_handle = lib
     return lib
 

@@ -447,4 +447,81 @@ int64_t oppack_extract(
     return 0;
 }
 
+// oppack_widen — undo the export transfer encodings in one native pass:
+// narrow (int16 / int8-pair) source buffer → the canonical [D, R_canon, S]
+// int32 layout mergetree_kernel.widen_export produces (byte-identical;
+// pinned by tests).  Replaces the numpy widen on the extraction hot path.
+//
+// desc: R_canon rows × 4 int32 = [mode, arg, fill, flags]
+//   mode 0 = FILL      (constant `fill`)
+//   mode 1 = ROW16     (arg = source row; int16 elements)
+//   mode 2 = PAIR8     (arg = src_row * 2 + half; int16 lane holds two
+//                       int8 values, half 0 = high byte, 1 = low byte)
+//   mode 3 = MISC      (stitch misc[d, j] for j < misc_cols, else 0)
+// flags bit0: remap sentinel_src → sentinel_dst
+// flags bit1: re-add doc_base[d] on slots < n (live-slot tstart rebase);
+//             n is read from the canonical misc row (always last, col 0).
+int32_t oppack_widen(
+    const int16_t* src, int32_t D, int32_t S,
+    int32_t R_src, int32_t R_canon,
+    const int16_t* misc, int32_t misc_cols,
+    const int32_t* desc,
+    const int32_t* doc_base,
+    int32_t sentinel_src, int32_t sentinel_dst,
+    int32_t* dst) {
+    const int64_t src_doc = static_cast<int64_t>(R_src) * S;
+    const int64_t dst_doc = static_cast<int64_t>(R_canon) * S;
+    for (int32_t d = 0; d < D; ++d) {
+        const int16_t* sp = src + static_cast<int64_t>(d) * src_doc;
+        int32_t* dp = dst + static_cast<int64_t>(d) * dst_doc;
+        // n for the live-slot rebase: misc col 0 (separate misc output in
+        // the pair layout, last source row otherwise).
+        const int32_t n = misc != nullptr
+            ? misc[static_cast<int64_t>(d) * misc_cols + 0]
+            : sp[static_cast<int64_t>(R_src - 1) * S + 0];
+        if (n < 0 || n > S) return -1;
+        for (int32_t r = 0; r < R_canon; ++r) {
+            const int32_t mode = desc[r * 4 + 0];
+            const int32_t arg = desc[r * 4 + 1];
+            const int32_t fill = desc[r * 4 + 2];
+            const int32_t flags = desc[r * 4 + 3];
+            int32_t* row = dp + static_cast<int64_t>(r) * S;
+            if (mode == 0) {
+                for (int32_t s = 0; s < S; ++s) row[s] = fill;
+                continue;
+            }
+            if (mode == 3) {
+                for (int32_t s = 0; s < S; ++s)
+                    row[s] = s < misc_cols
+                        ? misc[static_cast<int64_t>(d) * misc_cols + s] : 0;
+                continue;
+            }
+            if (mode == 1) {
+                const int16_t* srow = sp + static_cast<int64_t>(arg) * S;
+                for (int32_t s = 0; s < S; ++s) row[s] = srow[s];
+            } else if (mode == 2) {
+                const int16_t* srow =
+                    sp + static_cast<int64_t>(arg / 2) * S;
+                const bool hi = (arg % 2) == 0;
+                for (int32_t s = 0; s < S; ++s) {
+                    const uint16_t pair = static_cast<uint16_t>(srow[s]);
+                    row[s] = static_cast<int8_t>(
+                        hi ? (pair >> 8) : (pair & 0xFF));
+                }
+            } else {
+                return -1;
+            }
+            if (flags & 1) {
+                for (int32_t s = 0; s < S; ++s)
+                    if (row[s] == sentinel_src) row[s] = sentinel_dst;
+            }
+            if ((flags & 2) && doc_base != nullptr) {
+                const int32_t base = doc_base[d];
+                for (int32_t s = 0; s < n; ++s) row[s] += base;
+            }
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"

@@ -183,3 +183,52 @@ def test_narrow_bounds_recheck_falls_back_to_wide():
 
 def test_narrow_dtype_table_covers_every_op_field():
     assert set(_UPLOAD_NARROW_DTYPES) == set(MTOps._fields)
+
+
+def test_native_widen_matches_python_widen_all_layouts():
+    """oppack_widen vs widen_export: byte-identical canonical buffers on
+    every transfer layout the export can emit (i16, i8 pairs, ob/ov row
+    elisions, props elision, warm doc_base rebase)."""
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        _export_flags,
+        widen_export,
+        widen_export_native,
+    )
+    from fluidframework_tpu.ops.native_pack import load_library
+
+    if load_library() is None:
+        pytest.skip("liboppack unavailable")
+
+    cases = {
+        # props-free sequential bench docs: i8 pairs + ob/ov/props elision
+        "i8-elided": [bench.synth_doc(i, 48) for i in range(16)],
+        # annotate-carrying docs: props rows present
+        "props": [bench.synth_doc(3 * i + 1, 48) for i in range(12)],
+        # warm snapshot+tail docs: doc_base rebase over base states
+        "warm": [_warm_doc(240 + i) for i in range(3)],
+    }
+    exercised = set()
+    for name, docs in cases.items():
+        state, ops, meta = pack_mergetree_batch(docs)
+        S = state.tstart.shape[1]
+        assert meta["i16_ok"], name
+        st = state if name == "warm" else None
+        ex = export_to_numpy(replay_export(st, ops, meta, S=S))
+        _i16, ob_f, ov_f, i8_f, props_f = _export_flags(meta)
+        exercised.add((ob_f, ov_f, i8_f, props_f))
+        native = widen_export_native(ex, meta.get("doc_base"), ob_f, ov_f,
+                                     i8_f, meta.get("props_K"), props_f)
+        assert native is not None, name
+        py = widen_export(ex, meta.get("doc_base"), ob_rows=ob_f,
+                          ov_rows=ov_f, i8=i8_f,
+                          n_props=meta.get("props_K"), props_rows=props_f)
+        np.testing.assert_array_equal(native, py, err_msg=name)
+        assert native.dtype == py.dtype == np.int32
+    assert len(exercised) >= 2, f"layout variety too thin: {exercised}"
+    # int32 full-layout buffers must pass through to the numpy path
+    state, ops, meta = pack_mergetree_batch(cases["props"])
+    meta32 = dict(meta, i16_ok=False)
+    ex32 = export_to_numpy(
+        replay_export(None, ops, meta32, S=state.tstart.shape[1]))
+    assert widen_export_native(ex32, None, True, True, False,
+                               meta.get("props_K"), True) is None
