@@ -10,6 +10,7 @@ log() { echo "$(date -u +%H:%M:%S) $*" >> window_artifacts/status.log; }
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     log "HEALTHY — starting measurement chain"
+    pkill -f test_fuzz_nightly 2>/dev/null; sleep 2
     timeout 580 python bench.py > window_artifacts/bench_sdt.json 2> window_artifacts/bench_sdt.err
     log "bench sdt rc=$? $(head -c 120 window_artifacts/bench_sdt.json)"
     BENCH_E2E_PIPELINE=legacy timeout 580 python bench.py > window_artifacts/bench_legacy.json 2> window_artifacts/bench_legacy.err
