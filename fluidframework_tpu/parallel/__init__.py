@@ -19,7 +19,6 @@ from .shard import (
     replay_matrix_sharded,
     replay_mergetree_sharded,
     replay_tree_sharded,
-    sharded_replay_step,
     tree_sharded_replay_step,
 )
 
@@ -32,6 +31,5 @@ __all__ = [
     "replay_matrix_sharded",
     "replay_mergetree_sharded",
     "replay_tree_sharded",
-    "sharded_replay_step",
     "tree_sharded_replay_step",
 ]

@@ -2,10 +2,12 @@
 
 The batch state/op tensors are laid out ``[D, ...]`` with D the document
 axis; sharding them ``P("docs")`` makes XLA partition the vmapped op-fold
-with no communication (each chip folds its shard of documents), and the
-final cross-chip assembly (per-doc summary digests/lengths replicated for
-the host summarizer) is a single all-gather over ICI, expressed as a
-replication sharding constraint.
+with no communication (each chip folds its shard of documents).  The
+merge-tree path exports per-doc transfer buffers doc-sharded (fully
+collective-free — each chip encodes its shard); where a step needs
+cross-chip assembly (matrix resolved cells, tree/map replicated
+outputs) it is a single all-gather over ICI, expressed as a replication
+sharding constraint.
 
 Multi-slice (DCN) scale-out: :func:`dcn_mesh` builds a 2-D
 ``("slice", "docs")`` mesh — the slice axis spans TPU slices connected
@@ -34,7 +36,6 @@ from ..ops.mergetree_kernel import (
     MTOps,
     MTState,
     MergeTreeDocInput,
-    NOT_REMOVED,
     _export_cold_fn,
     _export_flags,
     _export_warm_fn,
@@ -44,7 +45,6 @@ from ..ops.mergetree_kernel import (
     narrow_state_for_upload,
     oracle_fallback_summary,
     pack_mergetree_batch,
-    replay_vmapped,
     summaries_from_export,
 )
 from ..protocol.summary import SummaryTree
@@ -101,45 +101,6 @@ def _doc_spec(mesh: Mesh) -> P:
     1-D mesh this is P("docs"); on a dcn_mesh it is P(("slice", "docs")),
     i.e. data parallelism across the whole fleet."""
     return P(tuple(mesh.axis_names))
-
-
-@functools.lru_cache(maxsize=8)
-def sharded_replay_step(mesh: Mesh):
-    """Build the jitted, mesh-sharded full replay step (cached per mesh —
-    a fresh jit closure every call would recompile identical shapes).
-
-    Returns ``step(state, ops) -> (final_state, lengths)`` where the fold is
-    partitioned along the doc axis and ``lengths`` (per-doc visible length —
-    the scalar assembled cross-chip for summarizer headers) comes back
-    replicated, forcing the ICI all-gather.
-    """
-    shard = NamedSharding(mesh, _doc_spec(mesh))
-    replicated = NamedSharding(mesh, P())
-
-    def _step(state: MTState, ops: MTOps):
-        final = replay_vmapped(state, ops)
-        slot = jnp.arange(final.tlen.shape[1])[None, :]
-        alive = (slot < final.n[:, None]) & (final.rem_seq == NOT_REMOVED)
-        lengths = jnp.sum(jnp.where(alive, final.tlen, 0), axis=1)
-        # Merged per-doc state assembled over ICI for the (host) summarizer.
-        lengths = jax.lax.with_sharding_constraint(lengths, replicated)
-        return final, lengths
-
-    state_shardings = MTState(
-        tstart=shard, tlen=shard, ins_seq=shard, ins_client=shard,
-        rem_seq=shard, rem_client=shard, rem2_seq=shard, rem2_client=shard,
-        ob1_seq=shard, ob1_client=shard, ob2_seq=shard, ob2_client=shard,
-        props=shard, n=shard, overflow=shard,
-    )
-    ops_shardings = MTOps(
-        kind=shard, seq=shard, client=shard, ref_seq=shard, min_seq=shard,
-        a=shard, b=shard, tstart=shard, tlen=shard, pvals=shard,
-    )
-    return jax.jit(
-        _step,
-        in_shardings=(state_shardings, ops_shardings),
-        out_shardings=(state_shardings, replicated),
-    )
 
 
 def _pad_docs(docs: Sequence, multiple: int, make_pad):

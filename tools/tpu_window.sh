@@ -2,21 +2,41 @@
 # Round-5 TPU window catcher: probe the axon tunnel on a loop; in the FIRST
 # healthy window run the full measurement chain (bench.py on the
 # single-device-thread pipeline, a legacy-pipeline A/B, the five-config
-# table), each timeboxed, artifacts to window_artifacts/.  The operator
-# (or the next session) commits what lands.  Status: window_artifacts/status.log
+# table), each timeboxed.  Artifacts whose run exited 0 with a parseable
+# JSON line are committed LOCALLY (no remote exists in this environment —
+# the driver collects the repo).  Status: window_artifacts/status.log
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p window_artifacts
 log() { echo "$(date -u +%H:%M:%S) $*" >> window_artifacts/status.log; }
+run_one() {  # run_one <name> <cmd...>
+  local name="$1"; shift
+  timeout 580 env "$@" > "window_artifacts/$name.json" 2> "window_artifacts/$name.err"
+  local rc=$?
+  log "$name rc=$rc $(head -c 120 "window_artifacts/$name.json")"
+  if [ "$rc" -eq 0 ] && python -c "import json,sys; json.load(open('window_artifacts/$name.json'))" 2>/dev/null; then
+    cp "window_artifacts/$name.json" "BENCH_tpu_window_$name.json" && KEEP+=("BENCH_tpu_window_$name.json")
+  else
+    log "$name artifact rejected (rc=$rc or unparseable) — not committed"
+  fi
+}
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     log "HEALTHY — starting measurement chain"
-    pkill -f test_fuzz_nightly 2>/dev/null; sleep 2
-    timeout 580 python bench.py > window_artifacts/bench_sdt.json 2> window_artifacts/bench_sdt.err
-    log "bench sdt rc=$? $(head -c 120 window_artifacts/bench_sdt.json)"
-    BENCH_E2E_PIPELINE=legacy timeout 580 python bench.py > window_artifacts/bench_legacy.json 2> window_artifacts/bench_legacy.err
-    log "bench legacy rc=$?"
-    timeout 580 python tools/bench_configs.py > window_artifacts/bench_configs.json 2> window_artifacts/bench_configs.err
-    log "configs rc=$?"
+    pkill -f test_fuzz_nightly 2>/dev/null; pkill -f "pytest tests/" 2>/dev/null; sleep 2
+    KEEP=()
+    run_one sdt python bench.py
+    run_one legacy BENCH_E2E_PIPELINE=legacy python bench.py
+    run_one configs python tools/bench_configs.py
+    if [ "${#KEEP[@]}" -gt 0 ]; then
+      log "committing ${#KEEP[@]} artifact(s): ${KEEP[*]}"
+      git add -- "${KEEP[@]}" && \
+        git commit -q -m "TPU window measurement chain artifacts (${KEEP[*]})" -- "${KEEP[@]}" \
+        && log "commit ok" || log "commit FAILED"
+    else
+      log "no valid artifacts this window — will keep probing"
+      sleep 150
+      continue
+    fi
     touch window_artifacts/CHAIN_DONE
     log "chain complete"
     exit 0
