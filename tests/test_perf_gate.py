@@ -232,3 +232,57 @@ def test_device_e2e_beats_oracle():
     assert dev_rate > 5 * cpu_rate, (
         f"device e2e {dev_rate:,.0f} ops/s < 5x oracle {cpu_rate:,.0f}"
     )
+
+
+def test_native_widen_beats_numpy_widen(packed_chunk):
+    """Relative gate (portable across hosts): the C++ narrow→canonical
+    widen must stay meaningfully faster than the numpy inverse it
+    replaced on the extraction hot path."""
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        _export_flags,
+        export_to_numpy,
+        widen_export,
+        widen_export_native,
+    )
+    from fluidframework_tpu.ops.native_pack import load_library
+
+    if load_library() is None:
+        pytest.skip("liboppack unavailable")
+    _docs, state, ops, meta = packed_chunk
+    assert meta["i16_ok"], "gate needs a narrow-eligible chunk"
+    ex = export_to_numpy(replay_export(None, ops, meta, S=state.tstart.shape[1]))
+    _i16, ob_f, ov_f, i8_f, props_f = _export_flags(meta)
+    args = (meta.get("doc_base"), ob_f, ov_f, i8_f, meta.get("props_K"),
+            props_f)
+    native = py = float("inf")
+    widen_export_native(ex, *args)  # warm
+    for _ in range(3):
+        t0 = time.time()
+        assert widen_export_native(ex, *args) is not None
+        native = min(native, time.time() - t0)
+        t0 = time.time()
+        widen_export(ex, args[0], ob_rows=ob_f, ov_rows=ov_f, i8=i8_f,
+                     n_props=meta.get("props_K"), props_rows=props_f)
+        py = min(py, time.time() - t0)
+    assert native < py, (
+        f"native widen ({native*1e3:.2f}ms) no faster than numpy "
+        f"({py*1e3:.2f}ms)"
+    )
+
+
+def test_narrow_upload_shrinks_op_stream(packed_chunk):
+    """The narrow transfer encoding must keep cutting ≥40% off the
+    qualifying op-stream upload (the h2d leg of the link budget)."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.mergetree_kernel import narrow_ops_for_upload
+
+    _docs, _state, ops, meta = packed_chunk
+    assert meta["i16_ok"]
+    wide = sum(np.asarray(x).nbytes for x in ops)
+    narrow = sum(
+        np.asarray(x).nbytes for x in narrow_ops_for_upload(ops, meta)
+    )
+    assert narrow <= wide * 0.6, (
+        f"narrow upload only {wide - narrow} of {wide} bytes saved"
+    )
