@@ -51,13 +51,21 @@ def test_pack_stage_within_budget(packed_chunk):
     )
 
 
-def test_extract_stage_within_budget(packed_chunk):
-    _docs, state, ops, meta = packed_chunk
+@pytest.fixture(scope="module")
+def chunk_export(packed_chunk):
+    """The chunk's fetched export buffer — shared by every gate that
+    reads it (one fold dispatch + download per module, not per test)."""
     from fluidframework_tpu.ops.mergetree_kernel import export_to_numpy
 
-    export = export_to_numpy(
+    _docs, state, ops, meta = packed_chunk
+    return export_to_numpy(
         replay_export(None, ops, meta, S=state.tstart.shape[1])
     )
+
+
+def test_extract_stage_within_budget(packed_chunk, chunk_export):
+    _docs, _state, _ops, meta = packed_chunk
+    export = chunk_export
     summaries_from_export(meta, export)  # warm (library load etc.)
     best = float("inf")
     for _ in range(3):
@@ -234,13 +242,12 @@ def test_device_e2e_beats_oracle():
     )
 
 
-def test_native_widen_beats_numpy_widen(packed_chunk):
+def test_native_widen_beats_numpy_widen(packed_chunk, chunk_export):
     """Relative gate (portable across hosts): the C++ narrow→canonical
     widen must stay meaningfully faster than the numpy inverse it
     replaced on the extraction hot path."""
     from fluidframework_tpu.ops.mergetree_kernel import (
         _export_flags,
-        export_to_numpy,
         widen_export,
         widen_export_native,
     )
@@ -248,9 +255,9 @@ def test_native_widen_beats_numpy_widen(packed_chunk):
 
     if load_library() is None:
         pytest.skip("liboppack unavailable")
-    _docs, state, ops, meta = packed_chunk
+    _docs, _state, _ops, meta = packed_chunk
     assert meta["i16_ok"], "gate needs a narrow-eligible chunk"
-    ex = export_to_numpy(replay_export(None, ops, meta, S=state.tstart.shape[1]))
+    ex = chunk_export
     _i16, ob_f, ov_f, i8_f, props_f = _export_flags(meta)
     args = (meta.get("doc_base"), ob_f, ov_f, i8_f, meta.get("props_K"),
             props_f)
@@ -270,13 +277,15 @@ def test_native_widen_beats_numpy_widen(packed_chunk):
     )
 
 
-def test_narrow_upload_shrinks_op_stream(packed_chunk):
+def test_narrow_upload_shrinks_op_stream(packed_chunk, monkeypatch):
     """The narrow transfer encoding must keep cutting ≥40% off the
     qualifying op-stream upload (the h2d leg of the link budget)."""
     import numpy as np
 
     from fluidframework_tpu.ops.mergetree_kernel import narrow_ops_for_upload
 
+    # The documented disable switch would make this gate fail spuriously.
+    monkeypatch.delenv("FF_UPLOAD_NARROW", raising=False)
     _docs, _state, ops, meta = packed_chunk
     assert meta["i16_ok"]
     wide = sum(np.asarray(x).nbytes for x in ops)
