@@ -1,0 +1,28 @@
+"""Standalone Pallas/Mosaic canary: the smallest highest-value TPU
+measurement — did the VMEM-resident fold Mosaic-compile, and how fast is
+it vs the scan on one chunk?  Runs bench._pallas_canary's subprocess
+harness without the rest of the bench, so a tunnel window of a couple of
+minutes still captures the round's riskiest unknown (SURVEY §7 hard-part
+#4; the round-5 block-shape fix is unvalidated until this compiles on a
+real chip).  Prints ONE JSON line."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    out = bench._pallas_canary()
+    if out is None:  # FF_NO_PALLAS_CANARY set — no measurement was taken
+        print("pallas canary disabled (FF_NO_PALLAS_CANARY)",
+              file=sys.stderr)
+        sys.exit(1)
+    print(json.dumps({"metric": "pallas_canary", "result": out}))
+
+
+if __name__ == "__main__":
+    main()
