@@ -7,7 +7,15 @@
 # the driver collects the repo).  Status: window_artifacts/status.log
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p window_artifacts
+# Singleton via pidfile (pkill -f patterns match unrelated shells whose
+# command lines merely mention this path — kill by pid, never by name).
+if [ -f window_artifacts/catcher.pid ] \
+    && kill -0 "$(cat window_artifacts/catcher.pid)" 2>/dev/null; then
+  exit 0
+fi
+echo $$ > window_artifacts/catcher.pid
 log() { echo "$(date -u +%H:%M:%S) $*" >> window_artifacts/status.log; }
+log "catcher started pid $$"
 run_one() {  # run_one <name> <cmd...> ; returns 0 on accepted artifact
   local name="$1"; shift
   timeout 580 env "$@" > "window_artifacts/$name.json" 2> "window_artifacts/$name.err"
