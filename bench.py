@@ -574,8 +574,9 @@ def run_e2e(docs):
 
     Stage times are per-stage BUSY seconds (they overlap); ``wall`` is the
     honest end-to-end wall-clock the throughput number uses.
-    ``packed_chunks`` [(ops, meta, S)] lets the steady-fold section reuse
-    the pack work instead of repeating it.
+    ``packed_chunks`` [(state_or_None, ops, meta, S)] lets the
+    steady-fold section reuse the pack work (warm chunks keep their base
+    state so the re-timed fold runs the e2e's own executable).
 
     Two pipeline shapes, selected by ``BENCH_E2E_PIPELINE``:
 
@@ -598,106 +599,27 @@ def run_e2e(docs):
     return _run_e2e_single_device_thread(docs)
 
 
-def _start_host_copy(ex) -> None:
-    """Begin the d2h transfer(s) for an export handle without blocking —
-    the fetch that trails the dispatch front then finds the bytes (or at
-    least the transfer) already in flight."""
-    leaves = ex if isinstance(ex, tuple) else (ex,)
-    for leaf in leaves:
-        copy = getattr(leaf, "copy_to_host_async", None)
-        if copy is not None:
-            copy()
-
-
 def _run_e2e_single_device_thread(docs):
-    """One loop, one device thread: pull packed chunks in submission
-    order from the pack pool's sliding window, dispatch + start the
-    async host copy, fetch ``FETCH_DEPTH`` chunks behind, and fan
-    extraction out to its own pool.  Errors surface naturally in the
-    caller's thread (pool futures re-raise on ``.result()``); the only
-    cleanup is cancelling not-yet-started pack/extract futures."""
-    import collections
-    from concurrent.futures import ThreadPoolExecutor
-
-    from fluidframework_tpu.ops.mergetree_kernel import narrow_ops_for_upload
+    """The PRODUCT pipeline (fluidframework_tpu.ops.pipeline) with the
+    bench's instrumentation hooks attached — the harness measures the
+    same code the catch-up service runs, not a private copy of it."""
+    from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
 
     stage = {"pack": 0.0, "dispatch": 0.0, "download": 0.0, "extract": 0.0}
-    packed_chunks = []
-    summaries, stats = [], {}
-    fetch_depth = int(os.environ.get("BENCH_FETCH_DEPTH", "2"))
-
-    def pack_one(lo):
-        # Narrowing (bounds re-check + astype copies over the whole
-        # stream) belongs in the parallel pack pool, not on the single
-        # serialized device thread; replay_export's internal call is an
-        # identity passthrough on the already-narrow stream.
-        t0 = time.time()
-        state, ops, meta = pack_mergetree_batch(docs[lo:lo + CHUNK_DOCS])
-        ops = narrow_ops_for_upload(ops, meta)
-        return state, ops, meta, time.time() - t0
-
-    def extract_one(meta, arr):
-        t0 = time.time()
-        st: dict = {}
-        res = summaries_from_export(meta, arr, stats=st)
-        return res, st, time.time() - t0
-
-    def collect(fut) -> None:
-        res, st, dt = fut.result()
-        summaries.extend(res)
-        stage["extract"] += dt  # busy (overlapped) seconds
-        for k, v in st.items():
-            stats[k] = stats.get(k, 0) + v
-
-    starts = list(range(0, len(docs), CHUNK_DOCS))
+    packed_chunks: list = []
+    stats: dict = {}
     wall0 = time.time()
-    pack_futs: collections.deque = collections.deque()
-    ex_futs: collections.deque = collections.deque()
-    inflight: collections.deque = collections.deque()
-    with ThreadPoolExecutor(max_workers=PACK_THREADS) as pack_pool, \
-            ThreadPoolExecutor(max_workers=EXTRACT_THREADS) as ex_pool:
-        try:
-            next_i = 0
-            while next_i < len(starts) and len(pack_futs) < PACK_THREADS + 1:
-                pack_futs.append(pack_pool.submit(pack_one, starts[next_i]))
-                next_i += 1
-
-            def fetch_one(meta, ex) -> None:
-                t0 = time.time()
-                arr = export_to_numpy(ex)  # the D2H link RPC(s)
-                stage["download"] += time.time() - t0
-                ex_futs.append(ex_pool.submit(extract_one, meta, arr))
-                if len(ex_futs) >= EXTRACT_THREADS + 1:
-                    collect(ex_futs.popleft())
-
-            while pack_futs:
-                fut = pack_futs.popleft()
-                state, ops, meta, dt = fut.result()
-                if next_i < len(starts):
-                    pack_futs.append(
-                        pack_pool.submit(pack_one, starts[next_i]))
-                    next_i += 1
-                stage["pack"] += dt  # busy (overlapped) seconds
-                t0 = time.time()
-                S = state.tstart.shape[1]
-                ex = replay_export(None, ops, meta, S=S)
-                _start_host_copy(ex)
-                stage["dispatch"] += time.time() - t0
-                packed_chunks.append((ops, meta, S))
-                inflight.append((meta, ex))
-                if len(inflight) > fetch_depth:
-                    fetch_one(*inflight.popleft())
-            while inflight:
-                fetch_one(*inflight.popleft())
-            while ex_futs:
-                collect(ex_futs.popleft())
-        finally:
-            # On error: drop queued-but-unstarted work so pool shutdown
-            # does not run the rest of the stream first.
-            for f in pack_futs:
-                f.cancel()
-            for f in ex_futs:
-                f.cancel()
+    summaries = pipelined_mergetree_replay(
+        docs,
+        chunk_docs=CHUNK_DOCS,
+        pack_threads=PACK_THREADS,
+        extract_threads=EXTRACT_THREADS,
+        fetch_depth=int(os.environ.get("BENCH_FETCH_DEPTH", "2")),
+        schedule=True,
+        stats=stats,
+        stage=stage,
+        packed_out=packed_chunks,
+    )
     return summaries, stats, stage, time.time() - wall0, packed_chunks
 
 
@@ -771,7 +693,7 @@ def _run_e2e_legacy(docs):
                         S = state.tstart.shape[1]
                         ex = replay_export(None, ops, meta, S=S)
                         stage["dispatch"] += time.time() - t0
-                        packed_chunks.append((ops, meta, S))
+                        packed_chunks.append((None, ops, meta, S))
                         if not put(folded, (meta, ex)):
                             return
                 finally:
@@ -982,12 +904,20 @@ def _run_bench(probe: dict) -> dict:
 
     resident = []
     upload_bytes = 0
-    for ops, meta, s in packed_chunks:
+    for chunk_state, ops, meta, s in packed_chunks:
         ops_n = narrow_ops_for_upload(ops, meta)  # same stream e2e uploads
         upload_bytes += sum(np.asarray(x).nbytes for x in ops_n)
         ops_dev = jax.device_put(ops_n)
         jax.block_until_ready(ops_dev)
-        resident.append((ops_dev, meta, s))
+        # Warm chunks re-time with their base state resident too — the
+        # SAME executable the e2e dispatched, not a cold rebuild.
+        state_dev = None
+        if chunk_state is not None:
+            state_dev = jax.device_put(chunk_state)
+            jax.block_until_ready(state_dev)
+            upload_bytes += sum(
+                np.asarray(x).nbytes for x in chunk_state)
+        resident.append((state_dev, ops_dev, meta, s))
     print(
         f"op-stream upload (narrowed where i16_ok): "
         f"{upload_bytes / 1e6:.1f} MB",
@@ -997,8 +927,8 @@ def _run_bench(probe: dict) -> dict:
     for _rep in range(3):
         t0 = time.time()
         finals = [
-            replay_export(None, ops_dev, meta, S=s)
-            for ops_dev, meta, s in resident
+            replay_export(state_dev, ops_dev, meta, S=s)
+            for state_dev, ops_dev, meta, s in resident
         ]
         for final in finals:
             jax.block_until_ready(final)

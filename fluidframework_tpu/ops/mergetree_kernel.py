@@ -1360,6 +1360,7 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
         "arena": arena,
         "docs": docs,
         "doc_base": doc_base,
+        "_S": S,  # the padded slot bucket (cold-start export builders)
         "i16_ok": i16_ok,
         "i8_ok": i8_ok,
         "props_K": K,

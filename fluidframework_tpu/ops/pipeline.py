@@ -1,0 +1,205 @@
+"""Chunked, pipelined bulk merge-tree replay — the PRODUCT's version of
+the bench harness's e2e loop (SURVEY §3.2: catch-up is the north-star
+path, and the service must not be slower than the benchmark of itself).
+
+Shape (round-5 pipeline, BASELINE.md):
+
+- documents are chunked (``chunk_docs``) so jitted shapes stay bucketed
+  and per-transfer sizes bounded;
+- chunks are fact-scheduled (annotate-free docs grouped) so the majority
+  volume folds with the props plane traced away — results return in the
+  CALLER's order regardless;
+- packing (C++, GIL-released) runs in a thread pool; extraction
+  likewise; ALL device interaction — dispatch, ``copy_to_host_async``,
+  the blocking fetch — stays on the calling thread.  The axon client
+  degrades persistently (~70–90 ms/call) when a second thread fetches
+  while another dispatches (BASELINE.md round-5 measurement), and a
+  single device thread also serializes correctly on every backend;
+- the blocking fetch trails the dispatch front by ``fetch_depth`` chunks
+  so upload/fold/download overlap without a second device thread;
+- oracle-fallback docs route around the device exactly like
+  ``replay_mergetree_batch`` (shared ``partition_replay`` + post-fold
+  overflow handling inside ``summaries_from_export``).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+from concurrent.futures import ThreadPoolExecutor
+from time import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .batching import partition_replay
+from .mergetree_kernel import (
+    MergeTreeDocInput,
+    export_to_numpy,
+    known_oracle_fallback,
+    narrow_ops_for_upload,
+    narrow_state_for_upload,
+    oracle_fallback_summary,
+    pack_mergetree_batch,
+    replay_export,
+    summaries_from_export,
+)
+
+
+def pipelined_mergetree_replay(
+    docs: Sequence[MergeTreeDocInput],
+    *,
+    chunk_docs: int = 1024,
+    pack_threads: int = 4,
+    extract_threads: int = 3,
+    fetch_depth: int = 2,
+    schedule: bool = True,
+    stats: Optional[dict] = None,
+    stage: Optional[dict] = None,
+    packed_out: Optional[list] = None,
+):
+    """Canonical summaries for ``docs`` in the given order.
+
+    ``stats`` accumulates ``device_docs``/``fallback_docs``; ``stage``
+    (if given) accumulates busy seconds under ``pack``/``dispatch``/
+    ``download``/``extract`` — the bench harness's instrumentation hook;
+    ``packed_out`` (if given) collects ``(ops, meta, S)`` per chunk in
+    schedule order so a caller can reuse the pack work."""
+
+    def fold(batch):
+        return _pipelined_fold(
+            batch, chunk_docs, pack_threads, extract_threads, fetch_depth,
+            schedule, stats, stage, packed_out,
+        )
+
+    return partition_replay(
+        docs, known_oracle_fallback, oracle_fallback_summary, fold,
+        stats=stats,
+    )
+
+
+def _bump(stage: Optional[dict], key: str, t0: float) -> None:
+    if stage is not None:
+        stage[key] = stage.get(key, 0.0) + (time() - t0)
+
+
+def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
+                    fetch_depth, schedule, stats, stage, packed_out):
+    order = list(range(len(batch)))
+    if schedule and any(d.binary_ops is not None for d in batch):
+        # Fact-homogeneous scheduling: annotate-free docs first, so their
+        # chunks compile with the props plane traced away (~20% fold win
+        # on the pure-text majority).  Stable sort; order restored below.
+        # Binary docs carry the fact in their header (O(1)); message-list
+        # docs would need an O(ops) serial pre-scan on this thread, so a
+        # batch with no binary docs keeps its order (the pack pre-scan
+        # derives the facts in the parallel pool regardless).
+        order.sort(key=lambda i: batch[i].binary_prop_keys is not None
+                   if batch[i].binary_ops is not None
+                   else _has_props(batch[i]))
+    sched = [batch[i] for i in order]
+    starts = list(range(0, len(sched), chunk_docs))
+
+    def pack_one(lo):
+        t0 = time()
+        state, ops, meta = pack_mergetree_batch(sched[lo:lo + chunk_docs])
+        chunk = sched[lo:lo + chunk_docs]
+        warm = any(d.base_records for d in chunk)
+        state = narrow_state_for_upload(state, meta) if warm else None
+        ops = narrow_ops_for_upload(ops, meta)
+        return state, ops, meta, time() - t0
+
+    def extract_one(meta, arr):
+        t0 = time()
+        st: dict = {}
+        res = summaries_from_export(meta, arr, stats=st)
+        return res, st, time() - t0
+
+    out: List = []
+
+    def collect(fut) -> None:
+        res, st, dt = fut.result()
+        out.extend(res)
+        if stage is not None:
+            stage["extract"] = stage.get("extract", 0.0) + dt
+        if stats is not None:
+            for k, v in st.items():
+                stats[k] = stats.get(k, 0) + v
+
+    pack_futs: collections.deque = collections.deque()
+    ex_futs: collections.deque = collections.deque()
+    inflight: collections.deque = collections.deque()
+    with ThreadPoolExecutor(max_workers=pack_threads) as pack_pool, \
+            ThreadPoolExecutor(max_workers=extract_threads) as ex_pool:
+        try:
+            next_i = 0
+            while next_i < len(starts) and len(pack_futs) < pack_threads + 1:
+                pack_futs.append(pack_pool.submit(pack_one, starts[next_i]))
+                next_i += 1
+
+            def fetch_one(meta, ex) -> None:
+                t0 = time()
+                arr = export_to_numpy(ex)  # the d2h link RPC(s)
+                _bump(stage, "download", t0)
+                ex_futs.append(ex_pool.submit(extract_one, meta, arr))
+                if len(ex_futs) >= extract_threads + 1:
+                    collect(ex_futs.popleft())
+
+            while pack_futs:
+                fut = pack_futs.popleft()
+                state, ops, meta, dt = fut.result()
+                if next_i < len(starts):
+                    pack_futs.append(
+                        pack_pool.submit(pack_one, starts[next_i]))
+                    next_i += 1
+                if stage is not None:
+                    stage["pack"] = stage.get("pack", 0.0) + dt
+                t0 = time()
+                S = _chunk_S(meta)
+                ex = replay_export(state, ops, meta, S=S)
+                _start_host_copy(ex)
+                _bump(stage, "dispatch", t0)
+                if packed_out is not None:
+                    # state included so a caller re-timing the fold can
+                    # replay WARM chunks with the same executable the e2e
+                    # used (None for cold chunks).
+                    packed_out.append((state, ops, meta, S))
+                inflight.append((meta, ex))
+                if len(inflight) > fetch_depth:
+                    fetch_one(*inflight.popleft())
+            while inflight:
+                fetch_one(*inflight.popleft())
+            while ex_futs:
+                collect(ex_futs.popleft())
+        finally:
+            for f in pack_futs:
+                f.cancel()
+            for f in ex_futs:
+                f.cancel()
+    # Restore the caller's order.
+    restored: List = [None] * len(batch)
+    for pos, i in enumerate(order):
+        restored[i] = out[pos]
+    return restored
+
+
+def _has_props(doc: MergeTreeDocInput) -> bool:
+    for msg in doc.ops:
+        op = msg.contents
+        if not op["kind"].startswith("interval") and op.get("props"):
+            return True
+    return bool(any(r.get("p") for r in (doc.base_records or [])))
+
+
+def _chunk_S(meta: dict) -> int:
+    """The chunk's padded slot capacity (pack_mergetree_batch's S bucket),
+    recovered from the packed meta for the cold-start export builder."""
+    return int(meta["_S"])
+
+
+def _start_host_copy(ex) -> None:
+    leaves = ex if isinstance(ex, tuple) else (ex,)
+    for leaf in leaves:
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is not None:
+            copy()

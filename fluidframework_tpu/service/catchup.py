@@ -26,7 +26,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..ops.batching import partition_replay
-from ..ops.mergetree_kernel import MergeTreeDocInput, replay_mergetree_batch
+from ..ops.mergetree_kernel import MergeTreeDocInput
 from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.summary import SummaryTree, canonical_json
 from ..runtime.container import ContainerRuntime
@@ -456,8 +456,14 @@ class CatchupService:
                 TREE_TYPE: functools.partial(replay_tree_sharded, mesh=mesh),
             }
         else:
+            from ..ops.pipeline import pipelined_mergetree_replay
+
+            # String channels (the north-star volume) ride the chunked,
+            # fact-scheduled, single-device-thread pipeline — the same
+            # code path bench.py measures; the other kernels' batches are
+            # small enough to fold in one dispatch each.
             replay = {
-                STRING_TYPE: replay_mergetree_batch,
+                STRING_TYPE: pipelined_mergetree_replay,
                 MAP_TYPE: replay_map_batch,
                 MATRIX_TYPE: replay_matrix_batch,
                 TREE_TYPE: replay_tree_batch,

@@ -1,0 +1,66 @@
+"""The product's pipelined bulk replay (ops/pipeline.py) vs the one-batch
+replay_mergetree_batch: identical summaries in the caller's order across
+cold, warm, interval, attribution, and oracle-fallback docs — the service
+and the bench harness both ride this path."""
+
+import numpy as np
+import pytest
+
+import bench
+from fluidframework_tpu.ops.mergetree_kernel import (
+    MergeTreeDocInput,
+    replay_mergetree_batch,
+)
+from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
+from fluidframework_tpu.testing.fuzz import StringFuzzSpec, run_fuzz
+from fluidframework_tpu.testing.mocks import channel_log
+from tests.test_upload_narrow import _warm_doc
+
+
+def _mixed_docs():
+    docs = [bench.synth_doc(i, 40) for i in range(40)]      # cold binary
+    docs += [_warm_doc(260 + i) for i in range(3)]          # warm
+    for seed in (270, 271):                                  # fuzz logs
+        _r, f = run_fuzz(StringFuzzSpec(annotate=True, intervals=True),
+                         seed=seed, n_clients=3, rounds=8, sync_every=2)
+        docs.append(MergeTreeDocInput(
+            doc_id=f"mix{seed}", ops=channel_log(f, "fuzz"),
+            final_seq=f.sequencer.seq, final_msn=f.sequencer.min_seq))
+    return docs
+
+
+def test_pipelined_matches_one_batch_replay():
+    docs = _mixed_docs()
+    base_stats: dict = {}
+    expect = [s.digest() for s in replay_mergetree_batch(docs, base_stats)]
+    stats: dict = {}
+    stage: dict = {}
+    packed: list = []
+    got = pipelined_mergetree_replay(
+        docs, chunk_docs=16, pack_threads=2, extract_threads=2,
+        fetch_depth=1, stats=stats, stage=stage, packed_out=packed)
+    assert [s.digest() for s in got] == expect, "pipeline changed bytes"
+    assert len(packed) == (len(docs) + 15) // 16
+    assert all(len(entry) == 4 for entry in packed)  # (state, ops, meta, S)
+    assert stats.get("device_docs", 0) > 0
+    assert stats.get("fallback_docs", 0) == base_stats.get("fallback_docs", 0)
+    assert stage.get("pack", 0) > 0 and stage.get("download", 0) >= 0
+
+
+def test_pipelined_schedule_returns_caller_order():
+    """Fact scheduling reorders chunks internally; results must come back
+    in the caller's order (alternate props/pure docs so the sort really
+    permutes)."""
+    docs = []
+    for i in range(30):
+        docs.append(bench.synth_doc(3 * i + 1, 32))  # mix annotate/pure
+    expect = [s.digest() for s in replay_mergetree_batch(docs)]
+    got = pipelined_mergetree_replay(docs, chunk_docs=8)
+    assert [s.digest() for s in got] == expect
+
+
+def test_pipelined_empty_and_single():
+    assert pipelined_mergetree_replay([]) == []
+    [one] = pipelined_mergetree_replay([bench.synth_doc(5, 24)])
+    [ref] = replay_mergetree_batch([bench.synth_doc(5, 24)])
+    assert one.digest() == ref.digest()
