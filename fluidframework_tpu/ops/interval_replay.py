@@ -45,36 +45,55 @@ class FinalStateView:
         self.rem2_seq = np.asarray(state_np["rem2_seq"][d, :n])
         self.rem2_client = np.asarray(state_np["rem2_client"][d, :n])
         self.not_removed = not_removed
+        self._vis_cache: Dict[tuple, np.ndarray] = {}
 
     # -- bounded historical views ---------------------------------------------
 
-    def _vis_len(self, s: int, ref: int, client: int, up_to: int) -> int:
-        ins_vis = self.ins_seq[s] <= ref or (
-            self.ins_client[s] == client and self.ins_seq[s] < up_to
+    def _vis_cumsum(self, ref: int, client: int, up_to: int) -> np.ndarray:
+        """Inclusive cumsum of per-slot visible lengths for one bounded
+        view.  A slot is visible iff its insert sequenced at or below
+        ``ref`` (or is the client's own, earlier in the fold) AND no
+        removal counts against the view: a removal sequenced at or below
+        ``ref``, or the client's own first/second removal earlier in the
+        fold (NOT_REMOVED is int32-max, so the < / <= comparisons short
+        out identically to the scalar rules).  Cached per
+        (ref, client, up_to): base-interval resolution and multi-part
+        ops hit the same view repeatedly."""
+        key = (ref, client, up_to)
+        hit = self._vis_cache.get(key)
+        if hit is not None:
+            return hit
+        ins_vis = (self.ins_seq <= ref) | (
+            (self.ins_client == client) & (self.ins_seq < up_to)
         )
-        if not ins_vis:
-            return 0
-        if self.rem_seq[s] != self.not_removed and self.rem_seq[s] <= ref:
-            return 0
-        if self.rem_client[s] == client and self.rem_seq[s] < up_to:
-            return 0
-        if self.rem2_client[s] == client and self.rem2_seq[s] < up_to:
-            return 0
-        return int(self.tlen[s])
+        removed = (
+            ((self.rem_seq != self.not_removed) & (self.rem_seq <= ref))
+            | ((self.rem_client == client) & (self.rem_seq < up_to))
+            | ((self.rem2_client == client) & (self.rem2_seq < up_to))
+        )
+        cum = np.cumsum(np.where(ins_vis & ~removed, self.tlen, 0))
+        self._vis_cache[key] = cum
+        return cum
 
     def resolve(self, pos: int, ref: int, client: int, up_to: int):
         """View-position → (slot, offset) anchor, or None (empty view).
-        Mirrors MergeTreeOracle.create_reference."""
-        c = 0
-        for s in range(self.n):
-            v = self._vis_len(s, ref, client, up_to)
-            if v > 0 and c + v > pos:
-                return s, pos - c
-            c += v
-        for s in range(self.n - 1, -1, -1):
-            if self._vis_len(s, ref, client, up_to) > 0:
-                return s, int(self.tlen[s])
-        return None
+        Mirrors MergeTreeOracle.create_reference.  Vectorized: one
+        visibility cumsum + searchsorted instead of a per-slot Python
+        walk (the interval fold's hot loop — config #3)."""
+        if self.n == 0:
+            return None
+        cum = self._vis_cumsum(ref, client, up_to)
+        total = int(cum[-1])
+        if pos < total:
+            s = int(np.searchsorted(cum, pos, side="right"))
+            return s, pos - int(cum[s - 1] if s else 0)
+        if total == 0:
+            return None  # empty view — nothing to anchor to
+        # Past the end: anchor at the END of the LAST visible slot — the
+        # first index where cum reaches total (contributions are
+        # positive, so that index is the last contributor).
+        s = int(np.searchsorted(cum, total - 1, side="right"))
+        return s, int(self.tlen[s])
 
     # -- slide cascade ---------------------------------------------------------
 
