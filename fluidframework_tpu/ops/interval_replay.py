@@ -56,13 +56,18 @@ class FinalStateView:
         removal counts against the view: a removal sequenced at or below
         ``ref``, or the client's own first/second removal earlier in the
         fold (NOT_REMOVED is int32-max, so the < / <= comparisons short
-        out identically to the scalar rules).  Cached per
-        (ref, client, up_to): base-interval resolution and multi-part
-        ops hit the same view repeatedly."""
+        out identically to the scalar rules).  Tiny FIFO cache (2
+        entries): every realizable hit is either the base view resolved
+        repeatedly up front or one op's start/end pair back-to-back —
+        each interval op's (ref, client, seq) key is unique, so an
+        unbounded cache would retain one O(n) array per op for the
+        lifetime of the extraction."""
         key = (ref, client, up_to)
         hit = self._vis_cache.get(key)
         if hit is not None:
             return hit
+        if len(self._vis_cache) >= 2:
+            self._vis_cache.pop(next(iter(self._vis_cache)))
         ins_vis = (self.ins_seq <= ref) | (
             (self.ins_client == client) & (self.ins_seq < up_to)
         )

@@ -264,7 +264,16 @@ def oracle_tree(doc: TreeDocInput):
 # -- the measurement loop -----------------------------------------------------
 
 
-def run_config(name, docs, n_ops, oracle_fn, device_batch_fn):
+def _pipelined_string(docs, stats=None):
+    """Config #1/#3 device path = the PRODUCT pipeline (the same chunked
+    single-device-thread fold the catch-up service runs)."""
+    from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
+
+    return pipelined_mergetree_replay(docs, chunk_docs=CHUNK, stats=stats)
+
+
+def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
+               self_chunked=False):
     total_ops = sum(n_ops(d) for d in docs)
     sample = docs[:CPU_SAMPLE]
     t0 = time.time()
@@ -275,13 +284,18 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn):
     # Device end-to-end (chunked like production).  Warm the compile cache
     # on a FULL first chunk — the (S, T) buckets derive from batch maxima,
     # so a tiny warm batch would compile a different shape and leave the
-    # real compilation inside the timed loop.
+    # real compilation inside the timed loop.  ``self_chunked`` fns (the
+    # product's pipelined replay) receive the whole population in one
+    # call and chunk/overlap internally.
     device_batch_fn(docs[:CHUNK])
     stats: dict = {}
     t0 = time.time()
-    summaries = []
-    for i in range(0, len(docs), CHUNK):
-        summaries.extend(device_batch_fn(docs[i:i + CHUNK], stats=stats))
+    if self_chunked:
+        summaries = list(device_batch_fn(docs, stats=stats))
+    else:
+        summaries = []
+        for i in range(0, len(docs), CHUNK):
+            summaries.extend(device_batch_fn(docs[i:i + CHUNK], stats=stats))
     dev_t = time.time() - t0
     dev_rate = total_ops / dev_t
 
@@ -340,7 +354,7 @@ def _run_configs(probe: dict) -> dict:
     print(f"gen sharedstring {time.time()-t0:.1f}s", file=sys.stderr)
     results["sharedstring"] = run_config(
         "sharedstring", docs, lambda d: k,
-        oracle_string_binary, replay_mergetree_batch,
+        oracle_string_binary, _pipelined_string, self_chunked=True,
     )
 
     n, k = sizes["map"]
@@ -357,7 +371,7 @@ def _run_configs(probe: dict) -> dict:
     print(f"gen intervals {time.time()-t0:.1f}s", file=sys.stderr)
     results["intervals"] = run_config(
         "intervals", docs, lambda d: len(d.ops),
-        oracle_string, replay_mergetree_batch,
+        oracle_string, _pipelined_string, self_chunked=True,
     )
 
     n, k = sizes["matrix"]
