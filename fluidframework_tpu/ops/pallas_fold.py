@@ -4,27 +4,38 @@ The XLA ``lax.scan`` fold streams the whole carried state — 12 int32
 ``[S]`` columns plus an ``[S, K]`` props plane per document — through HBM
 on every op step: ~``2 * S * (12+K) * 4`` bytes per applied op, the
 roofline bench.py reports against.  A document's entire state is tiny
-(S=256, K=1: ~13 KB), so the TPU-native shape is ONE kernel instance per
-document that loads the state into VMEM once, folds every op of the tail
-with a ``fori_loop``, and writes the final state back once: HBM traffic
-drops from O(T x state) to O(state + ops) and the fold leaves the
-bandwidth roofline entirely.
+(S=256, K=1: ~13 KB), so the TPU-native shape is a kernel instance that
+loads state into VMEM once, folds every op of the tail with a
+``fori_loop``, and writes the final state back once: HBM traffic drops
+from O(T x state) to O(state + ops) and the fold leaves the bandwidth
+roofline entirely.
+
+Each grid step owns a SUBLANE-PACKED BATCH of B=8 documents: blocks are
+``(8, S)`` over ``(D, S)`` arrays, which satisfies Mosaic's block rule
+directly (sublane dim divisible by 8, lane dim equal to the array's) and
+fills the VPU's 8 sublanes instead of wasting 7 of them on a
+one-doc-per-step layout (the round-5 compile failure was a ``(1, S)``
+block).  ``D`` pads to a multiple of 8 with inert no-op documents.
 
 Semantics are a faithful port of ``mergetree_kernel._apply_op`` /
-``_split_at`` (the canonical scan step), restated Mosaic-conservatively:
+``_split_at`` (the canonical scan step), restated Mosaic-conservatively
+and batch-wide:
 
 - every gather is a roll+select (the step's shifts are shift-right-by-one
-  above an index) or a masked one-hot reduction (single-slot reads);
+  above an index) or a masked one-hot reduction (single-slot reads),
+  reduced per-row (``axis=1, keepdims=True``);
 - prefix sums are an unrolled Hillis-Steele ladder of masked rolls;
-- first/nearest-slot searches are min/max reductions over masked iotas;
-- all iotas are 2D (``broadcasted_iota``), state rows are ``(1, S)``.
+- first/nearest-slot searches are per-row min/max reductions over masked
+  iotas;
+- all iotas are 2D (``broadcasted_iota``); per-op values are ``(B, 1)``
+  columns broadcasting against the ``(B, S)`` state planes.
 
 Exact-parity tests (tests/test_pallas_fold.py) pin this port to the
 canonical step on directed + fuzz streams, byte-identical through the
 summary extraction.  CI runs the kernel in interpret mode (pure jax, any
 backend); on real TPU the compiled path is gated behind
 ``FF_PALLAS_FOLD=1`` until a healthy-tunnel window lets it be measured
-(BASELINE.md round-4 status).
+(BASELINE.md round-5 status; tools/pallas_probe.py is the window canary).
 """
 
 from __future__ import annotations
@@ -54,6 +65,9 @@ _COL_FIELDS = ("tstart", "tlen", "ins_seq", "ins_client", "rem_seq",
                "rem_client", "rem2_seq", "rem2_client", "ob1_seq",
                "ob1_client", "ob2_seq", "ob2_client")
 
+#: documents per grid step — the int32 sublane count; blocks are (8, S)
+DOC_BLOCK = 8
+
 
 def _iota(S: int) -> jnp.ndarray:
     return jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
@@ -72,15 +86,16 @@ def _excl_cumsum(v: jnp.ndarray, S: int) -> jnp.ndarray:
 
 
 def _at(f: jnp.ndarray, slot: jnp.ndarray, idx, valid, default):
-    """f[idx] as a masked one-hot reduction (no gather): exact when
-    ``valid`` (idx names a real slot), ``default`` otherwise."""
-    hit = jnp.sum(jnp.where(slot == idx, f, 0))
+    """Per-row f[idx] as a masked one-hot reduction (no gather): exact
+    when ``valid`` (idx names a real slot), ``default`` otherwise.
+    ``f`` is (B, S); ``idx``/``valid`` are (B, 1); result is (B, 1)."""
+    hit = jnp.sum(jnp.where(slot == idx, f, 0), axis=1, keepdims=True)
     return jnp.where(valid, hit, jnp.int32(default))
 
 
 def _shift_up_from(f: jnp.ndarray, slot: jnp.ndarray, idx) -> jnp.ndarray:
     """moved[i] = f[i] for i <= idx else f[i-1] — the pool shift-right a
-    split/insert performs, as roll+select."""
+    split/insert performs, as roll+select (per row; idx is (B, 1))."""
     return jnp.where(slot <= idx, f, jnp.roll(f, 1, axis=1))
 
 
@@ -97,12 +112,13 @@ def _visible(cols: dict, n, ref_seq, client, S: int) -> jnp.ndarray:
 
 
 def _split_at(cols, props, n, char_pos, ref_seq, client, enable, S):
-    """Port of mergetree_kernel._split_at on (1, S) rows."""
+    """Port of mergetree_kernel._split_at on (B, S) rows; per-op values
+    are (B, 1) columns."""
     slot = _iota(S)
     v = _visible(cols, n, ref_seq, client, S)
     cum = _excl_cumsum(v, S)
     inside = (cum < char_pos) & (char_pos < cum + v)
-    first = jnp.min(jnp.where(inside, slot, S))
+    first = jnp.min(jnp.where(inside, slot, S), axis=1, keepdims=True)
     do = enable & (first < S)
     idx = first  # unique when present; gated by ``do`` below
     off = char_pos - _at(cum, slot, idx, do, 0)
@@ -115,18 +131,19 @@ def _split_at(cols, props, n, char_pos, ref_seq, client, enable, S):
         is_left, off, jnp.where(is_right, tlen - off, tlen))
     new_cols["tstart"] = jnp.where(
         is_right, new_cols["tstart"] + off, new_cols["tstart"])
-    new_props = jnp.where(slot[..., None] <= idx, props,
+    new_props = jnp.where(slot[..., None] <= idx[..., None], props,
                           jnp.roll(props, 1, axis=1))
 
     cols = {f: jnp.where(do, new_cols[f], cols[f]) for f in _COL_FIELDS}
-    props = jnp.where(do, new_props, props)
+    props = jnp.where(do[..., None], new_props, props)
     n = jnp.where(do, n + 1, n)
     return cols, props, n
 
 
 def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
-    """Port of mergetree_kernel._apply_op on (1, S)/(1, S, K) rows.
-    ``op`` is a dict of scalars; ``pvals`` is the op's (K,) prop values."""
+    """Port of mergetree_kernel._apply_op on (B, S)/(B, S, K) planes.
+    ``op`` is a dict of (B, 1) per-doc values; ``pvals`` is (B, K);
+    ``n``/``overflow`` are (B, 1)."""
     ref_seq, client = op["ref_seq"], op["client"]
     is_ins = op["kind"] == K_INSERT
     is_rem = op["kind"] == K_REMOVE
@@ -153,13 +170,15 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
 
     # --- insert: tie-break = first slot with cum >= pos.
     can = (cum >= op["a"]) & active
-    jfirst = jnp.min(jnp.where(can, slot, S))
+    jfirst = jnp.min(jnp.where(can, slot, S), axis=1, keepdims=True)
     j = jnp.where(jfirst < S, jfirst, n)
 
     # Obliterate-on-arrival neighbor rule.
     present = active & ~expired
-    left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1))
-    right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S))
+    left_idx = jnp.max(jnp.where(present & (slot < j), slot, -1),
+                       axis=1, keepdims=True)
+    right_idx = jnp.min(jnp.where(present & (slot >= j), slot, S),
+                        axis=1, keepdims=True)
     has_left = left_idx >= 0
     has_right = right_idx < S
     l1s = _at(cols["ob1_seq"], slot, left_idx, has_left, NOT_REMOVED)
@@ -204,11 +223,12 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
     ins_pvals = jnp.where(pvals == PROP_NOT_TOUCHED, PROP_ABSENT, pvals)
     ins_props = jnp.where(
         (slot == j)[..., None],
-        ins_pvals[None, None, :],
-        jnp.where(slot[..., None] <= j, props, jnp.roll(props, 1, axis=1)),
+        ins_pvals[:, None, :],
+        jnp.where(slot[..., None] <= j[..., None], props,
+                  jnp.roll(props, 1, axis=1)),
     )
     cols = {f: jnp.where(is_ins, ins_cols[f], cols[f]) for f in _COL_FIELDS}
-    props = jnp.where(is_ins, ins_props, props)
+    props = jnp.where(is_ins[..., None], ins_props, props)
     n = jnp.where(is_ins, n + 1, n)
 
     # --- remove / annotate / obliterate over [a, b) in the view.
@@ -241,24 +261,19 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
         ob2_seq=jnp.where(to_ob2, op["seq"], cols["ob2_seq"]),
         ob2_client=jnp.where(to_ob2, client, cols["ob2_client"]),
     )
-    overflow = overflow | jnp.any(third) | jnp.any(ob_over)
+    overflow = overflow | jnp.any(third, axis=1, keepdims=True) \
+        | jnp.any(ob_over, axis=1, keepdims=True)
 
-    touch = (pvals != PROP_NOT_TOUCHED)[None, None, :] \
+    touch = (pvals != PROP_NOT_TOUCHED)[:, None, :] \
         & (covered & is_ann)[..., None]
-    props = jnp.where(touch, jnp.broadcast_to(pvals, props.shape), props)
+    props = jnp.where(touch, pvals[:, None, :], props)
     return cols, props, n, overflow
 
 
-def _fold_kernel(S: int, K: int, T: int, *refs):
-    """One document per grid step: state lives in VMEM values across the
-    whole tail.
-
-    Every ref carries a leading unit axis (block shape ``(1, 1, ...)``
-    over a ``(D, 1, ...)`` array) so the block's last two dims EQUAL the
-    array's — Mosaic's block-mapping rule rejects a ``(1, S)`` block
-    over ``(D, S)`` (sublane dim 1 is neither divisible by 8 nor equal
-    to D).  ``r[0]`` strips the unit axis back to the ``(1, S)`` /
-    ``(1, S, K)`` row shapes the step math is written in."""
+def _fold_kernel(S: int, K: int, T: int, B: int, *refs):
+    """A sublane batch of B documents per grid step: state lives in VMEM
+    values across the whole tail; every block is 2-D ``(B, ...)`` so the
+    Mosaic block rule holds without padding tricks."""
     op_refs = refs[:len(_OP_FIELDS)]
     pvals_ref = refs[len(_OP_FIELDS)]
     in_cols = refs[len(_OP_FIELDS) + 1:len(_OP_FIELDS) + 1 + len(_COL_FIELDS)]
@@ -266,25 +281,25 @@ def _fold_kernel(S: int, K: int, T: int, *refs):
                                    len(_OP_FIELDS) + 4 + len(_COL_FIELDS)]
     outs = refs[len(_OP_FIELDS) + 4 + len(_COL_FIELDS):]
 
-    cols = {f: r[0] for f, r in zip(_COL_FIELDS, in_cols)}
-    props = in_props[0]
-    n = in_n[0, 0, 0]
-    overflow = in_over[0, 0, 0] != 0
+    cols = {f: r[...] for f, r in zip(_COL_FIELDS, in_cols)}
+    props = in_props[...]
+    n = in_n[...]          # (B, 1)
+    overflow = in_over[...] != 0
 
     def body(t, carry):
         cols, props, n, overflow = carry
-        op = {f: r[0, 0, t] for f, r in zip(_OP_FIELDS, op_refs)}
-        pvals = pvals_ref[0, 0, t, :]
+        op = {f: r[:, t].reshape(B, 1) for f, r in zip(_OP_FIELDS, op_refs)}
+        pvals = pvals_ref[:, t, :]
         return _apply_op_rows(cols, props, n, overflow, op, pvals, S, K)
 
     cols, props, n, overflow = jax.lax.fori_loop(
         0, T, body, (cols, props, n, overflow))
 
     for f, r in zip(_COL_FIELDS, outs):
-        r[0] = cols[f]
-    outs[len(_COL_FIELDS)][0] = props
-    outs[len(_COL_FIELDS) + 1][0, 0, 0] = n
-    outs[len(_COL_FIELDS) + 2][0, 0, 0] = overflow.astype(jnp.int32)
+        r[...] = cols[f]
+    outs[len(_COL_FIELDS)][...] = props
+    outs[len(_COL_FIELDS) + 1][...] = n
+    outs[len(_COL_FIELDS) + 2][...] = overflow.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -292,20 +307,28 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
                           interpret: bool = True) -> MTState:
     """Drop-in replacement for ``replay_vmapped``: same (state, ops)
     pytrees in, same final MTState out — the fold itself runs as one
-    Pallas program instance per document with VMEM-resident state."""
+    Pallas program instance per 8-document sublane batch with
+    VMEM-resident state.  ``D`` pads to a multiple of 8 with inert no-op
+    documents (noop op rows never match a kind; zero state rows never
+    activate), sliced off on return."""
     D, S = state.tstart.shape
     K = state.props.shape[-1]
     T = ops.kind.shape[1]
+    B = DOC_BLOCK
+    Dp = ((D + B - 1) // B) * B
+    pad = Dp - D
 
-    # A leading unit axis on every operand makes each block's last two
-    # dims EQUAL the array's (Mosaic's alternative to the 8/128
-    # divisibility rule) while the grid still walks one document per
-    # step.  Shapes: (D, 1, X) with block (1, 1, X).
-    row = pl.BlockSpec((1, 1, S), lambda d: (d, 0, 0))
-    op_row = pl.BlockSpec((1, 1, T), lambda d: (d, 0, 0))
-    props_blk = pl.BlockSpec((1, 1, S, K), lambda d: (d, 0, 0, 0))
-    pvals_blk = pl.BlockSpec((1, 1, T, K), lambda d: (d, 0, 0, 0))
-    scalar = pl.BlockSpec((1, 1, 1), lambda d: (d, 0, 0))
+    def pad_rows(x, fill):
+        if pad == 0:
+            return x
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, width, constant_values=fill)
+
+    row = pl.BlockSpec((B, S), lambda d: (d, 0))
+    op_row = pl.BlockSpec((B, T), lambda d: (d, 0))
+    props_blk = pl.BlockSpec((B, S, K), lambda d: (d, 0, 0))
+    pvals_blk = pl.BlockSpec((B, T, K), lambda d: (d, 0, 0))
+    scalar = pl.BlockSpec((B, 1), lambda d: (d, 0))
 
     in_specs = (
         [op_row] * len(_OP_FIELDS) + [pvals_blk]
@@ -313,39 +336,40 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
     )
     out_specs = [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
     out_shape = (
-        [jax.ShapeDtypeStruct((D, 1, S), jnp.int32)] * len(_COL_FIELDS)
-        + [jax.ShapeDtypeStruct((D, 1, S, K), jnp.int32),
-           jax.ShapeDtypeStruct((D, 1, 1), jnp.int32),
-           jax.ShapeDtypeStruct((D, 1, 1), jnp.int32)]
+        [jax.ShapeDtypeStruct((Dp, S), jnp.int32)] * len(_COL_FIELDS)
+        + [jax.ShapeDtypeStruct((Dp, S, K), jnp.int32),
+           jax.ShapeDtypeStruct((Dp, 1), jnp.int32),
+           jax.ShapeDtypeStruct((Dp, 1), jnp.int32)]
     )
 
     inputs = (
-        [getattr(ops, f).astype(jnp.int32).reshape(D, 1, T)
+        [pad_rows(getattr(ops, f).astype(jnp.int32), 0)
          for f in _OP_FIELDS]
-        + [ops.pvals.astype(jnp.int32).reshape(D, 1, T, K)]
-        + [getattr(state, f).astype(jnp.int32).reshape(D, 1, S)
+        + [pad_rows(ops.pvals.astype(jnp.int32), int(PROP_NOT_TOUCHED))]
+        + [pad_rows(getattr(state, f).astype(jnp.int32),
+                    int(NOT_REMOVED) if f.endswith("_seq")
+                    and f != "ins_seq" else 0)
            for f in _COL_FIELDS]
-        + [state.props.astype(jnp.int32).reshape(D, 1, S, K),
-           state.n.astype(jnp.int32).reshape(D, 1, 1),
-           state.overflow.astype(jnp.int32).reshape(D, 1, 1)]
+        + [pad_rows(state.props.astype(jnp.int32), int(PROP_ABSENT)),
+           pad_rows(state.n.astype(jnp.int32).reshape(D, 1), 0),
+           pad_rows(state.overflow.astype(jnp.int32).reshape(D, 1), 0)]
     )
 
     outs = pl.pallas_call(
-        functools.partial(_fold_kernel, S, K, T),
-        grid=(D,),
+        functools.partial(_fold_kernel, S, K, T, B),
+        grid=(Dp // B,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
 
-    cols = {f: o.reshape(D, S)
-            for f, o in zip(_COL_FIELDS, outs[:len(_COL_FIELDS)])}
+    cols = {f: o[:D] for f, o in zip(_COL_FIELDS, outs[:len(_COL_FIELDS)])}
     return MTState(
         **cols,
-        props=outs[len(_COL_FIELDS)].reshape(D, S, K),
-        n=outs[len(_COL_FIELDS) + 1].reshape(D),
-        overflow=outs[len(_COL_FIELDS) + 2].reshape(D).astype(bool),
+        props=outs[len(_COL_FIELDS)][:D],
+        n=outs[len(_COL_FIELDS) + 1][:D].reshape(D),
+        overflow=outs[len(_COL_FIELDS) + 2][:D].reshape(D).astype(bool),
     )
 
 

@@ -52,7 +52,6 @@ from fluidframework_tpu.ops.matrix_kernel import (  # noqa: E402
 )
 from fluidframework_tpu.ops.mergetree_kernel import (  # noqa: E402
     MergeTreeDocInput,
-    replay_mergetree_batch,
 )
 from fluidframework_tpu.ops.tree_kernel import (  # noqa: E402
     TreeDocInput,
