@@ -1,0 +1,106 @@
+"""Service-scale bulk catch-up benchmark — the FULL container-level
+north-star path (SURVEY §3.2), not just the string-kernel slice bench.py
+times: ordering-service oplog → decode/plan → the product's pipelined
+device fold → container summary assembly → storage upload.
+
+Seeds N documents by driving real ContainerRuntimes through the in-proc
+sequencer (the honest envelope format the service decodes), then times
+ONE CatchupService.catch_up() over the whole population and verifies
+sampled digests against per-doc oracle runtimes.
+
+Prints ONE JSON line:
+    {"metric": "service_bulk_catchup_ops_per_sec", "value": ..., ...}
+
+Env knobs: SVC_DOCS (default 2048), SVC_OPS (default 96).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.runtime.container import ContainerRuntime  # noqa: E402
+from fluidframework_tpu.service.catchup import CatchupService  # noqa: E402
+from fluidframework_tpu.service.orderer import LocalOrderingService  # noqa: E402
+
+N_DOCS = int(os.environ.get("SVC_DOCS", "2048"))
+OPS = int(os.environ.get("SVC_OPS", "96"))
+
+
+def seed(service: LocalOrderingService):
+    """N_DOCS documents, OPS string edits each, via real runtimes; returns
+    {doc_id: oracle_digest} for the sampled verification."""
+    digests = {}
+    for d in range(N_DOCS):
+        rng = random.Random(7000 + d)
+        doc_id = f"doc{d}"
+        ep = service.create_document(doc_id)
+        runtime = ContainerRuntime()
+        ds = runtime.create_datastore("ds")
+        text = ds.create_channel("sequence-tpu", "text")
+        runtime.connect(ep, f"c{d}")
+        runtime.drain()
+        service.storage.upload(doc_id, runtime.summarize(), 0)
+        for _ in range(OPS):
+            L = len(text.text)
+            k = rng.random()
+            if k < 0.62 or L == 0:
+                text.insert_text(rng.randint(0, L),
+                                 rng.choice(["lorem ", "ip", "x"]))
+            elif k < 0.82:
+                a0 = rng.randint(0, L - 1)
+                text.remove_range(a0, min(L, a0 + 2))
+            else:
+                a0 = rng.randint(0, L - 1)
+                text.annotate_range(a0, min(L, a0 + 1),
+                                    {"w": rng.choice(["1", "2"])})
+        runtime.drain()
+        if d % 64 == 0:
+            digests[doc_id] = runtime.summarize().digest()
+    return digests
+
+
+def main() -> None:
+    t0 = time.time()
+    service = LocalOrderingService()
+    oracle = seed(service)
+    seed_sec = time.time() - t0
+    print(f"seeded {N_DOCS} docs x {OPS} ops in {seed_sec:.1f}s",
+          file=sys.stderr)
+
+    svc = CatchupService(service)
+    t0 = time.time()
+    handles = svc.catch_up()
+    wall = time.time() - t0
+    total_ops = N_DOCS * OPS
+    checked = 0
+    for doc_id, want in oracle.items():
+        handle, _seq = handles[doc_id]
+        assert service.storage.read(handle).digest() == want, doc_id
+        checked += 1
+    print(
+        f"bulk catch-up {wall:.2f}s = {total_ops / wall:,.0f} ops/s "
+        f"(device {svc.device_docs} / cpu {svc.cpu_docs} / host-ch "
+        f"{svc.host_channels}); {checked} sampled digests == oracle",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "service_bulk_catchup_ops_per_sec",
+        "value": round(total_ops / wall, 1),
+        "unit": "ops/sec",
+        "n_docs": N_DOCS,
+        "ops_per_doc": OPS,
+        "catchup_sec": round(wall, 3),
+        "device_docs": svc.device_docs,
+        "cpu_docs": svc.cpu_docs,
+        "sampled_digests_ok": checked,
+    }))
+
+
+if __name__ == "__main__":
+    main()
