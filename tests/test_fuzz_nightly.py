@@ -46,6 +46,8 @@ from fluidframework_tpu.testing.mocks import channel_log
 
 ROUNDS = int(os.environ.get("FF_FUZZ_ROUNDS", "0"))
 SEEDS = int(os.environ.get("FF_FUZZ_SEEDS", "100"))
+#: campaign seed offset — vary across sessions to broaden coverage
+SEED_BASE = int(os.environ.get("FF_FUZZ_SEED_BASE", "90000"))
 
 pytestmark = pytest.mark.skipif(
     ROUNDS <= 0,
@@ -136,7 +138,7 @@ def test_nightly_dds_campaign(seed):
     rounds = ROUNDS if kind == "string" else max(20, ROUNDS // 2)
     replicas, factory = run_fuzz(
         spec,
-        seed=90_000 + seed,
+        seed=SEED_BASE + seed,
         n_clients=n_clients,
         rounds=rounds,
         sync_every=2 + seed % 7,
