@@ -44,6 +44,10 @@ class FinalStateView:
         self.rem_client = np.asarray(state_np["rem_client"][d, :n])
         self.rem2_seq = np.asarray(state_np["rem2_seq"][d, :n])
         self.rem2_client = np.asarray(state_np["rem2_client"][d, :n])
+        self.ob1_seq = np.asarray(state_np["ob1_seq"][d, :n])
+        self.ob1_client = np.asarray(state_np["ob1_client"][d, :n])
+        self.ob2_seq = np.asarray(state_np["ob2_seq"][d, :n])
+        self.ob2_client = np.asarray(state_np["ob2_client"][d, :n])
         self.not_removed = not_removed
         self._vis_cache: Dict[tuple, np.ndarray] = {}
 
@@ -71,10 +75,18 @@ class FinalStateView:
         ins_vis = (self.ins_seq <= ref) | (
             (self.ins_client == client) & (self.ins_seq < up_to)
         )
+        is_removed = self.rem_seq != self.not_removed
         removed = (
-            ((self.rem_seq != self.not_removed) & (self.rem_seq <= ref))
+            (is_removed & (self.rem_seq <= ref))
             | ((self.rem_client == client) & (self.rem_seq < up_to))
             | ((self.rem2_client == client) & (self.rem2_seq < up_to))
+            # Ob-stamp authors are involved in the removal (the oracle's
+            # rule; kernel-side gap found at fuzz seed 1500041) — the
+            # stamp itself must be sequenced before the view's fold
+            # position, as must the removal.
+            | (is_removed & (self.rem_seq < up_to)
+               & (((self.ob1_client == client) & (self.ob1_seq < up_to))
+                  | ((self.ob2_client == client) & (self.ob2_seq < up_to))))
         )
         cum = np.cumsum(np.where(ins_vis & ~removed, self.tlen, 0))
         self._vis_cache[key] = cum

@@ -93,7 +93,8 @@ class MTOps(NamedTuple):
     pvals: jnp.ndarray    # [T, K] per-key values / PROP_NOT_TOUCHED
 
 
-def _visible_len(state: MTState, ref_seq, client) -> jnp.ndarray:
+def _visible_len(state: MTState, ref_seq, client,
+                 has_ob: bool = True) -> jnp.ndarray:
     slot = jnp.arange(state.tlen.shape[0])
     active = slot < state.n
     ins_vis = (state.ins_seq <= ref_seq) | (state.ins_client == client)
@@ -102,6 +103,18 @@ def _visible_len(state: MTState, ref_seq, client) -> jnp.ndarray:
         | (state.rem_client == client)
         | (state.rem2_client == client)
     )
+    if has_ob:
+        # An obliterate STAMP makes its author involved in the removal
+        # even when another client's remove won it: the author's
+        # optimistic view hid every covered slot, so views in the
+        # author's name must hide the tombstone too (the oracle's
+        # fuzz-found rule, merge_tree._removed_in_view; kernel gap found
+        # at fuzz seed 1500041 — a lagged insert resolved 4 chars off).
+        # Ob-free chunks (compile-time fact) skip the plane reads.
+        removed = state.rem_seq != NOT_REMOVED
+        rem_vis = rem_vis \
+            | (removed & (state.ob1_client == client)) \
+            | (removed & (state.ob2_client == client))
     return jnp.where(active & ins_vis & ~rem_vis, state.tlen, 0)
 
 
@@ -122,7 +135,7 @@ def _split_at(state: MTState, char_pos, ref_seq, client, enable,
     sequential views + no base "ro") never write rem2, props-free chunks
     never write the [S, K] plane."""
     S = state.tlen.shape[0]
-    v = _visible_len(state, ref_seq, client)
+    v = _visible_len(state, ref_seq, client, has_ob)
     cum = _excl_cumsum(v)
     inside = (cum < char_pos) & (char_pos < cum + v)
     do = enable & inside.any()
@@ -196,7 +209,7 @@ def _apply_op(state: MTState, op, sequential: bool = False,
     state = _split_at(state, op.b, ref_seq, client, is_rangey,
                       has_ob, has_ov, has_props)
 
-    v = _visible_len(state, ref_seq, client)
+    v = _visible_len(state, ref_seq, client, has_ob)
     cum = _excl_cumsum(v)
     slot = jnp.arange(S)
     active = slot < state.n

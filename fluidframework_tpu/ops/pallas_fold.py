@@ -103,10 +103,15 @@ def _visible(cols: dict, n, ref_seq, client, S: int) -> jnp.ndarray:
     slot = _iota(S)
     active = slot < n
     ins_vis = (cols["ins_seq"] <= ref_seq) | (cols["ins_client"] == client)
+    removed = cols["rem_seq"] != NOT_REMOVED
     rem_vis = (
         (cols["rem_seq"] <= ref_seq)
         | (cols["rem_client"] == client)
         | (cols["rem2_client"] == client)
+        # Ob-stamp authors are involved in the removal (the oracle's
+        # fuzz-found rule; kernel gap found at fuzz seed 1500041).
+        | (removed & (cols["ob1_client"] == client))
+        | (removed & (cols["ob2_client"] == client))
     )
     return jnp.where(active & ins_vis & ~rem_vis, cols["tlen"], 0)
 

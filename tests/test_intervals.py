@@ -105,3 +105,72 @@ def test_fuzz_string_with_intervals(seed):
     run_fuzz(
         StringFuzzSpec(intervals=True), seed=700 + seed, n_clients=3, rounds=30
     )
+
+
+def test_interval_tail_over_base_ob_stamps_device_parity():
+    """The interval fold's stamp-author involvement clause (fuzz seed
+    1500041's rule) on its production shape: a WARM doc whose base
+    records carry obliterate stamps, with an interval-op tail (no tail
+    obliterates — that mix routes to the oracle pre-pack).  The device
+    interval replay must resolve lagged positions with stamped
+    tombstones hidden from the stamp author's views, byte-identical to
+    the oracle."""
+    import json as _json
+
+    from fluidframework_tpu.ops.mergetree_kernel import (
+        MergeTreeDocInput,
+        replay_mergetree_batch,
+    )
+    from fluidframework_tpu.testing.fuzz import StringFuzzSpec, run_fuzz
+    from fluidframework_tpu.testing.mocks import channel_log
+
+    covered = 0
+    for seed in range(840, 852):
+        spec = StringFuzzSpec(obliterate=True)
+        replicas, factory = run_fuzz(spec, seed=seed, n_clients=3,
+                                     rounds=10, sync_every=3)
+        base_summary = replicas[0].summarize()
+        base_records = _json.loads(base_summary.blob_bytes("body"))
+        if not any(r.get("ob") for r in base_records):
+            continue  # no live stamps survived into this base
+        base_seq = factory.sequencer.seq
+        # Interval + text tail (obliterate-free) on the live session.
+        import random as _random
+
+        rng = _random.Random(seed)
+        ids = []
+        for step in range(25):
+            c = rng.choice(replicas)
+            L = len(c.text)
+            k = rng.random()
+            if k < 0.4 or L < 4:
+                c.insert_text(rng.randint(0, L), rng.choice(["ab ", "z"]))
+            elif k < 0.7 or not ids:
+                a0 = rng.randint(0, L - 2)
+                ids.append(c.add_interval(
+                    a0, min(L - 1, a0 + rng.randint(1, 5)), {"s": str(step)}))
+            else:
+                c.change_interval(rng.choice(ids),
+                                  start=rng.randint(0, L - 1))
+            if step % 4 == 0:
+                factory.process_some_messages(rng.randint(1, 3))
+        factory.process_all_messages()
+        full = channel_log(factory, "fuzz")
+        doc = MergeTreeDocInput(
+            doc_id=f"obiv{seed}",
+            ops=[m for m in full if m.seq > base_seq],
+            base_records=base_records,
+            base_seq=base_seq,
+            final_seq=factory.sequencer.seq,
+            final_msn=factory.sequencer.min_seq,
+        )
+        stats: dict = {}
+        [dev] = replay_mergetree_batch([doc], stats=stats)
+        assert stats.get("fallback_docs", 0) == 0, (
+            f"seed {seed}: expected the device path"
+        )
+        assert dev.digest() == replicas[0].summarize().digest(), (
+            f"seed {seed}: warm ob-stamp + interval tail != oracle"
+        )
+        covered += 1
+    assert covered >= 3, f"only {covered} seeds produced stamped bases"

@@ -443,3 +443,46 @@ def test_header_fast_format_matches_canonical_json():
         fast = b'{"length":%d,"minSeq":%d,"seq":%d}' % (length, min_seq, seq)
         assert fast == canonical_json(
             {"seq": seq, "minSeq": min_seq, "length": length})
+
+
+def test_ob_stamp_author_involvement_in_lagged_view():
+    """Fuzz seed 1500041 (minimized): a segment removed by one client but
+    carrying ANOTHER client's obliterate stamp must be hidden from views
+    in the stamp author's name — the author's optimistic view hid every
+    covered slot, so a lagged insert by the author resolves positions
+    without it.  The kernel's visibility lacked the stamp-author term and
+    placed the insert several chars off."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def m(seq, client, ref, contents):
+        return SequencedMessage(seq=seq, client_id=client, client_seq=seq,
+                                ref_seq=ref, min_seq=0,
+                                type=MessageType.OP, contents=contents)
+
+    log = [
+        m(1, "c0", 0, {"kind": "insert", "pos": 0, "text": "abcdef"}),
+        # c1's remove of [2,4) wins the removal of "cd"...
+        m(2, "c1", 1, {"kind": "remove", "start": 2, "end": 4}),
+        # ...then c2 obliterates [1,3) of its ref-2 view "abef" — the
+        # "cd" tombstone sits at ZERO WIDTH strictly inside the range,
+        # so it gets c2's stamp with NO remover bookkeeping (the stamp
+        # is the only durable record of c2's coverage).
+        m(3, "c2", 2, {"kind": "obliterate", "start": 1, "end": 3}),
+        # c2's lagged insert (ref 1, before the removal): in c2's own
+        # view "cd" must be HIDDEN (c2 stamped it) even though c1 won
+        # the removal and c2 never became its overlap remover — pos 2
+        # is the end of "af", not a point inside "cd".
+        m(4, "c2", 1, {"kind": "insert", "pos": 2, "text": "XY"}),
+    ]
+    oracle = SharedString("obinv")
+    for msg in log:
+        oracle.process(msg, local=False)
+    doc = MergeTreeDocInput(doc_id="obinv", ops=log, final_seq=4,
+                            final_msn=0)
+    [summary] = replay_mergetree_batch([doc])
+    assert summary.digest() == oracle.summarize().digest(), (
+        "stamp-author involvement: kernel != oracle"
+    )
