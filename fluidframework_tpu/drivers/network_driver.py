@@ -28,7 +28,9 @@ from typing import Callable, Dict, List, Optional
 
 from ..protocol.messages import NackError, RawOperation, SequencedMessage
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
-from ..protocol.wire import LEN as _LEN, WIRE_VERSION, frame_bytes
+from ..protocol.wire import (LEN as _LEN, WIRE_VERSION,
+                             decode_sequenced_message,
+                             encode_raw_operation, frame_bytes)
 
 
 class RpcError(RuntimeError):
@@ -219,7 +221,7 @@ class NetworkConnection:
             self._tapped = True
 
     def _on_op_event(self, frame: dict) -> None:
-        msg = SequencedMessage.from_dict(frame["msg"])
+        msg = decode_sequenced_message(frame["msg"])
         for fn in list(self._subscribers):
             fn(msg)
 
@@ -251,9 +253,9 @@ class NetworkConnection:
 
     def submit(self, op: RawOperation) -> Optional[SequencedMessage]:
         result = self._rpc.request(
-            "submit", {"doc": self.doc_id, "op": op.to_dict()}
+            "submit", {"doc": self.doc_id, "op": encode_raw_operation(op)}
         )
-        return SequencedMessage.from_dict(result) if result else None
+        return decode_sequenced_message(result) if result else None
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         self._ensure_tap()
@@ -275,7 +277,7 @@ class NetworkConnection:
             "deltas",
             {"doc": self.doc_id, "from_seq": from_seq, "to_seq": to_seq},
         )
-        return [SequencedMessage.from_dict(m) for m in msgs]
+        return [decode_sequenced_message(m) for m in msgs]
 
     def submit_signal(self, client_id: str, content,
                       target_client_id: Optional[str] = None) -> None:

@@ -39,10 +39,18 @@ class ConnectionState(enum.Enum):
 
 
 class DeltaManager:
-    """Gap-free ordered delivery + connection lifecycle over a driver."""
+    """Gap-free ordered delivery + connection lifecycle over a driver.
 
-    def __init__(self, document_service) -> None:
+    ``clock`` is the manager's only time source (nack retryAfter holds are
+    schedule decisions).  It defaults to the wall clock for live sessions;
+    replay/test harnesses inject a virtual clock so a catch-up run is
+    reproducible byte-for-byte regardless of when it executes.
+    """
+
+    def __init__(self, document_service,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self._service = document_service
+        self._clock = clock or time.time
         self.state = ConnectionState.DISCONNECTED
         self.client_id: Optional[str] = None
         self.read_only = False
@@ -107,18 +115,19 @@ class DeltaManager:
         strand a diverged replica."""
         return (self.state is ConnectionState.CONNECTED
                 and not self.read_only
-                and time.time() >= self.nacked_until)
+                and self._clock() >= self.nacked_until)
 
     def submit(self, op: RawOperation):
         if self.read_only:
             raise PermissionError("container is in read-only mode")
         if self.state is not ConnectionState.CONNECTED:
             raise ConnectionError(f"not connected (state={self.state.value})")
-        if time.time() < self.nacked_until:
+        now = self._clock()
+        if now < self.nacked_until:
             # Direct submitters honor the retryAfter hold too (the flush
             # path is already gated by can_send).
             raise NackError("held by retryAfter",
-                            retry_after=self.nacked_until - time.time())
+                            retry_after=self.nacked_until - now)
         try:
             return self._service.connection().submit(op)
         except NackError as nack:
@@ -128,7 +137,7 @@ class DeltaManager:
             # writable flush resends them.
             self.nacks += 1
             self.nacked_until = max(
-                self.nacked_until, time.time() + nack.retry_after
+                self.nacked_until, self._clock() + nack.retry_after
             )
             if nack.code == "staleView":
                 self.rebase_required = True

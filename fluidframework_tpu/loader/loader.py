@@ -195,11 +195,16 @@ class Loader:
     def __init__(self, factory,
                  registry: Optional[ChannelRegistry] = None,
                  mc: Optional[MonitoringContext] = None,
-                 runtime_options=None) -> None:
+                 runtime_options=None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.factory = factory
         self.registry = registry
         self.runtime_options = runtime_options
         self.mc = (mc or MonitoringContext()).child("loader")
+        # Injected time source for every DeltaManager this loader wires
+        # (None = wall clock).  Replay harnesses pass a virtual clock so
+        # nack retryAfter holds resolve identically on every run.
+        self.clock = clock
 
     def _new_runtime(self) -> ContainerRuntime:
         return ContainerRuntime(self.registry, options=self.runtime_options)
@@ -316,7 +321,8 @@ class Loader:
                     break  # need an older summary: refetch
         runtime.load(summary)
 
-        container = Container(doc_id, runtime, DeltaManager(service))
+        container = Container(doc_id, runtime,
+                              DeltaManager(service, clock=self.clock))
 
         # Catch-up replay: one fetch of the whole tail, split at the
         # earliest replayed authoring point and at the stash point.  THE
@@ -459,6 +465,9 @@ class Loader:
         from ..runtime.op_pipeline import decode_stream
 
         old_ids = set(pending_state.get("clientIds", []))
+        # Sorted once up front: the loops below run per pending op, and
+        # alias-adoption order must not depend on set hash order.
+        old_sorted = sorted(old_ids)
         if any(p.get("refSeq") is None for p in pending_state["pending"]):
             # Legacy stash (no per-op authoring points): previous
             # semantics — drop ops the tail will deliver, re-apply the
@@ -470,7 +479,7 @@ class Loader:
                 runtime.process(msg)
             for p in pending_state["pending"]:
                 if any((cid, p["clientSeq"]) in sequenced
-                       for cid in old_ids):
+                       for cid in old_sorted):
                     continue
                 ds = runtime.datastores[p["ds"]]
                 ds.channels[p["channel"]].apply_stashed_op(p["contents"])
@@ -550,9 +559,10 @@ class Loader:
                 )
             channel.apply_stashed_op(p["contents"])
             new_cs = channel._pending[-1][0]
-            for cid, cs in p.get(
-                "aliases", [[c, p["clientSeq"]] for c in old_ids]
-            ):
+            op_aliases = p.get("aliases")
+            if op_aliases is None:
+                op_aliases = [[c, p["clientSeq"]] for c in old_sorted]
+            for cid, cs in op_aliases:
                 aliases[(cid, cs)] = new_cs
         while i < len(mid_tail):
             runtime.process(mid_tail[i])
@@ -560,7 +570,8 @@ class Loader:
 
     def _wire(self, doc_id: str, runtime: ContainerRuntime, service,
               client_id: str) -> Container:
-        container = Container(doc_id, runtime, DeltaManager(service))
+        container = Container(doc_id, runtime,
+                              DeltaManager(service, clock=self.clock))
         container.delta_manager.note_delivered(runtime.ref_seq)
         container.runtime.connect(container.delta_manager, client_id)
         container.drain()

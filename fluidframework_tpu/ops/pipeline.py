@@ -27,7 +27,7 @@ from __future__ import annotations
 import collections
 import os
 from concurrent.futures import ThreadPoolExecutor
-from time import time
+from time import perf_counter
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -80,7 +80,7 @@ def pipelined_mergetree_replay(
 
 def _bump(stage: Optional[dict], key: str, t0: float) -> None:
     if stage is not None:
-        stage[key] = stage.get(key, 0.0) + (time() - t0)
+        stage[key] = stage.get(key, 0.0) + (perf_counter() - t0)
 
 
 def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
@@ -101,19 +101,19 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
     starts = list(range(0, len(sched), chunk_docs))
 
     def pack_one(lo):
-        t0 = time()
+        t0 = perf_counter()
         state, ops, meta = pack_mergetree_batch(sched[lo:lo + chunk_docs])
         chunk = sched[lo:lo + chunk_docs]
         warm = any(d.base_records for d in chunk)
         state = narrow_state_for_upload(state, meta) if warm else None
         ops = narrow_ops_for_upload(ops, meta)
-        return state, ops, meta, time() - t0
+        return state, ops, meta, perf_counter() - t0
 
     def extract_one(meta, arr):
-        t0 = time()
+        t0 = perf_counter()
         st: dict = {}
         res = summaries_from_export(meta, arr, stats=st)
-        return res, st, time() - t0
+        return res, st, perf_counter() - t0
 
     out: List = []
 
@@ -138,7 +138,7 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                 next_i += 1
 
             def fetch_one(meta, ex) -> None:
-                t0 = time()
+                t0 = perf_counter()
                 arr = export_to_numpy(ex)  # the d2h link RPC(s)
                 _bump(stage, "download", t0)
                 ex_futs.append(ex_pool.submit(extract_one, meta, arr))
@@ -154,7 +154,7 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     next_i += 1
                 if stage is not None:
                     stage["pack"] = stage.get("pack", 0.0) + dt
-                t0 = time()
+                t0 = perf_counter()
                 S = _chunk_S(meta)
                 ex = replay_export(state, ops, meta, S=S)
                 _start_host_copy(ex)

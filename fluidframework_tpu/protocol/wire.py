@@ -1,7 +1,14 @@
-"""Shared wire-protocol constants and framing for the TCP server/driver.
+"""Shared wire-protocol constants, framing, and message codecs for the
+TCP server/driver.
 
 One definition point so a protocol bump can never ship a client/server
-pair that disagree on the version they stamp/accept.
+pair that disagree on the version they stamp/accept — or on the field
+names a message serializes under.  Every dataclass in
+``protocol/messages.py`` has exactly one encode and one decode function
+here, registered in ``MESSAGE_CODECS``; drivers, the standalone server,
+and the durable op log all dispatch through these instead of calling
+``to_dict``/``from_dict`` at scattered call sites (fluidlint's
+FL-WIRE-COMPLETE rule pins the registry exhaustive).
 
 Frame layout: [4-byte big-endian length][json bytes].
 """
@@ -11,6 +18,8 @@ from __future__ import annotations
 import json
 import struct
 
+from .messages import RawOperation, SequencedMessage
+
 WIRE_VERSION = 1
 LEN = struct.Struct(">I")
 MAX_FRAME = 256 << 20
@@ -19,3 +28,30 @@ MAX_FRAME = 256 << 20
 def frame_bytes(obj: dict) -> bytes:
     payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     return LEN.pack(len(payload)) + payload
+
+
+# -- message codecs -----------------------------------------------------------
+
+
+def encode_raw_operation(op: RawOperation) -> dict:
+    return op.to_dict()
+
+
+def decode_raw_operation(d: dict) -> RawOperation:
+    return RawOperation.from_dict(d)
+
+
+def encode_sequenced_message(msg: SequencedMessage) -> dict:
+    return msg.to_dict()
+
+
+def decode_sequenced_message(d: dict) -> SequencedMessage:
+    return SequencedMessage.from_dict(d)
+
+
+#: class name -> (encode, decode); the dispatch surface drivers/services
+#: use, and the exhaustiveness surface FL-WIRE-COMPLETE checks.
+MESSAGE_CODECS = {
+    "RawOperation": (encode_raw_operation, decode_raw_operation),
+    "SequencedMessage": (encode_sequenced_message, decode_sequenced_message),
+}

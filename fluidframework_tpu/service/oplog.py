@@ -22,6 +22,8 @@ from typing import Dict, List, Optional
 
 from ..protocol.messages import SequencedMessage
 from ..protocol.summary import canonical_json
+from ..protocol.wire import (decode_sequenced_message,
+                             encode_sequenced_message)
 from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 
 
@@ -47,7 +49,7 @@ class OpLog:
             repair_jsonl_tail(path)
             for rec in iter_jsonl_tolerant(path):
                 self._docs.setdefault(rec["doc"], []).append(
-                    SequencedMessage.from_dict(rec["msg"])
+                    decode_sequenced_message(rec["msg"])
                 )
             self._file = open(path, "a", encoding="utf-8")
 
@@ -59,7 +61,7 @@ class OpLog:
             return  # exactly-once: replays after crash-resume are idempotent
         log.append(msg)
         if self._file is not None:
-            rec = {"doc": doc_id, "msg": msg.to_dict()}
+            rec = {"doc": doc_id, "msg": encode_sequenced_message(msg)}
             self._file.write(canonical_json(rec).decode("utf-8") + "\n")
             if self._autoflush:
                 # Durable-before-broadcast: the append rides first in the

@@ -35,9 +35,11 @@ import json
 import threading
 from typing import Dict, Optional, Set
 
-from ..protocol.messages import NackError, RawOperation, SequencedMessage
+from ..protocol.messages import NackError, SequencedMessage
 from ..protocol.summary import tree_from_obj, tree_to_obj
-from ..protocol.wire import LEN as _LEN, MAX_FRAME, WIRE_VERSION, frame_bytes
+from ..protocol.wire import (LEN as _LEN, MAX_FRAME, WIRE_VERSION,
+                             decode_raw_operation,
+                             encode_sequenced_message, frame_bytes)
 from .orderer import LocalOrderingService
 
 
@@ -122,7 +124,7 @@ class _ClientSession:
 
         def on_op(msg: SequencedMessage) -> None:
             self.send({"v": WIRE_VERSION, "event": "op", "doc": out_doc,
-                       "msg": msg.to_dict()})
+                       "msg": encode_sequenced_message(msg)})
 
         def on_signal(signal: dict) -> None:
             target = signal.get("targetClientId")
@@ -281,9 +283,9 @@ class OrderingServer:
             return True
         if method == "submit":
             msg = service.endpoint(params["doc"]).submit(
-                RawOperation.from_dict(params["op"])
+                decode_raw_operation(params["op"])
             )
-            return msg.to_dict() if msg is not None else None
+            return encode_sequenced_message(msg) if msg is not None else None
         if method == "update_ref_seq":
             service.endpoint(params["doc"]).update_ref_seq(
                 params["client"], params["ref_seq"]
@@ -293,7 +295,7 @@ class OrderingServer:
             msgs = service.endpoint(params["doc"]).deltas(
                 params.get("from_seq", 0), params.get("to_seq")
             )
-            return [m.to_dict() for m in msgs]
+            return [encode_sequenced_message(m) for m in msgs]
         if method == "head":
             return service.endpoint(params["doc"]).head_seq
         if method == "signal":

@@ -37,6 +37,24 @@ class LoadSpec:
     max_clients: int = 8
 
 
+class VirtualClock:
+    """Deterministic time source for the simulated stack.
+
+    Each read advances by a fixed tick, so DeltaManager retryAfter holds
+    (``clock() + retry_after`` vs later reads) resolve after the same
+    number of scheduler decisions on every run, regardless of host speed
+    or wall-clock start — the load run is fully replayable from its seed.
+    """
+
+    def __init__(self, tick: float = 0.001) -> None:
+        self.now = 0.0
+        self.tick = tick
+
+    def __call__(self) -> float:
+        self.now += self.tick
+        return self.now
+
+
 @dataclasses.dataclass
 class LoadResult:
     steps: int
@@ -63,7 +81,10 @@ def run_load(spec: LoadSpec) -> LoadResult:
             return None
 
     service = LocalOrderingService(throttle=throttle)
-    loader = Loader(LocalDocumentServiceFactory(service))
+    # Wall-clock-free: every DeltaManager in the run shares one virtual
+    # clock, so nack holds resolve identically on every replay of a seed.
+    loader = Loader(LocalDocumentServiceFactory(service),
+                    clock=VirtualClock())
 
     def build(rt):
         ds = rt.create_datastore("ds")

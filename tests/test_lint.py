@@ -1,0 +1,64 @@
+"""Tier-1 gate: fluidlint must run clean over the whole package.
+
+Pure-AST analysis — no JAX tracing, CPU-only, fast.  A new finding
+anywhere in ``fluidframework_tpu/`` fails this test; the only escape
+hatch is a reviewed entry (with a non-empty ``reason``) in
+``lint_baseline.json``, and stale/reason-less entries fail too, so the
+baseline can only shrink through review.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+from tools.fluidlint import (all_rules, analyze, apply_baseline,
+                             load_baseline)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+BASELINE = ROOT / "lint_baseline.json"
+
+
+def test_package_lints_clean():
+    findings = analyze(ROOT)
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    report = apply_baseline(findings, entries)
+    problems = [f.render() for f in report.unsuppressed]
+    problems += [f"baseline invalid: {m}" for m in report.invalid]
+    problems += [
+        f"baseline stale (matched no finding): [{e.get('rule')}] "
+        f"{e.get('path')}: {e.get('message')}" for e in report.stale
+    ]
+    assert not problems, (
+        "fluidlint gate failed — fix the finding or add a REVIEWED "
+        "suppression (with reason) to lint_baseline.json:\n"
+        + "\n".join(problems))
+
+
+def test_every_rule_registered_and_described():
+    rules = all_rules()
+    assert len(rules) >= 9, sorted(rules)
+    for name, rule in rules.items():
+        assert rule.description, f"{name} has no description"
+        assert rule.severity in ("error", "warning"), name
+
+
+def test_cli_exit_code_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.fluidlint",
+         "--baseline", "lint_baseline.json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout, proc.stdout
+
+
+def test_cli_exit_code_on_findings(tmp_path, capsys):
+    """The gate is real, not vacuous: a violation in a synthetic tree
+    makes the CLI exit 1 and print the finding."""
+    from tools.fluidlint.cli import main
+
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    assert main(["--root", str(tmp_path)]) == 1
+    assert "FL-DET-CLOCK" in capsys.readouterr().out

@@ -1,0 +1,439 @@
+"""fluidlint self-tests: one positive + one negative fixture per rule.
+
+A rule regression (pattern stops matching, scope widens/narrows, a rename
+breaks registration) fails here loudly instead of silently opening a hole
+in the tier-1 gate.  Module rules run through ``analyze_source`` against
+in-memory fixtures; the project rule (FL-WIRE-COMPLETE) runs through
+``analyze`` against a synthetic repo tree; the baseline machinery gets its
+own match/stale/invalid coverage.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from tools.fluidlint import (Finding, analyze, analyze_source,
+                             apply_baseline, load_baseline)
+
+OPS = "fluidframework_tpu/ops/x.py"          # replay + kernel scope
+LOADER = "fluidframework_tpu/loader/x.py"    # replay scope only
+RUNTIME = "fluidframework_tpu/runtime/x.py"  # event scope only
+TESTING = "fluidframework_tpu/testing/x.py"  # exempt everywhere
+
+
+def findings_for(src, relpath, rule=None):
+    out = analyze_source(textwrap.dedent(src), relpath)
+    return [f for f in out if rule is None or f.rule == rule]
+
+
+# -- one (positive, negative) pair per module rule ---------------------------
+
+MODULE_RULE_FIXTURES = {
+    "FL-DET-CLOCK": (
+        """
+        import time
+        def hold():
+            return time.time() + 5
+        """,
+        """
+        import time
+        def hold(clock=time.monotonic):
+            return clock() + 5
+        """,
+        LOADER,
+    ),
+    "FL-DET-RANDOM": (
+        """
+        import random
+        def jitter():
+            return random.random()
+        """,
+        """
+        import random
+        def jitter(rng: random.Random):
+            return rng.random()
+        """,
+        LOADER,
+    ),
+    "FL-DET-SETITER": (
+        """
+        def order(ids):
+            seen = {i for i in ids}
+            return [x for x in seen]
+        """,
+        """
+        def order(ids):
+            seen = {i for i in ids}
+            return [x for x in sorted(seen)]
+        """,
+        LOADER,
+    ),
+    "FL-TRACE-HOSTSYNC": (
+        """
+        import jax
+        @jax.jit
+        def fold(x):
+            return x + x.sum().item()
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def fold(x):
+            return x + jnp.sum(x)
+        """,
+        OPS,
+    ),
+    "FL-TRACE-PYCOND": (
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def clamp(x):
+            if jnp.sum(x) > 0:
+                return x
+            return -x
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def clamp(x):
+            return jnp.where(jnp.sum(x) > 0, x, -x)
+        """,
+        OPS,
+    ),
+    "FL-TRACE-LOOPJNP": (
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def fold(xs, n):
+            acc = xs[0]
+            for i in range(n):
+                acc = jnp.maximum(acc, xs[i])
+            return acc
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def fold(xs):
+            acc = xs[0]
+            for i in range(4):  # bounded constant unroll is idiomatic
+                acc = jnp.maximum(acc, xs[i])
+            return acc
+        """,
+        OPS,
+    ),
+    "FL-TRACE-STATIC": (
+        """
+        import jax
+        @jax.jit(static_argnames=("cfg",))
+        def fold(x, cfg: dict):
+            return x
+        """,
+        """
+        import jax
+        @jax.jit(static_argnames=("cfg",))
+        def fold(x, cfg: tuple):
+            return x
+        """,
+        OPS,
+    ),
+    "FL-EVENT-EMITITER": (
+        """
+        class Emitter:
+            def emit(self, event):
+                for fn in self._listeners[event]:
+                    fn(event)
+        """,
+        """
+        class Emitter:
+            def emit(self, event):
+                for fn in list(self._listeners[event]):
+                    fn(event)
+        """,
+        RUNTIME,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(MODULE_RULE_FIXTURES))
+def test_positive_fixture_fires(rule):
+    bad, _good, relpath = MODULE_RULE_FIXTURES[rule]
+    hits = findings_for(bad, relpath, rule)
+    assert hits, f"{rule}: positive fixture produced no finding"
+    assert all(f.line > 0 and f.message for f in hits)
+
+
+@pytest.mark.parametrize("rule", sorted(MODULE_RULE_FIXTURES))
+def test_negative_fixture_is_clean(rule):
+    _bad, good, relpath = MODULE_RULE_FIXTURES[rule]
+    assert findings_for(good, relpath, rule) == [], (
+        f"{rule}: negative fixture flagged")
+
+
+@pytest.mark.parametrize("rule", sorted(MODULE_RULE_FIXTURES))
+def test_testing_dir_is_exempt(rule):
+    bad, _good, _relpath = MODULE_RULE_FIXTURES[rule]
+    assert findings_for(bad, TESTING, rule) == []
+
+
+def test_setiter_reports_each_site_once():
+    # a loop inside a def is visible from the module walk AND its own
+    # scope walk; the walker must stop at scope boundaries or every
+    # function-body site double-reports
+    src = """
+    def order():
+        ids = {1, 2, 3}
+        for i in ids:
+            pass
+    """
+    assert len(findings_for(src, LOADER, "FL-DET-SETITER")) == 1
+
+
+def test_setiter_checks_class_bodies():
+    # class bodies are their own lexical scope; a hash-order-dependent
+    # class attribute must not slip past the gate
+    src = """
+    class Registry:
+        IDS = {"b", "a"}
+        ORDER = [x for x in IDS]
+    """
+    assert len(findings_for(src, LOADER, "FL-DET-SETITER")) == 1
+
+
+def test_trace_rules_do_not_fire_outside_kernel_scope():
+    bad, _good, _ = MODULE_RULE_FIXTURES["FL-TRACE-HOSTSYNC"]
+    assert findings_for(bad, LOADER, "FL-TRACE-HOSTSYNC") == []
+
+
+def test_untraced_function_not_flagged():
+    # host syncs in plain host-side code are fine — scope is traced defs
+    src = """
+    import numpy as np
+    def host_extract(arr):
+        return np.asarray(arr).tolist()
+    """
+    assert findings_for(src, OPS, "FL-TRACE-HOSTSYNC") == []
+
+
+def test_hostsync_messages_are_function_scoped():
+    # suppression keys are (rule, path, message): naming the owning def
+    # keeps one reviewed suppression from masking a future host sync in
+    # a different function of the same file
+    src = """
+    import jax
+    @jax.jit
+    def fold_a(x):
+        return x.item()
+    @jax.jit
+    def fold_b(x):
+        return x.item()
+    """
+    msgs = {f.message for f in findings_for(src, OPS, "FL-TRACE-HOSTSYNC")}
+    assert len(msgs) == 2
+    assert any("fold_a()" in m for m in msgs)
+    assert any("fold_b()" in m for m in msgs)
+
+
+def test_scan_argument_is_traced():
+    # functions passed by name to lax.scan count as traced
+    src = """
+    import jax
+    from jax import lax
+    def step(carry, x):
+        return carry + x.item(), x
+    def fold(xs):
+        return lax.scan(step, 0, xs)
+    """
+    assert findings_for(src, OPS, "FL-TRACE-HOSTSYNC")
+
+
+# -- project rule: FL-WIRE-COMPLETE ------------------------------------------
+
+
+def _write_wire_tree(root, wire_body, test_body=None):
+    proto = root / "fluidframework_tpu" / "protocol"
+    proto.mkdir(parents=True)
+    (proto / "messages.py").write_text(textwrap.dedent("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class PingMessage:
+            seq: int
+    """))
+    (proto / "wire.py").write_text(textwrap.dedent(wire_body))
+    if test_body is not None:
+        tdir = root / "tests"
+        tdir.mkdir()
+        (tdir / "test_wire_roundtrip.py").write_text(
+            textwrap.dedent(test_body))
+
+
+COMPLETE_WIRE = """
+    def encode_ping_message(m): return {"seq": m.seq}
+    def decode_ping_message(d): return d["seq"]
+    MESSAGE_CODECS = {"PingMessage": (encode_ping_message,
+                                      decode_ping_message)}
+"""
+
+
+def test_wire_complete_positive(tmp_path):
+    _write_wire_tree(tmp_path, "MESSAGE_CODECS = {}\n", test_body="x = 1\n")
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-WIRE-COMPLETE"}
+    assert any("encode_ping_message" in m for m in msgs), msgs
+    assert any("decode_ping_message" in m for m in msgs), msgs
+    assert any("MESSAGE_CODECS" in m for m in msgs), msgs
+    assert any("round-trip coverage" in m for m in msgs), msgs
+
+
+def test_wire_complete_negative(tmp_path):
+    _write_wire_tree(tmp_path, COMPLETE_WIRE,
+                     test_body="from x import PingMessage\n")
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-WIRE-COMPLETE"] == []
+
+
+def test_project_rules_skipped_on_path_scoped_runs(tmp_path):
+    # whole-repo contracts don't belong to a "files I touched" run (and
+    # their suppressions would be filtered out of scope with them)
+    _write_wire_tree(tmp_path, "MESSAGE_CODECS = {}\n", test_body="x = 1\n")
+    scoped = analyze(tmp_path,
+                     relpaths=["fluidframework_tpu/protocol/messages.py"])
+    assert [f for f in scoped if f.rule == "FL-WIRE-COMPLETE"] == []
+
+
+def test_wire_complete_missing_test_suite(tmp_path):
+    _write_wire_tree(tmp_path, COMPLETE_WIRE, test_body=None)
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-WIRE-COMPLETE"}
+    assert any("no tests/test_wire*.py" in m for m in msgs), msgs
+
+
+# -- baseline machinery ------------------------------------------------------
+
+
+def _finding(msg="m1"):
+    return Finding("FL-DET-CLOCK", "error", "pkg/a.py", 10, msg)
+
+
+def _entry(msg="m1", reason="reviewed: fixture"):
+    return {"rule": "FL-DET-CLOCK", "path": "pkg/a.py",
+            "message": msg, "reason": reason}
+
+
+def test_baseline_suppresses_by_rule_path_message():
+    report = apply_baseline([_finding()], [_entry()])
+    assert report.clean
+    assert len(report.suppressed) == 1
+
+
+def test_baseline_is_line_independent():
+    moved = Finding("FL-DET-CLOCK", "error", "pkg/a.py", 99, "m1")
+    assert apply_baseline([moved], [_entry()]).clean
+
+
+def test_stale_suppression_fails_gate():
+    report = apply_baseline([], [_entry()])
+    assert not report.clean
+    assert report.stale == [_entry()]
+
+
+def test_reasonless_suppression_fails_gate():
+    report = apply_baseline([_finding()], [_entry(reason="  ")])
+    assert not report.clean
+    assert report.invalid
+
+
+def test_unsuppressed_finding_fails_gate():
+    report = apply_baseline([_finding("other")], [_entry()])
+    assert not report.clean
+    assert [f.message for f in report.unsuppressed] == ["other"]
+
+
+def test_missing_baseline_path_is_a_usage_error(tmp_path):
+    from tools.fluidlint.cli import main
+    assert main(["--root", str(tmp_path),
+                 "--baseline", "lint_baseline.json"]) == 2
+
+
+def test_path_scoped_run_ignores_out_of_scope_suppressions(tmp_path):
+    # linting one clean file must not go red because the baseline also
+    # covers findings in files outside the analyzed subset
+    from tools.fluidlint.cli import main
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "clean.py").write_text("x = 1\n")
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "FL-DET-CLOCK",
+         "path": "fluidframework_tpu/loader/other.py",
+         "message": "m", "reason": "reviewed"}]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "fluidframework_tpu/loader/clean.py"]) == 0
+
+
+def test_path_arguments_are_normalized_against_root(tmp_path, capsys):
+    # a './'-spelled path must hit the same rule scopes as the canonical
+    # repo-relative form, not silently match nothing and pass
+    from tools.fluidlint.cli import main
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    rc = main(["--root", str(tmp_path),
+               "./fluidframework_tpu/loader/bad.py"])
+    assert rc == 1
+    assert "FL-DET-CLOCK" in capsys.readouterr().out
+    assert main(["--root", str(tmp_path), "/etc/passwd"]) == 2
+
+
+def test_path_scoped_run_ignores_project_rule_suppressions(tmp_path):
+    # analyze() skips project rules on scoped runs, so their reviewed
+    # suppressions must not surface as stale
+    from tools.fluidlint.cli import main
+    pkg = tmp_path / "fluidframework_tpu" / "protocol"
+    pkg.mkdir(parents=True)
+    (pkg / "wire.py").write_text("x = 1\n")
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "FL-WIRE-COMPLETE",
+         "path": "fluidframework_tpu/protocol/wire.py",
+         "message": "m", "reason": "reviewed"}]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "fluidframework_tpu/protocol/wire.py"]) == 0
+
+
+def test_directory_path_argument_expands_to_py_files(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    assert main(["--root", str(tmp_path), "fluidframework_tpu"]) == 1
+    assert "FL-DET-CLOCK" in capsys.readouterr().out
+
+
+def test_duplicate_baseline_entries_are_invalid():
+    report = apply_baseline([_finding()], [_entry(), _entry()])
+    assert not report.clean
+    assert any("duplicate" in m for m in report.invalid)
+    assert report.stale == []
+
+
+def test_invalid_entry_not_double_reported_as_stale():
+    report = apply_baseline([], [{"rule": "FL-DET-CLOCK",
+                                  "message": "m", "reason": "r"}])
+    assert report.invalid
+    assert report.stale == []
+
+
+def test_load_baseline_rejects_non_object(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(["not", "an", "object"]))
+    with pytest.raises(ValueError):
+        load_baseline(p)

@@ -1,0 +1,22 @@
+"""fluidlint — determinism & trace-safety static analysis for the
+fluidframework_tpu package.
+
+CLI: ``python -m tools.fluidlint --baseline lint_baseline.json``
+Library: ``analyze(root)``, ``analyze_source(src, relpath)`` for the
+self-test fixtures, ``all_rules()`` for the catalog.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    ModuleContext,
+    ProjectContext,
+    ProjectRule,
+    Rule,
+    all_rules,
+    analyze,
+    analyze_source,
+    apply_baseline,
+    baseline_skeleton,
+    load_baseline,
+    register,
+)

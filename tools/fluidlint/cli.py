@@ -1,0 +1,126 @@
+"""fluidlint command line.
+
+    python -m tools.fluidlint [--root DIR] [--baseline FILE]
+                              [--format text|json] [--list-rules]
+                              [--write-baseline FILE] [paths ...]
+
+Exit codes: 0 clean, 1 unsuppressed findings / stale or invalid baseline,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from .core import (ProjectRule, all_rules, analyze, apply_baseline,
+                   baseline_skeleton, load_baseline)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.fluidlint",
+        description="determinism & trace-safety static analysis",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="repo-relative files to analyze "
+                             "(default: the fluidframework_tpu package)")
+    parser.add_argument("--root", default=".",
+                        help="repo root (default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline suppression file (JSON)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="write a baseline skeleton covering current "
+                             "findings (reasons left empty for review)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name} [{rule.severity}] {rule.description}")
+        return 0
+
+    root = pathlib.Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"error: --root {root} is not a directory", file=sys.stderr)
+        return 2
+    relpaths = None
+    if args.paths:
+        # Normalize to root-relative posix form: rule scopes are prefix
+        # matches on that form, so a './' or absolute spelling must not
+        # silently fall outside every scope and pass vacuously.
+        relpaths = []
+        for p in args.paths:
+            rp = pathlib.Path(p)
+            rp = (rp if rp.is_absolute() else root / rp).resolve()
+            expanded = (sorted(rp.rglob("*.py")) if rp.is_dir() else [rp])
+            for f in expanded:
+                try:
+                    relpaths.append(f.relative_to(root).as_posix())
+                except ValueError:
+                    print(f"error: {p} is outside --root {root}",
+                          file=sys.stderr)
+                    return 2
+    findings = analyze(root, relpaths=relpaths)
+
+    if args.write_baseline:
+        doc = baseline_skeleton(findings)
+        pathlib.Path(args.write_baseline).write_text(
+            json.dumps(doc, indent=2, sort_keys=False) + "\n",
+            encoding="utf-8")
+        print(f"wrote {len(doc['suppressions'])} suppression entries to "
+              f"{args.write_baseline} (fill in every 'reason' field)")
+        return 0
+
+    entries = []
+    if args.baseline:
+        bp = pathlib.Path(args.baseline)
+        if not bp.is_absolute():
+            bp = root / bp
+        if not bp.is_file():
+            print(f"error: baseline {bp} not found", file=sys.stderr)
+            return 2
+        entries = load_baseline(bp)
+        if relpaths is not None:
+            # Path-scoped run: entries for files outside the analyzed
+            # subset — and for project rules, which analyze() skips when
+            # given a subset — can't match anything; dropping them keeps
+            # the staleness check meaningful instead of spuriously red.
+            in_scope = set(relpaths)
+            project_rules = {n for n, r in all_rules().items()
+                             if isinstance(r, ProjectRule)}
+            entries = [e for e in entries
+                       if e.get("path") in in_scope
+                       and e.get("rule") not in project_rules]
+    report = apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "unsuppressed": [f.__dict__ for f in report.unsuppressed],
+            "suppressed": [f.__dict__ for f in report.suppressed],
+            "stale_suppressions": report.stale,
+            "invalid_suppressions": report.invalid,
+        }, indent=2))
+        return 0 if report.clean else 1
+
+    for f in report.unsuppressed:
+        print(f.render())
+    for msg in report.invalid:
+        print(f"baseline: {msg}")
+    for e in report.stale:
+        print(f"baseline: stale suppression (matched no finding): "
+              f"[{e.get('rule')}] {e.get('path')}: {e.get('message')}")
+    n_err = sum(1 for f in report.unsuppressed if f.severity == "error")
+    n_warn = len(report.unsuppressed) - n_err
+    print(f"fluidlint: {n_err} error(s), {n_warn} warning(s), "
+          f"{len(report.suppressed)} suppressed, "
+          f"{len(report.stale)} stale suppression(s)")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

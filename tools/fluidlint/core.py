@@ -1,0 +1,326 @@
+"""fluidlint core — AST rule engine, registry, baseline suppressions.
+
+The analyzer walks the package's Python sources once, parses each file to
+an AST, and hands a ``ModuleContext`` to every registered module rule whose
+scope covers the file.  Project rules (cross-file contracts like wire
+completeness) run once against a ``ProjectContext`` over the repo root.
+
+Findings are identified for baseline purposes by ``(rule, path, message)``
+— deliberately *not* by line number, so unrelated edits above a reviewed
+suppression don't invalidate it.  Every baseline entry must carry a
+non-empty ``reason`` (JSON has no comments; the reason field IS the
+comment) and every entry must still match a live finding — stale entries
+fail the gate so the baseline can only shrink through review.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+#: directories never analyzed by module rules (tests exercise nondeterminism
+#: on purpose; the linter must not lint itself into a corner).
+DEFAULT_EXEMPT = (
+    "fluidframework_tpu/testing/",
+    "tests/",
+    "tools/",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.severity}: {self.message}"
+
+    @property
+    def suppression_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+
+class ImportMap:
+    """Local name → dotted module path, built from a module's imports.
+
+    ``import jax.numpy as jnp`` binds ``jnp -> jax.numpy``;
+    ``from time import time`` binds ``time -> time.time``;
+    ``import time`` binds ``time -> time``.  Relative imports are
+    intra-package and irrelevant to every shipped rule, so they are
+    ignored.
+    """
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.names[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        self.names[root] = root
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                for alias in node.names:
+                    self.names[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted qualified name for a Name/Attribute chain, or None when
+        the chain is rooted in something we can't see (a local object, a
+        call result)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.names.get(node.id)
+        if base is None:
+            # Not imported: a builtin or a local binding.  Builtins are
+            # meaningful bare ("float", "set"); attribute chains on local
+            # objects are opaque.
+            if parts:
+                return None
+            return node.id
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    path: str          # repo-relative posix path
+    tree: ast.Module
+    source: str
+    imports: ImportMap
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule.name,
+            severity=rule.severity,
+            path=self.path,
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+@dataclasses.dataclass
+class ProjectContext:
+    root: pathlib.Path
+
+    def parse(self, relpath: str) -> Optional[ast.Module]:
+        p = self.root / relpath
+        if not p.is_file():
+            return None
+        return ast.parse(p.read_text(encoding="utf-8"), filename=str(p))
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(
+            p.relative_to(self.root).as_posix()
+            for p in self.root.glob(pattern)
+        )
+
+
+class Rule:
+    """A per-module rule.  Subclasses set ``name``/``severity``/``scope``
+    and implement ``check``."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+    #: path prefixes this rule runs on; empty tuple = every analyzed file
+    scope: Tuple[str, ...] = ()
+
+    def applies(self, relpath: str) -> bool:
+        if any(relpath.startswith(e) for e in DEFAULT_EXEMPT):
+            return False
+        if not self.scope:
+            return True
+        return any(relpath.startswith(s) for s in self.scope)
+
+    def check(self, module: ModuleContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def project_finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(self.name, self.severity, path, line, message)
+
+
+class ProjectRule(Rule):
+    """A cross-file contract rule; runs once per analysis."""
+
+    def check(self, module: ModuleContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule."""
+    inst = cls()
+    assert inst.name, f"{cls.__name__} has no name"
+    assert inst.severity in SEVERITIES, inst.severity
+    assert inst.name not in _REGISTRY, f"duplicate rule {inst.name}"
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    from . import rules  # noqa: F401  (registers on first import)
+
+    return dict(_REGISTRY)
+
+
+# -- analysis drivers ---------------------------------------------------------
+
+
+def iter_py_files(root: pathlib.Path,
+                  packages: Sequence[str] = ("fluidframework_tpu",)
+                  ) -> Iterator[str]:
+    for pkg in packages:
+        base = root / pkg
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            yield p.relative_to(root).as_posix()
+
+
+def analyze_source(source: str, relpath: str,
+                   rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
+    """Run module rules over one in-memory source (self-test entry)."""
+    rules = rules if rules is not None else all_rules()
+    tree = ast.parse(source, filename=relpath)
+    ctx = ModuleContext(relpath, tree, source, ImportMap(tree))
+    out: List[Finding] = []
+    for rule in rules.values():
+        if isinstance(rule, ProjectRule) or not rule.applies(relpath):
+            continue
+        out.extend(rule.check(ctx))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze(root: pathlib.Path,
+            relpaths: Optional[Sequence[str]] = None,
+            rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
+    """Run every applicable rule over the package rooted at ``root``.
+
+    With an explicit ``relpaths`` subset, only module rules run:
+    project rules are whole-repo contracts — their findings (and any
+    reviewed suppressions for them) don't belong to a path-scoped run.
+    """
+    rules = rules if rules is not None else all_rules()
+    root = pathlib.Path(root)
+    files = list(relpaths) if relpaths is not None else list(iter_py_files(root))
+    out: List[Finding] = []
+    for relpath in files:
+        text = (root / relpath).read_text(encoding="utf-8")
+        tree = ast.parse(text, filename=relpath)
+        ctx = ModuleContext(relpath, tree, text, ImportMap(tree))
+        for rule in rules.values():
+            if isinstance(rule, ProjectRule) or not rule.applies(relpath):
+                continue
+            out.extend(rule.check(ctx))
+    if relpaths is None:
+        project = ProjectContext(root)
+        for rule in rules.values():
+            if isinstance(rule, ProjectRule):
+                out.extend(rule.check_project(project))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BaselineReport:
+    unsuppressed: List[Finding]
+    suppressed: List[Finding]
+    stale: List[dict]      # entries that matched nothing
+    invalid: List[str]     # structural problems (missing reason, ...)
+
+    @property
+    def clean(self) -> bool:
+        return not (self.unsuppressed or self.stale or self.invalid)
+
+
+def load_baseline(path: pathlib.Path) -> List[dict]:
+    data = json.loads(pathlib.Path(path).read_text(encoding="utf-8"))
+    if isinstance(data, dict):
+        return list(data.get("suppressions", []))
+    raise ValueError(f"{path}: baseline must be an object with 'suppressions'")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   entries: Sequence[dict]) -> BaselineReport:
+    invalid: List[str] = []
+    bad_ids = set()
+    for i, e in enumerate(entries):
+        for field in ("rule", "path", "message"):
+            if not isinstance(e.get(field), str) or not e.get(field):
+                invalid.append(f"suppression[{i}]: missing '{field}'")
+                bad_ids.add(id(e))
+        if not str(e.get("reason", "")).strip():
+            invalid.append(
+                f"suppression[{i}] ({e.get('rule')}, {e.get('path')}): "
+                "a reviewed suppression must carry a non-empty 'reason'"
+            )
+            bad_ids.add(id(e))
+    # Invalid entries neither suppress nor count as stale: each problem
+    # surfaces exactly once, as the invalid diagnostic.
+    keys = {}
+    for e in entries:
+        if id(e) in bad_ids:
+            continue
+        k = (e.get("rule"), e.get("path"), e.get("message"))
+        if k in keys:
+            # a shadowed duplicate would otherwise be dead weight the
+            # staleness check can never see
+            invalid.append(
+                f"duplicate suppression for ({k[0]}, {k[1]}): merge the "
+                "entries (one key, one reviewed reason)"
+            )
+            continue
+        keys[k] = e
+    matched = set()
+    unsuppressed: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        if f.suppression_key in keys:
+            suppressed.append(f)
+            matched.add(f.suppression_key)
+        else:
+            unsuppressed.append(f)
+    stale = [e for k, e in keys.items() if k not in matched]
+    return BaselineReport(unsuppressed, suppressed, stale, invalid)
+
+
+def baseline_skeleton(findings: Sequence[Finding]) -> dict:
+    """A baseline document covering ``findings`` — every entry needs its
+    TODO reason replaced by an actual review note before it will pass."""
+    seen = set()
+    entries = []
+    for f in findings:
+        if f.suppression_key in seen:
+            continue
+        seen.add(f.suppression_key)
+        entries.append({
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "reason": "",
+        })
+    return {"version": 1, "suppressions": entries}

@@ -1,0 +1,10 @@
+"""Imports every rule module so ``core.register`` sees them all.
+
+Adding a rule = write it in the right themed module (or a new one) with
+the ``@register`` decorator, then import that module here.
+"""
+
+from . import rules_determinism  # noqa: F401
+from . import rules_events       # noqa: F401
+from . import rules_trace        # noqa: F401
+from . import rules_wire         # noqa: F401

@@ -1,0 +1,220 @@
+"""Replay-determinism rules.
+
+The north star is byte-identical summaries from a 50x catch-up replay; any
+wall-clock read, global-PRNG draw, or hash-order-dependent iteration on a
+merge/replay path can silently diverge replicas.  These rules cover the
+client/service code the replay actually flows through: ``ops/``,
+``protocol/``, ``service/``, ``loader/`` (testing/ is exempt — fuzzers are
+nondeterministic on purpose, behind explicit seeds).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Set, Tuple
+
+from .core import Finding, ModuleContext, Rule, register
+
+REPLAY_SCOPE = (
+    "fluidframework_tpu/ops/",
+    "fluidframework_tpu/protocol/",
+    "fluidframework_tpu/service/",
+    "fluidframework_tpu/loader/",
+)
+
+#: absolute wall-clock reads — never appropriate on a replay path; durations
+#: belong to time.monotonic()/time.perf_counter() (not flagged) and *schedule*
+#: decisions (nack holds, deadlines) must come from an injected clock.
+WALL_CLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.ctime",
+    "time.localtime",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+#: explicitly-seeded constructors and generator APIs stay allowed; everything
+#: else under random./numpy.random. draws from ambient global state.
+SEEDED_PRNG_ALLOWED = {
+    "random.Random",
+    "random.SystemRandom",
+    "numpy.random.default_rng",
+    "numpy.random.Generator",
+    "numpy.random.SeedSequence",
+    "numpy.random.RandomState",
+}
+
+
+@register
+class WallClockRule(Rule):
+    name = "FL-DET-CLOCK"
+    severity = "error"
+    scope = REPLAY_SCOPE
+    description = (
+        "wall-clock read (time.time/datetime.now) on a replay/merge path; "
+        "inject a clock callable or use time.monotonic for durations"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = m.imports.resolve(node.func)
+            if q in WALL_CLOCK_CALLS:
+                yield m.finding(
+                    self, node,
+                    f"wall-clock read {q}() on a replay path; inject a "
+                    "clock callable (default wall clock, deterministic "
+                    "under replay) or use time.monotonic for durations",
+                )
+
+
+@register
+class GlobalRandomRule(Rule):
+    name = "FL-DET-RANDOM"
+    severity = "error"
+    scope = REPLAY_SCOPE
+    description = (
+        "unseeded global-PRNG draw (random.* / numpy.random.*); construct "
+        "a seeded random.Random / numpy default_rng and thread it through"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = m.imports.resolve(node.func)
+            if q is None or q in SEEDED_PRNG_ALLOWED:
+                continue
+            if q.startswith("random.") or q.startswith("numpy.random."):
+                yield m.finding(
+                    self, node,
+                    f"global-PRNG draw {q}() on a replay path; use a "
+                    "seeded random.Random / numpy.random.default_rng "
+                    "instance threaded from the caller",
+                )
+
+
+# -- set-iteration order ------------------------------------------------------
+
+_ORDERED_CONSUMER_CALLS = {"list", "tuple", "enumerate", "reversed", "iter"}
+
+
+def _scope_bodies(tree: ast.Module) -> Iterator[Tuple[ast.AST, List[ast.stmt]]]:
+    """Every lexical function/class scope plus the module scope."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            yield node, node.body
+
+
+def _walk_scope(stmts: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    bodies (those are separate scopes with their own locals).  The
+    nested def/class statement itself is yielded; its body is not —
+    ``_scope_bodies`` hands each nested function scope its own walk."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _SetTracker:
+    """Names in one scope whose *every* assignment is a set expression."""
+
+    def __init__(self, stmts: List[ast.stmt]) -> None:
+        set_assigned: Set[str] = set()
+        other_assigned: Set[str] = set()
+        for node in _walk_scope(stmts):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            else:
+                continue
+            value = getattr(node, "value", None)
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if value is not None and self._is_set_literal(value):
+                    set_assigned.add(t.id)
+                else:
+                    other_assigned.add(t.id)
+        self.set_names = set_assigned - other_assigned
+
+    @staticmethod
+    def _is_set_literal(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("set", "frozenset"))
+
+    def is_set_expr(self, node: ast.AST) -> bool:
+        if self._is_set_literal(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.set_names
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return (self.is_set_expr(node.left)
+                    or self.is_set_expr(node.right))
+        return False
+
+
+@register
+class SetIterationRule(Rule):
+    name = "FL-DET-SETITER"
+    severity = "error"
+    scope = REPLAY_SCOPE
+    description = (
+        "order-dependent iteration over a set (hash order is randomized "
+        "per process); sort first, or iterate a list/dict"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for _scope, stmts in _scope_bodies(m.tree):
+            tracker = _SetTracker(stmts)
+            for node in _walk_scope(stmts):
+                yield from self._check_node(m, node, tracker)
+
+    def _check_node(self, m: ModuleContext, node: ast.AST,
+                    tracker: _SetTracker) -> Iterator[Finding]:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if tracker.is_set_expr(node.iter):
+                yield self._flag(m, node, "for-loop")
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                if tracker.is_set_expr(gen.iter):
+                    yield self._flag(m, node, "comprehension")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Name)
+                    and func.id in _ORDERED_CONSUMER_CALLS
+                    and node.args
+                    and tracker.is_set_expr(node.args[0])):
+                yield self._flag(m, node, f"{func.id}()")
+            elif (isinstance(func, ast.Name) and func.id == "zip"
+                    and any(tracker.is_set_expr(a) for a in node.args)):
+                yield self._flag(m, node, "zip()")
+            elif (isinstance(func, ast.Attribute) and func.attr == "join"
+                    and node.args
+                    and tracker.is_set_expr(node.args[0])):
+                yield self._flag(m, node, "str.join()")
+
+    def _flag(self, m: ModuleContext, node: ast.AST,
+              consumer: str) -> Finding:
+        return m.finding(
+            self, node,
+            f"order-dependent {consumer} over a set; set iteration order "
+            "is hash-randomized across processes — wrap in sorted(...) or "
+            "keep an ordered container",
+        )

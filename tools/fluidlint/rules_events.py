@@ -1,0 +1,69 @@
+"""Event-emission safety.
+
+``emit()`` iterates a listener list that handlers can mutate re-entrantly
+(``once`` unsubscribes itself; app handlers subscribe siblings).  Python's
+list iterator over a mutating list skips or double-fires entries, so every
+emit loop must iterate a *snapshot* (``list(...)``/``tuple(...)``) of the
+listener collection — never the live list.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, ModuleContext, Rule, register
+
+_LISTENER_ATTR = re.compile(
+    r"(listener|subscriber|handler|observer|callback)s?$", re.IGNORECASE)
+
+_SNAPSHOT_CALLS = {"list", "tuple", "sorted"}
+
+
+def _listener_attr_name(node: ast.AST) -> Optional[str]:
+    """The listener-collection attribute an expression reads, if any.
+
+    Matches ``self._listeners``, ``self._listeners[event]``,
+    ``self._listeners.get(event, [])``, ``obj.handlers.values()`` — the
+    shapes that yield the LIVE list."""
+    if isinstance(node, ast.Attribute):
+        if _LISTENER_ATTR.search(node.attr):
+            return node.attr
+        # .get(...) / .values() hang off the collection attribute
+        return None
+    if isinstance(node, ast.Subscript):
+        return _listener_attr_name(node.value)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("get", "values"):
+        return _listener_attr_name(node.func.value)
+    return None
+
+
+@register
+class EmitIterationRule(Rule):
+    name = "FL-EVENT-EMITITER"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "emit loops must iterate a snapshot (list(...)) of the listener "
+        "collection; handlers may subscribe/unsubscribe during dispatch"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            it = node.iter
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                    and it.func.id in _SNAPSHOT_CALLS:
+                continue  # snapshot taken — safe
+            name = _listener_attr_name(it)
+            if name is not None:
+                yield m.finding(
+                    self, node,
+                    f"iterating live listener collection '{name}'; a "
+                    "handler that subscribes/unsubscribes during dispatch "
+                    "corrupts this loop — iterate "
+                    "list(...) of it instead",
+                )

@@ -1,0 +1,417 @@
+"""Trace-safety and recompile-hazard rules for the device kernels.
+
+Scope is the kernel layer (``ops/``, ``parallel/``): the files that define
+jitted/scanned folds.  A "traced function" is any function that is (a)
+decorated with a tracing entrypoint (``jax.jit``, ``jax.vmap``,
+``functools.partial(jax.jit, ...)``), or (b) referenced by name as an
+argument to one (``jax.lax.scan(step, ...)``, ``jax.jit(f)``), plus every
+function lexically nested inside one.  Host syncs, Python control flow on
+traced values, and Python loops over ``jnp`` ops inside those bodies are
+exactly the hazards that either crash at trace time on real inputs or
+silently serialize the device pipeline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, ImportMap, ModuleContext, Rule, register
+
+KERNEL_SCOPE = (
+    "fluidframework_tpu/ops/",
+    "fluidframework_tpu/parallel/",
+)
+
+#: calls whose function-valued arguments get traced
+TRACING_ENTRYPOINTS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.map",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.associative_scan",
+    "jax.experimental.pallas.pallas_call",
+}
+
+_CACHE_DECORATORS = {
+    "functools.lru_cache",
+    "functools.cache",
+    "lru_cache",
+    "cache",
+}
+
+
+def _entrypoint_of(imports: ImportMap, node: ast.AST) -> Optional[str]:
+    """The tracing entrypoint a decorator/call expression resolves to.
+
+    Handles bare references (``jax.jit``), calls (``jax.jit(...)``) and
+    ``functools.partial(jax.jit, ...)``.
+    """
+    if isinstance(node, ast.Call):
+        q = imports.resolve(node.func)
+        if q == "functools.partial" and node.args:
+            return _entrypoint_of(imports, node.args[0])
+        if q in TRACING_ENTRYPOINTS:
+            return q
+        return None
+    q = imports.resolve(node)
+    return q if q in TRACING_ENTRYPOINTS else None
+
+
+def traced_defs(m: ModuleContext) -> List[ast.FunctionDef]:
+    """Top-of-chain traced function definitions in the module (nested defs
+    inside them are traced too; callers should walk subtrees)."""
+    # Names referenced as traceable arguments anywhere in the module.
+    traced_names: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Call) and _entrypoint_of(m.imports, node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    traced_names.add(arg.id)
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in traced_names:
+            out.append(node)
+        elif any(_entrypoint_of(m.imports, d) for d in node.decorator_list):
+            out.append(node)
+    return out
+
+
+def _walk_traced(defs: List[ast.FunctionDef]) -> Iterator[Tuple[ast.FunctionDef, ast.AST]]:
+    """(owning traced def, node) for every node inside a traced body,
+    without double-reporting defs nested in other traced defs."""
+    def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+        return any(n is inner for n in ast.walk(outer))
+
+    tops = [d for d in defs
+            if not any(o is not d and _contains(o, d) for o in defs)]
+    seen: Set[int] = set()
+    for d in tops:
+        for node in ast.walk(d):
+            if id(node) not in seen:
+                seen.add(id(node))
+                yield d, node
+
+
+def _contains_jnp_call(imports: ImportMap, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            q = imports.resolve(sub.func)
+            if q and (q.startswith("jax.numpy.") or q.startswith("jax.lax.")
+                      or q.startswith("jax.ops.")):
+                return True
+    return False
+
+
+def _is_shapelike(node: ast.AST) -> bool:
+    """Concrete-at-trace-time expressions: shapes, dims, lengths."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    name = "FL-TRACE-HOSTSYNC"
+    severity = "error"
+    scope = KERNEL_SCOPE
+    description = (
+        "host synchronization (.item()/.tolist()/np.asarray/float()) "
+        "inside a traced function — blocks the device pipeline or fails "
+        "under jit"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        # Messages name the owning traced def: suppression keys are
+        # line-independent (rule, path, message), so the owner name keeps
+        # a reviewed suppression from masking future findings elsewhere
+        # in the same file.
+        for owner, node in _walk_traced(traced_defs(m)):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in (
+                    "item", "tolist"):
+                yield m.finding(
+                    self, node,
+                    f".{func.attr}() inside traced {owner.name}() forces "
+                    "a device->host sync; keep the value on device "
+                    "(jnp.where / lax.select) or hoist it out of the fold",
+                )
+                continue
+            q = m.imports.resolve(func)
+            if q in ("numpy.asarray", "numpy.array"):
+                yield m.finding(
+                    self, node,
+                    f"{q}() inside traced {owner.name}() materializes "
+                    "the tracer on host; use jnp equivalents inside the "
+                    "fold and convert after the export fetch",
+                )
+            elif q in ("float", "int", "bool") and node.args \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_shapelike(node.args[0]):
+                yield m.finding(
+                    self, node,
+                    f"{q}() on a traced value in {owner.name}() forces "
+                    "concretization; compute with jnp dtypes on device, "
+                    "or mark the argument static if it is genuinely "
+                    "host data",
+                )
+
+
+@register
+class PythonControlFlowRule(Rule):
+    name = "FL-TRACE-PYCOND"
+    severity = "error"
+    scope = KERNEL_SCOPE
+    description = (
+        "Python if/while on a traced expression inside a jitted/scanned "
+        "function — use lax.cond/lax.select/jnp.where"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for owner, node in _walk_traced(traced_defs(m)):
+            if isinstance(node, (ast.If, ast.While)) and \
+                    _contains_jnp_call(m.imports, node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield m.finding(
+                    self, node,
+                    f"Python `{kind}` on a traced expression in "
+                    f"{owner.name}(); trace-time branching on tracer "
+                    "values fails under jit — use lax.cond / lax.select "
+                    "/ jnp.where",
+                )
+
+
+@register
+class PythonLoopOverJnpRule(Rule):
+    name = "FL-TRACE-LOOPJNP"
+    severity = "warning"
+    scope = KERNEL_SCOPE
+    description = (
+        "jnp ops inside a Python loop in a traced function unroll at "
+        "trace time; prefer lax.scan/vmap (fixed small range(<const>) "
+        "unrolls are exempt)"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for owner, node in _walk_traced(traced_defs(m)):
+            if isinstance(node, ast.While):
+                body = ast.Module(body=node.body, type_ignores=[])
+                if _contains_jnp_call(m.imports, body):
+                    yield self._flag(m, node, owner, "while")
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if self._is_const_range(node.iter):
+                    continue  # deliberate bounded unroll idiom
+                body = ast.Module(body=node.body, type_ignores=[])
+                if _contains_jnp_call(m.imports, body):
+                    yield self._flag(m, node, owner, "for")
+
+    @staticmethod
+    def _is_const_range(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "range"
+                and all(isinstance(a, ast.Constant) for a in node.args))
+
+    def _flag(self, m: ModuleContext, node: ast.AST,
+              owner: ast.FunctionDef, kind: str) -> Finding:
+        return m.finding(
+            self, node,
+            f"jnp ops inside a Python `{kind}` loop in traced "
+            f"{owner.name}() unroll at trace time (compile-time blowup, "
+            "no fusion across steps); restructure as lax.scan or vmap",
+        )
+
+
+# -- recompile hazards --------------------------------------------------------
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+_MUTABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set",
+                        "bytearray"}
+
+
+def _static_params(jit_call: ast.Call) -> Tuple[List[int], List[str]]:
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            nums.extend(_const_ints(kw.value))
+        elif kw.arg == "static_argnames":
+            names.extend(_const_strs(kw.value))
+    return nums, names
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _annotation_name(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+@register
+class RecompileHazardRule(Rule):
+    name = "FL-TRACE-STATIC"
+    severity = "error"
+    scope = KERNEL_SCOPE
+    description = (
+        "jit static parameters must be hashable-by-value; mutable "
+        "defaults/annotations on statics and jit calls inside loops or "
+        "uncached functions recompile (or fail) per call"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        yield from self._check_static_params(m)
+        yield from self._check_jit_placement(m)
+
+    # (a) static args whose parameter is provably non-hashable
+    def _check_static_params(self, m: ModuleContext) -> Iterator[Finding]:
+        defs = {n.name: n for n in ast.walk(m.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(m.tree):
+            target: Optional[ast.FunctionDef] = None
+            call: Optional[ast.Call] = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and \
+                            _entrypoint_of(m.imports, dec) == "jax.jit":
+                        target, call = node, dec
+                    elif isinstance(dec, ast.Call) and \
+                            m.imports.resolve(dec.func) == "functools.partial" \
+                            and dec.args and _entrypoint_of(
+                                m.imports, dec.args[0]) == "jax.jit":
+                        target, call = node, dec
+            elif isinstance(node, ast.Call) and \
+                    _entrypoint_of(m.imports, node) == "jax.jit" and \
+                    node.args and isinstance(node.args[0], ast.Name):
+                target = defs.get(node.args[0].id)
+                call = node
+            if target is None or call is None:
+                continue
+            yield from self._check_target(m, call, target)
+
+    def _check_target(self, m: ModuleContext, call: ast.Call,
+                      fn: ast.FunctionDef) -> Iterator[Finding]:
+        nums, names = _static_params(call)
+        params = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = list(fn.args.defaults)
+        # right-align defaults against params
+        default_of = {}
+        for param, d in zip(params[len(params) - len(defaults):], defaults):
+            default_of[param.arg] = d
+        for kwarg, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            params.append(kwarg)
+            if d is not None:
+                default_of[kwarg.arg] = d
+        statics = set(names)
+        for i in nums:
+            if 0 <= i < len(params):
+                statics.add(params[i].arg)
+        for p in params:
+            if p.arg not in statics:
+                continue
+            d = default_of.get(p.arg)
+            if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                yield m.finding(
+                    self, call,
+                    f"jit-static parameter '{p.arg}' of {fn.name}() has a "
+                    "non-hashable default; statics are hashed into the "
+                    "compile cache key — use a tuple/frozenset or drop "
+                    "the static",
+                )
+            ann = _annotation_name(p.annotation)
+            if ann in _MUTABLE_ANNOTATIONS:
+                yield m.finding(
+                    self, call,
+                    f"jit-static parameter '{p.arg}' of {fn.name}() is "
+                    f"annotated '{ann}' (unhashable); statics must be "
+                    "hashable by value or every call raises/recompiles",
+                )
+
+    # (b)/(c) jit created per call
+    def _check_jit_placement(self, m: ModuleContext) -> Iterator[Finding]:
+        flagged: Set[int] = set()
+        for scope in ast.walk(m.tree):
+            if isinstance(scope, (ast.For, ast.AsyncFor, ast.While)):
+                for node in ast.walk(scope):
+                    if isinstance(node, ast.Call) and \
+                            m.imports.resolve(node.func) == "jax.jit" and \
+                            id(node) not in flagged:
+                        flagged.add(id(node))
+                        yield m.finding(
+                            self, node,
+                            "jax.jit(...) constructed inside a loop builds "
+                            "a fresh executable (and compile-cache entry) "
+                            "per iteration; hoist the jitted callable out",
+                        )
+        for fn in ast.walk(m.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(m.imports.resolve(d) in _CACHE_DECORATORS or
+                   (isinstance(d, ast.Call)
+                    and m.imports.resolve(d.func) in _CACHE_DECORATORS)
+                   for d in fn.decorator_list):
+                continue
+            for node in _direct_body(fn):
+                if isinstance(node, ast.Call) and \
+                        m.imports.resolve(node.func) == "jax.jit" and \
+                        id(node) not in flagged:
+                    flagged.add(id(node))
+                    yield m.finding(
+                        self, node,
+                        f"jax.jit(...) called inside uncached function "
+                        f"{fn.name}() returns a fresh callable per call — "
+                        "each one re-traces; memoize with "
+                        "functools.lru_cache or hoist to module level",
+                    )
+
+
+def _direct_body(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Nodes in ``fn``'s own body, excluding nested function scopes."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)

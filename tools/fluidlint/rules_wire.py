@@ -1,0 +1,139 @@
+"""Wire-completeness rule — a cross-file protocol contract.
+
+Every dataclass in ``protocol/messages.py`` is a wire message: it must
+have an encode and a decode path in ``protocol/wire.py`` (the single
+definition point for framing and codecs, so a protocol bump can never ship
+a client/server pair that disagree) and a round-trip test exercising it.
+
+The contract is purely structural so it stays checkable without importing
+the package:
+
+- ``protocol/wire.py`` defines ``encode_<snake_name>`` and
+  ``decode_<snake_name>`` functions and lists the class name as a key of
+  the ``MESSAGE_CODECS`` dict literal;
+- some ``tests/test_wire*.py`` file references the class name (the shipped
+  round-trip suite additionally asserts exhaustiveness dynamically, so a
+  new dataclass fails BOTH this rule and that test until covered).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from .core import Finding, ProjectContext, ProjectRule, register
+
+MESSAGES_PATH = "fluidframework_tpu/protocol/messages.py"
+WIRE_PATH = "fluidframework_tpu/protocol/wire.py"
+TEST_GLOB = "tests/test_wire*.py"
+
+
+def snake_case(name: str) -> str:
+    return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+
+
+def dataclass_names(tree: ast.Module) -> List[str]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = target.attr if isinstance(target, ast.Attribute) else \
+                getattr(target, "id", None)
+            if name == "dataclass":
+                out.append(node.name)
+                break
+    return out
+
+
+def _identifiers(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        elif isinstance(node, ast.alias):
+            names.add(node.name.split(".")[-1])
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            names.add(node.value)
+    return names
+
+
+def _codec_dict_keys(tree: ast.Module) -> Set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "MESSAGE_CODECS"
+                for t in node.targets) and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return set()
+
+
+@register
+class WireCompletenessRule(ProjectRule):
+    name = "FL-WIRE-COMPLETE"
+    severity = "error"
+    description = (
+        "every dataclass in protocol/messages.py needs encode_/decode_ "
+        "paths in protocol/wire.py (MESSAGE_CODECS) and a round-trip test"
+    )
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        messages = project.parse(MESSAGES_PATH)
+        if messages is None:
+            return
+        wire = project.parse(WIRE_PATH)
+        classes = dataclass_names(messages)
+        if not classes:
+            return
+        if wire is None:
+            yield self.project_finding(
+                MESSAGES_PATH, 1,
+                f"{WIRE_PATH} is missing but {MESSAGES_PATH} defines "
+                f"{len(classes)} wire dataclasses",
+            )
+            return
+        wire_defs = {n.name for n in ast.walk(wire)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+        codec_keys = _codec_dict_keys(wire)
+        test_files = project.glob(TEST_GLOB)
+        test_idents: Set[str] = set()
+        for tf in test_files:
+            tree = project.parse(tf)
+            if tree is not None:
+                test_idents |= _identifiers(tree)
+        for cls in classes:
+            snake = snake_case(cls)
+            for prefix in ("encode_", "decode_"):
+                fn = prefix + snake
+                if fn not in wire_defs:
+                    yield self.project_finding(
+                        WIRE_PATH, 1,
+                        f"message dataclass {cls} has no {fn}() in "
+                        f"{WIRE_PATH}; every wire message needs an "
+                        "explicit encode and decode path",
+                    )
+            if cls not in codec_keys:
+                yield self.project_finding(
+                    WIRE_PATH, 1,
+                    f"message dataclass {cls} is not registered in "
+                    "MESSAGE_CODECS; the codec registry is the dispatch "
+                    "surface drivers/services use",
+                )
+            if not test_files:
+                yield self.project_finding(
+                    MESSAGES_PATH, 1,
+                    f"no {TEST_GLOB} round-trip suite exists to cover "
+                    f"message dataclass {cls}",
+                )
+            elif cls not in test_idents:
+                yield self.project_finding(
+                    MESSAGES_PATH, 1,
+                    f"message dataclass {cls} has no round-trip coverage "
+                    f"in {TEST_GLOB}",
+                )
