@@ -87,9 +87,21 @@ class TextArena:
         return start
 
     def finalize(self) -> str:
-        joined = "".join(self._chunks)
-        self._chunks = [joined]
-        return joined
+        # Append-safe compaction: the catch-up pack cache shares one
+        # arena between a cached chunk being extracted (finalize) and a
+        # suffix extension appending new text.  Join a snapshot prefix
+        # and splice it back over exactly those elements — a chunk
+        # appended mid-join lands at index >= n and survives the slice
+        # assignment (each list op is atomic under the GIL), where the
+        # old wholesale `self._chunks = [joined]` would silently drop it.
+        n = len(self._chunks)
+        if n == 0:
+            return ""
+        if n > 1:
+            joined = "".join(self._chunks[:n])
+            self._chunks[:n] = [joined]
+            return joined
+        return self._chunks[0]
 
     def slice(self, start: int, length: int) -> str:
         return self.finalize()[start : start + length]

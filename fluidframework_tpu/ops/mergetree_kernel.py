@@ -1100,6 +1100,14 @@ class MergeTreeDocInput:
     #: so this is pure extraction work; such docs take the Python record
     #: path (the C++ extractor emits bodies only).
     attribution: bool = False
+    #: Opaque identity of the (document, base summary, storage generation)
+    #: this tail extends — set by callers (the catch-up service) that want
+    #: the pipeline's pack cache to reuse packed windows across calls.
+    #: The contract: two inputs with equal tokens draw their ops from the
+    #: SAME append-only sequenced stream over the SAME base, so a shared
+    #: (first_seq .. last_seq) prefix is byte-identical.  None (the
+    #: default) opts the doc out of pack caching entirely.
+    cache_token: Optional[tuple] = None
 
 
 class _DocPack:
@@ -1114,6 +1122,53 @@ class _DocPack:
         if client_id is None:
             return -1
         return self.clients.intern(client_id)
+
+
+def fill_sequence_op_rows(op, d: int, t: int, msgs, pack, arena,
+                          key_id, values) -> int:
+    """Fill doc ``d``'s op rows from a message list, starting after row
+    ``t`` — THE per-op row fill, shared by the fresh pack below and the
+    pack cache's suffix extension (ops/pipeline.py) so the two can never
+    drift byte-wise.  Interval ops route into ``pack.interval_ops``;
+    ``key_id`` maps a property key to its chunk-global column.  Returns
+    the last row filled."""
+    for msg in msgs:
+        contents = msg.contents
+        kind = contents["kind"]
+        if kind.startswith("interval"):
+            for cl in ([msg.client_id] if msg.client_id else []):
+                pack.client_idx(cl)
+            pack.interval_ops.append(msg)
+            continue
+        t += 1
+        op["seq"][d, t] = msg.seq
+        op["client"][d, t] = pack.client_idx(msg.client_id)
+        op["ref_seq"][d, t] = msg.ref_seq
+        op["min_seq"][d, t] = msg.min_seq
+        if kind == "insert":
+            op["kind"][d, t] = K_INSERT
+            op["a"][d, t] = contents["pos"]
+            op["tstart"][d, t] = arena.append(contents["text"])
+            op["tlen"][d, t] = len(contents["text"])
+        elif kind == "remove":
+            op["kind"][d, t] = K_REMOVE
+            op["a"][d, t] = contents["start"]
+            op["b"][d, t] = contents["end"]
+        elif kind == "obliterate":
+            op["kind"][d, t] = K_OBLITERATE
+            op["a"][d, t] = contents["start"]
+            op["b"][d, t] = contents["end"]
+        elif kind == "annotate":
+            op["kind"][d, t] = K_ANNOTATE
+            op["a"][d, t] = contents["start"]
+            op["b"][d, t] = contents["end"]
+        else:
+            raise ValueError(f"unknown sequence op kind {kind!r}")
+        for key, value in (contents.get("props") or {}).items():
+            op["pvals"][d, t, key_id(key)] = (
+                PROP_ABSENT if value is None else values.intern(value)
+            )
+    return t
 
 
 def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
@@ -1289,44 +1344,8 @@ def pack_mergetree_batch(docs: Sequence[MergeTreeDocInput]):
             arena.append(doc_bytes.decode("utf-8"))
             continue
 
-        t = -1
-        for msg in doc.ops:
-            contents = msg.contents
-            kind = contents["kind"]
-            if kind.startswith("interval"):
-                for cl in ([msg.client_id] if msg.client_id else []):
-                    pack.client_idx(cl)
-                pack.interval_ops.append(msg)
-                continue
-            t += 1
-            op["seq"][d, t] = msg.seq
-            op["client"][d, t] = pack.client_idx(msg.client_id)
-            op["ref_seq"][d, t] = msg.ref_seq
-            op["min_seq"][d, t] = msg.min_seq
-            if kind == "insert":
-                op["kind"][d, t] = K_INSERT
-                op["a"][d, t] = contents["pos"]
-                op["tstart"][d, t] = arena.append(contents["text"])
-                op["tlen"][d, t] = len(contents["text"])
-            elif kind == "remove":
-                op["kind"][d, t] = K_REMOVE
-                op["a"][d, t] = contents["start"]
-                op["b"][d, t] = contents["end"]
-            elif kind == "obliterate":
-                op["kind"][d, t] = K_OBLITERATE
-                op["a"][d, t] = contents["start"]
-                op["b"][d, t] = contents["end"]
-            elif kind == "annotate":
-                op["kind"][d, t] = K_ANNOTATE
-                op["a"][d, t] = contents["start"]
-                op["b"][d, t] = contents["end"]
-            else:
-                raise ValueError(f"unknown sequence op kind {kind!r}")
-            for key, value in (contents.get("props") or {}).items():
-                k = prop_keys.intern(key)
-                op["pvals"][d, t, k] = (
-                    PROP_ABSENT if value is None else values.intern(value)
-                )
+        fill_sequence_op_rows(op, d, -1, doc.ops, pack, arena,
+                              prop_keys.intern, values)
 
     # int16-export eligibility: every value the final state can hold must fit
     # strictly under the int16 sentinel (see the export layout comment).

@@ -142,6 +142,7 @@ def sharded_export_step(mesh: Mesh, S: int, i16: bool, ob_rows: bool,
 def replay_mergetree_sharded(
     docs: Sequence[MergeTreeDocInput],
     mesh: Optional[Mesh] = None,
+    stats: Optional[dict] = None,
 ) -> List[SummaryTree]:
     """Multi-chip catch-up replay: pack → narrow → shard over the mesh →
     fold+export in-graph → shared host extraction (the single-chip
@@ -149,7 +150,13 @@ def replay_mergetree_sharded(
     single-chip path and the CPU oracle.  Until round 5 this path
     downloaded all 13 full int32 state planes; it now fetches the same
     fused (elided/int16/int8) export buffer as single-chip — ~10× less
-    d2h per chunk — and uploads the narrow encodings."""
+    d2h per chunk — and uploads the narrow encodings.
+
+    ``stats`` (optional dict) accumulates ``device_docs`` /
+    ``fallback_docs`` exactly like ``replay_mergetree_batch`` — pre-pack
+    oracle routing plus post-fold overflow fallbacks — so the multichip
+    service path reports the same device-vs-oracle split as single-chip
+    (advisor, round 5)."""
     from ..ops.batching import partition_replay
 
     if mesh is None:
@@ -193,11 +200,11 @@ def replay_mergetree_sharded(
             doc_packs=meta["doc_packs"][:n_real],
             doc_base=meta["doc_base"][:n_real],
         )
-        return summaries_from_export(meta_real, ex_np)
+        return summaries_from_export(meta_real, ex_np, stats=stats)
 
     return partition_replay(
         docs, known_oracle_fallback, oracle_fallback_summary,
-        fold_batch_export,
+        fold_batch_export, stats=stats,
     )
 
 

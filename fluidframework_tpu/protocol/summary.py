@@ -292,12 +292,37 @@ class SummaryStorage:
         """Returns (tree, ref_seq) of the newest summary, or (None, 0).
         With ``at_or_below``, the newest summary whose ref_seq does not
         exceed it (historical reconstruction / replay driver)."""
+        tree, ref_seq, _handle = self.latest_with_handle(
+            doc_id, at_or_below=at_or_below)
+        return tree, ref_seq
+
+    def latest_with_handle(self, doc_id: str, at_or_below: int = None):
+        """(tree, ref_seq, tree handle) of the newest summary, or
+        (None, 0, None).  The handle comes straight off the commit — the
+        digest was computed once at upload time, so callers that key on
+        it (the catch-up result cache) never re-hash the whole tree."""
         for commit in self._walk(self.head(doc_id)):
             if at_or_below is None or commit.ref_seq <= at_or_below:
                 node = self.read(commit.tree)  # disk-backed stores lazy-load
                 assert isinstance(node, SummaryTree)
-                return node, commit.ref_seq
-        return None, 0
+                return node, commit.ref_seq, commit.tree
+        return None, 0, None
+
+    def upload_absent(self, doc_id: str, tree: SummaryTree, ref_seq: int,
+                      message: str = "",
+                      handle: Optional[str] = None) -> str:
+        """Idempotent :meth:`upload`: no-op when a commit for this exact
+        (tree, ref_seq) already exists, check-and-upload atomic under the
+        store lock — N cache-served catch-up followers publishing the
+        same fold chain ONE commit, not N duplicates.  ``handle`` (when
+        the caller already knows ``tree.digest()`` — e.g. off a cache
+        entry) skips re-hashing; it MUST be the tree's true digest."""
+        with self._lock:
+            if handle is None:
+                handle = tree.digest()
+            if self.commit_for(doc_id, handle, ref_seq) is None:
+                return self.upload(doc_id, tree, ref_seq, message)
+            return handle
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
         return self._objects[handle]

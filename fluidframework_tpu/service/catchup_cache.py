@@ -1,0 +1,242 @@
+"""Seq-anchored catch-up result cache: the memory tier of the two-tier
+catch-up cache (ISSUE 3; the snapshot-cache/EpochTracker capability of
+SURVEY §3.2 applied to the SERVICE's own fold work).
+
+Round-5 hardware truth: the device fold is ~free while the host pack +
+extract busy time caps e2e throughput.  But the serving workload is
+heavily repeated reads — thousands of loading clients catching up to the
+same ``(document, seq)`` point — so the second and every later request
+for an identical fold should cost a dict lookup, not a pack → fold →
+extract pass.
+
+Keying and correctness:
+
+- Entries are keyed ``(storage epoch, doc id, base summary digest,
+  base ref_seq, tail head seq)``.  The op log is append-only and the
+  summary store content-addressed, so within one storage generation that
+  tuple pins the exact ``(base bytes, tail bytes)`` input of the fold —
+  a cached tree is byte-identical to a fresh fold by construction
+  (asserted by golden + fuzz tests, cache-on vs cache-off).
+- The epoch component is the EpochTracker parity: a recreated store gets
+  a fresh epoch, so entries from a dead generation can never be served;
+  :meth:`invalidate_epoch` additionally drops them eagerly.
+- No wall-clock anywhere (fluidlint FL-DET-CLOCK applies to this path):
+  recency is an LRU over dict insertion order, not timestamps, so replay
+  runs are deterministic.
+
+Concurrency — single-flight: concurrent requests for the same key are
+collapsed to one fold.  The first caller ``begin()``s the key and becomes
+the LEADER (it computes the fold and ``finish()``es); every other caller
+``join()``s and blocks until the leader publishes — a thundering herd of
+N loading clients costs exactly one device pass and N-1 waits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, NamedTuple, Optional, Tuple
+
+from ..protocol.summary import SummaryBlob, SummaryTree
+from ..utils.telemetry import CounterSet
+
+#: accounting overhead charged per summary node (name + dict slot + object
+#: headers) so byte budgets track real memory, not just blob payloads.
+NODE_OVERHEAD = 96
+
+
+def tree_nbytes(node) -> int:
+    """Approximate retained bytes of a summary tree: blob payloads plus a
+    flat per-node overhead.  Deterministic (no sys.getsizeof walks)."""
+    if isinstance(node, SummaryBlob):
+        return NODE_OVERHEAD + len(node.content)
+    total = NODE_OVERHEAD
+    if isinstance(node, SummaryTree):
+        for name, child in node.children.items():
+            total += len(name) + tree_nbytes(child)
+    return total
+
+
+class CachedFold(NamedTuple):
+    """A served cache entry: the folded tree plus its handle, digested
+    ONCE at publish time — a hit is a dict lookup, never a Merkle walk."""
+
+    tree: SummaryTree
+    handle: str
+
+
+class _Flight:
+    """One in-flight fold: the leader publishes, waiters block on the
+    event and read the result (None = leader abandoned; waiters retry)."""
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.result: Optional[CachedFold] = None
+
+
+class CatchupResultCache:
+    """Byte-bounded LRU of folded catch-up summaries with single-flight.
+
+    All mutation happens under one lock; ``join()`` waits outside it.
+    Counters: ``hits`` / ``misses`` (lookup outcomes), ``inserts`` /
+    ``evictions`` (LRU churn), ``waits`` (single-flight joins that
+    blocked on a leader), ``invalidations`` (epoch drops).
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20) -> None:
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        # dict insertion order IS the LRU order (touch = delete+reinsert).
+        self._entries: Dict[tuple, Tuple[CachedFold, int]] = {}
+        self._bytes = 0
+        self._flights: Dict[tuple, _Flight] = {}
+        self._last_epoch: Optional[str] = None  # invalidate fast path
+        self.counters = CounterSet(
+            "hits", "misses", "inserts", "evictions", "waits",
+            "invalidations",
+        )
+
+    # -- introspection ---------------------------------------------------------
+
+    @property
+    def current_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = self.counters.snapshot()
+            out["entries"] = len(self._entries)
+            out["bytes"] = self._bytes
+        return out
+
+    # -- plain lookup/insert ---------------------------------------------------
+
+    def lookup(self, key: tuple) -> Optional[CachedFold]:
+        """Cached (tree, handle) for ``key`` (LRU-touched), or None."""
+        with self._lock:
+            found = self._get_locked(key)
+            self.counters.bump("hits" if found is not None else "misses")
+            return found
+
+    def _get_locked(self, key: tuple) -> Optional[CachedFold]:
+        """Uncounted fetch + LRU touch.  Counting discipline: ``hits``
+        bump wherever an entry is served; ``misses`` bump ONLY at the
+        authoritative claim point (``begin``/``lookup``) — ``join`` is a
+        probe and counting its empty result too would double-count every
+        doc that probes first and claims right after."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        # Touch: move to the back of the insertion order.
+        del self._entries[key]
+        self._entries[key] = entry
+        return entry[0]
+
+    def insert(self, key: tuple, tree: SummaryTree) -> CachedFold:
+        with self._lock:
+            return self._insert_locked(key, tree)
+
+    def _insert_locked(self, key: tuple, tree: SummaryTree) -> CachedFold:
+        # Digest ONCE here, at publish time — every later hit serves the
+        # stored handle instead of re-walking the tree.
+        fold = CachedFold(tree, tree.digest())
+        nbytes = tree_nbytes(tree)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        if nbytes > self.max_bytes:
+            # Never admit an entry the budget cannot hold: admitting it
+            # would evict the whole cache for a single un-keepable tree.
+            self.counters.bump("evictions")
+            return fold
+        self._entries[key] = (fold, nbytes)
+        self._bytes += nbytes
+        self.counters.bump("inserts")
+        while self._bytes > self.max_bytes and self._entries:
+            oldest = next(iter(self._entries))
+            _fold, n = self._entries.pop(oldest)
+            self._bytes -= n
+            self.counters.bump("evictions")
+        return fold
+
+    # -- single-flight ---------------------------------------------------------
+
+    def begin(self, key: tuple):
+        """Claim a key: ``("hit", CachedFold)`` when cached, else
+        ``("lead", None)`` — the caller is now the leader and MUST
+        ``finish`` or ``abandon`` the key (use try/finally).  A second
+        ``begin`` for a key already in flight also leads (callers
+        serialized by the catch-up lock re-claim after an abandon);
+        waiters use :meth:`join`."""
+        with self._lock:
+            found = self._get_locked(key)
+            if found is not None:
+                self.counters.bump("hits")
+                return "hit", found
+            self.counters.bump("misses")
+            self._flights.setdefault(key, _Flight())
+            return "lead", None
+
+    def finish(self, key: tuple, tree: SummaryTree) -> CachedFold:
+        """Leader publishes: insert into the LRU and wake every waiter.
+        Returns the (tree, handle) pair so the leader reuses the one
+        digest computed at insert."""
+        with self._lock:
+            fold = self._insert_locked(key, tree)
+            flight = self._flights.pop(key, None)
+        if flight is not None:
+            flight.result = fold
+            flight.done.set()
+        return fold
+
+    def abandon(self, key: tuple) -> None:
+        """Leader failed: wake waiters empty-handed (they retry or fold
+        themselves).  Safe on a key that was already finished."""
+        with self._lock:
+            flight = self._flights.pop(key, None)
+        if flight is not None:
+            flight.done.set()
+
+    def join(self, key: tuple,
+             timeout: Optional[float] = None) -> Optional[CachedFold]:
+        """Wait-or-read: the cached (tree, handle); else, when a leader
+        is in flight, block until it publishes and return its result
+        (None if it abandoned or ``timeout`` elapsed); else None
+        immediately."""
+        with self._lock:
+            found = self._get_locked(key)
+            if found is not None:
+                self.counters.bump("hits")
+                return found
+            flight = self._flights.get(key)
+            if flight is None:
+                return None  # probe only: begin() counts the miss
+            self.counters.bump("waits")
+        if not flight.done.wait(timeout):
+            return None
+        return flight.result
+
+    # -- epoch invalidation ----------------------------------------------------
+
+    def invalidate_epoch(self, current_epoch: str) -> int:
+        """Drop every entry pinned to a DIFFERENT storage generation.
+        The epoch is key component 0, so stale generations can never be
+        served even without this call — eager dropping just frees the
+        budget the moment the store is recreated.  Returns entries
+        dropped.  O(1) while the epoch is unchanged (the hot serving
+        loop calls this per request; the full scan runs only on an
+        actual generation change).  Callers sharing one cache must all
+        serve the SAME store: this treats every other epoch as dead, so
+        two live stores alternating here would evict each other."""
+        with self._lock:
+            if current_epoch == self._last_epoch:
+                return 0
+            self._last_epoch = current_epoch
+            stale = [k for k in self._entries if k[0] != current_epoch]
+            for key in stale:
+                _tree, n = self._entries.pop(key)
+                self._bytes -= n
+                self.counters.bump("invalidations")
+        return len(stale)

@@ -316,6 +316,14 @@ class OrderingServer:
             with self._catchup_init:
                 if self._catchup is None:
                     self._catchup = CatchupService(service)
+            if self._catchup.cache is not None:
+                # Epoch-keyed invalidation (EpochTracker parity for the
+                # SERVER's own fold cache): entries are keyed by the
+                # storage generation so a recreated store can never be
+                # served a stale fold — dropping dead-generation entries
+                # here just frees the budget immediately.
+                self._catchup.cache.invalidate_epoch(
+                    service.storage.epoch)
             doc_ids = params.get("docs")
             prefix = f"{session.tenant}/" if self.tenants is not None else ""
             if doc_ids is not None:
@@ -340,6 +348,11 @@ class OrderingServer:
                 ),
                 "deviceDocs": stats.get("deviceDocs", 0),
                 "cpuDocs": stats.get("cpuDocs", 0),
+                # Cumulative fold-cache health (hits/misses/evictions/
+                # waits + bytes) — operators watching a herd of loading
+                # clients see the single-flight amortization here.
+                "cache": (self._catchup.cache.stats()
+                          if self._catchup.cache is not None else None),
             }
         if method == "latest_summary":
             epoch = service.storage.epoch

@@ -93,6 +93,50 @@ def benchmark(
 
 
 @dataclasses.dataclass
+class ColdWarmResult:
+    """Steady-state pair: the first (cold) run against the best of the
+    following (warm) runs — the cache-amortization shape of serving
+    workloads (catch-up re-reads, snapshot caches)."""
+
+    name: str
+    cold_s: float
+    warm_s: float  # best warm run
+    warm_runs: int
+
+    @property
+    def speedup(self) -> float:
+        return self.cold_s / self.warm_s if self.warm_s > 0 \
+            else float("inf")
+
+    def report(self) -> str:
+        return (
+            f"{self.name}: cold {self.cold_s * 1e3:.3f}ms | warm "
+            f"{self.warm_s * 1e3:.3f}ms (best of {self.warm_runs}) | "
+            f"{self.speedup:.1f}x"
+        )
+
+
+def benchmark_cold_warm(
+    fn: Callable[[], object],
+    name: str = "cold-warm",
+    warm_runs: int = 3,
+) -> ColdWarmResult:
+    """Cold/warm mode: time ``fn`` once cold, then ``warm_runs`` more
+    times taking the best — no setup hook on purpose (the state carried
+    between runs IS the measurement)."""
+    t0 = time.perf_counter()
+    fn()
+    cold = time.perf_counter() - t0
+    warm = float("inf")
+    for _ in range(max(1, warm_runs)):
+        t0 = time.perf_counter()
+        fn()
+        warm = min(warm, time.perf_counter() - t0)
+    return ColdWarmResult(name=name, cold_s=cold, warm_s=warm,
+                          warm_runs=max(1, warm_runs))
+
+
+@dataclasses.dataclass
 class MemoryResult:
     name: str
     peak_bytes: int

@@ -101,6 +101,33 @@ class PerformanceEvent:
         })
 
 
+class CounterSet:
+    """Named monotonic counters for steady-state subsystems (caches,
+    retry loops): cheap bumps on the hot path, one dict snapshot for
+    telemetry/bench reporting.  NOT internally synchronized — owners that
+    bump from several threads do so under their own lock (the catch-up
+    cache holds its LRU lock across every bump)."""
+
+    def __init__(self, *names: str) -> None:
+        self._counts: Dict[str, int] = {name: 0 for name in names}
+
+    def bump(self, name: str, by: int = 1) -> int:
+        value = self._counts.get(name, 0) + by
+        self._counts[name] = value
+        return value
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    def send_to(self, logger, event_name: str, **properties) -> None:
+        """Emit one event carrying every counter (cache hit/miss/evict
+        telemetry rides the same logger tree as everything else)."""
+        logger.send({"eventName": event_name, **self._counts, **properties})
+
+
 class ConfigProvider:
     """Layered feature gates: explicit dict over environment variables
     (``FLUID_TPU_<KEY>``), read through typed getters — the reference's

@@ -469,6 +469,18 @@ int32_t oppack_widen(
     const int32_t* doc_base,
     int32_t sentinel_src, int32_t sentinel_dst,
     int32_t* dst) {
+    // Validate the desc table up front, like the per-doc `n` check below:
+    // a source-row index past R_src (ROW16 directly, PAIR8 via arg/2) or a
+    // MISC row without the misc output would read out of bounds.  -1, not
+    // UB, on a malformed table.
+    for (int32_t r = 0; r < R_canon; ++r) {
+        const int32_t mode = desc[r * 4 + 0];
+        const int32_t arg = desc[r * 4 + 1];
+        if (mode == 1 && (arg < 0 || arg >= R_src)) return -1;
+        if (mode == 2 && (arg < 0 || arg / 2 >= R_src)) return -1;
+        if (mode == 3 && misc == nullptr) return -1;
+        if (mode < 0 || mode > 3) return -1;
+    }
     const int64_t src_doc = static_cast<int64_t>(R_src) * S;
     const int64_t dst_doc = static_cast<int64_t>(R_canon) * S;
     for (int32_t d = 0; d < D; ++d) {

@@ -49,10 +49,18 @@ def test_mesh_spans_eight_devices():
 def test_sharded_replay_matches_oracle_and_single_chip(fuzz_docs):
     docs, oracle_digests = fuzz_docs
     mesh = doc_mesh()
-    sharded = replay_mergetree_sharded(docs, mesh=mesh)
+    stats: dict = {}
+    sharded = replay_mergetree_sharded(docs, mesh=mesh, stats=stats)
     assert [s.digest() for s in sharded] == oracle_digests
-    single = replay_mergetree_batch(docs)
+    single_stats: dict = {}
+    single = replay_mergetree_batch(docs, single_stats)
     assert [s.digest() for s in single] == oracle_digests
+    # The multichip path reports the same device-vs-oracle split as the
+    # single-chip batch entry point (advisor, round 5: sharded replay
+    # silently dropped its stats).
+    assert stats.get("device_docs", 0) + stats.get("fallback_docs", 0) \
+        == len(docs)
+    assert stats == single_stats
 
 
 def test_sharded_replay_single_doc_pads_to_mesh(fuzz_docs):

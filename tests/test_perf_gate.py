@@ -214,6 +214,9 @@ def test_bench_emits_skip_json_when_backend_unavailable(tmp_path):
     assert parsed["metric"] == bench.METRIC_NAME
     assert parsed["skipped"] == "backend-unavailable"
     assert "error_tail" in parsed["probe"]
+    # Schema-stable cache field: present on every artifact, null when the
+    # run never reached the catch-up cache phase.
+    assert "cache_hit_rate" in parsed and parsed["cache_hit_rate"] is None
 
 
 @pytest.mark.skipif(
@@ -245,7 +248,11 @@ def test_device_e2e_beats_oracle():
 def test_native_widen_beats_numpy_widen(packed_chunk, chunk_export):
     """Relative gate (portable across hosts): the C++ narrow→canonical
     widen must stay meaningfully faster than the numpy inverse it
-    replaced on the extraction hot path."""
+    replaced on the extraction hot path.  Measured warm best-of-5 with a
+    10% margin (advisor, round 5): the strict ``native < py`` form at
+    millisecond scale tripped on scheduler noise, and a gate that can
+    only fail on noise measures nothing — the real win is ~10×, so
+    demanding ≥10% still flags a genuine regression."""
     from fluidframework_tpu.ops.mergetree_kernel import (
         _export_flags,
         widen_export,
@@ -262,8 +269,11 @@ def test_native_widen_beats_numpy_widen(packed_chunk, chunk_export):
     args = (meta.get("doc_base"), ob_f, ov_f, i8_f, meta.get("props_K"),
             props_f)
     native = py = float("inf")
-    widen_export_native(ex, *args)  # warm
-    for _ in range(3):
+    for _ in range(2):  # warm both sides (allocator, library load)
+        widen_export_native(ex, *args)
+        widen_export(ex, args[0], ob_rows=ob_f, ov_rows=ov_f, i8=i8_f,
+                     n_props=meta.get("props_K"), props_rows=props_f)
+    for _ in range(5):
         t0 = time.time()
         assert widen_export_native(ex, *args) is not None
         native = min(native, time.time() - t0)
@@ -271,9 +281,42 @@ def test_native_widen_beats_numpy_widen(packed_chunk, chunk_export):
         widen_export(ex, args[0], ob_rows=ob_f, ov_rows=ov_f, i8=i8_f,
                      n_props=meta.get("props_K"), props_rows=props_f)
         py = min(py, time.time() - t0)
-    assert native < py, (
-        f"native widen ({native*1e3:.2f}ms) no faster than numpy "
+    assert native < py * 0.9, (
+        f"native widen ({native*1e3:.2f}ms) not ≥10% faster than numpy "
         f"({py*1e3:.2f}ms)"
+    )
+
+
+def test_catchup_warm_hit_skips_pack_stage_entirely():
+    """Warm-vs-cold catch-up gate: a full tier-1 hit must do ZERO pack
+    work — asserted via the pipeline stage counters, not wall-clock, so
+    the gate cannot flake on scheduler noise.  mesh=None pins the
+    single-device pipelined path (the conftest's virtual 8-device mesh
+    would otherwise route around the stage-instrumented pipeline)."""
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.service.catchup import CatchupService
+
+    n_docs, ops = 24, 16
+    service = LocalOrderingService()
+    doc_ids = bench.build_catchup_corpus(service, n_docs, ops)
+    svc = CatchupService(service, mesh=None)
+
+    cold = svc.catch_up(doc_ids, upload=False)
+    assert svc.pipeline_stage.get("pack", 0) > 0, (
+        "cold catch-up never reached the pack stage — gate miswired"
+    )
+    stage_after_cold = dict(svc.pipeline_stage)
+    counters = svc.cache.counters
+
+    hits_before = counters.get("hits")
+    warm = svc.catch_up(doc_ids, upload=False)
+    assert warm == cold, "warm catch-up changed bytes"
+    assert svc.pipeline_stage == stage_after_cold, (
+        f"warm hit touched pipeline stages: {svc.pipeline_stage} "
+        f"vs {stage_after_cold}"
+    )
+    assert counters.get("hits") - hits_before == n_docs, (
+        "warm pass was not a full tier-1 hit"
     )
 
 

@@ -232,3 +232,45 @@ def test_native_widen_matches_python_widen_all_layouts():
         replay_export(None, ops, meta32, S=state.tstart.shape[1]))
     assert widen_export_native(ex32, None, True, True, False,
                                meta.get("props_K"), True) is None
+
+
+def test_native_widen_rejects_malformed_desc_table():
+    """oppack_widen must bounds-check the DESC table, not just ``n``
+    (advisor, round 5): a ROW16 source index past R_src, a PAIR8 pair
+    index past R_src, an unknown mode, or a MISC row without the misc
+    output all return -1 instead of reading out of bounds."""
+    import ctypes
+
+    from fluidframework_tpu.ops.native_pack import load_library
+
+    lib = load_library()
+    if lib is None:
+        pytest.skip("liboppack unavailable")
+    D, S, R_src = 1, 4, 2
+    src = np.zeros((D, R_src, S), np.int16)  # n (last row, col 0) = 0
+    dst = np.zeros((D, 2, S), np.int32)
+
+    def widen(desc_rows, misc=None):
+        desc = np.asarray(desc_rows, np.int32).reshape(-1)
+        misc_ptr = misc.ctypes.data if misc is not None else None
+        misc_cols = misc.shape[1] if misc is not None else 0
+        return lib.oppack_widen(
+            src, D, S, R_src, len(desc_rows), misc_ptr, misc_cols, desc,
+            None, 32767, 2147483647, dst,
+        )
+
+    ok = [(1, 0, 0, 0), (1, R_src - 1, 0, 0)]
+    assert widen(ok) == 0  # control: a valid table still widens
+    # ROW16 source index out of range (both ends)
+    assert widen([(1, R_src, 0, 0), (1, 0, 0, 0)]) == -1
+    assert widen([(1, -1, 0, 0), (1, 0, 0, 0)]) == -1
+    # PAIR8 pair index maps past the source rows (arg/2 >= R_src)
+    assert widen([(2, 2 * R_src, 0, 0), (1, 0, 0, 0)]) == -1
+    assert widen([(2, -1, 0, 0), (1, 0, 0, 0)]) == -1
+    # MISC row requires a non-null misc pointer
+    assert widen([(3, 0, 0, 0), (1, 0, 0, 0)]) == -1
+    misc = np.zeros((D, 2), np.int16)
+    assert widen([(3, 0, 0, 0), (1, 0, 0, 0)], misc=misc) == 0
+    # unknown mode
+    assert widen([(4, 0, 0, 0), (1, 0, 0, 0)]) == -1
+    assert widen([(-1, 0, 0, 0), (1, 0, 0, 0)]) == -1
