@@ -152,12 +152,19 @@ class FileSummaryStorage(SummaryStorage):
     # -- lazy reads from disk (latest() inherits these via read()) -------------
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
-        cached = self._objects.get(handle)
+        # Same guarded-by: _lock discipline as the base class (fluidrace)
+        # for the memo dict — but the disk read happens OUTSIDE the lock:
+        # holding the store-wide lock across I/O would serialize every
+        # head()/upload() behind one cold load.  Content-addressing makes
+        # the race benign: two threads loading the same handle produce
+        # identical nodes, and setdefault keeps exactly one.
+        with self._lock:
+            cached = self._objects.get(handle)
         if cached is not None:
             return cached
         node = self._load_from_disk(handle)
-        self._objects[handle] = node
-        return node
+        with self._lock:
+            return self._objects.setdefault(handle, node)
 
     def _load_from_disk(self, digest: str) -> Union[SummaryTree, SummaryBlob]:
         path = os.path.join(self._objects_dir, digest)

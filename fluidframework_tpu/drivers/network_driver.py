@@ -57,10 +57,14 @@ class _RpcClient:
         self._timeout = timeout
         self._write_lock = threading.Lock()
         self._ids = itertools.count(1)
-        self._pending: Dict[int, queue.Queue] = {}
+        self._pending: Dict[int, queue.Queue] = {}  # guarded-by: _pending_lock
         self._pending_lock = threading.Lock()
         self._events: queue.Queue = queue.Queue()
-        self._handlers: Dict[str, List[Callable[[dict], None]]] = {}
+        # Subscription state: mutated by caller threads (subscribe/
+        # unsubscribe), read by the dispatcher thread per event — its own
+        # lock so delivery never contends with the request/response path.
+        self._state_lock = threading.Lock()
+        self._handlers: Dict[str, List[Callable[[dict], None]]] = {}  # guarded-by: _state_lock
         self._closed = False
         #: storage generation this CONNECTION is pinned to (odsp
         #: EpochTracker): adopted from the first storage response and then
@@ -74,7 +78,7 @@ class _RpcClient:
         #: centrally, before the error propagates.  Held as WEAK method refs
         #: so a long-lived shared connection does not pin every per-doc
         #: storage instance (and its snapshot cache) forever (ADVICE r4).
-        self._epoch_listeners: List["weakref.WeakMethod"] = []
+        self._epoch_listeners: List["weakref.WeakMethod"] = []  # guarded-by: _state_lock
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         self._dispatcher = threading.Thread(
@@ -121,7 +125,13 @@ class _RpcClient:
             if frame is None:
                 return
             key = f"{frame['event']}:{frame.get('doc', '')}"
-            for fn in list(self._handlers.get(key, [])):
+            # Snapshot under the lock, deliver outside it: a handler that
+            # issues further RPCs (or re-subscribes) must not deadlock or
+            # corrupt the dispatch loop (fluidrace: the live list is
+            # mutated by on()/off() on caller threads).
+            with self._state_lock:
+                handlers = list(self._handlers.get(key, ()))
+            for fn in handlers:
                 try:
                     fn(frame)
                 except Exception:
@@ -169,17 +179,25 @@ class _RpcClient:
             if frame.get("code") == "epochMismatch":
                 # Dead generation: unpin and drop EVERY cache riding this
                 # connection before anyone can retry unpinned against the
-                # new generation with stale state still live.
+                # new generation with stale state still live.  Same
+                # discipline as the dispatcher: snapshot under the lock,
+                # invoke the callbacks OUTSIDE it (a listener that
+                # re-registers must not self-deadlock on the plain Lock),
+                # then prune dead weakrefs by re-reading the LIVE list —
+                # never by writing back the stale snapshot, which would
+                # drop listeners registered during delivery.
                 self.epoch = None
-                for ref in list(self._epoch_listeners):
+                with self._state_lock:
+                    listeners = list(self._epoch_listeners)
+                for ref in listeners:
                     invalidate = ref()
-                    if invalidate is None:  # storage instance collected
-                        try:
-                            self._epoch_listeners.remove(ref)
-                        except ValueError:
-                            pass  # concurrent mismatch already pruned it
-                    else:
+                    if invalidate is not None:
                         invalidate()
+                with self._state_lock:
+                    self._epoch_listeners[:] = [
+                        r for r in self._epoch_listeners
+                        if r() is not None
+                    ]
                 raise EpochMismatchError(
                     frame.get("error", "storage epoch mismatch"),
                     frame.get("epoch"),
@@ -188,15 +206,34 @@ class _RpcClient:
         return frame.get("result")
 
     def on(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
-        self._handlers.setdefault(f"{event}:{doc_id}", []).append(fn)
+        with self._state_lock:
+            self._handlers.setdefault(f"{event}:{doc_id}", []).append(fn)
 
     def off(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
-        handlers = self._handlers.get(f"{event}:{doc_id}", [])
-        if fn in handlers:
-            handlers.remove(fn)
+        with self._state_lock:
+            handlers = self._handlers.get(f"{event}:{doc_id}", [])
+            if fn in handlers:
+                handlers.remove(fn)
+
+    def add_epoch_listener(self, ref: "weakref.WeakMethod") -> None:
+        """Register an invalidation callback (weak method ref) — under the
+        state lock so registration never races the mismatch sweep's
+        prune-and-replace."""
+        with self._state_lock:
+            self._epoch_listeners.append(ref)
 
     def close(self) -> None:
         self._closed = True
+        try:
+            # shutdown() (not just close()) wakes the reader thread out
+            # of its blocking recv with EOF; close() alone leaves it
+            # parked on the dead fd forever — a daemon-thread leak the
+            # threaded stress test pins (tests/test_concurrency.py).
+            # The reader's exit then enqueues the dispatcher's sentinel,
+            # so both driver threads wind down.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -325,7 +362,7 @@ class _RemoteStorage:
         self.doc_id = doc_id
         self._last_uploaded: Optional[SummaryTree] = None
         self._snapshot_cache: "dict[str, SummaryTree]" = {}
-        rpc._epoch_listeners.append(weakref.WeakMethod(self._drop_caches))
+        rpc.add_epoch_listener(weakref.WeakMethod(self._drop_caches))
 
     def _drop_caches(self) -> None:
         self._snapshot_cache.clear()

@@ -160,12 +160,13 @@ class PackCache:
         # claim unconditional instead of relying on callers never
         # sharing a token tuple across concurrent pack() calls.
         self._extend_lock = threading.Lock()
-        self._entries: dict = {}  # tokens -> _PackEntry (insertion = LRU)
-        self._bytes = 0
+        # tokens -> _PackEntry (insertion order = LRU order)
+        self._entries: dict = {}  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
         self.counters = CounterSet(
             "exact_hits", "suffix_hits", "misses", "bypass", "inserts",
             "evictions",
-        )
+        )  # guarded-by: _lock (CounterSet is not internally synchronized)
 
     def stats(self) -> dict:
         with self._lock:
@@ -212,7 +213,7 @@ class PackCache:
 
     # -- bookkeeping -----------------------------------------------------------
 
-    def _touch(self, tokens) -> None:
+    def _touch(self, tokens) -> None:  # holds-lock: _lock
         entry = self._entries.pop(tokens, None)
         if entry is not None:
             self._entries[tokens] = entry

@@ -155,11 +155,11 @@ class SummaryStorage:
         #: never silently mix with a new one.  File-backed stores persist
         #: it (restart = same epoch; a wiped/recreated dir = new epoch).
         self.epoch: str = uuid.uuid4().hex
-        self._objects: Dict[str, Union[SummaryTree, SummaryBlob]] = {}
-        self._commit_objects: Dict[str, SummaryCommit] = {}
-        self._refs: Dict[str, Dict[str, str]] = {}  # doc -> ref -> commit
+        self._objects: Dict[str, Union[SummaryTree, SummaryBlob]] = {}  # guarded-by: _lock
+        self._commit_objects: Dict[str, SummaryCommit] = {}  # guarded-by: _lock
+        self._refs: Dict[str, Dict[str, str]] = {}  # guarded-by: _lock (doc -> ref -> commit)
         # (doc, tree, ref_seq) -> newest commit digest; O(1) ack stamping.
-        self._commit_index: Dict[tuple, str] = {}
+        self._commit_index: Dict[tuple, str] = {}  # guarded-by: _lock
         # Serializes the head read-modify-write of the commit chain: the
         # server runs bulk catch-up uploads on an executor thread while
         # client uploads ride the event loop — unsynchronized, whichever
@@ -182,6 +182,7 @@ class SummaryStorage:
     # -- commit/ref history chain ----------------------------------------------
 
     def _record_commit(self, commit: SummaryCommit) -> None:
+        # holds-lock: _lock
         digest = commit.digest()
         self._commit_objects[digest] = commit
         self._commit_index[
@@ -190,17 +191,23 @@ class SummaryStorage:
         self._set_ref(commit.doc_id, self.DEFAULT_REF, digest)
 
     def _set_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
+        # holds-lock: _lock
         self._refs.setdefault(doc_id, {})[name] = commit_digest
 
     def head(self, doc_id: str, ref: str = DEFAULT_REF) -> Optional[str]:
-        """Commit digest the ref points at, or None."""
-        return self._refs.get(doc_id, {}).get(ref)
+        """Commit digest the ref points at, or None.  Readers take the
+        (re-entrant) lock too: the chain is read from executor threads
+        while event-loop uploads advance it (fluidrace FL-RACE-GUARD)."""
+        with self._lock:
+            return self._refs.get(doc_id, {}).get(ref)
 
     def read_commit(self, digest: str) -> SummaryCommit:
-        return self._commit_objects[digest]
+        with self._lock:
+            return self._commit_objects[digest]
 
     def refs(self, doc_id: str) -> Dict[str, str]:
-        return dict(self._refs.get(doc_id, {}))
+        with self._lock:
+            return dict(self._refs.get(doc_id, {}))
 
     def create_ref(self, doc_id: str, name: str, commit_digest: str) -> None:
         """Pin a named ref (tag/branch) at an existing commit.  ``main`` is
@@ -223,7 +230,9 @@ class SummaryStorage:
         """Generator over the parent chain from ``digest``, newest first;
         a missing link is reported as corruption, not a bare KeyError."""
         while digest is not None:
-            commit = self._commit_objects.get(digest)
+            with self._lock:  # point read per step: a generator must not
+                # pin the store lock across its consumer's loop body
+                commit = self._commit_objects.get(digest)
             if commit is None:
                 raise ValueError(
                     f"corrupt commit chain: commit {digest} is missing "
@@ -264,7 +273,8 @@ class SummaryStorage:
         summarize op carries, so content-identical trees uploaded at
         different sequence points resolve to their own commits (scribe
         stamps this into summary acks)."""
-        return self._commit_index.get((doc_id, tree_handle, ref_seq))
+        with self._lock:
+            return self._commit_index.get((doc_id, tree_handle, ref_seq))
 
     def upload_obj(self, doc_id: str, obj: dict, ref_seq: int) -> str:
         """Upload from a (possibly INCREMENTAL) wire object: ``{"h": ...}``
@@ -278,9 +288,11 @@ class SummaryStorage:
         return self.upload(doc_id, tree, ref_seq)
 
     def has(self, handle: str) -> bool:
-        return handle in self._objects
+        with self._lock:
+            return handle in self._objects
 
     def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
+        # holds-lock: _lock
         digest = node.digest()
         self._objects[digest] = node
         if isinstance(node, SummaryTree):
@@ -325,7 +337,8 @@ class SummaryStorage:
             return handle
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
-        return self._objects[handle]
+        with self._lock:
+            return self._objects[handle]
 
 
 # -- wire codec (versioned) ----------------------------------------------------

@@ -39,6 +39,13 @@ from typing import Dict, NamedTuple, Optional, Tuple
 from ..protocol.summary import SummaryBlob, SummaryTree
 from ..utils.telemetry import CounterSet
 
+#: default bound for join(): a crashed leader must never hang a follower
+#: forever, even at call sites that never thought about timeouts
+#: (CatchupService.JOIN_TIMEOUT carries the same value; the
+#: Catchup.JoinTimeout gate overrides it per service).  Pass
+#: timeout=None explicitly to wait unbounded.
+DEFAULT_JOIN_TIMEOUT = 60.0
+
 #: accounting overhead charged per summary node (name + dict slot + object
 #: headers) so byte budgets track real memory, not just blob payloads.
 NODE_OVERHEAD = 96
@@ -86,23 +93,28 @@ class CatchupResultCache:
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         # dict insertion order IS the LRU order (touch = delete+reinsert).
-        self._entries: Dict[tuple, Tuple[CachedFold, int]] = {}
-        self._bytes = 0
-        self._flights: Dict[tuple, _Flight] = {}
-        self._last_epoch: Optional[str] = None  # invalidate fast path
+        self._entries: Dict[tuple, Tuple[CachedFold, int]] = {}  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._flights: Dict[tuple, _Flight] = {}  # guarded-by: _lock
+        self._last_epoch: Optional[str] = None  # guarded-by: _lock (invalidate fast path)
         self.counters = CounterSet(
             "hits", "misses", "inserts", "evictions", "waits",
             "invalidations",
-        )
+        )  # guarded-by: _lock (CounterSet is not internally synchronized)
 
     # -- introspection ---------------------------------------------------------
 
     @property
     def current_bytes(self) -> int:
-        return self._bytes
+        # Under the lock (fluidrace FL-RACE-GUARD): `_bytes` is adjusted
+        # in multi-step insert/evict sequences — an unlocked read could
+        # observe a torn mid-eviction value.
+        with self._lock:
+            return self._bytes
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -200,11 +212,20 @@ class CatchupResultCache:
             flight.done.set()
 
     def join(self, key: tuple,
-             timeout: Optional[float] = None) -> Optional[CachedFold]:
+             timeout: Optional[float] = DEFAULT_JOIN_TIMEOUT
+             ) -> Optional[CachedFold]:
         """Wait-or-read: the cached (tree, handle); else, when a leader
         is in flight, block until it publishes and return its result
         (None if it abandoned or ``timeout`` elapsed); else None
-        immediately."""
+        immediately.
+
+        A timeout presumes the leader crashed without reaching its
+        finally-abandon: the flight is removed — only if it is still
+        THE flight this caller waited on, so a fresh leader's flight is
+        never popped — and its event set, waking every other waiter
+        stuck on the dead leader (they retry or fold themselves).  A
+        merely-slow leader losing its flight is benign: ``finish`` on a
+        popped flight still publishes to the LRU."""
         with self._lock:
             found = self._get_locked(key)
             if found is not None:
@@ -215,6 +236,15 @@ class CatchupResultCache:
                 return None  # probe only: begin() counts the miss
             self.counters.bump("waits")
         if not flight.done.wait(timeout):
+            with self._lock:
+                if self._flights.get(key) is flight:
+                    self._flights.pop(key)
+                    # set() only for the flight this caller reaped: when
+                    # the guard fails, whoever popped it (finish/abandon/
+                    # another reaper) sets the event once the result is
+                    # in place — setting it here would wake the other
+                    # waiters to result=None on a COMPLETED fold.
+                    flight.done.set()
             return None
         return flight.result
 

@@ -192,8 +192,8 @@ class LocalOrderingService:
         #: deployments agree; content-addressed nodes can be owned by many
         #: tenants at once.  A production store would prune these with
         #: summary eviction; entries are per-node and tiny.
-        self.handle_tenants: Dict[str, set] = {}
-        self._orderers: Dict[str, DocumentOrderer] = {}
+        self.handle_tenants: Dict[str, set] = {}  # guarded-by: state_lock
+        self._orderers: Dict[str, DocumentOrderer] = {}  # guarded-by: state_lock
         #: guards handle_tenants and lazy orderer creation: the network
         #: front door offloads catchup/upload_summary to executor THREADS
         #: that mutate these maps concurrently with event-loop dispatches
@@ -249,8 +249,15 @@ class LocalOrderingService:
         oplog: OpLog, storage: SummaryStorage, checkpoint: dict
     ) -> "LocalOrderingService":
         service = LocalOrderingService(oplog, storage)
-        for doc_id, doc_checkpoint in checkpoint.items():
-            service._orderers[doc_id] = DocumentOrderer.restore(
+        # Replay OUTSIDE the lock — state_lock is a dict-operations-only
+        # lock (see endpoint()), and per-document restore is seconds of
+        # work — then publish everything in one locked dict update.
+        restored = {
+            doc_id: DocumentOrderer.restore(
                 doc_id, oplog, storage, doc_checkpoint
             )
+            for doc_id, doc_checkpoint in checkpoint.items()
+        }
+        with service.state_lock:
+            service._orderers.update(restored)
         return service

@@ -172,8 +172,10 @@ class OrderingServer:
 
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
-        self._catchup = None  # lazy CatchupService (the "catchup" method)
-        self._catchup_init = threading.Lock()  # executor threads race init
+        # lazy CatchupService (the "catchup" method); executor threads
+        # race the init.
+        self._catchup = None  # guarded-by: _catchup_init
+        self._catchup_init = threading.Lock()
 
     # -- tenancy scoping -------------------------------------------------------
 
@@ -316,13 +318,18 @@ class OrderingServer:
             with self._catchup_init:
                 if self._catchup is None:
                     self._catchup = CatchupService(service)
-            if self._catchup.cache is not None:
+                # Hand the instance out of the critical section as a
+                # local: every later use reads the local, not the guarded
+                # attribute (fluidrace FL-RACE-GUARD — the instance is
+                # immutable-once-set, the attribute slot is not).
+                catchup = self._catchup
+            if catchup.cache is not None:
                 # Epoch-keyed invalidation (EpochTracker parity for the
                 # SERVER's own fold cache): entries are keyed by the
                 # storage generation so a recreated store can never be
                 # served a stale fold — dropping dead-generation entries
                 # here just frees the budget immediately.
-                self._catchup.cache.invalidate_epoch(
+                catchup.cache.invalidate_epoch(
                     service.storage.epoch)
             doc_ids = params.get("docs")
             prefix = f"{session.tenant}/" if self.tenants is not None else ""
@@ -332,7 +339,7 @@ class OrderingServer:
                 doc_ids = [d for d in service.doc_ids()
                            if d.startswith(prefix)]
             stats: dict = {}
-            results = self._catchup.catch_up(doc_ids, stats=stats)
+            results = catchup.catch_up(doc_ids, stats=stats)
             out = {}
             for doc_id, (handle, seq) in results.items():
                 self._grant_tree(service.storage.read(handle),
@@ -351,8 +358,8 @@ class OrderingServer:
                 # Cumulative fold-cache health (hits/misses/evictions/
                 # waits + bytes) — operators watching a herd of loading
                 # clients see the single-flight amortization here.
-                "cache": (self._catchup.cache.stats()
-                          if self._catchup.cache is not None else None),
+                "cache": (catchup.cache.stats()
+                          if catchup.cache is not None else None),
             }
         if method == "latest_summary":
             epoch = service.storage.epoch

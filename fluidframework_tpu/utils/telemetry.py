@@ -122,11 +122,6 @@ class CounterSet:
     def snapshot(self) -> Dict[str, int]:
         return dict(self._counts)
 
-    def send_to(self, logger, event_name: str, **properties) -> None:
-        """Emit one event carrying every counter (cache hit/miss/evict
-        telemetry rides the same logger tree as everything else)."""
-        logger.send({"eventName": event_name, **self._counts, **properties})
-
 
 class ConfigProvider:
     """Layered feature gates: explicit dict over environment variables

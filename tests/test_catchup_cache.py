@@ -115,6 +115,114 @@ def test_single_flight_abandon_unblocks_waiters():
     assert cache.lookup(key) is None
 
 
+def test_join_timeout_returns_none_when_leader_never_finishes():
+    """The bounded-wait contract (fluidrace, ISSUE 4): a leader that died
+    without finish/abandon must not hang a follower — join(timeout)
+    returns None once the budget elapses."""
+    import time
+
+    cache = CatchupResultCache()
+    key = ("e", "doc")
+    assert cache.begin(key)[0] == "lead"  # ...and the leader "crashes"
+    t0 = time.monotonic()
+    assert cache.join(key, timeout=0.1) is None
+    assert time.monotonic() - t0 < 10
+    assert cache.stats()["waits"] == 1
+
+
+def test_join_timeout_pop_is_identity_guarded():
+    """A timed-out waiter removes the flight it actually waited on —
+    never a fresh leader's flight that replaced it in the race window
+    (popping that would degrade the herd's single-flight to N folds)."""
+    import time
+
+    cache = CatchupResultCache()
+    key = ("e", "doc")
+    assert cache.begin(key)[0] == "lead"
+    got = []
+    waiter = threading.Thread(
+        target=lambda: got.append(cache.join(key, timeout=0.8)))
+    waiter.start()
+    time.sleep(0.1)
+    # Simulate the race: the stale flight vanishes (crashed leader's
+    # flight reaped) and a NEW leader begins before the timeout fires.
+    with cache._lock:
+        cache._flights.pop(key)
+    assert cache.begin(key)[0] == "lead"
+    fresh = cache._flights[key]
+    waiter.join(timeout=10)
+    assert got == [None]
+    assert cache._flights.get(key) is fresh, \
+        "live flight must survive a stale waiter's timeout"
+
+
+def test_stale_timeout_reaper_does_not_wake_live_waiters():
+    """The reap path sets the event ONLY for the flight it actually
+    popped: when finish() has already popped the flight but not yet
+    published, a timed-out waiter setting done would wake every other
+    waiter to result=None on a successfully COMPLETED fold (they would
+    all fall through and fold again, serialized)."""
+    import time
+
+    cache = CatchupResultCache()
+    key = ("e", "doc")
+    assert cache.begin(key)[0] == "lead"
+    flight = cache._flights[key]
+    got_timeout, got_result = [], []
+    stale = threading.Thread(
+        target=lambda: got_timeout.append(cache.join(key, timeout=0.3)))
+    live = threading.Thread(
+        target=lambda: got_result.append(cache.join(key, timeout=30)))
+    stale.start()
+    live.start()
+    time.sleep(0.1)
+    # finish() preempted mid-publish: flight popped, result not yet set
+    with cache._lock:
+        cache._flights.pop(key)
+    stale.join(timeout=10)
+    assert got_timeout == [None]
+    assert not flight.done.is_set(), \
+        "a guard-failed reaper must not wake the leader's other waiters"
+    assert not got_result, "live waiter woken before the result exists"
+    # the preempted finish() resumes: publish, then wake
+    flight.result = "fold-result"
+    flight.done.set()
+    live.join(timeout=10)
+    assert got_result == ["fold-result"]
+
+
+def test_catch_up_survives_crashed_leader():
+    """Service-level timeout fallback: a key left in flight forever (the
+    leader thread was killed before its finally-abandon) times the
+    follower out, the dead flight is abandoned, and the follower folds
+    the document itself — with a byte-identical result."""
+    import time
+
+    service = LocalOrderingService()
+    bench.build_catchup_corpus(service, 1, 12)
+    svc = CatchupService(service, mesh=None)
+    svc.join_timeout = 0.2
+    _summary, ref_seq, handle = service.storage.latest_with_handle("cdoc0")
+    tail = service.oplog.get("cdoc0", from_seq=ref_seq)
+    key = svc._cache_key("cdoc0", handle, ref_seq, tail)
+    assert svc.cache.begin(key)[0] == "lead"  # the crashed leader
+    t0 = time.monotonic()
+    results = svc.catch_up(["cdoc0"], upload=False)
+    assert time.monotonic() - t0 < 30, "follower must not hang"
+    fresh = CatchupService(service, cache=None, mesh=None)
+    assert results == fresh.catch_up(["cdoc0"], upload=False)
+    # the dead flight was abandoned: nothing in flight, entry published,
+    # so the next herd single-flights normally again
+    assert svc.cache._flights == {}
+    assert svc.catch_up(["cdoc0"], upload=False) == results
+
+
+def test_join_timeout_config_gate(monkeypatch):
+    monkeypatch.setenv("FLUID_TPU_CATCHUP_JOINTIMEOUT", "7.5")
+    svc = CatchupService(LocalOrderingService(), mesh=None)
+    assert svc.join_timeout == 7.5
+
+
 def test_concurrent_catch_up_threads_cost_one_fold():
     """The thundering-herd contract: N concurrent catch-ups of the same
     (doc, seq) → ONE fold; the rest wait on the in-flight key and serve

@@ -12,7 +12,7 @@ import subprocess
 import sys
 
 from tools.fluidlint import (all_rules, analyze, apply_baseline,
-                             load_baseline)
+                             baseline_function_hygiene, load_baseline)
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 BASELINE = ROOT / "lint_baseline.json"
@@ -28,6 +28,11 @@ def test_package_lints_clean():
         f"baseline stale (matched no finding): [{e.get('rule')}] "
         f"{e.get('path')}: {e.get('message')}" for e in report.stale
     ]
+    # Hygiene: function-scoped suppression keys rot when the function
+    # they name disappears; a rotten entry fails the gate like a stale
+    # one (the finding it reviewed no longer describes live code).
+    problems += [f"baseline hygiene: {m}"
+                 for m in baseline_function_hygiene(ROOT, entries)]
     assert not problems, (
         "fluidlint gate failed — fix the finding or add a REVIEWED "
         "suppression (with reason) to lint_baseline.json:\n"
@@ -36,7 +41,7 @@ def test_package_lints_clean():
 
 def test_every_rule_registered_and_described():
     rules = all_rules()
-    assert len(rules) >= 9, sorted(rules)
+    assert len(rules) >= 15, sorted(rules)  # 9 (PR 2) + 6 fluidrace
     for name, rule in rules.items():
         assert rule.description, f"{name} has no description"
         assert rule.severity in ("error", "warning"), name
@@ -62,3 +67,28 @@ def test_cli_exit_code_on_findings(tmp_path, capsys):
         "import time\n\ndef hold():\n    return time.time()\n")
     assert main(["--root", str(tmp_path)]) == 1
     assert "FL-DET-CLOCK" in capsys.readouterr().out
+
+
+def test_cli_write_baseline_bootstraps_missing_file(tmp_path, capsys):
+    """`--baseline X --write-baseline X` with no X yet is the bootstrap
+    flow: it must write the skeleton, not die on 'baseline not found'
+    (--write-baseline never reads the baseline)."""
+    import json
+
+    from tools.fluidlint.cli import main
+
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    out = tmp_path / "lint_baseline.json"
+    assert main(["--root", str(tmp_path), "--baseline", str(out),
+                 "--write-baseline", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert len(doc["suppressions"]) == 1
+    # a path that IS read (analysis / --check-baseline) still errors
+    missing = str(tmp_path / "nope.json")
+    assert main(["--root", str(tmp_path), "--baseline", missing]) == 2
+    assert main(["--root", str(tmp_path), "--baseline", missing,
+                 "--check-baseline"]) == 2
+    capsys.readouterr()

@@ -14,11 +14,13 @@ import textwrap
 import pytest
 
 from tools.fluidlint import (Finding, analyze, analyze_source,
-                             apply_baseline, load_baseline)
+                             apply_baseline, baseline_function_hygiene,
+                             load_baseline)
 
 OPS = "fluidframework_tpu/ops/x.py"          # replay + kernel scope
 LOADER = "fluidframework_tpu/loader/x.py"    # replay scope only
 RUNTIME = "fluidframework_tpu/runtime/x.py"  # event scope only
+SERVICE = "fluidframework_tpu/service/x.py"  # replay + serving scope
 TESTING = "fluidframework_tpu/testing/x.py"  # exempt everywhere
 
 
@@ -142,6 +144,151 @@ MODULE_RULE_FIXTURES = {
         """,
         OPS,
     ),
+    "FL-RACE-GUARD": (
+        """
+        import threading
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def size(self):
+                return len(self._entries)
+        """,
+        """
+        import threading
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def size(self):
+                with self._lock:
+                    return len(self._entries)
+        """,
+        SERVICE,
+    ),
+    "FL-RACE-BLOCKING": (
+        """
+        import threading
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def ping(self):
+                with self._lock:
+                    return self.request("ping", {})
+        """,
+        """
+        import threading
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def ping(self):
+                with self._lock:
+                    pending = True
+                return self.request("ping", {})
+        """,
+        SERVICE,
+    ),
+    "FL-RACE-ORDER": (
+        """
+        import threading
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """,
+        """
+        import threading
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """,
+        SERVICE,
+    ),
+    "FL-RACE-MUTITER": (
+        """
+        import threading
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def sweep(self):
+                with self._lock:
+                    for key in self._entries:
+                        self._entries.pop(key)
+        """,
+        """
+        import threading
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def sweep(self):
+                with self._lock:
+                    for key in list(self._entries):
+                        self._entries.pop(key)
+        """,
+        SERVICE,
+    ),
+    "FL-RACE-CHECKACT": (
+        """
+        import threading
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def put(self, k, v):
+                with self._lock:
+                    seen = k in self._entries
+                if not seen:
+                    with self._lock:
+                        self._entries[k] = v
+        """,
+        """
+        import threading
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}  # guarded-by: _lock
+            def put(self, k, v):
+                with self._lock:
+                    if k not in self._entries:
+                        self._entries[k] = v
+        """,
+        SERVICE,
+    ),
+    "FL-RACE-WAITFOREVER": (
+        """
+        import threading
+        def run(flight):
+            done = threading.Event()
+            done.wait()
+        """,
+        """
+        import threading
+        def run(flight):
+            done = threading.Event()
+            if not done.wait(timeout=30.0):
+                raise TimeoutError
+        """,
+        SERVICE,
+    ),
     "FL-EVENT-EMITITER": (
         """
         class Emitter:
@@ -250,6 +397,445 @@ def test_scan_argument_is_traced():
         return lax.scan(step, 0, xs)
     """
     assert findings_for(src, OPS, "FL-TRACE-HOSTSYNC")
+
+
+# -- fluidrace: the concurrency family ---------------------------------------
+
+
+RACE_PREAMBLE = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+"""
+
+
+def test_race_guard_inferred_without_annotation():
+    # all writes under one lock => the attribute is adopted as guarded;
+    # the unlocked read is a finding even with no '# guarded-by' comment
+    src = RACE_PREAMBLE + """
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def peek(self):
+        return self._n
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1 and "peek()" in hits[0].message
+
+
+def test_race_guard_ambiguous_multi_lock_inference_declined():
+    # writes only in a `_locked` method of a two-lock class are "held
+    # under ALL locks" — adopting either one would be a guess, flagging
+    # correctly-locked reads against the wrong lock; such attrs need an
+    # explicit declaration
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._extend_lock = threading.Lock()
+        self._n = 0
+    def _bump_locked(self):
+        self._n += 1
+    def peek(self):
+        with self._lock:
+            return self._n
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_guard_mixed_lock_writes_not_inferred():
+    # a write outside any lock makes the inference ambiguous — flagging
+    # reads would be noise; only a declaration enforces such an attr
+    src = RACE_PREAMBLE + """
+        self._n = 0
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def reset(self):
+        self._n = 0
+    def peek(self):
+        return self._n
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_guard_locked_suffix_and_holds_comment_exempt():
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+    def _get_locked(self, k):
+        return self._entries[k]
+    def fetch(self, k):  # holds-lock: _lock
+        return self._entries[k]
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_guard_holds_comment_may_follow_signature():
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+    def fetch(self, k):
+        # holds-lock: _lock
+        return self._entries[k]
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_guard_unknown_lock_declaration_is_flagged():
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _mutex
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1 and "_mutex" in hits[0].message
+
+
+def test_race_guard_unknown_holds_lock_annotation_is_flagged():
+    # a typo'd '# holds-lock:' must not silently exempt the method (and
+    # silently decline all-writes inference for what it writes)
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+    def fetch(self, k):  # holds-lock: _lokc
+        return self._entries[k]
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 2  # the bad annotation AND the unguarded read
+    bad = [h for h in hits if "_lokc" in h.message]
+    assert len(bad) == 1 and "fetch()" in bad[0].message
+
+
+def test_race_guard_known_holds_lock_annotation_not_flagged():
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+    def fetch(self, k):  # holds-lock: _lock
+        return self._entries[k]
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_guard_deferred_closure_is_not_lock_held():
+    # a callback defined under the lock RUNS later, without it
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+        self._cb = None
+    def kick(self):
+        with self._lock:
+            def cb():
+                return self._entries
+            self._cb = cb
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1
+    assert "deferred callback" in hits[0].message
+    assert "kick()" in hits[0].message
+
+
+def test_race_guard_messages_are_function_scoped():
+    src = RACE_PREAMBLE + """
+        self._n = 0  # guarded-by: _lock
+    def peek_a(self):
+        return self._n
+    def peek_b(self):
+        return self._n
+"""
+    msgs = {f.message for f in findings_for(src, SERVICE, "FL-RACE-GUARD")}
+    assert len(msgs) == 2
+    assert any("peek_a()" in m for m in msgs)
+    assert any("peek_b()" in m for m in msgs)
+
+
+def test_race_single_threaded_class_is_not_analyzed():
+    # no locks, no threads, no events: annotation-free and silent even
+    # with "racy"-looking access patterns
+    src = """
+class Plain:
+    def __init__(self):
+        self._entries = {}
+    def put(self, k, v):
+        self._entries[k] = v
+"""
+    for rule in ("FL-RACE-GUARD", "FL-RACE-CHECKACT", "FL-RACE-MUTITER"):
+        assert findings_for(src, SERVICE, rule) == []
+
+
+def test_race_order_self_deadlock_on_nonreentrant_lock():
+    src = RACE_PREAMBLE + """
+    def oops(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-ORDER")
+    assert len(hits) == 1 and "non-reentrant" in hits[0].message
+
+
+def test_race_order_rlock_self_nesting_allowed():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+    def fine(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-ORDER") == []
+
+
+def test_race_order_multi_item_with_acquires_sequentially():
+    # `with a, b:` orders a before b, so an opposite nested order in
+    # another method is a real cycle
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def one(self):
+        with self._a, self._b:
+            pass
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-ORDER")
+    assert len(hits) == 1 and "_a" in hits[0].message
+
+
+def test_race_order_cycle_reported_once_per_class():
+    bad, _good, _path = MODULE_RULE_FIXTURES["FL-RACE-ORDER"]
+    hits = findings_for(bad, SERVICE, "FL-RACE-ORDER")
+    assert len(hits) == 1
+    assert "_a" in hits[0].message and "_b" in hits[0].message
+
+
+def test_race_blocking_event_wait_under_lock():
+    src = RACE_PREAMBLE + """
+        self.done = threading.Event()
+    def stall(self):
+        with self._lock:
+            self.done.wait(5)
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and "stall()" in hits[0].message
+
+
+def test_race_class_level_lock_spelled_via_class_name():
+    # `with C._serial:` inside class C counts as acquiring C's own lock
+    src = """
+import threading
+class C:
+    _serial = threading.RLock()
+    def __init__(self):
+        self.n = 0  # guarded-by: _serial
+    def bump(self):
+        with C._serial:
+            self.n += 1
+    def peek(self):
+        return self.n
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1 and "peek()" in hits[0].message
+
+
+def test_race_checkact_ignores_deferred_writes():
+    # a callback DEFINED under the second acquisition does not mutate in
+    # that critical section — no check-then-act
+    src = RACE_PREAMBLE + """
+        self._entries = {}  # guarded-by: _lock
+        self._cb = None
+    def arm(self, k):
+        with self._lock:
+            seen = k in self._entries
+        if not seen:
+            with self._lock:
+                def cb():
+                    self._entries[k] = 1
+                self._cb = cb
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-CHECKACT") == []
+
+
+def test_race_non_lock_context_manager_not_adopted_as_lock():
+    # `with self._file:` on an attr visibly assigned a non-lock must not
+    # poison guard inference with a bogus '_file' lock
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._file = open("/dev/null")
+        self._n = 0
+    def write_a(self):
+        with self._file:
+            self._n += 1
+    def write_b(self):
+        with self._file:
+            self._n += 1
+    def peek(self):
+        return self._n
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_manual_acquire_method_exempt_not_flagged():
+    # imperative lock.acquire()/try/finally-release flow is beyond the
+    # lexical held-set: such methods are trusted, never false-positived
+    src = RACE_PREAMBLE + """
+        self._n = 0  # guarded-by: _lock
+    def manual(self):
+        self._lock.acquire()
+        try:
+            self._n = 1
+        finally:
+            self._lock.release()
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_method_local_lock_not_adopted_as_member():
+    # `lk = threading.Lock()` inside a method is a local, not a class
+    # lock; `with lk:` must not feed guard inference
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._real = threading.Lock()
+        self._n = 0
+    def bump(self):
+        lk = threading.Lock()
+        with lk:
+            self._n += 1
+    def peek(self):
+        return self._n
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-GUARD") == []
+
+
+def test_race_checkact_nested_reentrant_acquire_is_one_section():
+    # an RLock re-acquired inside its own critical section never
+    # releases in between — not a separate acquisition
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._m = {}  # guarded-by: _lock
+    def put(self):
+        with self._lock:
+            x = self._m.get(1)
+            with self._lock:
+                self._m[1] = 2
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-CHECKACT") == []
+
+
+def test_race_waitforever_only_on_serving_paths():
+    bad, _good, _path = MODULE_RULE_FIXTURES["FL-RACE-WAITFOREVER"]
+    assert findings_for(bad, RUNTIME, "FL-RACE-WAITFOREVER") == []
+
+
+def test_race_annotated_lock_assignment_still_analyzed():
+    # a type-hinted lock (AnnAssign) must not silently disable the class
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock: threading.Lock = threading.Lock()
+        self._m = {}  # guarded-by: _lock
+    def peek(self):
+        return self._m
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1 and "peek()" in hits[0].message
+
+
+def test_race_nested_class_model_does_not_leak_into_enclosing():
+    # Inner's lock + guarded-by must not make Outer thread-visible or
+    # flag Outer's same-named attribute; Inner itself is still analyzed
+    # (class_models builds a model per ClassDef, nested included)
+    src = """
+import threading
+class Outer:
+    def __init__(self):
+        self._m = {}
+    def touch(self):
+        return self._m
+    class Inner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._m = {}  # guarded-by: _lock
+        def peek(self):
+            return self._m
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1
+    assert "Inner" in hits[0].message and "peek()" in hits[0].message
+
+
+def test_race_bare_annotated_lock_declaration_recognized():
+    # a value-less typed declaration (`_lock: threading.Lock`, assigned
+    # by a base/harness) must keep the class thread-visible and serve as
+    # a guard — not silently disable the whole analysis
+    src = """
+import threading
+class C:
+    _lock: threading.Lock
+    def __init__(self):
+        self._m = {}  # guarded-by: _lock
+    def put(self, k, v):
+        with self._lock:
+            self._m[k] = v
+    def peek(self):
+        return self._m
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-GUARD")
+    assert len(hits) == 1 and "peek()" in hits[0].message
+
+
+def test_race_condition_wait_under_its_lock_not_blocking():
+    # Condition.wait() REQUIRES the lock held (it releases internally):
+    # the canonical pattern must not be a blocking-under-lock finding...
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+    def consume(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(5.0)
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-BLOCKING") == []
+    # ...but a timeout-less Condition.wait() still hangs a crashed
+    # notifier's waiters: FL-RACE-WAITFOREVER owns that case.
+    src_no_timeout = src.replace("self._cond.wait(5.0)",
+                                 "self._cond.wait()")
+    hits = findings_for(src_no_timeout, SERVICE, "FL-RACE-WAITFOREVER")
+    assert len(hits) == 1 and "consume()" in hits[0].message
+
+
+def test_race_blocking_messages_survive_baseline_hygiene(tmp_path):
+    # the bare-acquire message spells '.acquire()' dot-prefixed so a
+    # reviewed suppression of it can actually pass the hygiene check
+    src = RACE_PREAMBLE + """
+        self._other = threading.Lock()
+    def grab(self):
+        with self._lock:
+            self._other.acquire()
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and ".acquire()" in hits[0].message
+    pkg = tmp_path / "fluidframework_tpu" / "service"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text(textwrap.dedent(src))
+    entry = {"rule": "FL-RACE-BLOCKING", "path": SERVICE,
+             "message": hits[0].message, "reason": "reviewed"}
+    assert baseline_function_hygiene(tmp_path, [entry]) == []
 
 
 # -- project rule: FL-WIRE-COMPLETE ------------------------------------------
@@ -437,3 +1023,126 @@ def test_load_baseline_rejects_non_object(tmp_path):
     p.write_text(json.dumps(["not", "an", "object"]))
     with pytest.raises(ValueError):
         load_baseline(p)
+
+
+# -- baseline function hygiene ------------------------------------------------
+
+
+def _hygiene_tree(tmp_path, body="def hold():\n    return 1\n"):
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(body)
+    return "fluidframework_tpu/loader/mod.py"
+
+
+def _hygiene_entry(path, msg):
+    return {"rule": "FL-DET-CLOCK", "path": path, "message": msg,
+            "reason": "reviewed"}
+
+
+def test_hygiene_flags_entry_for_deleted_function(tmp_path):
+    path = _hygiene_tree(tmp_path)
+    entries = [_hygiene_entry(path, "wall-clock read in vanished()")]
+    problems = baseline_function_hygiene(tmp_path, entries)
+    assert len(problems) == 1 and "vanished" in problems[0]
+
+
+def test_hygiene_accepts_live_function_reference(tmp_path):
+    path = _hygiene_tree(tmp_path)
+    entries = [_hygiene_entry(path, "wall-clock read in hold()")]
+    assert baseline_function_hygiene(tmp_path, entries) == []
+
+
+def test_hygiene_ignores_builtins_and_dotted_calls(tmp_path):
+    # "time.time()" names an API, "int()" a builtin — neither is a
+    # function-scoped key; only bare local names count
+    path = _hygiene_tree(tmp_path)
+    entries = [_hygiene_entry(
+        path, "int() via time.time() then str.join() somewhere")]
+    assert baseline_function_hygiene(tmp_path, entries) == []
+
+
+def test_hygiene_flags_entry_for_deleted_file(tmp_path):
+    _hygiene_tree(tmp_path)
+    entries = [_hygiene_entry("fluidframework_tpu/loader/gone.py",
+                              "wall-clock read in hold()")]
+    problems = baseline_function_hygiene(tmp_path, entries)
+    assert len(problems) == 1 and "no longer exists" in problems[0]
+
+
+def test_hygiene_fails_the_cli_gate(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    path = _hygiene_tree(tmp_path)
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        # message matches nothing AND names a dead function: surface the
+        # hygiene diagnostic alongside staleness, and fail
+        _hygiene_entry(path, "wall-clock read in vanished()")]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp)]) == 1
+    out = capsys.readouterr().out
+    assert "vanished" in out and "hygiene" in out
+
+
+def test_check_baseline_mode_runs_without_analysis(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    path = _hygiene_tree(tmp_path)
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        _hygiene_entry(path, "wall-clock read in hold()")]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--check-baseline"]) == 0
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        _hygiene_entry(path, "wall-clock read in vanished()")]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--check-baseline"]) == 1
+
+
+# -- CLI: --rules family filtering & --json -----------------------------------
+
+
+def _clock_violation_tree(tmp_path):
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+
+
+def test_rules_filter_excludes_other_families(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    _clock_violation_tree(tmp_path)
+    # The clock violation is invisible to a FL-RACE-only run...
+    assert main(["--root", str(tmp_path), "--rules", "FL-RACE"]) == 0
+    capsys.readouterr()
+    # ...and still red for the family that owns it (prefix match).
+    assert main(["--root", str(tmp_path), "--rules", "FL-DET"]) == 1
+    assert "FL-DET-CLOCK" in capsys.readouterr().out
+
+
+def test_rules_filter_spares_out_of_family_suppressions(tmp_path):
+    # entries for unselected rules are ignored, not reported stale
+    from tools.fluidlint.cli import main
+    _clock_violation_tree(tmp_path)
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "FL-DET-CLOCK",
+         "path": "fluidframework_tpu/loader/bad.py",
+         "message": "m-that-matches-nothing", "reason": "reviewed"}]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--rules", "FL-RACE"]) == 0
+
+
+def test_rules_filter_rejects_unknown_family(tmp_path):
+    from tools.fluidlint.cli import main
+    _clock_violation_tree(tmp_path)
+    assert main(["--root", str(tmp_path), "--rules", "FL-NOPE"]) == 2
+
+
+def test_json_flag_emits_machine_readable_report(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    _clock_violation_tree(tmp_path)
+    assert main(["--root", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["unsuppressed"], doc
+    assert doc["unsuppressed"][0]["rule"] == "FL-DET-CLOCK"
+    assert set(doc) == {"unsuppressed", "suppressed", "stale_suppressions",
+                       "invalid_suppressions", "baseline_hygiene"}

@@ -16,6 +16,7 @@ from .core import (  # noqa: F401
     analyze,
     analyze_source,
     apply_baseline,
+    baseline_function_hygiene,
     baseline_skeleton,
     load_baseline,
     register,

@@ -1,11 +1,13 @@
 """fluidlint command line.
 
     python -m tools.fluidlint [--root DIR] [--baseline FILE]
-                              [--format text|json] [--list-rules]
-                              [--write-baseline FILE] [paths ...]
+                              [--rules FAMILY[,FAMILY...]]
+                              [--format text|json | --json] [--list-rules]
+                              [--check-baseline] [--write-baseline FILE]
+                              [paths ...]
 
-Exit codes: 0 clean, 1 unsuppressed findings / stale or invalid baseline,
-2 usage error.
+Exit codes: 0 clean, 1 unsuppressed findings / stale or invalid baseline /
+baseline hygiene failure, 2 usage error.
 """
 
 from __future__ import annotations
@@ -17,7 +19,8 @@ import sys
 from typing import List, Optional
 
 from .core import (ProjectRule, all_rules, analyze, apply_baseline,
-                   baseline_skeleton, load_baseline)
+                   baseline_function_hygiene, baseline_skeleton,
+                   load_baseline)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -33,14 +36,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--baseline", default=None,
                         help="baseline suppression file (JSON)")
     parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output (alias for "
+                             "--format json)")
+    parser.add_argument("--rules", default=None, metavar="FAMILY",
+                        help="comma-separated rule ids or family prefixes "
+                             "to run (e.g. 'FL-RACE' or "
+                             "'FL-DET-CLOCK,FL-TRACE'); baseline entries "
+                             "for other rules are ignored, not stale")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--check-baseline", action="store_true",
+                        help="baseline hygiene only: fail when an entry's "
+                             "message references a function that no "
+                             "longer exists (no analysis pass)")
     parser.add_argument("--write-baseline", metavar="FILE",
                         help="write a baseline skeleton covering current "
                              "findings (reasons left empty for review)")
     args = parser.parse_args(argv)
+    if args.json:
+        args.format = "json"
+
+    rules = all_rules()
+    if args.rules:
+        families = [f.strip() for f in args.rules.split(",") if f.strip()]
+        rules = {name: rule for name, rule in rules.items()
+                 if any(name == f or name.startswith(f) for f in families)}
+        if not rules:
+            print(f"error: --rules {args.rules!r} selects no known rule "
+                  "(see --list-rules)", file=sys.stderr)
+            return 2
 
     if args.list_rules:
-        for name, rule in sorted(all_rules().items()):
+        for name, rule in sorted(rules.items()):
             print(f"{name} [{rule.severity}] {rule.description}")
         return 0
 
@@ -48,6 +75,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not root.is_dir():
         print(f"error: --root {root} is not a directory", file=sys.stderr)
         return 2
+    baseline_path = None
+    if args.baseline:
+        baseline_path = pathlib.Path(args.baseline)
+        if not baseline_path.is_absolute():
+            baseline_path = root / baseline_path
+        # --write-baseline never READS the baseline: bootstrapping the
+        # first baseline at the gate's own path must not fail on its
+        # not existing yet
+        if not baseline_path.is_file() and \
+                (args.check_baseline or not args.write_baseline):
+            print(f"error: baseline {baseline_path} not found",
+                  file=sys.stderr)
+            return 2
     relpaths = None
     if args.paths:
         # Normalize to root-relative posix form: rule scopes are prefix
@@ -65,7 +105,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print(f"error: {p} is outside --root {root}",
                           file=sys.stderr)
                     return 2
-    findings = analyze(root, relpaths=relpaths)
+    if args.check_baseline:
+        if baseline_path is None:
+            print("error: --check-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        problems = baseline_function_hygiene(root,
+                                             load_baseline(baseline_path))
+        for msg in problems:
+            print(f"baseline: {msg}")
+        print(f"fluidlint: baseline hygiene — {len(problems)} problem(s)")
+        return 1 if problems else 0
+
+    findings = analyze(root, relpaths=relpaths, rules=rules)
 
     if args.write_baseline:
         doc = baseline_skeleton(findings)
@@ -77,14 +129,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     entries = []
-    if args.baseline:
-        bp = pathlib.Path(args.baseline)
-        if not bp.is_absolute():
-            bp = root / bp
-        if not bp.is_file():
-            print(f"error: baseline {bp} not found", file=sys.stderr)
-            return 2
-        entries = load_baseline(bp)
+    if baseline_path is not None:
+        entries = load_baseline(baseline_path)
         if relpaths is not None:
             # Path-scoped run: entries for files outside the analyzed
             # subset — and for project rules, which analyze() skips when
@@ -96,7 +142,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             entries = [e for e in entries
                        if e.get("path") in in_scope
                        and e.get("rule") not in project_rules]
+        if args.rules:
+            # Rule-scoped run: same logic for entries of unselected rules.
+            entries = [e for e in entries if e.get("rule") in rules]
     report = apply_baseline(findings, entries)
+    hygiene = baseline_function_hygiene(root, entries)
+    clean = report.clean and not hygiene
 
     if args.format == "json":
         print(json.dumps({
@@ -104,12 +155,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             "suppressed": [f.__dict__ for f in report.suppressed],
             "stale_suppressions": report.stale,
             "invalid_suppressions": report.invalid,
+            "baseline_hygiene": hygiene,
         }, indent=2))
-        return 0 if report.clean else 1
+        return 0 if clean else 1
 
     for f in report.unsuppressed:
         print(f.render())
     for msg in report.invalid:
+        print(f"baseline: {msg}")
+    for msg in hygiene:
         print(f"baseline: {msg}")
     for e in report.stale:
         print(f"baseline: stale suppression (matched no finding): "
@@ -118,8 +172,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     n_warn = len(report.unsuppressed) - n_err
     print(f"fluidlint: {n_err} error(s), {n_warn} warning(s), "
           f"{len(report.suppressed)} suppressed, "
-          f"{len(report.stale)} stale suppression(s)")
-    return 0 if report.clean else 1
+          f"{len(report.stale)} stale suppression(s), "
+          f"{len(hygiene)} hygiene problem(s)")
+    return 0 if clean else 1
 
 
 if __name__ == "__main__":

@@ -16,9 +16,13 @@ fail the gate so the baseline can only shrink through review.
 from __future__ import annotations
 
 import ast
+import builtins
 import dataclasses
+import io
 import json
 import pathlib
+import re
+import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 SEVERITIES = ("error", "warning")
@@ -102,6 +106,36 @@ class ModuleContext:
     tree: ast.Module
     source: str
     imports: ImportMap
+    _comments: Optional[Dict[int, str]] = None
+
+    @property
+    def comments(self) -> Dict[int, str]:
+        """lineno -> comment text (sans ``#``) for every comment token.
+
+        Rules that honor comment conventions (``# guarded-by: _lock``,
+        ``# holds-lock: _lock``) read annotations here; the AST alone
+        drops comments.  Lazy — only comment-aware rules pay for the
+        tokenize pass."""
+        if self._comments is None:
+            out: Dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string.lstrip("#").strip()
+            except (tokenize.TokenError, IndentationError):
+                pass  # unparsable tail: annotations simply absent
+            self._comments = out
+        return self._comments
+
+    def stmt_comment(self, node: ast.AST) -> str:
+        """Trailing comment on a statement's first or last line ('' when
+        none).  Multi-line statements may carry the annotation on the
+        closing line (``)  # guarded-by: _lock``)."""
+        first = self.comments.get(getattr(node, "lineno", 0), "")
+        if first:
+            return first
+        return self.comments.get(getattr(node, "end_lineno", 0), "")
 
     def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
         return Finding(
@@ -306,6 +340,69 @@ def apply_baseline(findings: Sequence[Finding],
             unsuppressed.append(f)
     stale = [e for k, e in keys.items() if k not in matched]
     return BaselineReport(unsuppressed, suppressed, stale, invalid)
+
+
+#: ``name()`` references in finding messages (the function-scoped key
+#: convention).  A leading ``.`` or word char means a method/dotted call
+#: (``time.time()``, ``.item()``) — those name APIs, not local functions.
+_FUNC_REF = re.compile(r"(?<![.\w])([A-Za-z_]\w*)\(\)")
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def baseline_function_hygiene(root: pathlib.Path,
+                              entries: Sequence[dict]) -> List[str]:
+    """Entries whose message names a function that no longer exists in
+    the entry's file.
+
+    Suppression keys are function-scoped on purpose (messages embed the
+    owning ``def``'s name), so when that function is deleted or renamed
+    the reviewed reason no longer describes anything real.  Staleness
+    catches most of this — the finding disappears with the function —
+    but a hygiene failure pinpoints *why* the entry is dead (file gone,
+    function gone) instead of a bare "matched no finding", and it runs
+    without a full analysis pass (``--check-baseline``)."""
+    root = pathlib.Path(root)
+    problems: List[str] = []
+    parsed: Dict[str, Optional[set]] = {}
+    for i, e in enumerate(entries):
+        path, msg = e.get("path"), e.get("message")
+        if not isinstance(path, str) or not isinstance(msg, str):
+            continue  # structurally invalid: apply_baseline reports it
+        refs = sorted({name for name in _FUNC_REF.findall(msg)
+                       if name not in _BUILTIN_NAMES})
+        if not refs:
+            continue
+        if path not in parsed:
+            p = root / path
+            if not p.is_file():
+                parsed[path] = None
+            else:
+                try:
+                    tree = ast.parse(p.read_text(encoding="utf-8"))
+                except SyntaxError:
+                    parsed[path] = None  # unparsable: let the gate's
+                    # analysis pass surface the real problem
+                else:
+                    parsed[path] = {
+                        n.name for n in ast.walk(tree)
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    }
+        defined = parsed[path]
+        if defined is None:
+            if not (root / path).is_file():
+                problems.append(
+                    f"suppression[{i}] ({e.get('rule')}, {path}): file no "
+                    "longer exists — delete or re-review the entry")
+            continue
+        missing = [name for name in refs if name not in defined]
+        if missing:
+            problems.append(
+                f"suppression[{i}] ({e.get('rule')}, {path}): message "
+                f"references function(s) {', '.join(missing)} that no "
+                "longer exist in that file — the reviewed finding is "
+                "gone; delete or re-review the entry")
+    return problems
 
 
 def baseline_skeleton(findings: Sequence[Finding]) -> dict:

@@ -4,6 +4,7 @@ Adding a rule = write it in the right themed module (or a new one) with
 the ``@register`` decorator, then import that module here.
 """
 
+from . import rules_concurrency  # noqa: F401
 from . import rules_determinism  # noqa: F401
 from . import rules_events       # noqa: F401
 from . import rules_trace        # noqa: F401

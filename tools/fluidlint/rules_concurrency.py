@@ -1,0 +1,861 @@
+"""fluidrace — lock-discipline & atomicity rules for the threaded serving
+path.
+
+PR 3 made serving genuinely concurrent: executor threads in
+``service/server.py``, single-flight fold caching in
+``service/catchup_cache.py``, reader/dispatcher threads in
+``drivers/network_driver.py``, and locks in ``ops/pipeline.py``,
+``protocol/summary.py`` and ``service/orderer.py``.  Nothing *enforced*
+that shared state is touched under the right lock — a data race survives
+every deterministic tier-1 test by definition.  In the spirit of Infer's
+RacerD (compositional, per-class reasoning) and Clang thread-safety
+analysis (``GUARDED_BY`` declarations), this family checks the lock
+discipline statically, per class, over the plain AST.
+
+The class model
+---------------
+
+A class is **thread-visible** when its state can be reached from more
+than one thread: it creates ``threading.Thread``s, owns
+``Lock``/``RLock``/``Condition``/``Event`` members, or acquires a lock
+attribute it inherits (``with self._lock:`` with no local assignment).
+Only thread-visible classes are analyzed — single-threaded classes stay
+annotation-free and silent.
+
+The guarded-by relation maps attributes to the lock that protects them:
+
+- **declared**: a trailing comment ``# guarded-by: <lock>`` on the
+  attribute's assignment (conventionally in ``__init__``; for multi-line
+  assignments the closing line works too);
+- **inferred**: every write outside ``__init__`` happens under the same
+  held lock — the attribute is adopted as guarded by it.
+
+A method is *lock-held* (its body runs with a lock already acquired by
+its callers) when its name ends in ``_locked`` (all class locks assumed)
+or its ``def`` line carries ``# holds-lock: <lock>[, <lock>]``.  Held
+methods are exempt from the outside-lock check and their writes count as
+locked for inference.  Nested functions/lambdas defined under a ``with``
+run *later*, possibly on another thread — they are analyzed with an
+empty held set.
+
+Known limits (document, don't pretend): the analysis is per class and
+per file — cross-object guarding (``self.service.state_lock`` protecting
+``self.service.handle_tenants``) and inherited annotations are invisible,
+and interprocedural lock flow is only visible through the two held-method
+conventions above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleContext, Rule, register
+
+#: serving paths: the places where an unbounded wait hangs a client- or
+#: server-side thread that traffic depends on.
+SERVING_SCOPE = (
+    "fluidframework_tpu/service/",
+    "fluidframework_tpu/drivers/",
+)
+
+#: lock constructors → kind (re-entrancy matters for self-acquisition)
+LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+EVENT_CTORS = ("threading.Event", "threading.Barrier")
+#: Condition.wait() REQUIRES its lock held (it releases internally) — the
+#: blocking rule must not flag the canonical pattern, but a timeout-less
+#: Condition.wait() still hangs a crashed-notifier waiter.
+CONDITION_CTOR = "threading.Condition"
+THREAD_CTOR = "threading.Thread"
+
+#: PROJECT-CONFIGURABLE blocklist: terminal call names known to block —
+#: RPC round-trips, device folds, packs, socket reads.  Extend this set
+#: when a new slow entry point appears; holding any lock across one of
+#: these stalls every thread contending for that lock.
+BLOCKING_CALLS = {
+    "request",               # _RpcClient.request — network round-trip
+    "run_in_executor",
+    "readexactly", "recv", "accept", "connect_ex",
+    "pack_mergetree_batch",  # host pack: the serving floor's busy stage
+    "replay_export",         # device dispatch
+    "export_to_numpy",       # blocking d2h fetch
+    "catch_up",              # a whole bulk fold
+    "urlopen", "sleep",
+}
+
+#: attribute calls that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "add", "pop", "popitem", "popleft", "clear",
+    "update", "remove", "discard", "setdefault", "extend", "insert",
+}
+
+GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+HOLDS_LOCK_RE = re.compile(r"holds-lock:\s*([A-Za-z_][\w, ]*)")
+
+_CTOR_EXEMPT = ("__init__", "__new__", "__del__")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` when ``node`` is exactly ``self.X``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _Access:
+    method: str
+    attr: str
+    write: bool
+    held: FrozenSet[str]
+    node: ast.AST
+    deferred: bool  # inside a nested def/lambda (runs later, elsewhere)
+
+
+@dataclasses.dataclass
+class _LockEvent:
+    """One lock acquisition site (a ``with`` item or ``.acquire()``)."""
+
+    method: str
+    lock: str
+    held_before: FrozenSet[str]
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _BlockingCall:
+    method: str
+    name: str
+    held: FrozenSet[str]
+    node: ast.AST
+
+
+class _ClassModel:
+    """Everything the rule family needs to know about one class."""
+
+    def __init__(self, m: ModuleContext, cls: ast.ClassDef) -> None:
+        self.cls = cls
+        self.name = cls.name
+        self.locks: Dict[str, str] = {}       # lock attr -> kind
+        self.declared: Dict[str, str] = {}    # attr -> lock (annotations)
+        self.bad_declarations: List[Tuple[ast.AST, str]] = []
+        self.spawns_threads = False
+        self.has_events = False
+        # Event names visible module-wide: `.wait()` on one of these
+        # while a lock is held is a blocking call (Condition names are
+        # NOT here — Condition.wait requires its lock held).
+        self._module_events, _, _ = _module_waitables(m)
+        self.methods: List[ast.FunctionDef] = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self._collect_members(m)
+        self._collect_declarations(m)
+        # A typo'd '# holds-lock:' must be as loud as a typo'd
+        # '# guarded-by:': an unknown name would otherwise silently
+        # exempt nothing while the author believes the method is covered
+        # (and all-writes inference quietly declines).
+        self.bad_holds: List[Tuple[ast.AST, str]] = []
+        for fn in self.methods:
+            names = self._holds_declaration(fn, m)
+            for lock in sorted((names or set()) - set(self.locks)):
+                self.bad_holds.append((fn, lock))
+        self.thread_visible = bool(self.locks) or self.spawns_threads \
+            or self.has_events
+        self.accesses: List[_Access] = []
+        self.acquisitions: List[_LockEvent] = []
+        self.blocking: List[_BlockingCall] = []
+        # Methods that lock manually (bare lock.acquire()/release()):
+        # the walker's held-set is lexical (`with` blocks + held-method
+        # conventions) and cannot track imperative acquire flow, so these
+        # methods are exempt from guard checking and excluded from
+        # inference rather than false-positived.  `with` is the
+        # analyzable idiom (see README known limits).
+        self.manual_lock_methods: Set[str] = set()
+        if self.thread_visible:
+            for fn in self.methods:
+                if any(isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Attribute)
+                       and n.func.attr == "acquire"
+                       and self.lock_of_expr(n.func.value) is not None
+                       for n in ast.walk(fn)):
+                    self.manual_lock_methods.add(fn.name)
+                self._walk_method(m, fn)
+        self.guards = self._build_guards()
+
+    # -- member discovery ------------------------------------------------------
+
+    def _collect_members(self, m: ModuleContext) -> None:
+        non_locks: Set[str] = set()  # attrs locally assigned a non-lock
+        class_body = set(map(id, self.cls.body))
+        for node in _walk_class_scope(self.cls):
+            if isinstance(node, ast.Call):
+                q = m.imports.resolve(node.func)
+                if q == THREAD_CTOR:
+                    self.spawns_threads = True
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if isinstance(value, ast.Call):
+                q = m.imports.resolve(value.func)
+            elif value is None:
+                # bare typed declaration (`_lock: threading.RLock`, no
+                # value — assigned by a base/harness): classify by the
+                # annotation so the class stays thread-visible and the
+                # member is a usable guard
+                q = m.imports.resolve(node.annotation)
+            else:
+                q = None
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is None and isinstance(target, ast.Name) \
+                        and id(node) in class_body:
+                    # bare names are members only at CLASS level (a
+                    # shared `_serial = RLock()`); method locals are not
+                    attr = target.id
+                if attr is None:
+                    continue
+                if q in LOCK_CTORS:
+                    self.locks[attr] = LOCK_CTORS[q]
+                else:
+                    if q in EVENT_CTORS or q == CONDITION_CTOR:
+                        self.has_events = True
+                    if value is not None:
+                        # only an attr VISIBLY ASSIGNED a non-lock may
+                        # poison inherited-lock adoption; a value-less
+                        # declaration assigns nothing
+                        non_locks.add(attr)
+        # Inherited locks: acquired here, constructed in a base class —
+        # but never an attr this class visibly assigns a NON-lock (a file
+        # handle or other context manager in a `with` must not poison
+        # guard inference).
+        for node in _walk_class_scope(self.cls):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr not in self.locks \
+                            and attr not in non_locks:
+                        self.locks[attr] = "inherited"
+
+    def _collect_declarations(self, m: ModuleContext) -> None:
+        for node in _walk_class_scope(self.cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            match = GUARDED_BY_RE.search(m.stmt_comment(node))
+            if not match:
+                continue
+            lock = match.group(1)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                attr = _self_attr(target)
+                if attr is None and isinstance(target, ast.Name):
+                    attr = target.id
+                if attr is None:
+                    continue
+                if lock not in self.locks:
+                    self.bad_declarations.append((node, lock))
+                else:
+                    self.declared[attr] = lock
+
+    # -- per-method walk -------------------------------------------------------
+
+    def _holds_declaration(self, fn: ast.FunctionDef, m: ModuleContext
+                           ) -> Optional[Set[str]]:
+        """Raw lock names from a ``# holds-lock:`` annotation on the
+        method header, or None when there is no annotation.  The comment
+        may trail any header line or stand alone between the signature
+        and the docstring (long signatures keep their type hints)."""
+        first_body = fn.body[0].lineno if fn.body else fn.lineno + 1
+        for line in range(fn.lineno, first_body):
+            match = HOLDS_LOCK_RE.search(m.comments.get(line, ""))
+            if match:
+                return {n.strip() for n in match.group(1).split(",")
+                        if n.strip()}
+        return None
+
+    def held_for(self, fn: ast.FunctionDef, m: ModuleContext
+                 ) -> FrozenSet[str]:
+        names = self._holds_declaration(fn, m)
+        if names is not None:
+            return frozenset(n for n in names if n in self.locks)
+        if fn.name.endswith("_locked"):
+            return frozenset(self.locks)
+        return frozenset()
+
+    def lock_of_expr(self, node: ast.AST) -> Optional[str]:
+        """Terminal lock name for ``self.X`` / ``<ClassName>.X`` / bare
+        ``X`` when X is a known lock of this class."""
+        attr = _self_attr(node)
+        if attr is None and isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == self.name:
+            attr = node.attr
+        if attr is None and isinstance(node, ast.Name):
+            attr = node.id
+        return attr if attr is not None and attr in self.locks else None
+
+    def _write_ids(self, fn: ast.FunctionDef) -> Set[int]:
+        """ids of ``self.X`` Attribute nodes that are writes despite Load
+        ctx: mutator-call receivers and subscript-store bases."""
+        out: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS and \
+                    _self_attr(node.func.value) is not None:
+                out.add(id(node.func.value))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    _self_attr(node.value) is not None:
+                out.add(id(node.value))
+        return out
+
+    def _walk_method(self, m: ModuleContext, fn: ast.FunctionDef) -> None:
+        write_ids = self._write_ids(fn)
+        base_held = self.held_for(fn, m)
+
+        def visit(node: ast.AST, held: FrozenSet[str],
+                  deferred: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # Deferred body: executes after the with-block exits,
+                # possibly on another thread — locks are NOT held there.
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for child in body:
+                    visit(child, frozenset(), True)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # `with a, b:` acquires sequentially: b's held-set
+                # includes a, so opposite multi-item orders still cycle.
+                acquired: List[str] = []
+                for item in node.items:
+                    lock = self.lock_of_expr(item.context_expr)
+                    if lock is not None:
+                        self.acquisitions.append(_LockEvent(
+                            fn.name, lock, held | frozenset(acquired),
+                            node))
+                        acquired.append(lock)
+                    else:
+                        visit(item.context_expr, held, deferred)
+                new_held = held | frozenset(acquired)
+                for child in node.body:
+                    visit(child, new_held, deferred)
+                return
+            if isinstance(node, ast.Call):
+                self._classify_call(fn, node, held)
+            attr = _self_attr(node)
+            if attr is not None and attr not in self.locks:
+                write = isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    or id(node) in write_ids
+                self.accesses.append(_Access(
+                    fn.name, attr, write, held, node, deferred))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, deferred)
+
+        for stmt in fn.body:
+            visit(stmt, base_held, False)
+
+    def _classify_call(self, fn: ast.FunctionDef, node: ast.Call,
+                       held: FrozenSet[str]) -> None:
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name is None:
+            return
+        if name == "acquire" and isinstance(func, ast.Attribute):
+            lock = self.lock_of_expr(func.value)
+            self.acquisitions.append(_LockEvent(
+                fn.name, lock if lock is not None else "<unknown>",
+                held, node))
+            return
+        if name == "wait" and held and isinstance(func, ast.Attribute):
+            recv = _terminal_name(func.value)
+            if recv in self._module_events:
+                self.blocking.append(_BlockingCall(
+                    fn.name, f"{recv}.wait", held, node))
+            return
+        if name in BLOCKING_CALLS and held:
+            self.blocking.append(_BlockingCall(fn.name, name, held, node))
+
+    # -- guard relation --------------------------------------------------------
+
+    def _build_guards(self) -> Dict[str, str]:
+        guards = dict(self.declared)
+        writes: Dict[str, List[_Access]] = {}
+        for a in self.accesses:
+            if a.write and a.method not in _CTOR_EXEMPT \
+                    and a.method not in self.manual_lock_methods \
+                    and a.attr not in guards:
+                writes.setdefault(a.attr, []).append(a)
+        for attr, ws in writes.items():
+            if all(w.held for w in ws):
+                common = frozenset.intersection(*(w.held for w in ws))
+                if len(common) == 1:
+                    # Exactly one common lock: unambiguous adoption.  More
+                    # than one (e.g. writes only in `_locked` methods of a
+                    # two-lock class, where ALL locks are assumed held)
+                    # would make the guard a guess — flagging reads
+                    # against the wrong lock; such attrs need an explicit
+                    # declaration to be enforced.
+                    guards[attr] = next(iter(common))
+        return guards
+
+
+def class_models(m: ModuleContext) -> List[_ClassModel]:
+    """Thread-visible class models for a module, built once per context:
+    five rules consume the identical model, so it is memoized on the
+    ModuleContext (same pattern as its lazy ``comments``)."""
+    cached = getattr(m, "_race_models", None)
+    if cached is None:
+        cached = [
+            model for node in ast.walk(m.tree)
+            if isinstance(node, ast.ClassDef)
+            for model in [_ClassModel(m, node)]
+            if model.thread_visible
+        ]
+        m._race_models = cached
+    return cached
+
+
+# -- rules --------------------------------------------------------------------
+
+
+@register
+class GuardedAccessRule(Rule):
+    name = "FL-RACE-GUARD"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "read/write of a guarded attribute outside its lock in a "
+        "thread-visible class; guards come from '# guarded-by: <lock>' "
+        "declarations or all-writes-under-one-lock inference"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for model in class_models(m):
+            for node, lock in model.bad_declarations:
+                yield m.finding(
+                    self, node,
+                    f"'# guarded-by: {lock}' in class {model.name} names "
+                    "no known lock attribute of that class — fix the "
+                    "annotation or construct the lock in this class",
+                )
+            for fn, lock in model.bad_holds:
+                yield m.finding(
+                    self, fn,
+                    f"'# holds-lock: {lock}' {_owner_phrase(fn.name)} of "
+                    f"{model.name} names no known lock attribute of that "
+                    "class — the annotation exempts nothing and guard "
+                    "inference for the attributes it writes is silently "
+                    "declined; fix the name or construct the lock in "
+                    "this class",
+                )
+            for a in model.accesses:
+                if a.method in _CTOR_EXEMPT or \
+                        a.method in model.manual_lock_methods:
+                    continue
+                lock = model.guards.get(a.attr)
+                if lock is None or lock in a.held:
+                    continue
+                kind = "write to" if a.write else "read of"
+                where = "deferred callback in " if a.deferred else ""
+                yield m.finding(
+                    self, a.node,
+                    f"{kind} '{a.attr}' (guarded by '{lock}') outside the "
+                    f"lock in {where}{a.method}() of {model.name}; take "
+                    f"'with self.{lock}:' around the access or mark the "
+                    "method as lock-held ('# holds-lock', '_locked' "
+                    "suffix)",
+                )
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    name = "FL-RACE-BLOCKING"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "blocking operation (nested acquire, Event.wait, RPC/fold/pack "
+        "blocklist call) while holding a lock — stalls every thread "
+        "contending for it"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for model in class_models(m):
+            for acq in model.acquisitions:
+                if not acq.held_before:
+                    continue
+                if acq.lock in acq.held_before and \
+                        model.locks.get(acq.lock) in ("rlock", "inherited"):
+                    continue  # re-entrant re-acquire: the ORDER rule's
+                    # self-cycle check covers non-reentrant locks
+                if isinstance(acq.node, ast.Call):
+                    held = ", ".join(sorted(acq.held_before))
+                    # ".acquire()" (dot-prefixed) so the baseline hygiene
+                    # check reads it as an API name, not a function key.
+                    yield m.finding(
+                        self, acq.node,
+                        f"bare .acquire() call on '{acq.lock}' in "
+                        f"{acq.method}() of {model.name} while holding "
+                        f"'{held}'; nested blocking acquisition — "
+                        "restructure to one critical section or a fixed "
+                        "lock order with 'with'",
+                    )
+            for b in model.blocking:
+                held = ", ".join(sorted(b.held))
+                yield m.finding(
+                    self, b.node,
+                    f"blocking call '{b.name}' in {b.method}() of "
+                    f"{model.name} while holding '{held}'; move the slow "
+                    "work outside the critical section (copy state out, "
+                    "drop the lock, then block)",
+                )
+
+
+@register
+class LockOrderRule(Rule):
+    name = "FL-RACE-ORDER"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "inconsistent lock-acquisition order across a class's methods "
+        "(cycle in the per-class lock graph) or self-acquisition of a "
+        "non-reentrant lock — deadlock candidates"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for model in class_models(m):
+            edges: Dict[str, Set[str]] = {}
+            sites: Dict[Tuple[str, str], _LockEvent] = {}
+            for acq in model.acquisitions:
+                if acq.lock == "<unknown>":
+                    continue
+                if acq.lock in acq.held_before:
+                    if model.locks.get(acq.lock) == "lock":
+                        yield m.finding(
+                            self, acq.node,
+                            f"re-acquiring non-reentrant Lock "
+                            f"'{acq.lock}' already held in {acq.method}() "
+                            f"of {model.name} — guaranteed self-deadlock; "
+                            "use an RLock or split the critical section",
+                        )
+                    continue
+                for held in acq.held_before:
+                    edges.setdefault(held, set()).add(acq.lock)
+                    sites.setdefault((held, acq.lock), acq)
+            for cycle in _find_cycles(edges):
+                first = sites[(cycle[0], cycle[1])]
+                methods = sorted({sites[(cycle[i], cycle[i + 1])].method
+                                  for i in range(len(cycle) - 1)})
+                yield m.finding(
+                    self, first.node,
+                    f"lock-order cycle in {model.name}: "
+                    f"{' -> '.join(cycle)} (acquired in "
+                    f"{', '.join(methods)}) — two threads taking the "
+                    "locks in opposite order deadlock; pick one global "
+                    "order",
+                )
+
+
+@register
+class MutateDuringIterationRule(Rule):
+    name = "FL-RACE-MUTITER"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "iterating a guarded dict/set attribute while mutating it in the "
+        "loop body — RuntimeError under concurrency (and alone); iterate "
+        "a snapshot (list(...)) and mutate after"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for model in class_models(m):
+            for fn in model.methods:
+                write_ids = model._write_ids(fn)
+                for node in ast.walk(fn):
+                    if not isinstance(node, (ast.For, ast.AsyncFor)):
+                        continue
+                    attr = self._iterated_guarded_attr(model, node.iter)
+                    if attr is None:
+                        continue
+                    if self._body_mutates(fn, node, attr, write_ids):
+                        yield m.finding(
+                            self, node,
+                            f"iterating 'self.{attr}' while mutating it "
+                            f"in the loop body in {fn.name}() of "
+                            f"{model.name}; snapshot first "
+                            f"(list(self.{attr})) or collect keys and "
+                            "mutate after the loop",
+                        )
+
+    @staticmethod
+    def _iterated_guarded_attr(model, it: ast.AST) -> Optional[str]:
+        if isinstance(it, ast.Call):
+            func = it.func
+            if isinstance(func, ast.Name):
+                return None  # list(...)/sorted(...) snapshot — safe
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in ("keys", "values", "items"):
+                it = func.value
+            else:
+                return None
+        attr = _self_attr(it)
+        return attr if attr is not None and attr in model.guards else None
+
+    @staticmethod
+    def _body_mutates(fn, loop, attr: str, write_ids: Set[int]) -> bool:
+        for node in _walk_pruned(loop):
+            if node is loop.iter:
+                continue
+            a = _self_attr(node)
+            if a == attr and (isinstance(node.ctx, (ast.Store, ast.Del))
+                              or id(node) in write_ids):
+                return True
+        return False
+
+
+@register
+class CheckThenActRule(Rule):
+    name = "FL-RACE-CHECKACT"
+    severity = "warning"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "guarded state read under a lock and mutated under a later, "
+        "separate acquisition of the same lock in one method — the "
+        "decision may be stale by the time it is applied"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for model in class_models(m):
+            for fn in model.methods:
+                if fn.name in _CTOR_EXEMPT or \
+                        model.held_for(fn, m):
+                    continue
+                yield from self._check_method(m, model, fn)
+
+    def _check_method(self, m, model, fn) -> Iterator[Finding]:
+        blocks = self._lock_blocks(m, model, fn)
+        seen_reads: Set[Tuple[str, str]] = set()  # (lock, attr)
+        reported: Set[Tuple[str, str]] = set()
+        for lock, reads, writes, node in blocks:
+            for attr in writes:
+                key = (lock, attr)
+                if key in seen_reads and key not in reported:
+                    reported.add(key)
+                    yield m.finding(
+                        self, node,
+                        f"check-then-act on '{attr}' in {fn.name}() of "
+                        f"{model.name}: read under '{lock}', mutated "
+                        "under a later separate acquisition — another "
+                        "thread can change it in between; merge into one "
+                        "critical section or re-validate before mutating",
+                    )
+            for attr in reads:
+                seen_reads.add((lock, attr))
+
+    def _lock_blocks(self, m, model, fn):
+        """(lock, guarded-reads, guarded-writes, node) per OUTERMOST
+        with-block on each lock, in source order, nested callables
+        excluded.  A nested re-acquire of an already-held lock is the
+        same critical section (an RLock never releases in between), not
+        a separate acquisition."""
+        write_ids = model._write_ids(fn)
+        blocks = []
+
+        def visit(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            acquired = []
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = model.lock_of_expr(item.context_expr)
+                    if lock is None or lock in held:
+                        continue
+                    acquired.append(lock)
+                    reads: Set[str] = set()
+                    writes: Set[str] = set()
+                    for sub in _walk_pruned(node):
+                        attr = _self_attr(sub)
+                        if attr is None or \
+                                model.guards.get(attr) != lock:
+                            continue
+                        if isinstance(sub.ctx, (ast.Store, ast.Del)) \
+                                or id(sub) in write_ids:
+                            writes.add(attr)
+                        else:
+                            reads.add(attr)
+                    blocks.append((lock, reads, writes, node))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held | set(acquired))
+
+        for stmt in fn.body:
+            visit(stmt, model.held_for(fn, m))
+        return blocks
+
+
+@register
+class UnboundedWaitRule(Rule):
+    name = "FL-RACE-WAITFOREVER"
+    severity = "error"
+    scope = SERVING_SCOPE
+    description = (
+        "Event.wait()/Thread.join() with no timeout on a serving path — "
+        "a crashed peer thread hangs the waiter forever; pass a bounded "
+        "timeout and handle the expiry"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        events, threads, conditions = _module_waitables(m)
+        for fn_name, node in _calls_with_owner(m.tree):
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if node.args or any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            recv = _terminal_name(func.value)
+            where = _owner_phrase(fn_name)
+            if func.attr == "wait" and recv in (events | conditions):
+                yield m.finding(
+                    self, node,
+                    f"{recv}.wait() with no timeout {where} on a "
+                    "serving path; a crashed setter/notifier hangs this "
+                    "thread forever — wait(timeout) and handle the "
+                    "expiry",
+                )
+            elif func.attr == "join" and recv in threads:
+                yield m.finding(
+                    self, node,
+                    f"{recv}.join() with no timeout {where} on a "
+                    "serving path; a wedged thread hangs shutdown — "
+                    "join(timeout) and surface the leak",
+                )
+
+
+# -- shared helpers -----------------------------------------------------------
+
+
+def _walk_class_scope(cls: ast.ClassDef) -> Iterator[ast.AST]:
+    """Walk a class without descending into nested classes: a nested
+    class's locks, members, and '# guarded-by' declarations belong to
+    ITS model (class_models builds one per ClassDef, nested included),
+    and adopting them here would flag the enclosing class's same-named
+    attributes against a guard it does not have."""
+    stack: List[ast.AST] = [cls]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, ast.ClassDef) and cur is not cls:
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _walk_pruned(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a subtree without descending into nested function/lambda
+    bodies — those run deferred, outside the enclosing critical section
+    (the same boundary the access walker draws)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and cur is not node:
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _owner_phrase(fn_name: str) -> str:
+    """Render the owning scope for a message; '<module>()' would trip
+    the baseline function-hygiene check (no such def exists)."""
+    return "at module scope" if fn_name == "<module>" else f"in {fn_name}()"
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _module_waitables(m: ModuleContext
+                      ) -> Tuple[Set[str], Set[str], Set[str]]:
+    """Terminal names bound (anywhere in the module) to Event, Thread,
+    and Condition constructors: ``(events, threads, conditions)``."""
+    events: Set[str] = set()
+    threads: Set[str] = set()
+    conditions: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        q = m.imports.resolve(node.value.func)
+        if q is None:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for target in targets:
+            name = _terminal_name(target)
+            if name is None:
+                continue
+            if q in EVENT_CTORS:
+                events.add(name)
+            elif q == CONDITION_CTOR:
+                conditions.add(name)
+            elif q == THREAD_CTOR:
+                threads.add(name)
+    return events, threads, conditions
+
+
+def _calls_with_owner(tree: ast.Module) -> Iterator[Tuple[str, ast.Call]]:
+    """(owning function name, call node) for every call, innermost owner
+    wins; module-level calls report '<module>'."""
+
+    def visit(node: ast.AST, owner: str) -> Iterator[Tuple[str, ast.Call]]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+        if isinstance(node, ast.Call):
+            yield owner, node
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, owner)
+
+    yield from visit(tree, "<module>")
+
+
+def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Each distinct lock cycle once, as [a, b, ..., a], smallest start
+    first (deterministic output for stable suppression keys)."""
+    cycles: List[List[str]] = []
+    seen: Set[FrozenSet[str]] = set()
+    nodes = sorted(set(edges) | {n for vs in edges.values() for n in vs})
+
+    def dfs(start: str, current: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(edges.get(current, ())):
+            if nxt == start:
+                members = frozenset(path)
+                if members not in seen:
+                    seen.add(members)
+                    cycles.append(path + [start])
+            elif nxt not in on_path and nxt > start:
+                # only walk nodes > start: each cycle is discovered from
+                # its smallest member exactly once
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for n in nodes:
+        dfs(n, n, [n], {n})
+    return cycles
