@@ -193,4 +193,9 @@ class FileDocumentServiceFactory(LocalDocumentServiceFactory):
         super().__init__(service)
 
     def close(self) -> None:
+        # Idempotent end to end: OpLog.close() no-ops once its file handle
+        # is None'd, so a factory closed from both a host teardown and a
+        # with-block/atexit sweep flushes and closes exactly once
+        # (fluidleak FL-LEAK-DOUBLE-CLOSE discipline; pinned by
+        # tests/test_lifecycle.py).
         self.service.oplog.close()

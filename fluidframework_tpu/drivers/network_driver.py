@@ -51,10 +51,14 @@ class EpochMismatchError(RpcError):
 class _RpcClient:
     """Shared framed-JSON socket with response routing + event dispatch."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 mc=None) -> None:
+        from ..utils.telemetry import MonitoringContext
+
         self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
         self._timeout = timeout
+        self._mc = (mc or MonitoringContext()).child("rpc")
         self._write_lock = threading.Lock()
         self._ids = itertools.count(1)
         self._pending: Dict[int, queue.Queue] = {}  # guarded-by: _pending_lock
@@ -66,6 +70,11 @@ class _RpcClient:
         self._state_lock = threading.Lock()
         self._handlers: Dict[str, List[Callable[[dict], None]]] = {}  # guarded-by: _state_lock
         self._closed = False
+        self._sock_closed = False  # guarded-by: _state_lock
+        #: last exception a telemetry sink raised from the dispatcher
+        #: (dispatcher-thread-confined write; read via last_sink_error
+        #: for post-mortem — a dead sink must not also hide ITS failure)
+        self._last_sink_error: Optional[BaseException] = None
         #: storage generation this CONNECTION is pinned to (odsp
         #: EpochTracker): adopted from the first storage response and then
         #: attached to EVERY doc/storage request — deltas, submits, and
@@ -89,6 +98,7 @@ class _RpcClient:
     # -- wire ------------------------------------------------------------------
 
     def _read_loop(self) -> None:
+        rfile = None
         try:
             # Buffered file interface: exact-size reads without quadratic
             # bytes-concatenation on large frames (big summaries).
@@ -118,6 +128,16 @@ class _RpcClient:
             for slot in pending.values():
                 slot.put({"ok": False, "error": f"connection lost: {exc}"})
             self._events.put(None)
+        finally:
+            # The buffered reader pins the socket's io refcount; a reader
+            # that exits without closing it leaks the buffer for the
+            # process lifetime (fluidleak FL-LEAK-ESCAPE).  The socket
+            # itself stays owned by close().
+            if rfile is not None:
+                try:
+                    rfile.close()
+                except OSError:
+                    pass
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -134,8 +154,31 @@ class _RpcClient:
             for fn in handlers:
                 try:
                     fn(frame)
-                except Exception:
-                    pass  # a broken subscriber must not kill delivery
+                except Exception as exc:
+                    # A broken subscriber must not kill delivery — but its
+                    # failure must surface, not vanish (fluidleak
+                    # FL-LEAK-SWALLOW): hosts that inject a logger see
+                    # every dropped delivery with its event key.
+                    try:
+                        self._mc.logger.send({
+                            "eventName": "subscriberError", "event": key,
+                            "error": str(exc),
+                            "errorType": type(exc).__name__,
+                        })
+                    except Exception as sink_exc:
+                        # A broken SINK must not kill the dispatcher
+                        # either (a dead dispatcher silently halts every
+                        # delivery on the connection); stash the sink's
+                        # failure for post-mortem instead of dying.
+                        self._last_sink_error = sink_exc
+
+    @property
+    def last_sink_error(self) -> Optional[BaseException]:
+        """The most recent exception a telemetry sink raised from the
+        dispatcher thread, or None.  Hosts poll this post-mortem: the
+        dispatcher armors itself against a broken sink, so this is the
+        only place the sink's own failure surfaces."""
+        return self._last_sink_error
 
     def request(self, method: str, params: dict):
         if self._closed:
@@ -224,6 +267,15 @@ class _RpcClient:
 
     def close(self) -> None:
         self._closed = True
+        with self._state_lock:
+            # Idempotent (fluidleak FL-LEAK-DOUBLE-CLOSE discipline):
+            # close() is reachable from the factory, from error-path
+            # callers, and from teardown sweeps — only the first call
+            # touches the socket.  `_closed` alone cannot be the guard:
+            # a dead reader sets it without ever closing the fd.
+            if self._sock_closed:
+                return
+            self._sock_closed = True
         try:
             # shutdown() (not just close()) wakes the reader thread out
             # of its blocking recv with EOF; close() alone leaves it
@@ -464,8 +516,8 @@ class NetworkDocumentServiceFactory:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
                  timeout: float = 30.0, tenant: Optional[str] = None,
-                 secret: Optional[str] = None) -> None:
-        self._rpc = _RpcClient(host, port, timeout=timeout)
+                 secret: Optional[str] = None, mc=None) -> None:
+        self._rpc = _RpcClient(host, port, timeout=timeout, mc=mc)
         self._connections: Dict[str, NetworkConnection] = {}
         if tenant is not None:
             # Riddler capability: authenticate the connection before any

@@ -135,6 +135,14 @@ class Container:
             ds.resubmit_pending(force_rebase=force_rebase)
 
     def close(self) -> None:
+        # Idempotent (fluidleak FL-LEAK-DOUBLE-CLOSE): close() is called
+        # directly by hosts AND by close_and_get_pending_state(); the
+        # second call must not re-run the disconnect protocol.
+        if self.closed:
+            return
+        # Flag only after the disconnect protocol succeeds: an RpcError
+        # mid-close must leave close() retryable (delta_manager.close is
+        # re-entrant via its state check), not strand the subscription.
         self.delta_manager.close()
         self.closed = True
 

@@ -92,6 +92,7 @@ class _ClientSession:
         self.connected_clients: Dict[str, str] = {}  # client_id -> doc_id
         self._fns: Dict[str, tuple] = {}  # doc -> (op_fn, signal_fn)
         self.tenant: Optional[str] = None  # set by a successful "auth"
+        self._closed = False
 
     #: Disconnect a session whose unread broadcast backlog exceeds this
     #: (a stalled reader must not grow the server's buffers without bound;
@@ -139,6 +140,13 @@ class _ClientSession:
         self.subscribed_docs.add(doc_id)
 
     def close(self) -> None:
+        # Idempotent (fluidleak FL-LEAK-DOUBLE-CLOSE): the laggard-drop
+        # path (_write) closes mid-connection and _handle's finally
+        # closes again on unwind; the second call must not re-run the
+        # unsubscribe/disconnect sweep against re-registered state.
+        if self._closed:
+            return
+        self._closed = True
         for doc_id, (op_fn, signal_fn) in self._fns.items():
             try:
                 endpoint = self.server.service.endpoint(doc_id)

@@ -1,0 +1,308 @@
+"""Resource-lifecycle regressions (ISSUE 5, fluidleak): idempotent
+close/shutdown across the serving stack — the in-repo negative fixtures
+for FL-LEAK-DOUBLE-CLOSE — plus the "leader died without reaching its
+finally" single-flight scenario the exit-path rules exist to prevent.
+
+Each close here is reachable from more than one call path in production
+(`_ClientSession.close` from the laggard-drop AND the connection unwind,
+`_RpcClient.close` from the factory AND error-path callers, the file
+factory from host teardown AND atexit sweeps, `Container.close` from
+hosts AND `close_and_get_pending_state`); a second call must be a no-op,
+never a re-run of the release protocol.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import bench
+from fluidframework_tpu.drivers import FileDocumentServiceFactory
+from fluidframework_tpu.drivers.network_driver import _RpcClient
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.service import LocalOrderingService
+from fluidframework_tpu.service.catchup import CatchupService
+from fluidframework_tpu.service.server import OrderingServer, _ClientSession
+
+from tests.test_loader import build_text_doc, make_stack
+
+
+# --- _ClientSession.close (service/server.py) --------------------------------
+
+
+def test_session_close_idempotent():
+    """The laggard-drop path closes mid-connection and _handle's finally
+    closes again on unwind: the second close must not re-run the
+    unsubscribe/disconnect sweep (it would tear down listeners a
+    reconnected session re-registered in between)."""
+    service, _factory, loader = make_stack()
+    loader.create("doc", "alice", build_text_doc).drain()
+    server = OrderingServer(service)
+    session = _ClientSession(server, writer=None)
+    session.tap("doc")
+    session.connected_clients["c1"] = "doc"
+
+    endpoint_calls = []
+    real_endpoint = service.endpoint
+
+    def counting_endpoint(doc_id):
+        endpoint_calls.append(doc_id)
+        return real_endpoint(doc_id)
+
+    service.endpoint = counting_endpoint
+    session.close()
+    assert endpoint_calls, "first close must run the release sweep"
+    assert not session._fns and not session.connected_clients
+
+    endpoint_calls.clear()
+    session.close()
+    assert endpoint_calls == [], "second close must be a no-op"
+
+
+# --- _RpcClient.close (drivers/network_driver.py) ----------------------------
+
+
+class _CountingSocket:
+    """Delegating socket proxy that counts release calls."""
+
+    def __init__(self, sock):
+        self._sock = sock
+        self.shutdowns = 0
+        self.closes = 0
+
+    def shutdown(self, how):
+        self.shutdowns += 1
+        return self._sock.shutdown(how)
+
+    def close(self):
+        self.closes += 1
+        return self._sock.close()
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+def test_rpc_client_close_idempotent():
+    """close() is reachable from the factory, error-path callers, and
+    teardown sweeps; only the FIRST call may touch the socket.  The
+    `_closed` request-gate flag alone cannot be the guard — a dead
+    reader sets it without ever closing the fd."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    host, port = listener.getsockname()
+    try:
+        client = _RpcClient(host, port)
+        server_side, _addr = listener.accept()
+        counted = _CountingSocket(client._sock)
+        client._sock = counted
+
+        client.close()
+        assert (counted.shutdowns, counted.closes) == (1, 1)
+        client.close()
+        client.close()
+        assert (counted.shutdowns, counted.closes) == (1, 1), (
+            "second close re-ran the socket release")
+        # shutdown(SHUT_RDWR) delivered EOF: both driver threads exit
+        # (the daemon-leak contract of test_concurrency.py).
+        client._reader.join(timeout=10)
+        client._dispatcher.join(timeout=10)
+        assert not client._reader.is_alive()
+        assert not client._dispatcher.is_alive()
+        server_side.close()
+    finally:
+        listener.close()
+
+
+def test_rpc_dispatcher_surfaces_subscriber_errors():
+    """The FL-LEAK-SWALLOW fix: a broken subscriber must not kill event
+    delivery (the old contract) but its failure must reach the telemetry
+    logger instead of vanishing in a bare `except: pass` (the new one)."""
+    from fluidframework_tpu.utils.telemetry import (CollectingLogger,
+                                                    MonitoringContext)
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    host, port = listener.getsockname()
+    sink = CollectingLogger()
+    try:
+        client = _RpcClient(host, port, mc=MonitoringContext(logger=sink))
+        server_side, _addr = listener.accept()
+        delivered = threading.Event()
+        client.on("op", "doc", lambda frame: (_ for _ in ()).throw(
+            ValueError("broken subscriber")))
+        client.on("op", "doc", lambda frame: delivered.set())
+        # Feed the dispatcher directly: routing is the dispatcher's own
+        # job; the wire framing is test_network.py's concern.
+        client._events.put({"event": "op", "doc": "doc"})
+        assert delivered.wait(timeout=10), (
+            "a broken subscriber killed delivery to the next one")
+        errors = [e for e in sink.events
+                  if e.get("eventName", "").endswith("subscriberError")]
+        assert errors and errors[0]["errorType"] == "ValueError"
+        assert client.last_sink_error is None
+        # ... and a sink that ITSELF raises must not kill the dispatcher:
+        # the failure lands in last_sink_error, and delivery continues.
+        sink.send = lambda event: (_ for _ in ()).throw(
+            OSError("sink disk full"))
+        redelivered = threading.Event()
+        client.on("op", "doc2", lambda frame: (_ for _ in ()).throw(
+            ValueError("still broken")))
+        client.on("op", "doc2", lambda frame: redelivered.set())
+        client._events.put({"event": "op", "doc": "doc2"})
+        assert redelivered.wait(timeout=10), (
+            "a broken telemetry sink killed the dispatcher")
+        assert isinstance(client.last_sink_error, OSError)
+        client.close()
+        server_side.close()
+    finally:
+        listener.close()
+
+
+# --- FileDocumentServiceFactory.close (drivers/file_driver.py) ---------------
+
+
+def test_file_factory_close_idempotent(tmp_path):
+    """A factory closed from both a host teardown and a with-block/atexit
+    sweep must flush+close the op log exactly once; the second close must
+    not flush (fsync on a closed fd raises) or reopen anything."""
+    factory = FileDocumentServiceFactory(str(tmp_path / "store"))
+    loader = Loader(factory)
+    container = loader.create("doc", "alice", build_text_doc)
+    container.drain()
+
+    oplog = factory.service.oplog
+    flushes = []
+    real_flush = oplog.flush
+
+    def counting_flush():
+        flushes.append(1)
+        return real_flush()
+
+    oplog.flush = counting_flush
+    factory.close()
+    assert len(flushes) == 1 and oplog._file is None
+    factory.close()
+    assert len(flushes) == 1, "second close must not re-flush a closed fd"
+
+
+# --- Container.close (loader/loader.py) --------------------------------------
+
+
+def test_container_close_idempotent():
+    """close() is called directly by hosts AND by
+    close_and_get_pending_state(); the disconnect protocol (LEAVE
+    submission, listener teardown) must run once."""
+    _service, _factory, loader = make_stack()
+    container = loader.create("doc", "alice", build_text_doc)
+    container.drain()
+
+    dm_closes = []
+    real_close = container.delta_manager.close
+
+    def counting_close():
+        dm_closes.append(1)
+        return real_close()
+
+    container.delta_manager.close = counting_close
+    state = container.close_and_get_pending_state()
+    assert container.closed and dm_closes == [1]
+    container.close()  # the host's own teardown arrives second
+    assert dm_closes == [1], "double close re-ran the disconnect protocol"
+    assert state["docId"] == "doc"
+
+
+def test_container_close_failure_stays_retryable():
+    """The idempotency flag must be set AFTER the disconnect protocol
+    succeeds: a dead connection raising mid-close must not latch
+    closed=True and turn every retry into a no-op with the live-delta
+    subscription still registered."""
+    _service, _factory, loader = make_stack()
+    container = loader.create("doc", "alice", build_text_doc)
+    container.drain()
+
+    real_close = container.delta_manager.close
+    calls = []
+
+    def flaky_close():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("connection dead")
+        return real_close()
+
+    container.delta_manager.close = flaky_close
+    with pytest.raises(RuntimeError):
+        container.close()
+    assert not container.closed, "failed close latched the flag"
+    container.close()  # retry must actually run the protocol
+    assert container.closed and calls == [1, 1]
+    container.close()  # and a third call is the idempotent no-op
+    assert calls == [1, 1]
+
+
+# --- single-flight: leader killed mid-fold (service/catchup.py) --------------
+
+
+def test_crashed_leader_mid_fold_abandons_flight_and_wakes_waiters():
+    """The exact scenario catchup.py's finally-abandon exists for: the
+    fold raises out from under the single-flight leader.  The herd
+    waiting on that flight must wake well within join_timeout (via the
+    abandon, NOT the timeout), no flight object may survive in the
+    cache, and the followers must re-fold to the byte-identical result —
+    while the leader's own exception propagates (never swallowed)."""
+    service = LocalOrderingService()
+    bench.build_catchup_corpus(service, 1, 12)
+    svc = CatchupService(service, mesh=None)
+    svc.join_timeout = 60.0  # generous: abandon must win, not the timer
+
+    folding = threading.Event()
+    release = threading.Event()
+    fold_calls = []
+    real_fold = svc._device_fold
+
+    def dying_fold(works):
+        fold_calls.append(len(works))
+        if len(fold_calls) == 1:
+            folding.set()
+            assert release.wait(timeout=30)
+            raise RuntimeError("leader killed mid-fold")
+        return real_fold(works)
+
+    svc._device_fold = dying_fold
+    results = {}
+    errors = {}
+
+    def run(name):
+        try:
+            results[name] = svc.catch_up(["cdoc0"], upload=False)
+        except RuntimeError as exc:
+            errors[name] = str(exc)
+
+    leader = threading.Thread(target=run, args=("leader",))
+    leader.start()
+    assert folding.wait(timeout=30)  # the key is now in flight
+    waiters = [threading.Thread(target=run, args=(f"w{i}",))
+               for i in range(4)]
+    for t in waiters:
+        t.start()
+    time.sleep(0.05)  # let the herd reach join() on the live flight
+    t0 = time.monotonic()
+    release.set()  # the fold raises: leader dies, finally abandons
+    leader.join(timeout=60)
+    for t in waiters:
+        t.join(timeout=60)
+    elapsed = time.monotonic() - t0
+
+    assert errors == {"leader": "leader killed mid-fold"}, (
+        "the injected failure must propagate from the leader, unswallowed")
+    assert elapsed < svc.join_timeout / 2, (
+        "waiters woke via the timeout, not the finally-abandon")
+    assert svc.cache._flights == {}, "a flight object survived the crash"
+    assert set(results) == {f"w{i}" for i in range(4)}
+    # One waiter re-led and re-folded; the rest served from its publish.
+    assert fold_calls == [1, 1], fold_calls
+    fresh = CatchupService(service, cache=None, mesh=None)
+    expected = fresh.catch_up(["cdoc0"], upload=False)
+    assert all(r == expected for r in results.values())

@@ -304,6 +304,114 @@ MODULE_RULE_FIXTURES = {
         """,
         RUNTIME,
     ),
+    "FL-LEAK-PAIR": (
+        """
+        class S:
+            def work(self, key):
+                status = self.cache.begin(key)
+                tree = self.fold(key)
+                self.cache.finish(key)
+                return tree
+        """,
+        """
+        class S:
+            def work(self, key):
+                status = self.cache.begin(key)
+                try:
+                    return self.fold(key)
+                finally:
+                    self.cache.abandon(key)
+        """,
+        SERVICE,
+    ),
+    "FL-LEAK-ESCAPE": (
+        """
+        import socket
+        def probe(host):
+            s = socket.create_connection((host, 1))
+            data = s.recv(10)
+            s.close()
+            return data
+        """,
+        """
+        import socket
+        def probe(host):
+            with socket.create_connection((host, 1)) as s:
+                return s.recv(10)
+        """,
+        SERVICE,
+    ),
+    "FL-LEAK-SWALLOW": (
+        """
+        def loop(self):
+            try:
+                self.step()
+            except Exception:
+                pass
+        """,
+        """
+        def loop(self):
+            try:
+                self.step()
+            except Exception as exc:
+                self.mc.logger.send({"eventName": "stepError",
+                                     "error": str(exc)})
+        """,
+        SERVICE,
+    ),
+    "FL-LEAK-FINALLY-MASK": (
+        """
+        def f():
+            try:
+                work()
+            finally:
+                return 1
+        """,
+        """
+        def f():
+            try:
+                work()
+            finally:
+                cleanup()
+        """,
+        SERVICE,
+    ),
+    "FL-LEAK-GEN-HOLD": (
+        """
+        def walk(self):
+            with self._lock:
+                for x in self._items:
+                    yield x
+        """,
+        """
+        def walk(self):
+            with self._lock:
+                snap = list(self._items)
+            for x in snap:
+                yield x
+        """,
+        SERVICE,
+    ),
+    "FL-LEAK-DOUBLE-CLOSE": (
+        """
+        class Session:
+            def _write(self):
+                self.close()
+            def close(self):
+                self.writer.close()
+        """,
+        """
+        class Session:
+            def _write(self):
+                self.close()
+            def close(self):
+                if self._closed:
+                    return
+                self._closed = True
+                self.writer.close()
+        """,
+        SERVICE,
+    ),
 }
 
 
@@ -1146,3 +1254,669 @@ def test_json_flag_emits_machine_readable_report(tmp_path, capsys):
     assert doc["unsuppressed"][0]["rule"] == "FL-DET-CLOCK"
     assert set(doc) == {"unsuppressed", "suppressed", "stale_suppressions",
                        "invalid_suppressions", "baseline_hygiene"}
+
+
+# -- fluidleak: exit-path enumerator ------------------------------------------
+
+
+def _parse_fn(src):
+    import ast
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def test_exit_paths_enumerate_every_exit_kind():
+    from tools.fluidlint.core import iter_exit_paths
+    fn = _parse_fn("""
+    def f(x):
+        a = probe()
+        if x:
+            return 1
+        raise ValueError("no")
+    """)
+    kinds = {p.kind for p in iter_exit_paths(fn)}
+    # probe()/ValueError() may raise ("exception"), the explicit raise is
+    # "raise", the if-true arm is "return"; no path falls off the end.
+    assert kinds == {"return", "raise", "exception"}
+
+
+def test_exit_paths_fall_through_records_calls_in_order():
+    from tools.fluidlint.core import iter_exit_paths
+    fn = _parse_fn("""
+    def f():
+        first()
+        second()
+    """)
+    falls = [p for p in iter_exit_paths(fn) if p.kind == "fall"]
+    assert len(falls) == 1
+    names = [ev.node.func.id for ev in falls[0].events
+             if ev.kind == "call"]
+    assert names == ["first", "second"]
+
+
+def test_exit_paths_finally_runs_on_exception_flows():
+    from tools.fluidlint.core import iter_exit_paths
+    fn = _parse_fn("""
+    def f(res):
+        res.start()
+        try:
+            work()
+        finally:
+            res.stop()
+    """)
+    def attr(ev):
+        return getattr(ev.node.func, "attr", None)
+
+    for p in iter_exit_paths(fn):
+        started = [i for i, ev in enumerate(p.events)
+                   if ev.kind == "call" and attr(ev) == "start"]
+        if not started:
+            continue  # start() itself raised
+        assert any(attr(ev) == "stop"
+                   for ev in p.events[started[0] + 1:]
+                   if ev.kind in ("call", "call-raised")), (
+            f"path exiting via {p.kind} never reached the finally")
+
+
+def test_exit_paths_decline_over_budget():
+    from tools.fluidlint.core import iter_exit_paths
+    body = "".join(f"    if a{i}():\n        b{i}()\n" for i in range(64))
+    fn = _parse_fn("def f():\n" + body)
+    assert iter_exit_paths(fn) is None
+
+
+def test_pair_rule_declines_over_budget_instead_of_guessing():
+    # an opener followed by pathological branching: the enumerator
+    # declines, so the rule reports NOTHING (never guesses)
+    body = "".join(f"    if a{i}():\n        b{i}()\n" for i in range(64))
+    src = ("class S:\n    def work(self, k):\n"
+           "        self.cache.begin(k)\n" + body.replace("    ", "        "))
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR") == []
+
+
+# -- fluidleak: FL-LEAK-PAIR edges --------------------------------------------
+
+
+def test_pair_closer_on_every_branch_is_clean():
+    src = """
+    class S:
+        def work(self, k):
+            h = self.c.begin(k)
+            if h:
+                self.c.finish(k)
+            else:
+                self.c.abandon(k)
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_closer_on_one_branch_only_fires():
+    src = """
+    class S:
+        def work(self, k):
+            h = self.c.begin(k)
+            if h:
+                self.c.finish(k)
+    """
+    hits = findings_for(src, SERVICE, "FL-LEAK-PAIR")
+    assert hits and "begin" in hits[0].message
+
+
+def test_pair_receiver_must_match():
+    # closing a DIFFERENT receiver's protocol does not close this one
+    src = """
+    class S:
+        def work(self, k):
+            self.c.begin(k)
+            self.other.finish(k)
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR")
+
+
+def test_pair_pairs_with_annotation_declares_site_specific_closers():
+    bad = """
+    class S:
+        def work(self, key):
+            h = self.store.grab(key)  # pairs-with: put_back, drop
+            return self.fold(h)
+    """
+    good = """
+    class S:
+        def work(self, key):
+            h = self.store.grab(key)  # pairs-with: put_back, drop
+            try:
+                return self.fold(h)
+            finally:
+                self.store.drop(key)
+    """
+    assert findings_for(bad, SERVICE, "FL-LEAK-PAIR")
+    assert findings_for(good, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_with_statement_counts_as_closed():
+    src = """
+    class S:
+        def work(self, k):
+            with self.pool.acquire(k) as conn:
+                return conn.run()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_imperative_lock_requires_release():
+    bad = """
+    class S:
+        def work(self):
+            self._lock.acquire()
+            return self.compute()
+    """
+    good = """
+    class S:
+        def work(self):
+            self._lock.acquire()
+            try:
+                return self.compute()
+            finally:
+                self._lock.release()
+    """
+    assert findings_for(bad, SERVICE, "FL-LEAK-PAIR")
+    assert findings_for(good, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_exit_paths_break_escaping_a_finally_to_outer_loop():
+    # regression: break/continue flow items are bare event tuples — the
+    # finally re-threading used to index them as (events, node) pairs
+    # and crash the whole analyze() run with a TypeError
+    from tools.fluidlint.core import iter_exit_paths
+    fn = _parse_fn("""
+    def f(self, items):
+        for x in items:
+            try:
+                if x:
+                    break
+                if not x:
+                    continue
+            finally:
+                cleanup(x)
+        done()
+    """)
+    paths = iter_exit_paths(fn)
+    assert paths is not None
+    falls = [p for p in paths if p.kind == "fall"]
+    assert falls, "break out of the loop must still fall off the end"
+    # ...and the escaping break ran the finally before leaving the try
+    names = [[getattr(ev.node.func, "id", None) for ev in p.events
+              if ev.kind == "call"] for p in falls]
+    assert any("cleanup" in seq and "done" in seq for seq in names)
+
+
+def test_pair_break_through_finally_is_analyzed_not_crashed():
+    src = """
+    class S:
+        def work(self, items):
+            self._lock.acquire()
+            try:
+                for x in items:
+                    try:
+                        if x:
+                            break
+                    finally:
+                        self.note(x)
+            finally:
+                self._lock.release()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_match_case_arms_branch_not_flatten():
+    # regression: match fell into the plain-statement branch, flattening
+    # case bodies into straight-line code — a closer in ONE arm looked
+    # unconditional and a leaking arm's early return was invisible
+    bad = """
+    class S:
+        def work(self, k):
+            self.cache.begin(k)
+            match k:
+                case 0:
+                    return None
+                case _:
+                    self.cache.finish(k)
+    """
+    good = """
+    class S:
+        def work(self, k):
+            self.cache.begin(k)
+            match k:
+                case 0:
+                    self.cache.abandon(k)
+                case _:
+                    self.cache.finish(k)
+    """
+    hits = findings_for(bad, SERVICE, "FL-LEAK-PAIR")
+    assert hits and "begin" in hits[0].message
+    assert findings_for(good, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_non_exhaustive_match_keeps_fall_through_path():
+    # no wildcard arm: no case may match, so the closer inside the only
+    # arm does not cover the fall-through path
+    src = """
+    class S:
+        def work(self, k):
+            self.cache.begin(k)
+            match k:
+                case 0:
+                    self.cache.finish(k)
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-PAIR")
+
+
+# -- fluidleak: FL-LEAK-ESCAPE edges ------------------------------------------
+
+
+def test_escape_handoff_to_self_is_not_a_leak():
+    src = """
+    import socket
+    class C:
+        def connect(self, host):
+            s = socket.create_connection((host, 1))
+            self._sock = s
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-ESCAPE") == []
+
+
+def test_escape_handoff_as_argument_is_not_a_leak():
+    src = """
+    import socket
+    def connect(pool, host):
+        s = socket.create_connection((host, 1))
+        pool.adopt(s)
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-ESCAPE") == []
+
+
+def test_escape_daemon_thread_is_exempt_nondaemon_is_not():
+    daemon = """
+    import threading
+    def run(fn):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+    """
+    plain = """
+    import threading
+    def run(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+    """
+    assert findings_for(daemon, SERVICE, "FL-LEAK-ESCAPE") == []
+    assert findings_for(plain, SERVICE, "FL-LEAK-ESCAPE")
+
+
+def test_escape_close_in_finally_is_clean():
+    src = """
+    def read(path):
+        f = open(path)
+        try:
+            return f.read()
+        finally:
+            f.close()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-ESCAPE") == []
+
+
+def test_escape_makefile_needs_close():
+    bad = """
+    class C:
+        def loop(self):
+            rfile = self._sock.makefile("rb")
+            return rfile.read(4)
+    """
+    good = """
+    class C:
+        def loop(self):
+            rfile = self._sock.makefile("rb")
+            try:
+                return rfile.read(4)
+            finally:
+                rfile.close()
+    """
+    assert findings_for(bad, SERVICE, "FL-LEAK-ESCAPE")
+    assert findings_for(good, SERVICE, "FL-LEAK-ESCAPE") == []
+
+
+# -- fluidleak: FL-LEAK-SWALLOW edges -----------------------------------------
+
+
+def test_swallow_bare_except_fires():
+    src = """
+    def loop(self):
+        try:
+            self.step()
+        except:
+            self.count += 1
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW")
+
+
+def test_swallow_reraise_is_clean():
+    src = """
+    def loop(self):
+        try:
+            self.step()
+        except Exception:
+            self.rollback()
+            raise
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW") == []
+
+
+def test_swallow_narrow_exception_is_clean():
+    src = """
+    def loop(self):
+        try:
+            self.step()
+        except KeyError:
+            pass
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW") == []
+
+
+def test_swallow_tuple_broad_except_fires():
+    """`except (Exception, ValueError):` is the same front door as
+    `except Exception:` — the tuple spelling must not slip the gate."""
+    src = """
+    def loop(self):
+        try:
+            self.step()
+        except (Exception, ValueError):
+            pass
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW")
+    narrow = """
+    def loop(self):
+        try:
+            self.step()
+        except (KeyError, ValueError):
+            pass
+    """
+    assert findings_for(narrow, SERVICE, "FL-LEAK-SWALLOW") == []
+
+
+def test_swallow_sink_names_match_whole_words_only():
+    """A bare call only counts as a telemetry sink when a whole
+    underscore-word says so: 'update_backlog'/'login'/'catalog' merely
+    CONTAIN 'log' and must not launder the swallow, while a real
+    'log_event'/'warn' direct call still does."""
+    for decoy in ("self.update_backlog()", "self.login()", "catalog()",
+                  "self.backlog.put(1)"):
+        src = f"""
+        def loop(self):
+            try:
+                self.step()
+            except Exception:
+                {decoy}
+        """
+        assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW"), decoy
+    for sink in ("log_event('stepError')", "warn('stepError')"):
+        src = f"""
+        def loop(self):
+            try:
+                self.step()
+            except Exception:
+                {sink}
+        """
+        assert findings_for(src, SERVICE, "FL-LEAK-SWALLOW") == [], sink
+
+
+def test_swallow_scope_is_serving_paths_only():
+    bad, _good, _ = MODULE_RULE_FIXTURES["FL-LEAK-SWALLOW"]
+    assert findings_for(bad, RUNTIME, "FL-LEAK-SWALLOW") == []
+
+
+# -- fluidleak: FL-LEAK-FINALLY-MASK edges ------------------------------------
+
+
+def test_finally_mask_bare_reraise_is_fine():
+    src = """
+    def f():
+        try:
+            work()
+        except Exception:
+            raise
+        finally:
+            try:
+                cleanup()
+            except OSError:
+                raise
+    """
+    # `raise` with no exception re-raises; only `raise X` masks
+    assert findings_for(src, SERVICE, "FL-LEAK-FINALLY-MASK") == []
+
+
+def test_finally_mask_break_inside_local_loop_is_fine():
+    src = """
+    def f(items):
+        try:
+            work()
+        finally:
+            for x in items:
+                if x:
+                    break
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-FINALLY-MASK") == []
+
+
+def test_finally_mask_continue_fires():
+    src = """
+    def f(items):
+        for x in items:
+            try:
+                work(x)
+            finally:
+                continue
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-FINALLY-MASK")
+
+
+def test_finally_mask_nested_try_reported_once():
+    """A try/finally nested inside an outer finally must not double-
+    report: the outer finalbody walk already covers it, and check()'s
+    direct visit of the inner Try has to be skipped."""
+    src = """
+    def f():
+        try:
+            a()
+        finally:
+            try:
+                b()
+            finally:
+                return 1
+    """
+    found = findings_for(src, SERVICE, "FL-LEAK-FINALLY-MASK")
+    assert len(found) == 1, [f.message for f in found]
+
+
+def test_finally_mask_caught_raise_inside_finally_is_fine():
+    """A raise inside a finally-local try WITH handlers is assumed
+    caught before it can mask the in-flight exception; the same raise
+    in a handler or orelse body stays unprotected and fires."""
+    src = """
+    def f():
+        try:
+            work()
+        finally:
+            try:
+                raise ValueError("probe")
+            except ValueError:
+                cleanup()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-FINALLY-MASK") == []
+    src_handler = """
+    def f():
+        try:
+            work()
+        finally:
+            try:
+                cleanup()
+            except OSError:
+                raise RuntimeError("masks")
+    """
+    assert findings_for(src_handler, SERVICE, "FL-LEAK-FINALLY-MASK")
+
+
+# -- fluidleak: FL-LEAK-GEN-HOLD edges ----------------------------------------
+
+
+def test_gen_hold_open_file_handle_fires():
+    src = """
+    def lines(path):
+        with open(path) as f:
+            for line in f:
+                yield line
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-GEN-HOLD")
+
+
+def test_gen_hold_non_resource_context_is_fine():
+    src = """
+    def rows(self):
+        with self.profiler:
+            for r in self._rows:
+                yield r
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-GEN-HOLD") == []
+
+
+# -- fluidleak: FL-LEAK-DOUBLE-CLOSE edges ------------------------------------
+
+
+def test_double_close_two_tracked_call_sites_fire():
+    src = """
+    class C:
+        def close(self):
+            self._file.close()
+    def teardown():
+        c = C()
+        c.close()
+        c.close()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-DOUBLE-CLOSE")
+
+
+def test_double_close_single_call_site_is_quiet():
+    src = """
+    class C:
+        def close(self):
+            self._file.close()
+    def teardown():
+        c = C()
+        c.close()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-DOUBLE-CLOSE") == []
+
+
+def test_double_close_try_except_guard_accepted():
+    # the _RpcClient shape: every release individually armored
+    src = """
+    class C:
+        def reset(self):
+            self.close()
+        def close(self):
+            try:
+                self._sock.shutdown()
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-DOUBLE-CLOSE") == []
+
+
+def test_double_close_lock_wrapped_guard_accepted():
+    # the OTHER _RpcClient shape: the idempotency flag is checked and
+    # set under the state lock; the guard must be seen through `with`
+    src = """
+    class C:
+        def reset(self):
+            self.close()
+        def close(self):
+            with self._state_lock:
+                if self._closed:
+                    return
+                self._closed = True
+            self._writer.close()
+    """
+    assert findings_for(src, SERVICE, "FL-LEAK-DOUBLE-CLOSE") == []
+
+
+# -- registry meta-coverage ----------------------------------------------------
+
+
+def test_registry_fully_self_tested():
+    """Every registered rule must carry at least one positive (fires)
+    and one negative (stays quiet) self-test: module rules through a
+    MODULE_RULE_FIXTURES pair, project rules through named
+    test_<slug>_positive/negative functions.  A future rule landing
+    without tests fails HERE, not silently in production."""
+    from tools.fluidlint import all_rules
+    from tools.fluidlint.core import ProjectRule
+
+    rules = all_rules()
+    module_ids = {n for n, r in rules.items()
+                  if not isinstance(r, ProjectRule)}
+    missing = sorted(module_ids - set(MODULE_RULE_FIXTURES))
+    assert not missing, (
+        f"module rules without a (positive, negative) fixture pair in "
+        f"MODULE_RULE_FIXTURES: {missing}")
+    unknown = sorted(set(MODULE_RULE_FIXTURES) - module_ids)
+    assert not unknown, f"fixtures for unregistered rules: {unknown}"
+    for rule_id in sorted(set(rules) - module_ids):
+        slug = rule_id.lower().replace("fl-", "", 1).replace("-", "_")
+        for suffix in ("positive", "negative"):
+            assert f"test_{slug}_{suffix}" in globals(), (
+                f"{rule_id}: project rule needs a test_{slug}_{suffix}")
+
+
+# -- baseline rule-id hygiene --------------------------------------------------
+
+
+def test_rule_hygiene_flags_unregistered_rule_id():
+    from tools.fluidlint import baseline_rule_hygiene
+    problems = baseline_rule_hygiene([
+        {"rule": "FL-GONE-RULE", "path": "x.py", "message": "m",
+         "reason": "r"}])
+    assert problems and "not registered" in problems[0]
+    assert baseline_rule_hygiene([
+        {"rule": "FL-DET-CLOCK", "path": "x.py", "message": "m",
+         "reason": "r"}]) == []
+
+
+def test_check_baseline_flags_unregistered_rule_id(tmp_path, capsys):
+    from tools.fluidlint.cli import main
+    _clock_violation_tree(tmp_path)
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "FL-GONE-RULE",
+         "path": "fluidframework_tpu/loader/bad.py",
+         "message": "m", "reason": "reviewed"}]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--check-baseline"]) == 1
+    assert "not registered" in capsys.readouterr().out
+
+
+def test_unregistered_rule_entry_fails_even_under_rules_filter(tmp_path):
+    # --rules filtering ignores entries of UNSELECTED rules, but an
+    # UNREGISTERED rule id is dead weight on every run: the hygiene
+    # check consults the full, unfiltered registry and baseline.
+    from tools.fluidlint.cli import main
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "ok.py").write_text("X = 1\n")
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({"version": 1, "suppressions": [
+        {"rule": "FL-GONE-RULE",
+         "path": "fluidframework_tpu/loader/ok.py",
+         "message": "m", "reason": "reviewed"}]}))
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--rules", "FL-RACE"]) == 1

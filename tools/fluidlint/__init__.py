@@ -17,6 +17,8 @@ from .core import (  # noqa: F401
     analyze_source,
     apply_baseline,
     baseline_function_hygiene,
+    iter_exit_paths,
+    baseline_rule_hygiene,
     baseline_skeleton,
     load_baseline,
     register,

@@ -19,8 +19,15 @@ import sys
 from typing import List, Optional
 
 from .core import (ProjectRule, all_rules, analyze, apply_baseline,
-                   baseline_function_hygiene, baseline_skeleton,
-                   load_baseline)
+                   baseline_function_hygiene, baseline_rule_hygiene,
+                   baseline_skeleton, load_baseline)
+
+
+def rule_family(rule) -> str:
+    """Family name of a rule, from its defining module
+    (``rules_lifecycle`` -> ``lifecycle``)."""
+    module = type(rule).__module__.rsplit(".", 1)[-1]
+    return module.split("rules_", 1)[-1] if "rules_" in module else module
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -46,9 +53,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "for other rules are ignored, not stale")
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument("--check-baseline", action="store_true",
-                        help="baseline hygiene only: fail when an entry's "
-                             "message references a function that no "
-                             "longer exists (no analysis pass)")
+                        help="baseline hygiene only: fail when an entry "
+                             "names a rule id that is no longer "
+                             "registered, or its message references a "
+                             "function that no longer exists (no "
+                             "analysis pass)")
     parser.add_argument("--write-baseline", metavar="FILE",
                         help="write a baseline skeleton covering current "
                              "findings (reasons left empty for review)")
@@ -67,8 +76,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     if args.list_rules:
+        # One row per rule: id, family, default severity, one-line doc —
+        # the README coverage test keeps the rule-catalog tables in sync
+        # with exactly this registry.
         for name, rule in sorted(rules.items()):
-            print(f"{name} [{rule.severity}] {rule.description}")
+            doc = " ".join(rule.description.split())
+            print(f"{name} [{rule_family(rule)}/{rule.severity}] {doc}")
         return 0
 
     root = pathlib.Path(args.root).resolve()
@@ -110,8 +123,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("error: --check-baseline requires --baseline",
                   file=sys.stderr)
             return 2
-        problems = baseline_function_hygiene(root,
-                                             load_baseline(baseline_path))
+        entries = load_baseline(baseline_path)
+        problems = baseline_rule_hygiene(entries) \
+            + baseline_function_hygiene(root, entries)
         for msg in problems:
             print(f"baseline: {msg}")
         print(f"fluidlint: baseline hygiene — {len(problems)} problem(s)")
@@ -128,9 +142,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{args.write_baseline} (fill in every 'reason' field)")
         return 0
 
-    entries = []
+    entries = all_entries = []
     if baseline_path is not None:
-        entries = load_baseline(baseline_path)
+        entries = all_entries = load_baseline(baseline_path)
         if relpaths is not None:
             # Path-scoped run: entries for files outside the analyzed
             # subset — and for project rules, which analyze() skips when
@@ -146,7 +160,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             # Rule-scoped run: same logic for entries of unselected rules.
             entries = [e for e in entries if e.get("rule") in rules]
     report = apply_baseline(findings, entries)
-    hygiene = baseline_function_hygiene(root, entries)
+    # Rule hygiene checks the FULL registry on purpose: an entry for an
+    # unregistered rule is dead weight whether or not this run selected
+    # its family — so it runs over the UNFILTERED entry list.
+    hygiene = baseline_rule_hygiene(all_entries)
+    hygiene += baseline_function_hygiene(root, entries)
     clean = report.clean and not hygiene
 
     if args.format == "json":

@@ -276,6 +276,278 @@ def analyze(root: pathlib.Path,
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 
+# -- exit-path enumeration ----------------------------------------------------
+#
+# The fluidleak family (rules_lifecycle.py) asks flow questions the plain
+# AST walk cannot answer: "does call X happen on *every* path after call
+# Y?".  ``iter_exit_paths`` enumerates a function's control-flow paths —
+# normal return, early return, explicit raise, an exception propagating
+# out of any call, and fall-through — with ``try``/``except``/``finally``
+# composition, so a rule can inspect the event sequence of each exit.
+#
+# Approximations (deliberate, documented in the fluidlint README):
+# loops run zero-or-one times (``while True`` cannot run zero); every
+# call may raise; an except handler always catches (flows continue after
+# the try — an exception type no handler matches escaping unclosed is
+# invisible); nested def/lambda bodies run later and contribute nothing.
+# A raising call is recorded as a ``call-raised`` event: it *attempted*
+# but did not complete — closers accept attempts, openers do not.
+
+
+@dataclasses.dataclass(frozen=True)
+class PathEvent:
+    """One thing that happened along a path: a completed call
+    (``"call"``), a call that raised (``"call-raised"``), or entry into a
+    with-block (``"with"``, node = the context expression)."""
+
+    kind: str
+    node: ast.AST
+
+
+@dataclasses.dataclass(frozen=True)
+class ExitPath:
+    """One way out of a function: the ordered events leading there, the
+    exit kind (``return`` / ``raise`` / ``exception`` / ``fall``), and
+    the exiting node (Return/Raise statement, the raising call, or the
+    function itself for fall-through)."""
+
+    events: Tuple[PathEvent, ...]
+    kind: str
+    node: ast.AST
+
+
+class _PathBudgetExceeded(Exception):
+    pass
+
+
+def _eval_calls(node: ast.AST) -> List[ast.Call]:
+    """Call nodes of one expression in completion order (inner-first).
+    Lambda and nested-def bodies run later — skipped."""
+    out: List[ast.Call] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+        if isinstance(n, ast.Call):
+            out.append(n)
+
+    visit(node)
+    return out
+
+
+def iter_exit_paths(fn, max_flows: int = 1500) -> Optional[List[ExitPath]]:
+    """Every exit path of ``fn``, or ``None`` when the function is too
+    branchy for the budget — callers must *decline* (report nothing)
+    rather than guess."""
+    budget = [max_flows]
+
+    def spend(n: int = 1) -> None:
+        budget[0] -= n
+        if budget[0] < 0:
+            raise _PathBudgetExceeded
+
+    def new_flows() -> Dict[str, list]:
+        return {"ret": [], "raise": [], "break": [], "continue": []}
+
+    def merge(into: Dict[str, list], src: Dict[str, list]) -> None:
+        for k in ("ret", "raise", "break", "continue"):
+            into[k].extend(src[k])
+
+    def eval_expr(prefixes, expr, flows):
+        """Thread one expression's calls through every prefix; each call
+        forks an exception flow (events exclude nothing — the raising
+        call rides along as 'call-raised')."""
+        calls = _eval_calls(expr)
+        out = []
+        for p in prefixes:
+            events = p
+            for c in calls:
+                spend()
+                flows["raise"].append(
+                    (events + (PathEvent("call-raised", c),), c, "exception"))
+                events = events + (PathEvent("call", c),)
+            spend()
+            out.append(events)
+        return out
+
+    def block(stmts, prefixes) -> Dict[str, list]:
+        flows = new_flows()
+        cur = list(prefixes)
+        for stmt in stmts:
+            if not cur:
+                break  # unreachable tail
+            cur = handle(stmt, cur, flows)
+        flows["cont"] = cur
+        return flows
+
+    def handle(stmt, prefixes, flows):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Pass, ast.Global,
+                             ast.Nonlocal, ast.Import, ast.ImportFrom)):
+            return prefixes
+        if isinstance(stmt, ast.Return):
+            pre = eval_expr(prefixes, stmt.value, flows) \
+                if stmt.value is not None else prefixes
+            for p in pre:
+                spend()
+                flows["ret"].append((p, stmt))
+            return []
+        if isinstance(stmt, ast.Raise):
+            pre = prefixes
+            for part in (stmt.exc, stmt.cause):
+                if part is not None:
+                    pre = eval_expr(pre, part, flows)
+            for p in pre:
+                spend()
+                flows["raise"].append((p, stmt, "raise"))
+            return []
+        if isinstance(stmt, ast.Break):
+            flows["break"].extend(prefixes)
+            return []
+        if isinstance(stmt, ast.Continue):
+            flows["continue"].extend(prefixes)
+            return []
+        if isinstance(stmt, ast.If):
+            pre = eval_expr(prefixes, stmt.test, flows)
+            b = block(stmt.body, pre)
+            o = block(stmt.orelse, pre)
+            merge(flows, b)
+            merge(flows, o)
+            return b["cont"] + o["cont"]
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+            pre = eval_expr(prefixes, head, flows)
+            body = block(stmt.body, pre)
+            flows["ret"].extend(body["ret"])
+            flows["raise"].extend(body["raise"])
+            # zero-or-one iterations; `while True` cannot skip the body
+            always = isinstance(stmt, ast.While) and \
+                isinstance(stmt.test, ast.Constant) and bool(stmt.test.value)
+            after = (body["cont"] + body["continue"]
+                     + ([] if always else list(pre)))
+            if stmt.orelse:
+                o = block(stmt.orelse, after)
+                merge(flows, o)
+                after = o["cont"]
+            return after + body["break"]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pre = prefixes
+            for item in stmt.items:
+                pre = eval_expr(pre, item.context_expr, flows)
+                pre = [p + (PathEvent("with", item.context_expr),)
+                       for p in pre]
+            body = block(stmt.body, pre)
+            merge(flows, body)
+            return body["cont"]
+        if isinstance(stmt, ast.Try):
+            local = new_flows()  # this try's own flows, pre-finally
+            b = block(stmt.body, prefixes)
+            local["ret"].extend(b["ret"])
+            local["break"].extend(b["break"])
+            local["continue"].extend(b["continue"])
+            cont = b["cont"]
+            if stmt.orelse:
+                o = block(stmt.orelse, cont)
+                merge(local, o)
+                cont = o["cont"]
+            if stmt.handlers:
+                # every handler is assumed to catch (see module note);
+                # dedupe entry events so N raising calls with identical
+                # histories pay for one handler walk
+                entries = []
+                seen = set()
+                for events, _node, _kind in b["raise"]:
+                    if events not in seen:
+                        seen.add(events)
+                        entries.append(events)
+                for events in entries:
+                    for h in stmt.handlers:
+                        hf = block(h.body, [events])
+                        merge(local, hf)
+                        cont = cont + hf["cont"]
+            else:
+                local["raise"].extend(b["raise"])
+            if stmt.finalbody:
+                fin_cache: Dict[tuple, Dict[str, list]] = {}
+
+                def through(events):
+                    ff = fin_cache.get(events)
+                    if ff is None:
+                        ff = block(stmt.finalbody, [events])
+                        fin_cache[events] = ff
+                        # exits originating IN the finally mask the
+                        # in-flight flow (the FINALLY-MASK rule's domain)
+                        merge(flows, ff)
+                    return ff["cont"]
+
+                out_cont = []
+                for events in cont:
+                    out_cont.extend(through(events))
+                # ret/raise items are (events, node[, kind]) tuples;
+                # break/continue items are bare event tuples — escaping
+                # to an outer loop carries no exiting node.
+                for key in ("ret", "raise"):
+                    for item in local[key]:
+                        for tail in through(item[0]):
+                            flows[key].append((tail,) + tuple(item[1:]))
+                for key in ("break", "continue"):
+                    for events in local[key]:
+                        flows[key].extend(through(events))
+                cont = out_cont
+            else:
+                merge(flows, local)
+            return cont
+        if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+            # Each case arm branches like an If arm; without a wildcard
+            # (`case _:` / bare `case x:`) no arm may match and control
+            # falls through.  Flattening arms into straight-line code
+            # (the plain-statement fallback) would GUESS — a `return` in
+            # one arm would look unconditional to every rule.
+            pre = eval_expr(prefixes, stmt.subject, flows)
+            out = []
+            exhaustive = False
+            for case in stmt.cases:
+                cpre = pre
+                if case.guard is not None:
+                    cpre = eval_expr(cpre, case.guard, flows)
+                arm = block(case.body, cpre)
+                merge(flows, arm)
+                out.extend(arm["cont"])
+                if case.guard is None and \
+                        isinstance(case.pattern, ast.MatchAs) and \
+                        case.pattern.pattern is None:
+                    exhaustive = True
+            if not exhaustive:
+                out.extend(pre)
+            return out
+        # plain statement (Expr/Assign/AugAssign/AnnAssign/Assert/...)
+        pre = prefixes
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.expr_context, ast.operator)):
+                continue
+            pre = eval_expr(pre, child, flows)
+        if isinstance(stmt, ast.Assert):
+            for p in pre:
+                spend()
+                flows["raise"].append((p, stmt, "exception"))
+        return pre
+
+    try:
+        flows = block(fn.body, [()])
+    except (_PathBudgetExceeded, RecursionError):
+        return None
+    exits: List[ExitPath] = []
+    for events in flows["cont"]:
+        exits.append(ExitPath(events, "fall", fn))
+    for events, node in flows["ret"]:
+        exits.append(ExitPath(events, "return", node))
+    for events, node, kind in flows["raise"]:
+        exits.append(ExitPath(events, kind, node))
+    return exits
+
+
 # -- baseline -----------------------------------------------------------------
 
 
@@ -402,6 +674,29 @@ def baseline_function_hygiene(root: pathlib.Path,
                 f"references function(s) {', '.join(missing)} that no "
                 "longer exist in that file — the reviewed finding is "
                 "gone; delete or re-review the entry")
+    return problems
+
+
+def baseline_rule_hygiene(entries: Sequence[dict],
+                          known_rules: Optional[Iterable[str]] = None
+                          ) -> List[str]:
+    """Entries naming a rule id that is no longer registered.
+
+    The function hygiene check catches vanished *functions*; this
+    catches vanished *rules* — a renamed or deleted rule would otherwise
+    leave its reviewed suppressions as dead weight the staleness check
+    can never see (no rule, no finding, and entries of unselected rules
+    are deliberately ignored on ``--rules`` runs).  Always checked
+    against the FULL registry, never a family-filtered subset."""
+    known = set(known_rules) if known_rules is not None else set(all_rules())
+    problems: List[str] = []
+    for i, e in enumerate(entries):
+        rule = e.get("rule")
+        if isinstance(rule, str) and rule and rule not in known:
+            problems.append(
+                f"suppression[{i}] ({rule}, {e.get('path')}): rule id is "
+                "not registered (renamed or deleted rule) — delete the "
+                "entry or restore the rule")
     return problems
 
 

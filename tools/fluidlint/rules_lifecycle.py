@@ -1,0 +1,744 @@
+"""fluidleak — exception-path resource-lifecycle & error-hygiene rules.
+
+The serving path's correctness rests on hand-maintained cleanup
+protocols: the single-flight cache demands "``finish`` or ``abandon``
+the key (use try/finally)" (`service/catchup_cache.py`), sockets need
+``shutdown(SHUT_RDWR)`` *and* ``close()`` to unstick reader threads
+(`drivers/network_driver.py`), and a leader that "died without reaching
+its finally" strands a whole herd (`service/catchup.py`).  Nothing
+*checked* that every exit path honors these pairings — a leaked flight,
+an unclosed socket, or a silently-swallowed exception survives every
+deterministic test by definition and only shows up as a production
+hang.  This family closes that gap the way fluidlint closed it for
+determinism and fluidrace for lock discipline: statically, over the
+plain AST, using the exit-path enumerator in ``core.iter_exit_paths``.
+
+Protocol pairs
+--------------
+
+``PROTOCOL_PAIRS`` maps opener method names to their accepted closers
+(``begin -> finish | abandon``, ``acquire -> release``,
+``open -> close``, ``shutdown -> close``).  Openers and closers match on
+the *same receiver text* (``self.cache.begin`` pairs with
+``self.cache.abandon``, never ``other.abandon``).  Site-specific pairs
+are declared with a trailing comment on the opener's line::
+
+    handle = self.store.grab(key)  # pairs-with: put_back, drop
+
+Known limits (document, don't pretend): receiver matching is textual —
+aliasing (``c = self.cache; c.abandon(k)``) is invisible; loops run
+zero-or-one times; every except handler is assumed to catch (an
+exception type no handler matches escaping unclosed is invisible);
+functions too branchy for the path budget are declined, not guessed at;
+closures that capture a resource do not count as a hand-off.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .core import (ExitPath, Finding, ModuleContext, Rule,
+                   iter_exit_paths, register)
+from .rules_concurrency import (SERVING_SCOPE, _owner_phrase,
+                                _walk_pruned as _fn_walk)
+
+#: opener method name -> accepted closer method names (same receiver)
+PROTOCOL_PAIRS: Dict[str, Tuple[str, ...]] = {
+    "begin": ("finish", "abandon"),
+    "acquire": ("release",),
+    "open": ("close",),
+    "shutdown": ("close",),
+}
+
+PAIRS_WITH_RE = re.compile(r"pairs-with:\s*([A-Za-z_][\w, ]*)")
+
+#: constructors whose result owns an OS resource; the value must be
+#: closed on every path, escape the function, or live in a ``with``.
+RESOURCE_CTORS = {
+    "open": "open",
+    "socket.socket": "socket.socket",
+    "socket.create_connection": "socket.create_connection",
+    "concurrent.futures.ThreadPoolExecutor": "ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor": "ProcessPoolExecutor",
+    "threading.Thread": "threading.Thread",
+}
+#: attribute-call constructors matched by method name (receiver-typed
+#: resolution is beyond the AST): ``sock.makefile(...)`` ownership.
+RESOURCE_CTOR_METHODS = {"makefile"}
+
+#: calls that release a locally-owned resource
+RESOURCE_CLOSERS = {"close", "shutdown", "release", "terminate", "stop",
+                    "join"}
+
+#: method names that release member state (the double-close rule's
+#: notion of a "release site")
+RELEASE_VERBS = {"close", "shutdown", "release", "disconnect",
+                 "unsubscribe", "clear", "stop", "cancel", "terminate"}
+
+#: close-like method names whose definitions are checked for idempotency
+CLOSE_METHODS = ("close", "shutdown")
+
+#: telemetry / logging sinks: a broad except that reports through one of
+#: these is surfacing the error, not swallowing it
+_SINK_METHODS = {"send", "log", "warn", "warning", "exception", "error",
+                 "critical", "debug", "info", "put", "bump"}
+
+#: Whole underscore-words that mark a name as telemetry-ish.  Substring
+#: matching is a laundering hole in BOTH branches: 'update_backlog' /
+#: 'login' / 'catalog' as a direct call, 'self.backlog.put(...)' as a
+#: receiver (generic _SINK_METHODS verbs make the receiver the only
+#: real signal) — none of these may count as surfacing the error.
+_SINK_WORDS = {"log", "logger", "logging", "telemetry", "warn", "warning",
+               "metric", "metrics"}
+
+
+def _is_sink_name(name: str) -> bool:
+    return any(w in _SINK_WORDS for w in name.lower().split("_"))
+
+_LOCKISH = re.compile(r"lock|mutex|cond|sem", re.IGNORECASE)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``self.cache`` / ``a.b.c`` / ``x`` as text, None for anything
+    rooted in a call result or literal."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    """Every def in the module, nested included (each analyzed in its
+    own right — the enumerator never descends into nested defs)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _exit_paths_for(m: ModuleContext, fn) -> Optional[List[ExitPath]]:
+    """Memoized ``iter_exit_paths`` — PAIR and ESCAPE walk the same
+    functions; enumerate once per (module, def)."""
+    cache = getattr(m, "_leak_paths", None)
+    if cache is None:
+        cache = {}
+        m._leak_paths = cache
+    if id(fn) not in cache:
+        cache[id(fn)] = iter_exit_paths(fn)
+    return cache[id(fn)]
+
+
+def _with_item_nodes(fn) -> Set[int]:
+    """ids of every node inside a with-item's context expression: a
+    resource opened there is closed by ``__exit__`` on every path."""
+    out: Set[int] = set()
+    for node in _fn_walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    out.add(id(sub))
+    return out
+
+
+def _finally_protected(fn, opener: ast.Call, is_closer) -> bool:
+    """The opener sits in a try whose ``finally`` lexically contains a
+    matching closer — every path out of that try (including exceptions
+    and conditional closers the flow analysis cannot prove) runs it."""
+    for node in _fn_walk(fn):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        in_body = any(id(sub) == id(opener)
+                      for stmt in node.body
+                      for sub in ast.walk(stmt))
+        if not in_body:
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and is_closer(sub):
+                    return True
+    return False
+
+
+def _leaky_exits(paths: List[ExitPath], opener: ast.Call,
+                 is_closer) -> List[ExitPath]:
+    """Exit paths where the opener completed but no closer was even
+    attempted afterwards."""
+    bad: List[ExitPath] = []
+    for p in paths:
+        idx = None
+        for i, ev in enumerate(p.events):
+            if ev.kind == "call" and ev.node is opener:
+                idx = i
+                break
+        if idx is None:
+            continue  # opener not on this path (or never completed)
+        closed = any(
+            ev.kind in ("call", "call-raised") and is_closer(ev.node)
+            for ev in p.events[idx + 1:]
+        )
+        if not closed:
+            bad.append(p)
+    return bad
+
+
+def _exit_kinds(paths: List[ExitPath]) -> str:
+    order = ("exception", "raise", "return", "fall")
+    kinds = {p.kind for p in paths}
+    return "/".join(k for k in order if k in kinds)
+
+
+# -- FL-LEAK-PAIR --------------------------------------------------------------
+
+
+@register
+class ProtocolPairRule(Rule):
+    name = "FL-LEAK-PAIR"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "declared resource-protocol opener (begin/acquire/open/shutdown "
+        "or '# pairs-with:') reaching a function exit with no matching "
+        "closer on that path — close on every path (with / try-finally)"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            yield from self._check_fn(m, fn)
+
+    def _openers(self, m: ModuleContext, fn):
+        """(call, receiver text, closers) for every protocol opener in
+        the function — table-matched method calls plus '# pairs-with:'
+        annotated sites."""
+        for node in _fn_walk(fn):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            recv = _dotted(node.func.value)
+            if recv is None:
+                continue
+            comment = m.comments.get(node.lineno, "") or \
+                m.comments.get(getattr(node, "end_lineno", 0), "")
+            match = PAIRS_WITH_RE.search(comment)
+            if match:
+                closers = tuple(n.strip() for n in match.group(1).split(",")
+                                if n.strip())
+                if closers:
+                    yield node, recv, closers
+                    continue
+            closers = PROTOCOL_PAIRS.get(node.func.attr)
+            if closers is not None:
+                yield node, recv, closers
+
+    def _check_fn(self, m: ModuleContext, fn) -> Iterator[Finding]:
+        openers = list(self._openers(m, fn))
+        if not openers:
+            return
+        with_nodes = _with_item_nodes(fn)
+        paths = None
+        for call, recv, closers in openers:
+            if id(call) in with_nodes:
+                continue  # __exit__ closes on every path
+
+            def is_closer(c: ast.AST, recv=recv, closers=closers) -> bool:
+                return (isinstance(c, ast.Call)
+                        and isinstance(c.func, ast.Attribute)
+                        and c.func.attr in closers
+                        and _dotted(c.func.value) == recv)
+
+            if _finally_protected(fn, call, is_closer):
+                continue
+            if paths is None:
+                paths = _exit_paths_for(m, fn)
+            if paths is None:
+                break  # too branchy: decline the whole function
+            bad = _leaky_exits(paths, call, is_closer)
+            if bad:
+                want = "/".join(f".{c}()" for c in closers)
+                yield m.finding(
+                    self, call,
+                    f"'.{call.func.attr}()' on '{recv}' "
+                    f"{_owner_phrase(fn.name)} can exit via "
+                    f"{_exit_kinds(bad)} with no {want} on that "
+                    f"path; close the protocol on every path "
+                    "(try/finally) or annotate the intended pair with "
+                    "'# pairs-with:'",
+                )
+
+
+# -- FL-LEAK-ESCAPE ------------------------------------------------------------
+
+
+@register
+class ResourceEscapeRule(Rule):
+    name = "FL-LEAK-ESCAPE"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "locally-constructed resource (socket, open() handle, makefile, "
+        "executor, non-daemon thread) neither closed on every path nor "
+        "escaping via return/self./container/argument — use 'with'"
+    )
+
+    def _constructions(self, m: ModuleContext, fn):
+        """(local name, ctor label, call) for resource constructors
+        assigned to a plain local name."""
+        for node in _fn_walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                continue
+            label = None
+            q = m.imports.resolve(value.func)
+            if q in RESOURCE_CTORS:
+                label = RESOURCE_CTORS[q]
+                if q == "threading.Thread" and any(
+                        kw.arg == "daemon"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value for kw in value.keywords):
+                    continue  # daemon threads are fire-and-forget
+            elif isinstance(value.func, ast.Attribute) and \
+                    value.func.attr in RESOURCE_CTOR_METHODS:
+                label = f".{value.func.attr}"
+            if label is not None:
+                yield targets[0].id, label, value
+
+    @staticmethod
+    def _mentions_outside_calls(node: ast.AST, name: str) -> bool:
+        """``name`` appears in the expression in a value position — NOT
+        inside a call subtree.  ``return rfile`` hands the resource off;
+        ``return rfile.read(4)`` hands off bytes read *from* it (the
+        Call branch of ``_escapes`` separately catches the resource
+        passed as an argument)."""
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, ast.Call):
+                continue
+            if isinstance(cur, ast.Name) and cur.id == name:
+                return True
+            stack.extend(ast.iter_child_nodes(cur))
+        return False
+
+    @classmethod
+    def _escapes(cls, fn, name: str, ctor: ast.Call) -> bool:
+        """The resource is handed off: returned/yielded, stored on self
+        or into a container, or passed as a call argument."""
+        for node in _fn_walk(fn):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if cls._mentions_outside_calls(node, name):
+                    return True
+            elif isinstance(node, ast.Assign):
+                if node.value is ctor:
+                    continue
+                stores_out = any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets)
+                if stores_out and cls._mentions_outside_calls(node.value,
+                                                              name):
+                    return True
+            elif isinstance(node, ast.Call) and node is not ctor:
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if any(isinstance(sub, ast.Name) and sub.id == name
+                           for sub in ast.walk(arg)):
+                        return True
+        return False
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            constructions = list(self._constructions(m, fn))
+            if not constructions:
+                continue
+            with_nodes = _with_item_nodes(fn)
+            for name, label, ctor in constructions:
+                if id(ctor) in with_nodes:
+                    continue
+                if self._escapes(fn, name, ctor):
+                    continue
+
+                def is_closer(c: ast.AST, name=name) -> bool:
+                    return (isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr in RESOURCE_CLOSERS
+                            and isinstance(c.func.value, ast.Name)
+                            and c.func.value.id == name)
+
+                if _finally_protected(fn, ctor, is_closer):
+                    continue
+                paths = _exit_paths_for(m, fn)
+                if paths is None:
+                    break
+                bad = _leaky_exits(paths, ctor, is_closer)
+                if bad:
+                    yield m.finding(
+                        self, ctor,
+                        f"resource '{name}' ({label}) constructed "
+                        f"{_owner_phrase(fn.name)} can exit via "
+                        f"{_exit_kinds(bad)} neither closed nor "
+                        "handed off; wrap it in 'with' or close it in a "
+                        "try/finally",
+                    )
+
+
+# -- FL-LEAK-SWALLOW -----------------------------------------------------------
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    name = "FL-LEAK-SWALLOW"
+    severity = "error"
+    scope = SERVING_SCOPE
+    description = (
+        "bare/broad except on a serving path that neither re-raises, "
+        "uses the caught exception, nor reports through a telemetry/"
+        "logging sink — failures vanish instead of surfacing"
+    )
+
+    _BROAD = ("Exception", "BaseException")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            for node in _fn_walk(fn):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                label = self._broad_label(m, node)
+                if label is None:
+                    continue
+                if self._surfaces(node):
+                    continue
+                yield m.finding(
+                    self, node,
+                    f"broad '{label}' {_owner_phrase(fn.name)} swallows "
+                    "the error on a serving path (no re-raise, no "
+                    "telemetry); re-raise, narrow the exception type, or "
+                    "send an event through the telemetry logger",
+                )
+
+    def _broad_label(self, m: ModuleContext,
+                     node: ast.ExceptHandler) -> Optional[str]:
+        if node.type is None:
+            return "except:"
+        # `except (Exception, ValueError):` is the same front door as
+        # `except Exception:` — one broad member makes the tuple broad
+        types = node.type.elts if isinstance(node.type, ast.Tuple) \
+            else [node.type]
+        for t in types:
+            q = m.imports.resolve(t)
+            if q in self._BROAD:
+                return f"except {q}"
+        return None
+
+    @staticmethod
+    def _surfaces(handler: ast.ExceptHandler) -> bool:
+        """The handler does something with the failure: re-raises,
+        references the bound exception, or calls a telemetry sink."""
+        for node in _fn_walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if handler.name and isinstance(node, ast.Name) and \
+                    node.id == handler.name:
+                return True
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                attr = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else ""
+                parts = dotted.split(".")
+                if any(_is_sink_name(p) for p in parts[:-1]) \
+                        and (attr in _SINK_METHODS or not attr):
+                    return True
+                if _is_sink_name(parts[-1]):
+                    return True
+        return False
+
+
+# -- FL-LEAK-FINALLY-MASK ------------------------------------------------------
+
+
+@register
+class FinallyMaskRule(Rule):
+    name = "FL-LEAK-FINALLY-MASK"
+    severity = "error"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "return / raise X / break / continue inside a finally block — "
+        "silently discards any in-flight exception (a bare 're-raise' "
+        "raise is fine)"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            # _fn_walk yields ancestors first; a Try nested inside an
+            # outer finalbody was already scanned by that finalbody's
+            # walk — visiting it again would report every statement in
+            # ITS finalbody twice.
+            scanned: Set[int] = set()
+            for node in _fn_walk(fn):
+                if not isinstance(node, ast.Try) or not node.finalbody:
+                    continue
+                if id(node) in scanned:
+                    continue
+                for stmt in node.finalbody:
+                    for sub in _fn_walk(stmt):
+                        if isinstance(sub, ast.Try):
+                            scanned.add(id(sub))
+                    yield from self._check_finally(m, fn, stmt)
+
+    def _check_finally(self, m: ModuleContext, fn,
+                       root: ast.stmt) -> Iterator[Finding]:
+        # loops *inside* the finally own their break/continue
+        loop_subtrees: Set[int] = set()
+        for node in _fn_walk(root):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        loop_subtrees.add(id(sub))
+        # a raise in the BODY of a finally-local try that has handlers
+        # is (assumed) caught before it can mask anything; orelse and
+        # handler bodies stay unprotected
+        caught_subtrees: Set[int] = set()
+        for node in _fn_walk(root):
+            if isinstance(node, ast.Try) and node.handlers:
+                for stmt in node.body:
+                    for sub in _fn_walk(stmt):
+                        caught_subtrees.add(id(sub))
+        for node in _fn_walk(root):
+            if isinstance(node, ast.Return):
+                kind = "'return'"
+            elif isinstance(node, ast.Raise) and node.exc is not None \
+                    and id(node) not in caught_subtrees:
+                kind = "'raise'"
+            elif isinstance(node, (ast.Break, ast.Continue)) and \
+                    id(node) not in loop_subtrees:
+                kind = "'break'" if isinstance(node, ast.Break) \
+                    else "'continue'"
+            else:
+                continue
+            yield m.finding(
+                self, node,
+                f"{kind} inside 'finally' {_owner_phrase(fn.name)} masks "
+                "an in-flight exception — the error silently disappears; "
+                "move the statement out of the finally block",
+            )
+
+
+# -- FL-LEAK-GEN-HOLD ----------------------------------------------------------
+
+
+@register
+class GeneratorHoldRule(Rule):
+    name = "FL-LEAK-GEN-HOLD"
+    severity = "error"
+    scope = SERVING_SCOPE + ("fluidframework_tpu/protocol/",)
+    description = (
+        "'yield' while inside a 'with' over a lock/resource in a "
+        "generator on a serving path — an abandoned generator pins the "
+        "resource forever; snapshot under the lock, yield outside"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            # One finding per offending yield: nested resource withs
+            # around the same yield are ONE defect (the outermost walk
+            # order of _fn_walk reports it against the outermost with).
+            reported: Set[int] = set()
+            for node in _fn_walk(fn):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                held = [item for item in node.items
+                        if self._resource_like(m, item.context_expr)]
+                if not held:
+                    continue
+                for sub in _fn_walk(node):
+                    if not isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                        continue
+                    if id(sub) in reported:
+                        continue
+                    reported.add(id(sub))
+                    recv = _dotted(held[0].context_expr) or "resource"
+                    yield m.finding(
+                        self, sub,
+                        f"'yield' inside 'with {recv}' "
+                        f"{_owner_phrase(fn.name)}: a suspended "
+                        "generator holds the resource across its "
+                        "consumer's loop body, and an abandoned one "
+                        "pins it forever — snapshot under the "
+                        "resource and yield outside the with",
+                    )
+                    break  # one finding per with-block
+
+    @staticmethod
+    def _resource_like(m: ModuleContext, expr: ast.AST) -> bool:
+        dotted = _dotted(expr)
+        if dotted is not None:
+            return bool(_LOCKISH.search(dotted.split(".")[-1]))
+        if isinstance(expr, ast.Call):
+            q = m.imports.resolve(expr.func)
+            if q == "open" or q in RESOURCE_CTORS:
+                return True
+            if isinstance(expr.func, ast.Attribute):
+                return bool(_LOCKISH.search(expr.func.attr)) \
+                    or expr.func.attr in RESOURCE_CTOR_METHODS
+        return False
+
+
+# -- FL-LEAK-DOUBLE-CLOSE ------------------------------------------------------
+
+
+@register
+class DoubleCloseRule(Rule):
+    name = "FL-LEAK-DOUBLE-CLOSE"
+    severity = "warning"
+    scope = ("fluidframework_tpu/",)
+    description = (
+        "a close/shutdown method reachable from more than one call path "
+        "(an internal self.close() caller, or 2+ tracked call sites) "
+        "that is not idempotency-guarded — double-close must be a no-op"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        bindings = self._instance_bindings(m)
+        for cls in self._classes(m.tree):
+            yield from self._check_class(m, cls, bindings)
+
+    @staticmethod
+    def _classes(tree: ast.AST) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield node
+
+    def _instance_bindings(self, m: ModuleContext) -> Dict[str, str]:
+        """receiver text -> class name, from ``x = C(...)`` /
+        ``self.y = C(...)`` where C is a class defined in this module."""
+        class_names = {c.name for c in self._classes(m.tree)}
+        out: Dict[str, str] = {}
+        for node in ast.walk(m.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            func = node.value.func
+            if not isinstance(func, ast.Name) or \
+                    func.id not in class_names:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                recv = _dotted(t)
+                if recv is not None:
+                    out[recv] = func.id
+        return out
+
+    def _check_class(self, m: ModuleContext, cls: ast.ClassDef,
+                     bindings: Dict[str, str]) -> Iterator[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        for name in CLOSE_METHODS:
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            sites = self._release_sites(fn)
+            if not sites:
+                continue  # closes nothing worth guarding
+            if not self._multi_close(m, cls, name, methods, bindings):
+                continue
+            if self._guarded(fn, sites):
+                continue
+            yield m.finding(
+                self, fn,
+                f"{name}() of {cls.name} is reachable from more than "
+                "one call path but releases member state unguarded — a "
+                "second call re-runs the release; make double-close a "
+                "no-op (early return on a closed flag, or a None'd "
+                "handle check)",
+            )
+
+    @staticmethod
+    def _release_sites(fn) -> List[ast.Call]:
+        """Calls in the method that release self-rooted member state."""
+        out = []
+        for node in _fn_walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in RELEASE_VERBS:
+                recv = _dotted(node.func.value)
+                if recv is not None and recv.startswith("self."):
+                    out.append(node)
+        return out
+
+    def _multi_close(self, m: ModuleContext, cls: ast.ClassDef,
+                     name: str, methods, bindings) -> bool:
+        # (a) a sibling method calls self.<close>() — together with the
+        # public entry point that is two reachable close paths
+        for other_name, other in methods.items():
+            if other_name == name:
+                continue
+            for node in _fn_walk(other):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == name and \
+                        _dotted(node.func.value) == "self":
+                    return True
+        # (b) two or more module-wide call sites on tracked instances
+        count = 0
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == name:
+                recv = _dotted(node.func.value)
+                if recv is not None and bindings.get(recv) == cls.name:
+                    count += 1
+        return count >= 2
+
+    @staticmethod
+    def _method_stmts(fn) -> Iterator[ast.stmt]:
+        """Top-level statements, looking through `with` blocks: the
+        idempotency flag is routinely checked under the state lock
+        (`with self._state_lock: if self._closed: return`)."""
+        stack = list(reversed(fn.body))
+        while stack:
+            stmt = stack.pop()
+            yield stmt
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                stack.extend(reversed(stmt.body))
+
+    @classmethod
+    def _guarded(cls, fn, sites: List[ast.Call]) -> bool:
+        # (1) method-level early-return guard on member state
+        for stmt in cls._method_stmts(fn):
+            if isinstance(stmt, ast.If) and any(
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    for sub in ast.walk(stmt.test)) and any(
+                    isinstance(s, ast.Return) for s in stmt.body):
+                return True
+        # (2) every release site individually guarded: under an If whose
+        # test reads self state, or inside a try with handlers
+        site_ids = {id(s) for s in sites}
+        guarded: Set[int] = set()
+        for node in _fn_walk(fn):
+            if isinstance(node, ast.Try) and node.handlers:
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if id(sub) in site_ids:
+                            guarded.add(id(sub))
+            elif isinstance(node, ast.If) and any(
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    for sub in ast.walk(node.test)):
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if id(sub) in site_ids:
+                            guarded.add(id(sub))
+        return site_ids <= guarded
