@@ -220,7 +220,11 @@ def run_catchup_cache_bench(n_docs: int, ops_per_doc: int) -> dict:
             "cache_hit_rate": None,
             "catchup_cache": None,
             "pack_cache": None,
+            "delta_cache": None,
             "catchup_stages_busy_sec": {},
+            "catchup_d2h_bytes": None,
+            "catchup_cold_d2h_bytes": None,
+            "catchup_warm_d2h_bytes": None,
         }
     total_ops = n_docs * ops_per_doc
 
@@ -230,7 +234,8 @@ def run_catchup_cache_bench(n_docs: int, ops_per_doc: int) -> dict:
         results["out"] = svc.catch_up(doc_ids, upload=False)
 
     before = svc.cache.counters.snapshot()
-    pair = benchmark_cold_warm(fold, name="catchup", warm_runs=2)
+    pair = benchmark_cold_warm(fold, name="catchup", warm_runs=2,
+                               stage=svc.pipeline_stage)
     after = svc.cache.counters.snapshot()
     warm_lookups = n_docs * pair.warm_runs
     hit_rate = (after["hits"] - before["hits"]) / max(1, warm_lookups)
@@ -252,12 +257,116 @@ def run_catchup_cache_bench(n_docs: int, ops_per_doc: int) -> dict:
         "catchup_cache": svc.cache.stats(),
         "pack_cache": (svc._pack_cache.stats()
                        if svc._pack_cache is not None else None),
+        "delta_cache": (svc.delta_cache.stats()
+                        if svc.delta_cache is not None else None),
         "catchup_stages_busy_sec": {
             k: round(v, 3) for k, v in sorted(svc.pipeline_stage.items())
+            if k != "d2h_bytes"
         },
+        "catchup_d2h_bytes": int(svc.pipeline_stage.get("d2h_bytes", 0)),
+        # Warm tier-1 hits never reach the pipeline: warm d2h must be 0.
+        "catchup_cold_d2h_bytes": pair.cold_d2h_bytes,
+        "catchup_warm_d2h_bytes": pair.warm_d2h_bytes,
     }
     print(f"catchup cache: {pair.report()} | hit rate {hit_rate:.3f}",
           file=sys.stderr)
+    return out
+
+
+# Delta-download (tier 0) workload knobs: a full-scale corpus whose tails
+# grow on a fraction of documents between the cold fill and the warm
+# re-fold — the steady maintenance shape where corpus size >> churn.
+DELTA_DOCS = int(os.environ.get("BENCH_DELTA_DOCS", str(N_DOCS)))
+DELTA_GROW_EVERY = int(os.environ.get("BENCH_DELTA_GROW_EVERY", "8"))
+
+
+def run_delta_download_bench(n_docs: int, ops_per_doc: int) -> dict:
+    """Digest-gated delta download at full scale (ISSUE 6): fold a
+    tokened message-list corpus cold (tier 0 fills), grow every Nth
+    document's tail, then re-fold warm twice — once with delta download
+    ON (digest plane + changed rows only cross the d2h link) and once
+    with it OFF (the full-download reference) — asserting the two runs
+    are byte-identical and reporting the d2h byte and busy-second drop."""
+    from fluidframework_tpu.ops.pipeline import (
+        PackCache,
+        pipelined_mergetree_replay,
+    )
+    from fluidframework_tpu.service.catchup_cache import DeltaExportCache
+
+    base_ops = max(2, (ops_per_doc * 5) // 6)
+    streams = [doc_ops(synth_doc(i, ops_per_doc)) for i in range(n_docs)]
+
+    def window(i, n_ops):
+        msgs = streams[i][:n_ops]
+        return MergeTreeDocInput(
+            doc_id=f"ddoc{i}", ops=msgs, final_seq=msgs[-1].seq,
+            final_msn=0, cache_token=("bench-epoch", f"ddoc{i}", 0, ""),
+        )
+
+    docs_base = [window(i, base_ops) for i in range(n_docs)]
+    grown_idx = set(range(0, n_docs, max(1, DELTA_GROW_EVERY)))
+    docs_grown = [
+        window(i, ops_per_doc if i in grown_idx else base_ops)
+        for i in range(n_docs)
+    ]
+
+    def one_pass(docs, delta_cache, pack_cache):
+        stage = {"d2h_bytes": 0}
+        stats: dict = {}
+        t0 = time.time()
+        summaries = pipelined_mergetree_replay(
+            docs, chunk_docs=CHUNK_DOCS, pack_threads=PACK_THREADS,
+            extract_threads=EXTRACT_THREADS, stage=stage, stats=stats,
+            delta_cache=delta_cache, pack_cache=pack_cache,
+        )
+        return summaries, stage, stats, time.time() - t0
+
+    # BOTH warm runs ride an identically-warmed pack cache, so the fold
+    # configuration (suffix-extended packs — whose arena-tail offsets
+    # legitimately force the wide export layout at full scale) is the
+    # same and ONLY the download policy differs; the reference would
+    # otherwise fresh-pack narrow and the byte comparison would measure
+    # the transfer encoding, not delta download.
+    delta, pack = DeltaExportCache(), PackCache()
+    full_pack = PackCache()
+    _cold, stage_cold, _st, cold_wall = one_pass(docs_base, delta, pack)
+    one_pass(docs_base, None, full_pack)
+    warm, stage_delta, stats_delta, delta_wall = one_pass(
+        docs_grown, delta, pack)
+    full, stage_full, _st2, full_wall = one_pass(
+        docs_grown, None, full_pack)
+    assert [s.digest() for s in warm] == [s.digest() for s in full], (
+        "delta-download summaries != full-download summaries"
+    )
+    reduction = stage_full["d2h_bytes"] / max(1, stage_delta["d2h_bytes"])
+    out = {
+        "delta_docs_total": n_docs,
+        "delta_docs_grown": len(grown_idx),
+        "delta_base_ops": base_ops,
+        "delta_d2h_bytes_full": int(stage_full["d2h_bytes"]),
+        "delta_d2h_bytes_delta": int(stage_delta["d2h_bytes"]),
+        "delta_d2h_reduction": round(reduction, 2),
+        "delta_docs_served": stats_delta.get("delta_docs", 0),
+        "delta_warm_wall_sec": round(delta_wall, 3),
+        "delta_full_wall_sec": round(full_wall, 3),
+        "delta_cold_wall_sec": round(cold_wall, 3),
+        "delta_stages_busy_sec": {
+            k: round(v, 3) for k, v in sorted(stage_delta.items())
+            if k != "d2h_bytes"
+        },
+        "delta_full_stages_busy_sec": {
+            k: round(v, 3) for k, v in sorted(stage_full.items())
+            if k != "d2h_bytes"
+        },
+        "delta_cache_stats": delta.stats(),
+    }
+    print(
+        f"delta download: d2h {stage_full['d2h_bytes']/1e6:.1f} MB full "
+        f"-> {stage_delta['d2h_bytes']/1e6:.2f} MB delta "
+        f"({reduction:.1f}x less), {stats_delta.get('delta_docs', 0)}"
+        f"/{n_docs} docs served without download",
+        file=sys.stderr,
+    )
     return out
 # Coarse progress marker the run updates as it goes; the deadline watchdog
 # embeds it in the skip JSON so a wedge DURING the byte-identity
@@ -283,10 +392,12 @@ def _emit_skip(reason: str, detail: dict | None = None,
     line.update(base if base is not None
                 else {"value": None, "unit": "ops/sec",
                       "vs_baseline": None,
-                      # Schema-stable cache field: consumers diffing
-                      # artifacts across rounds always find it (null =
-                      # the run never reached the catch-up cache phase).
-                      "cache_hit_rate": None})
+                      # Schema-stable fields: consumers diffing artifacts
+                      # across rounds always find them (null = the run
+                      # never reached that phase).
+                      "cache_hit_rate": None,
+                      "d2h_bytes": None,
+                      "delta_d2h_reduction": None})
     line["skipped"] = reason
     line.update(detail or {})
     print(json.dumps(line), flush=True)
@@ -722,7 +833,8 @@ def _run_e2e_single_device_thread(docs):
     same code the catch-up service runs, not a private copy of it."""
     from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
 
-    stage = {"pack": 0.0, "dispatch": 0.0, "download": 0.0, "extract": 0.0}
+    stage = {"pack": 0.0, "dispatch": 0.0, "device_wait": 0.0,
+             "download": 0.0, "extract": 0.0, "d2h_bytes": 0}
     packed_chunks: list = []
     stats: dict = {}
     wall0 = time.time()
@@ -747,7 +859,8 @@ def _run_e2e_legacy(docs):
     sets ``abort`` so the other stages unblock from their bounded queues
     and the first error re-raises in the caller instead of
     deadlocking."""
-    stage = {"pack": 0.0, "dispatch": 0.0, "download": 0.0, "extract": 0.0}
+    stage = {"pack": 0.0, "dispatch": 0.0, "device_wait": 0.0,
+             "download": 0.0, "extract": 0.0, "d2h_bytes": 0}
     folded: queue.Queue = queue.Queue(maxsize=3)
     downloaded: queue.Queue = queue.Queue(maxsize=3)
     errors = []
@@ -832,9 +945,17 @@ def _run_e2e_legacy(docs):
                 if item is None:
                     break
                 meta, ex = item
+                # Honest split (mirrors the product pipeline): wait for
+                # device completion first, so "download" times the copy.
+                t0 = time.time()
+                jax.block_until_ready(ex)
+                stage["device_wait"] += time.time() - t0
                 t0 = time.time()
                 arr = export_to_numpy(ex)  # the D2H link RPC(s)
                 stage["download"] += time.time() - t0
+                stage["d2h_bytes"] += int(sum(
+                    a.nbytes for a in
+                    (arr if isinstance(arr, tuple) else (arr,))))
                 if not put(downloaded, (meta, arr)):
                     break
         except BaseException as e:
@@ -1008,8 +1129,10 @@ def _run_bench(probe: dict) -> dict:
     print(
         f"end-to-end {e2e_time:.2f}s = {e2e_ops_per_sec:,.0f} ops/s "
         f"(busy: pack {stage['pack']:.2f} | dispatch {stage['dispatch']:.2f}"
+        f" | device_wait {stage['device_wait']:.2f}"
         f" | download {stage['download']:.2f} | extract+summarize "
-        f"{stage['extract']:.2f}) | oracle fallbacks {fallbacks}/{N_DOCS}",
+        f"{stage['extract']:.2f} | d2h {stage['d2h_bytes']/1e6:.1f} MB)"
+        f" | oracle fallbacks {fallbacks}/{N_DOCS}",
         file=sys.stderr,
     )
 
@@ -1091,6 +1214,12 @@ def _run_bench(probe: dict) -> dict:
     # warm must serve from the seq-anchored cache with zero device work.
     CURRENT_PHASE["phase"] = "catchup-cache"
     catchup = run_catchup_cache_bench(CATCHUP_DOCS, OPS_PER_DOC)
+
+    # --- digest-gated delta download (tier 0): the warm grown-tail
+    # maintenance shape — corpus size >> churn, so d2h must scale with
+    # what CHANGED, not with the corpus.
+    CURRENT_PHASE["phase"] = "delta-download"
+    delta = run_delta_download_bench(DELTA_DOCS, OPS_PER_DOC)
     CURRENT_PHASE["phase"] = "done"
 
     # Returned (not printed): run_hardened emits exactly one line under
@@ -1115,12 +1244,18 @@ def _run_bench(probe: dict) -> dict:
         "stages_busy_sec": {
             "pack": round(stage["pack"], 3),
             "fold_dispatch": round(stage["dispatch"], 3),
+            # "download" used to absorb the async fold wait (CPU d2h is
+            # hundreds of GB/s yet "download" read as 12 s in r05c);
+            # device_wait now carries the wait, download the copy alone.
+            "device_wait": round(stage["device_wait"], 3),
             "download": round(stage["download"], 3),
             "extract_summarize": round(stage["extract"], 3),
         },
+        "d2h_bytes": int(stage["d2h_bytes"]),
         "end_to_end_sec": round(e2e_time, 3),
         "oracle_fallback_docs": fallbacks,
         **catchup,
+        **delta,
         "op_upload_MB": round(upload_bytes / 1e6, 1),
         # The resolved choice — the same predicate run_e2e dispatches on.
         "e2e_pipeline": (

@@ -562,6 +562,162 @@ def export_to_numpy(export):
     return np.asarray(export)
 
 
+# ---------------------------------------------------------------------------
+# Per-doc state digests (digest-gated delta download — ISSUE 6)
+# ---------------------------------------------------------------------------
+
+#: fixed per-plane salt ids for the digest mix.  Stable across layouts:
+#: the digest reads the CANONICAL final state, never the transfer buffer,
+#: so bucket growth / row elisions / byte packing cannot perturb it.
+_DIGEST_PLANES = tuple(EXPORT_SLOT_FIELDS)
+_DIGEST_PROPS_BASE = 16  # props column k salts at 16 + k
+
+
+def _mix_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix-style avalanche over uint32 lanes (wraparound on purpose;
+    runs in-graph on device)."""
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _doc_digests(final: MTState, doc_base: jnp.ndarray) -> jnp.ndarray:
+    """``[D, 2]`` int32 digest of each document's canonical final state —
+    the device-computed summary identity the delta-download path compares
+    before deciding which documents' export rows must cross the d2h link.
+
+    Properties the delta path relies on (pinned by tests):
+
+    - **masked**: only live slots (``slot < n``) contribute — dead-slot
+      shift leftovers (which legitimately differ between a fresh pack and
+      a suffix-extended one) never reach the hash;
+    - **rebased**: ``tstart`` enters relative to the doc's arena base, so
+      a document whose own bytes are unchanged digests identically even
+      when other documents in the chunk moved its absolute arena offsets;
+    - **bucket-invariant**: weights are per (plane, slot-index), so S/T
+      padding growth around an unchanged document cannot perturb it; a
+      props key the document never set contributes ZERO (set values hash
+      shifted by +1 — intern ids are >= 0, so "value 0" stays distinct
+      from "absent"), so K-bucket growth (another doc's new annotate
+      key) cannot perturb it either;
+    - 64 bits across two independently-salted lanes — a collision (the
+      only way delta download could serve wrong bytes for inputs the
+      host-side anchor check cannot distinguish) is a ~2^-64 event, and
+      every structural failure (missing entry, anchor drift, digest
+      mismatch) falls back to the full download.
+    """
+    D, S = final.tlen.shape
+    K = final.props.shape[2]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    active = slot < final.n[:, None]
+    live_len = jnp.where(
+        active & (final.rem_seq == NOT_REMOVED), final.tlen, 0
+    ).sum(axis=1)
+    slot_u = slot.astype(jnp.uint32)
+    accs = []
+    for lane_salt in (jnp.uint32(0x9E3779B9), jnp.uint32(0x85EBCA6B)):
+        acc = jnp.zeros((D,), jnp.uint32)
+        for i, f in enumerate(_DIGEST_PLANES):
+            plane = getattr(final, f)
+            if f == "tstart":
+                plane = plane - doc_base[:, None]
+            v = jnp.where(active, plane, 0).astype(jnp.uint32)
+            w = _mix_u32(slot_u * jnp.uint32(0x01000193)
+                         + jnp.uint32(i) + lane_salt)
+            acc = acc + (v * w).sum(axis=1, dtype=jnp.uint32)
+        for k in range(K):
+            plane = final.props[:, :, k]
+            # Absent keys hash 0 (K-bucket invariance); set values shift
+            # +1 so an explicit intern id 0 stays distinct from absent.
+            v = jnp.where(active & (plane != PROP_ABSENT), plane + 1,
+                          0).astype(jnp.uint32)
+            w = _mix_u32(slot_u * jnp.uint32(0x01000193)
+                         + jnp.uint32(_DIGEST_PROPS_BASE + k) + lane_salt)
+            acc = acc + (v * w).sum(axis=1, dtype=jnp.uint32)
+        acc = acc ^ _mix_u32(final.n.astype(jnp.uint32) + lane_salt)
+        acc = acc ^ _mix_u32(live_len.astype(jnp.uint32) * jnp.uint32(3)
+                             + lane_salt)
+        acc = acc ^ jnp.where(final.overflow, jnp.uint32(0x5BD1E995),
+                              jnp.uint32(0))
+        accs.append(_mix_u32(acc))
+    return jax.lax.bitcast_convert_type(
+        jnp.stack(accs, axis=-1), jnp.int32)
+
+
+def split_export_digest(export, digested: bool):
+    """``(core, digest_or_None)`` for a ``replay_export`` handle.  With
+    ``digest=True`` the digest rides as the LAST leaf of the returned
+    tuple; the core keeps the exact shape the non-digest path produces
+    (bare buffer, or ``(rows, misc)`` for i8 layouts) so every
+    downstream consumer is unchanged."""
+    if not digested:
+        return export, None
+    assert isinstance(export, tuple) and len(export) >= 2
+    core = export[0] if len(export) == 2 else export[:-1]
+    return core, export[-1]
+
+
+@jax.jit
+def _take_docs(a: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take(a, idx, axis=0)
+
+
+def _host_view(a) -> Optional[np.ndarray]:
+    """Zero-copy numpy view of a computed single-CPU-device array, or
+    None when the buffer is not host-reachable.  On the CPU backend the
+    "d2h link" IS host memory: a dlpack view + numpy row copy fetches
+    exactly the requested rows with no XLA dispatch (a per-shape device
+    gather would pay a ~0.5 s compile that swamps the bytes it saves)."""
+    try:
+        devs = a.devices()
+        if len(devs) != 1 or next(iter(devs)).platform != "cpu":
+            return None
+        return np.from_dlpack(a)
+    except Exception:
+        return None
+
+
+def gather_export_rows(export, idx: np.ndarray):
+    """Fetch ONLY the documents in ``idx`` from a device export handle —
+    the delta-download fetch.  Returns ``(rows, moved_bytes)`` where each
+    leaf of ``rows`` has exactly ``len(idx)`` doc rows and ``moved_bytes``
+    counts what actually crossed the d2h link.  On CPU-resident buffers
+    this is a direct row copy out of a zero-copy host view; on
+    accelerators it is a tiny in-graph gather along the doc axis (``idx``
+    padded to a fine bucket internally so the gather's jit cache stays
+    bounded — the pad rows DO cross, and are counted) followed by the
+    d2h copy of just those rows."""
+    leaves = export if isinstance(export, tuple) else (export,)
+    rows = np.asarray(idx, np.intp)
+    m = rows.shape[0]
+    out, moved = [], 0
+    dev_idx = None
+    for a in leaves:
+        view = _host_view(a)
+        if view is not None:
+            got = view[rows]
+            moved += got.nbytes
+        elif next_bucket_fine(m, floor=8) >= a.shape[0]:
+            # The padded device gather would move as many rows as the
+            # buffer holds: fetch full and slice host-side (no gather
+            # dispatch).  Accelerator economics only — the host-view
+            # branch above always copies exact rows.
+            full = np.asarray(a)
+            moved += full.nbytes
+            got = full[rows]
+        else:
+            if dev_idx is None:
+                pad = next_bucket_fine(m, floor=8) - m
+                padded = np.concatenate(
+                    [rows, np.repeat(rows[-1:], pad)]) if pad else rows
+                dev_idx = jnp.asarray(padded, jnp.int32)
+            full = np.asarray(_take_docs(a, dev_idx))
+            moved += full.nbytes
+            got = full[:m]
+        out.append(got)
+    return (tuple(out) if isinstance(export, tuple) else out[0]), moved
+
+
 def _widen_desc(ob_rows: bool, ov_rows: bool, i8: bool, props_rows: bool,
                 n_props: int):
     """The per-canonical-row descriptor table oppack_widen consumes:
@@ -751,19 +907,22 @@ def _fetch_format(sharding=None):
         return None
 
 
-def _out_shardings_for(i8: bool, sharding=None):
+def _out_shardings_for(i8: bool, sharding=None, digest: bool = False):
     """out_shardings matching the export's output structure: the fused 3-D
     buffer gets the forced row-major Format; the tiny [D, 4] misc output
-    (i8 layouts only) gets a 2-D one.  ``sharding`` threads through to
-    ``_fetch_format`` for the mesh path."""
+    (i8 layouts only) and the [D, 2] digest plane get 2-D ones.
+    ``sharding`` threads through to ``_fetch_format`` for the mesh
+    path."""
     fmt = _fetch_format(sharding)
     if fmt is None:
         return None
-    if not i8:
+    if not i8 and not digest:
         return fmt
     from jax.experimental.layout import Format, Layout
 
-    return (fmt, Format(Layout(major_to_minor=(0, 1)), fmt.sharding))
+    fmt2 = Format(Layout(major_to_minor=(0, 1)), fmt.sharding)
+    out = [fmt] + ([fmt2] if i8 else []) + ([fmt2] if digest else [])
+    return tuple(out)
 
 
 def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
@@ -785,39 +944,54 @@ def _fold_fn(mode: str, sequential: bool = False, has_ob: bool = True,
                                              has_ob, has_props, has_ov)
 
 
-def _export_out(i8: bool, sharding=None):
+def _export_out(i8: bool, sharding=None, digest: bool = False):
     """out_shardings for an export jit: the forced fetch layout when the
     backend supports layouts (carried on ``sharding`` when given — the
     mesh path), else the bare sharding, else None."""
-    fmt = _out_shardings_for(i8, sharding)
+    fmt = _out_shardings_for(i8, sharding, digest)
     if fmt is not None:
         return fmt
     if sharding is None:
         return None
-    return (sharding, sharding) if i8 else sharding
+    n_out = 1 + (1 if i8 else 0) + (1 if digest else 0)
+    return sharding if n_out == 1 else (sharding,) * n_out
+
+
+def _export_with_digest(final, doc_base, i16, ob_rows, ov_rows, i8,
+                        has_props, digest: bool):
+    """Export a final state, optionally appending the [D, 2] digest plane
+    as the LAST output leaf (see ``split_export_digest``)."""
+    ex = _export_state(final, doc_base, i16, ob_rows, ov_rows, i8,
+                       props_rows=has_props)
+    if not digest:
+        return ex
+    dig = _doc_digests(final, doc_base)
+    return ex + (dig,) if isinstance(ex, tuple) else (ex, dig)
 
 
 @functools.lru_cache(maxsize=None)
 def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
                     fold_mode: str = "", ov_rows: bool = True,
                     i8: bool = False, sequential: bool = False,
-                    has_props: bool = True, out_sharding=None):
+                    has_props: bool = True, out_sharding=None,
+                    digest: bool = False):
     """Compiled cold-start fold+export for one (S, width, layout) bucket,
     its output laid out for a line-rate fetch.  ``ob_rows``/``ov_rows``
     double as the fold facts (has_ob/has_ov): the export elides exactly
     the planes the fold provably never writes.  ``out_sharding`` (a
     NamedSharding) builds the mesh-sharded variant of the same pipeline —
-    ONE derivation point for single-chip and multi-chip exports."""
+    ONE derivation point for single-chip and multi-chip exports.
+    ``digest`` appends the per-doc state digest plane (delta download)."""
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(ops, doc_base):
         ops = _widen_ops(ops, doc_base)
-        return _export_state(
+        return _export_with_digest(
             fold(_cold_start(ops, S), ops), doc_base, i16, ob_rows,
-            ov_rows, i8, props_rows=has_props,
+            ov_rows, i8, has_props, digest,
         )
 
-    fmt = _export_out(i8, out_sharding)
+    fmt = _export_out(i8, out_sharding, digest)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
@@ -825,18 +999,18 @@ def _export_cold_fn(S: int, i16: bool, ob_rows: bool = True,
 def _export_warm_fn(i16: bool, ob_rows: bool = True, fold_mode: str = "",
                     ov_rows: bool = True, i8: bool = False,
                     sequential: bool = False, has_props: bool = True,
-                    out_sharding=None):
+                    out_sharding=None, digest: bool = False):
     """Compiled warm-start (base state uploaded) fold+export; see
-    ``_export_cold_fn`` for ``out_sharding``."""
+    ``_export_cold_fn`` for ``out_sharding``/``digest``."""
     fold = _fold_fn(fold_mode, sequential, ob_rows, has_props, ov_rows)
 
     def f(state, ops, doc_base):
         state = _widen_state(state, doc_base)
         ops = _widen_ops(ops, doc_base)
-        return _export_state(fold(state, ops), doc_base, i16, ob_rows,
-                             ov_rows, i8, props_rows=has_props)
+        return _export_with_digest(fold(state, ops), doc_base, i16,
+                                   ob_rows, ov_rows, i8, has_props, digest)
 
-    fmt = _export_out(i8, out_sharding)
+    fmt = _export_out(i8, out_sharding, digest)
     return jax.jit(f, out_shardings=fmt) if fmt is not None else jax.jit(f)
 
 
@@ -1022,17 +1196,25 @@ def _widen_ops(ops: MTOps, doc_base: jnp.ndarray) -> MTOps:
 
 
 def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
-                  S: Optional[int] = None) -> jnp.ndarray:
+                  S: Optional[int] = None,
+                  digest: bool = False) -> jnp.ndarray:
     """Dispatch the fold+export for a packed chunk (async); the result is
     the fused export buffer handle, int16 when the chunk qualifies (with
     obliterate/overlap row elision and int8 pair-packing per the pack-time
     layout facts).  Pass ``state=None`` for all-cold chunks (initial state
-    built in-graph — no zero upload)."""
+    built in-graph — no zero upload).  ``digest=True`` additionally emits
+    the per-doc state digest plane as the last output leaf (split it off
+    with ``split_export_digest`` — the delta-download gate fetches ONLY
+    that tiny plane eagerly)."""
     from .pallas_fold import pallas_fold_mode
 
     i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
     mode = pallas_fold_mode()
-    doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
+    # The digest rebases tstart per doc even on non-i16 chunks, so an
+    # unchanged document digests identically across repacks that moved
+    # its absolute arena offsets (_export_state reads doc_base only
+    # under i16 — passing the real bases is inert for the buffer).
+    doc_base = jnp.asarray(meta["doc_base"]) if (i16 or digest) else \
         jnp.zeros((ops.kind.shape[0],), jnp.int32)
     ops = narrow_ops_for_upload(ops, meta)  # h2d transfer encoding
     # The pallas fold ignores the chunk facts — normalize so mixed
@@ -1042,10 +1224,12 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     sequential = bool(meta.get("sequential")) and mode == ""
     if state is None:
         return _export_cold_fn(int(S), i16, ob_rows, mode, ov_rows,
-                               i8, sequential, has_props)(ops, doc_base)
+                               i8, sequential, has_props,
+                               digest=digest)(ops, doc_base)
     state = narrow_state_for_upload(state, meta)
     return _export_warm_fn(i16, ob_rows, mode, ov_rows, i8,
-                           sequential, has_props)(state, ops, doc_base)
+                           sequential, has_props,
+                           digest=digest)(state, ops, doc_base)
 
 
 def state_dict_from_export(export_np: np.ndarray) -> dict:

@@ -10,12 +10,23 @@ loads state into VMEM once, folds every op of the tail with a
 from O(T x state) to O(state + ops) and the fold leaves the bandwidth
 roofline entirely.
 
-Each grid step owns a SUBLANE-PACKED BATCH of B=8 documents: blocks are
-``(8, S)`` over ``(D, S)`` arrays, which satisfies Mosaic's block rule
-directly (sublane dim divisible by 8, lane dim equal to the array's) and
-fills the VPU's 8 sublanes instead of wasting 7 of them on a
-one-doc-per-step layout (the round-5 compile failure was a ``(1, S)``
-block).  ``D`` pads to a multiple of 8 with inert no-op documents.
+Every block is 2-D and satisfies Mosaic's divisibility rule OUTRIGHT
+(second-to-last dim a multiple of 8, last dim a multiple of 128):
+
+- each grid step owns a SUBLANE-PACKED BATCH of B=8 documents, so the
+  sublane dim is exactly 8 (the round-5 compile failure was a ``(1, S)``
+  block; the recorded round-5 TPU error was its lane-dim sibling —
+  ``block shape (1, 96)`` vs array ``(1024, 96)``);
+- the lane dims pad to multiples of 128: ``S → Sp`` and ``T → Tp``
+  round up, scalars (``n``/``overflow``) ride a 128-lane column with the
+  value in lane 0.  Pad lanes are masked by construction — state lanes
+  at ``slot >= n`` are inactive in every predicate, and the op loop runs
+  only the REAL ``T`` steps (the pad rows are never read);
+- the ``[S, K]`` props plane and ``[T, K]`` pvals plane are carried as K
+  separate ``(8, lanes)`` planes (K is a static pack-time bucket), so no
+  3-D block ever reaches Mosaic.
+
+``D`` pads to a multiple of 8 with inert no-op documents.
 
 Semantics are a faithful port of ``mergetree_kernel._apply_op`` /
 ``_split_at`` (the canonical scan step), restated Mosaic-conservatively
@@ -32,10 +43,12 @@ and batch-wide:
 
 Exact-parity tests (tests/test_pallas_fold.py) pin this port to the
 canonical step on directed + fuzz streams, byte-identical through the
-summary extraction.  CI runs the kernel in interpret mode (pure jax, any
-backend); on real TPU the compiled path is gated behind
-``FF_PALLAS_FOLD=1`` until a healthy-tunnel window lets it be measured
-(BASELINE.md round-5 status; tools/pallas_probe.py is the window canary).
+summary extraction, including shapes whose natural buckets violate the
+divisibility rule (S=48, T=24, K=1) so the padding really executes.  CI
+runs the kernel in interpret mode (pure jax, any backend); on real TPU
+the compiled path is gated behind ``FF_PALLAS_FOLD=1`` until a
+healthy-tunnel window lets it be measured (BASELINE.md round-5 status;
+tools/pallas_probe.py is the window canary).
 """
 
 from __future__ import annotations
@@ -44,7 +57,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 from .mergetree_kernel import (
@@ -65,8 +77,43 @@ _COL_FIELDS = ("tstart", "tlen", "ins_seq", "ins_client", "rem_seq",
                "rem_client", "rem2_seq", "rem2_client", "ob1_seq",
                "ob1_client", "ob2_seq", "ob2_client")
 
-#: documents per grid step — the int32 sublane count; blocks are (8, S)
+#: documents per grid step — the int32 sublane count; blocks are (8, lanes)
 DOC_BLOCK = 8
+#: every block's lane dim is a multiple of this (Mosaic's (8, 128) rule)
+LANE = 128
+
+# Host-side pad fills, precomputed ONCE at import as plain Python ints —
+# the typed helper that keeps the traced entry point free of int()
+# concretization (fluidlint FL-TRACE-HOSTSYNC: int() on a module constant
+# is concrete at trace time, but the rule cannot see through the binding;
+# hoisting the conversion out of trace scope makes the code and the rule
+# agree).
+_NOT_REMOVED_FILL: int = int(NOT_REMOVED)
+_PROP_ABSENT_FILL: int = int(PROP_ABSENT)
+_PROP_NOT_TOUCHED_FILL: int = int(PROP_NOT_TOUCHED)
+
+
+def _state_pad_fill(field: str) -> int:
+    """Pad fill for a state plane: the NOT_REMOVED sentinel for removal /
+    obliterate stamp seqs (a zero would read as 'removed at seq 0'),
+    zero elsewhere — pad slots are inactive (``slot >= n``) in every
+    predicate regardless; the sentinel is belt and braces."""
+    if field.endswith("_seq") and field != "ins_seq":
+        return _NOT_REMOVED_FILL
+    return 0
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def _padded_dims(D: int, S: int, T: int):
+    """The Mosaic-compliant padded shape: documents to a multiple of the
+    8-row sublane batch, both lane dims (slots, op rows) to multiples of
+    128 — so every BlockSpec below satisfies the (8, 128) divisibility
+    rule by construction."""
+    return _round_up(max(D, 1), DOC_BLOCK), _round_up(max(S, 1), LANE), \
+        _round_up(max(T, 1), LANE)
 
 
 def _iota(S: int) -> jnp.ndarray:
@@ -118,7 +165,7 @@ def _visible(cols: dict, n, ref_seq, client, S: int) -> jnp.ndarray:
 
 def _split_at(cols, props, n, char_pos, ref_seq, client, enable, S):
     """Port of mergetree_kernel._split_at on (B, S) rows; per-op values
-    are (B, 1) columns."""
+    are (B, 1) columns; ``props`` is a tuple of K (B, S) planes."""
     slot = _iota(S)
     v = _visible(cols, n, ref_seq, client, S)
     cum = _excl_cumsum(v, S)
@@ -136,18 +183,19 @@ def _split_at(cols, props, n, char_pos, ref_seq, client, enable, S):
         is_left, off, jnp.where(is_right, tlen - off, tlen))
     new_cols["tstart"] = jnp.where(
         is_right, new_cols["tstart"] + off, new_cols["tstart"])
-    new_props = jnp.where(slot[..., None] <= idx[..., None], props,
-                          jnp.roll(props, 1, axis=1))
 
     cols = {f: jnp.where(do, new_cols[f], cols[f]) for f in _COL_FIELDS}
-    props = jnp.where(do[..., None], new_props, props)
+    props = tuple(
+        jnp.where(do, _shift_up_from(p, slot, idx), p) for p in props
+    )
     n = jnp.where(do, n + 1, n)
     return cols, props, n
 
 
 def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
-    """Port of mergetree_kernel._apply_op on (B, S)/(B, S, K) planes.
-    ``op`` is a dict of (B, 1) per-doc values; ``pvals`` is (B, K);
+    """Port of mergetree_kernel._apply_op on (B, S) planes.
+    ``op`` is a dict of (B, 1) per-doc values; ``pvals`` is a tuple of K
+    (B, 1) columns; ``props`` a tuple of K (B, S) planes;
     ``n``/``overflow`` are (B, 1)."""
     ref_seq, client = op["ref_seq"], op["client"]
     is_ins = op["kind"] == K_INSERT
@@ -225,15 +273,14 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
         "ob2_seq": shifted(cols["ob2_seq"], NOT_REMOVED),
         "ob2_client": shifted(cols["ob2_client"], -1),
     }
-    ins_pvals = jnp.where(pvals == PROP_NOT_TOUCHED, PROP_ABSENT, pvals)
-    ins_props = jnp.where(
-        (slot == j)[..., None],
-        ins_pvals[:, None, :],
-        jnp.where(slot[..., None] <= j[..., None], props,
-                  jnp.roll(props, 1, axis=1)),
+    ins_props = tuple(
+        shifted(p, jnp.where(pv == PROP_NOT_TOUCHED, PROP_ABSENT, pv))
+        for p, pv in zip(props, pvals)
     )
     cols = {f: jnp.where(is_ins, ins_cols[f], cols[f]) for f in _COL_FIELDS}
-    props = jnp.where(is_ins[..., None], ins_props, props)
+    props = tuple(
+        jnp.where(is_ins, ip, p) for ip, p in zip(ins_props, props)
+    )
     n = jnp.where(is_ins, n + 1, n)
 
     # --- remove / annotate / obliterate over [a, b) in the view.
@@ -269,32 +316,37 @@ def _apply_op_rows(cols, props, n, overflow, op, pvals, S, K):
     overflow = overflow | jnp.any(third, axis=1, keepdims=True) \
         | jnp.any(ob_over, axis=1, keepdims=True)
 
-    touch = (pvals != PROP_NOT_TOUCHED)[:, None, :] \
-        & (covered & is_ann)[..., None]
-    props = jnp.where(touch, pvals[:, None, :], props)
+    props = tuple(
+        jnp.where((pv != PROP_NOT_TOUCHED) & (covered & is_ann), pv, p)
+        for p, pv in zip(props, pvals)
+    )
     return cols, props, n, overflow
 
 
 def _fold_kernel(S: int, K: int, T: int, B: int, *refs):
     """A sublane batch of B documents per grid step: state lives in VMEM
-    values across the whole tail; every block is 2-D ``(B, ...)`` so the
-    Mosaic block rule holds without padding tricks."""
-    op_refs = refs[:len(_OP_FIELDS)]
-    pvals_ref = refs[len(_OP_FIELDS)]
-    in_cols = refs[len(_OP_FIELDS) + 1:len(_OP_FIELDS) + 1 + len(_COL_FIELDS)]
-    in_props, in_n, in_over = refs[len(_OP_FIELDS) + 1 + len(_COL_FIELDS):
-                                   len(_OP_FIELDS) + 4 + len(_COL_FIELDS)]
-    outs = refs[len(_OP_FIELDS) + 4 + len(_COL_FIELDS):]
+    values across the whole tail; every block is 2-D ``(B, lanes)`` with
+    128-multiple lanes, so the Mosaic block rule holds by construction.
+    ``S`` is the PADDED slot lane count; ``T`` is the REAL op count — the
+    loop never reads the pad rows of the (B, Tp) op blocks."""
+    n_op = len(_OP_FIELDS)
+    n_col = len(_COL_FIELDS)
+    op_refs = refs[:n_op]
+    pvals_refs = refs[n_op:n_op + K]
+    in_cols = refs[n_op + K:n_op + K + n_col]
+    in_props = refs[n_op + K + n_col:n_op + 2 * K + n_col]
+    in_n, in_over = refs[n_op + 2 * K + n_col:n_op + 2 * K + n_col + 2]
+    outs = refs[n_op + 2 * K + n_col + 2:]
 
     cols = {f: r[...] for f, r in zip(_COL_FIELDS, in_cols)}
-    props = in_props[...]
-    n = in_n[...]          # (B, 1)
-    overflow = in_over[...] != 0
+    props = tuple(r[...] for r in in_props)
+    n = in_n[:, :1]                 # value rides lane 0 of the 128-lane pad
+    overflow = in_over[:, :1] != 0
 
     def body(t, carry):
         cols, props, n, overflow = carry
         op = {f: r[:, t].reshape(B, 1) for f, r in zip(_OP_FIELDS, op_refs)}
-        pvals = pvals_ref[:, t, :]
+        pvals = tuple(r[:, t].reshape(B, 1) for r in pvals_refs)
         return _apply_op_rows(cols, props, n, overflow, op, pvals, S, K)
 
     cols, props, n, overflow = jax.lax.fori_loop(
@@ -302,9 +354,13 @@ def _fold_kernel(S: int, K: int, T: int, B: int, *refs):
 
     for f, r in zip(_COL_FIELDS, outs):
         r[...] = cols[f]
-    outs[len(_COL_FIELDS)][...] = props
-    outs[len(_COL_FIELDS) + 1][...] = n
-    outs[len(_COL_FIELDS) + 2][...] = overflow.astype(jnp.int32)
+    for k in range(K):
+        outs[len(_COL_FIELDS) + k][...] = props[k]
+    lanes = outs[len(_COL_FIELDS) + K].shape[1]
+    # Scalars broadcast across their 128-lane pad; the host reads lane 0.
+    outs[len(_COL_FIELDS) + K][...] = jnp.broadcast_to(n, (B, lanes))
+    outs[len(_COL_FIELDS) + K + 1][...] = jnp.broadcast_to(
+        overflow.astype(jnp.int32), (B, lanes))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -315,53 +371,53 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
     Pallas program instance per 8-document sublane batch with
     VMEM-resident state.  ``D`` pads to a multiple of 8 with inert no-op
     documents (noop op rows never match a kind; zero state rows never
-    activate), sliced off on return."""
+    activate); the slot and op lane dims pad to multiples of 128 (pad
+    slots stay inactive — ``slot >= n`` — and pad op rows are never read:
+    the loop bound is the real T).  All padding is sliced off on
+    return."""
     D, S = state.tstart.shape
     K = state.props.shape[-1]
     T = ops.kind.shape[1]
     B = DOC_BLOCK
-    Dp = ((D + B - 1) // B) * B
-    pad = Dp - D
+    Dp, Sp, Tp = _padded_dims(D, S, T)
 
-    def pad_rows(x, fill):
-        if pad == 0:
+    def pad2(x, rows, lanes, fill):
+        pr, pl_ = rows - x.shape[0], lanes - x.shape[1]
+        if pr == 0 and pl_ == 0:
             return x
-        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return jnp.pad(x, width, constant_values=fill)
-
-    row = pl.BlockSpec((B, S), lambda d: (d, 0))
-    op_row = pl.BlockSpec((B, T), lambda d: (d, 0))
-    props_blk = pl.BlockSpec((B, S, K), lambda d: (d, 0, 0))
-    pvals_blk = pl.BlockSpec((B, T, K), lambda d: (d, 0, 0))
-    scalar = pl.BlockSpec((B, 1), lambda d: (d, 0))
-
-    in_specs = (
-        [op_row] * len(_OP_FIELDS) + [pvals_blk]
-        + [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
-    )
-    out_specs = [row] * len(_COL_FIELDS) + [props_blk, scalar, scalar]
-    out_shape = (
-        [jax.ShapeDtypeStruct((Dp, S), jnp.int32)] * len(_COL_FIELDS)
-        + [jax.ShapeDtypeStruct((Dp, S, K), jnp.int32),
-           jax.ShapeDtypeStruct((Dp, 1), jnp.int32),
-           jax.ShapeDtypeStruct((Dp, 1), jnp.int32)]
-    )
+        return jnp.pad(x, ((0, pr), (0, pl_)), constant_values=fill)
 
     inputs = (
-        [pad_rows(getattr(ops, f).astype(jnp.int32), 0)
+        [pad2(getattr(ops, f).astype(jnp.int32), Dp, Tp, 0)
          for f in _OP_FIELDS]
-        + [pad_rows(ops.pvals.astype(jnp.int32), int(PROP_NOT_TOUCHED))]
-        + [pad_rows(getattr(state, f).astype(jnp.int32),
-                    int(NOT_REMOVED) if f.endswith("_seq")
-                    and f != "ins_seq" else 0)
-           for f in _COL_FIELDS]
-        + [pad_rows(state.props.astype(jnp.int32), int(PROP_ABSENT)),
-           pad_rows(state.n.astype(jnp.int32).reshape(D, 1), 0),
-           pad_rows(state.overflow.astype(jnp.int32).reshape(D, 1), 0)]
+        + [pad2(ops.pvals[:, :, k].astype(jnp.int32), Dp, Tp,
+                _PROP_NOT_TOUCHED_FILL) for k in range(K)]
+        + [pad2(getattr(state, f).astype(jnp.int32), Dp, Sp,
+                _state_pad_fill(f)) for f in _COL_FIELDS]
+        + [pad2(state.props[:, :, k].astype(jnp.int32), Dp, Sp,
+                _PROP_ABSENT_FILL) for k in range(K)]
+        + [pad2(state.n.astype(jnp.int32).reshape(D, 1), Dp, LANE, 0),
+           pad2(state.overflow.astype(jnp.int32).reshape(D, 1), Dp, LANE,
+                0)]
+    )
+
+    row = pl.BlockSpec((B, Sp), lambda d: (d, 0))
+    op_row = pl.BlockSpec((B, Tp), lambda d: (d, 0))
+    scalar = pl.BlockSpec((B, LANE), lambda d: (d, 0))
+
+    in_specs = (
+        [op_row] * (len(_OP_FIELDS) + K)
+        + [row] * (len(_COL_FIELDS) + K) + [scalar, scalar]
+    )
+    out_specs = [row] * (len(_COL_FIELDS) + K) + [scalar, scalar]
+    out_shape = (
+        [jax.ShapeDtypeStruct((Dp, Sp), jnp.int32)]
+        * (len(_COL_FIELDS) + K)
+        + [jax.ShapeDtypeStruct((Dp, LANE), jnp.int32)] * 2
     )
 
     outs = pl.pallas_call(
-        functools.partial(_fold_kernel, S, K, T, B),
+        functools.partial(_fold_kernel, Sp, K, T, B),
         grid=(Dp // B,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -369,12 +425,14 @@ def replay_vmapped_pallas(state: MTState, ops: MTOps,
         interpret=interpret,
     )(*inputs)
 
-    cols = {f: o[:D] for f, o in zip(_COL_FIELDS, outs[:len(_COL_FIELDS)])}
+    n_col = len(_COL_FIELDS)
+    cols = {f: o[:D, :S] for f, o in zip(_COL_FIELDS, outs[:n_col])}
     return MTState(
         **cols,
-        props=outs[len(_COL_FIELDS)][:D],
-        n=outs[len(_COL_FIELDS) + 1][:D].reshape(D),
-        overflow=outs[len(_COL_FIELDS) + 2][:D].reshape(D).astype(bool),
+        props=jnp.stack([outs[n_col + k][:D, :S] for k in range(K)],
+                        axis=-1),
+        n=outs[n_col + K][:D, 0],
+        overflow=outs[n_col + K + 1][:D, 0].astype(bool),
     )
 
 

@@ -46,12 +46,14 @@ from .mergetree_kernel import (
     NOT_REMOVED,
     export_to_numpy,
     fill_sequence_op_rows,
+    gather_export_rows,
     known_oracle_fallback,
     narrow_ops_for_upload,
     narrow_state_for_upload,
     oracle_fallback_summary,
     pack_mergetree_batch,
     replay_export,
+    split_export_digest,
     summaries_from_export,
 )
 
@@ -424,21 +426,30 @@ def pipelined_mergetree_replay(
     stage: Optional[dict] = None,
     packed_out: Optional[list] = None,
     pack_cache: Optional[PackCache] = None,
+    delta_cache=None,
 ):
     """Canonical summaries for ``docs`` in the given order.
 
-    ``stats`` accumulates ``device_docs``/``fallback_docs``; ``stage``
-    (if given) accumulates busy seconds under ``pack``/``dispatch``/
-    ``download``/``extract`` — the bench harness's instrumentation hook;
-    ``packed_out`` (if given) collects ``(ops, meta, S)`` per chunk in
-    schedule order so a caller can reuse the pack work; ``pack_cache``
-    (if given) reuses packed windows across calls for docs carrying a
-    ``cache_token`` (see :class:`PackCache`)."""
+    ``stats`` accumulates ``device_docs``/``fallback_docs`` (plus
+    ``delta_docs`` for documents served from the tier-0 delta cache
+    without a download); ``stage`` (if given) accumulates busy seconds
+    under ``pack``/``dispatch``/``device_wait``/``download``/``extract``
+    and the integer byte counter ``d2h_bytes`` — the bench harness's
+    instrumentation hook; ``packed_out`` (if given) collects ``(ops,
+    meta, S)`` per chunk in schedule order so a caller can reuse the pack
+    work; ``pack_cache`` (if given) reuses packed windows across calls
+    for docs carrying a ``cache_token`` (see :class:`PackCache`);
+    ``delta_cache`` (a ``service.catchup_cache.DeltaExportCache``, tier 0
+    of the catch-up cache) turns on digest-gated delta download: the fold
+    emits a per-doc state digest, only the tiny digest plane round-trips
+    eagerly, and only CHANGED documents' export rows are gathered and
+    downloaded — unchanged documents serve their cached summaries
+    byte-identically.  Any miss/mismatch falls back to the full fetch."""
 
     def fold(batch):
         return _pipelined_fold(
             batch, chunk_docs, pack_threads, extract_threads, fetch_depth,
-            schedule, stats, stage, packed_out, pack_cache,
+            schedule, stats, stage, packed_out, pack_cache, delta_cache,
         )
 
     return partition_replay(
@@ -452,9 +463,37 @@ def _bump(stage: Optional[dict], key: str, t0: float) -> None:
         stage[key] = stage.get(key, 0.0) + (perf_counter() - t0)
 
 
+def _count_d2h(stage: Optional[dict], nbytes: int) -> None:
+    """Accumulate ACTUAL bytes fetched over the d2h link this call (an
+    integer counter riding the stage dict next to the busy seconds)."""
+    if stage is not None:
+        stage["d2h_bytes"] = stage.get("d2h_bytes", 0) + int(nbytes)
+
+
+def _nbytes(handle) -> int:
+    """Byte size of a device/host buffer handle (or tuple of them) from
+    shape metadata alone — never forces a transfer."""
+    leaves = handle if isinstance(handle, tuple) else (handle,)
+    return int(sum(leaf.nbytes for leaf in leaves))
+
+
+def _block_until_ready(*handles) -> None:
+    """Wait for device computation to finish WITHOUT transferring — the
+    honest boundary between fold wait and the d2h copy (numpy leaves on
+    the CPU backend pass through)."""
+    for handle in handles:
+        if handle is None:
+            continue
+        leaves = handle if isinstance(handle, tuple) else (handle,)
+        for leaf in leaves:
+            wait = getattr(leaf, "block_until_ready", None)
+            if wait is not None:
+                wait()
+
+
 def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     fetch_depth, schedule, stats, stage, packed_out,
-                    pack_cache=None):
+                    pack_cache=None, delta_cache=None):
     order = list(range(len(batch)))
     if schedule and any(d.binary_ops is not None for d in batch):
         # Fact-homogeneous scheduling: annotate-free docs first, so their
@@ -488,6 +527,48 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
         res = summaries_from_export(meta, arr, stats=st)
         return res, st, perf_counter() - t0
 
+    def extract_full_store(meta, arr, dig_np):
+        """Full-download extraction that also (re)publishes every doc's
+        tier-0 entry — the cold-fill leg of the delta path."""
+        res, st, dt = extract_one(meta, arr)
+        t0 = perf_counter()
+        delta_cache.put_many(
+            (doc, (int(dig_np[d, 0]), int(dig_np[d, 1])), res[d])
+            for d, doc in enumerate(meta["docs"]))
+        return res, st, dt + (perf_counter() - t0)
+
+    def extract_served(docs, served):
+        """Whole chunk served from tier 0: zero download, zero extract."""
+        return [served[d] for d in range(len(docs))], \
+            {"delta_docs": len(docs)}, 0.0
+
+    def extract_delta(meta, arr, changed, served, dig_np):
+        """Extract ONLY the changed documents from their gathered rows;
+        unchanged documents serve their cached summaries byte-identically
+        (the cached tree came out of this same extraction under an equal
+        digest + host anchor)."""
+        t0 = perf_counter()
+        docs = meta["docs"]
+        sub_meta = dict(
+            meta,
+            docs=[docs[d] for d in changed],
+            doc_packs=[meta["doc_packs"][d] for d in changed],
+            doc_base=np.asarray(meta["doc_base"])[
+                np.asarray(changed, np.intp)],
+        )
+        st: dict = {}
+        got = summaries_from_export(sub_meta, arr, stats=st)
+        res: List = [None] * len(docs)
+        for d, tree in served.items():
+            res[d] = tree
+        for d, tree in zip(changed, got):
+            res[d] = tree
+        delta_cache.put_many(
+            (docs[d], (int(dig_np[d, 0]), int(dig_np[d, 1])), tree)
+            for d, tree in zip(changed, got))
+        st["delta_docs"] = st.get("delta_docs", 0) + len(served)
+        return res, st, perf_counter() - t0
+
     out: List = []
 
     def collect(fut) -> None:
@@ -510,14 +591,65 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                 pack_futs.append(pack_pool.submit(pack_one, starts[next_i]))
                 next_i += 1
 
-            def fetch_one(meta, ex) -> None:
+            def fetch_one(meta, core, dig, cand) -> None:
+                # Honest stage split: wait for the DEVICE to finish first
+                # (fold + export compute), so "download" times the copy
+                # alone and d2h_bytes attributes what actually crossed.
                 t0 = perf_counter()
-                arr = export_to_numpy(ex)  # the d2h link RPC(s)
-                _bump(stage, "download", t0)
-                ex_futs.append(ex_pool.submit(extract_one, meta, arr))
+                _block_until_ready(core, dig)
+                _bump(stage, "device_wait", t0)
+                docs = meta["docs"]
+                if dig is None:
+                    t0 = perf_counter()
+                    arr = export_to_numpy(core)  # the d2h link RPC(s)
+                    _bump(stage, "download", t0)
+                    _count_d2h(stage, _nbytes(arr))
+                    ex_futs.append(ex_pool.submit(extract_one, meta, arr))
+                else:
+                    t0 = perf_counter()
+                    dig_np = np.asarray(dig)  # the tiny eager round-trip
+                    _bump(stage, "download", t0)
+                    _count_d2h(stage, dig_np.nbytes)
+                    # Host cache work stays OUTSIDE the download window
+                    # (the stage times link traffic alone); one lock
+                    # acquisition serves the whole chunk.
+                    served = (delta_cache.serve_many(docs, dig_np)
+                              if cand else {})
+                    if not served:
+                        # Cold / all-changed / fallback route — and the
+                        # golden oracle the delta path is tested against.
+                        t0 = perf_counter()
+                        arr = export_to_numpy(core)
+                        _bump(stage, "download", t0)
+                        _count_d2h(stage, _nbytes(arr))
+                        ex_futs.append(ex_pool.submit(
+                            extract_full_store, meta, arr, dig_np))
+                    elif len(served) == len(docs):
+                        delta_cache.note_bytes_saved(_nbytes(core))
+                        ex_futs.append(ex_pool.submit(
+                            extract_served, docs, served))
+                    else:
+                        changed = [d for d in range(len(docs))
+                                   if d not in served]
+                        # Exact rows on host-viewable buffers; fine-
+                        # bucketed device gather (or whole-buffer fetch
+                        # when padding would move it all) elsewhere —
+                        # gather_export_rows owns that choice and
+                        # reports the bytes that really crossed.
+                        t0 = perf_counter()
+                        sub, fetched = gather_export_rows(
+                            core, np.asarray(changed, np.int32))
+                        _bump(stage, "download", t0)
+                        _count_d2h(stage, fetched)
+                        delta_cache.note_bytes_saved(
+                            max(0, _nbytes(core) - fetched))
+                        ex_futs.append(ex_pool.submit(
+                            extract_delta, meta, sub, changed, served,
+                            dig_np))
                 if len(ex_futs) >= extract_threads + 1:
                     collect(ex_futs.popleft())
 
+            want_digest = delta_cache is not None
             while pack_futs:
                 fut = pack_futs.popleft()
                 state, ops, meta, dt = fut.result()
@@ -529,15 +661,27 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     stage["pack"] = stage.get("pack", 0.0) + dt
                 t0 = perf_counter()
                 S = _chunk_S(meta)
-                ex = replay_export(state, ops, meta, S=S)
-                _start_host_copy(ex)
+                ex = replay_export(state, ops, meta, S=S,
+                                   digest=want_digest)
+                core, dig = split_export_digest(ex, want_digest)
+                cand = want_digest and delta_cache.any_candidate(
+                    meta["docs"])
+                if dig is not None:
+                    _start_host_copy(dig)
+                if dig is None or not cand:
+                    # No tier-0 candidate can skip the download: start
+                    # the full async copy at dispatch like the plain
+                    # path.  With candidates present, starting it would
+                    # transfer the very bytes delta download exists to
+                    # avoid.
+                    _start_host_copy(core)
                 _bump(stage, "dispatch", t0)
                 if packed_out is not None:
                     # state included so a caller re-timing the fold can
                     # replay WARM chunks with the same executable the e2e
                     # used (None for cold chunks).
                     packed_out.append((state, ops, meta, S))
-                inflight.append((meta, ex))
+                inflight.append((meta, core, dig, cand))
                 if len(inflight) > fetch_depth:
                     fetch_one(*inflight.popleft())
             while inflight:

@@ -163,6 +163,7 @@ class CatchupService:
         mesh="auto",
         cache="default",
         pack_cache="default",
+        delta_cache="default",
     ) -> None:
         from ..utils.telemetry import MonitoringContext
 
@@ -178,7 +179,7 @@ class CatchupService:
         # server's per-RPC ``invalidate_epoch`` treats any other store's
         # epoch as a dead generation), or None to disable.
         from ..ops.pipeline import PackCache
-        from .catchup_cache import CatchupResultCache
+        from .catchup_cache import CatchupResultCache, DeltaExportCache
 
         def _gated(value, gate_key, bytes_key, default_bytes, ctor):
             if value != "default":
@@ -193,6 +194,13 @@ class CatchupService:
         self._pack_cache = _gated(pack_cache, "Catchup.PackCache",
                                   "Catchup.PackCacheBytes", 192 << 20,
                                   PackCache)
+        # Tier 0 (ISSUE 6): digest-gated delta download — summaries stay
+        # device-resident; only changed documents' export rows cross the
+        # d2h link on a warm catch-up.  Gate Catchup.DeltaDownload
+        # (default ON) / Catchup.DeltaCacheBytes.
+        self.delta_cache = _gated(delta_cache, "Catchup.DeltaDownload",
+                                   "Catchup.DeltaCacheBytes", 256 << 20,
+                                   DeltaExportCache)
         raw_timeout = self.mc.config.raw("Catchup.JoinTimeout")
         try:
             # Explicit None check: a configured 0 means "never wait on a
@@ -613,10 +621,11 @@ class CatchupService:
         if mesh is not None:
             # Mesh-sharded service fold: the same byte-identical summaries,
             # document axis partitioned over the mesh (parallel/shard.py).
-            # KNOWN LIMIT: tier-2 pack reuse and the per-stage busy
-            # counters exist only on the single-device pipeline below —
-            # the sharded fold packs fresh per call (tier 1 still serves
-            # repeated reads on every path).
+            # KNOWN LIMIT: tier-2 pack reuse, tier-0 delta download, and
+            # the per-stage busy counters exist only on the single-device
+            # pipeline below — the sharded fold packs fresh and downloads
+            # full per call (tier 1 still serves repeated reads on every
+            # path).
             import functools
 
             from ..parallel.shard import (
@@ -653,6 +662,7 @@ class CatchupService:
                     stats=self.pipeline_stats,
                     stage=self.pipeline_stage,
                     pack_cache=self._pack_cache,
+                    delta_cache=self.delta_cache,
                 ),
                 MAP_TYPE: replay_map_batch,
                 MATRIX_TYPE: replay_matrix_batch,

@@ -339,6 +339,10 @@ class OrderingServer:
                 # here just frees the budget immediately.
                 catchup.cache.invalidate_epoch(
                     service.storage.epoch)
+            if catchup.delta_cache is not None:
+                # Tier 0 (delta download) is epoch-keyed the same way.
+                catchup.delta_cache.invalidate_epoch(
+                    service.storage.epoch)
             doc_ids = params.get("docs")
             prefix = f"{session.tenant}/" if self.tenants is not None else ""
             if doc_ids is not None:
@@ -368,6 +372,11 @@ class OrderingServer:
                 # clients see the single-flight amortization here.
                 "cache": (catchup.cache.stats()
                           if catchup.cache is not None else None),
+                # Tier-0 delta-download health: documents whose rows
+                # never crossed the d2h link + the bytes that saved.
+                "deltaCache": (catchup.delta_cache.stats()
+                               if catchup.delta_cache is not None
+                               else None),
             }
         if method == "latest_summary":
             epoch = service.storage.epoch

@@ -102,6 +102,11 @@ class ColdWarmResult:
     cold_s: float
     warm_s: float  # best warm run
     warm_runs: int
+    #: d2h bytes the cold / per-warm run moved (from a pipeline ``stage``
+    #: dict's ``d2h_bytes`` counter) — None when no stage was attached,
+    #: so JSON consumers see the fields null-stable, not absent.
+    cold_d2h_bytes: Optional[int] = None
+    warm_d2h_bytes: Optional[int] = None  # LAST warm run (deterministic)
 
     @property
     def speedup(self) -> float:
@@ -109,31 +114,51 @@ class ColdWarmResult:
             else float("inf")
 
     def report(self) -> str:
-        return (
+        out = (
             f"{self.name}: cold {self.cold_s * 1e3:.3f}ms | warm "
             f"{self.warm_s * 1e3:.3f}ms (best of {self.warm_runs}) | "
             f"{self.speedup:.1f}x"
         )
+        if self.cold_d2h_bytes is not None:
+            out += (f" | d2h cold {self.cold_d2h_bytes} B, warm "
+                    f"{self.warm_d2h_bytes} B")
+        return out
 
 
 def benchmark_cold_warm(
     fn: Callable[[], object],
     name: str = "cold-warm",
     warm_runs: int = 3,
+    stage: Optional[dict] = None,
 ) -> ColdWarmResult:
     """Cold/warm mode: time ``fn`` once cold, then ``warm_runs`` more
     times taking the best — no setup hook on purpose (the state carried
-    between runs IS the measurement)."""
+    between runs IS the measurement).  ``stage`` (a pipeline stage dict
+    whose ``d2h_bytes`` counter ``fn`` advances) additionally attributes
+    the cold run's and the last warm run's d2h bytes — the delta-download
+    observable, deterministic where the timings are not."""
+
+    def _bytes() -> int:
+        return int(stage.get("d2h_bytes", 0)) if stage is not None else 0
+
+    b0 = _bytes()
     t0 = time.perf_counter()
     fn()
     cold = time.perf_counter() - t0
+    cold_bytes = _bytes() - b0
     warm = float("inf")
+    warm_bytes = 0
     for _ in range(max(1, warm_runs)):
+        b0 = _bytes()
         t0 = time.perf_counter()
         fn()
         warm = min(warm, time.perf_counter() - t0)
-    return ColdWarmResult(name=name, cold_s=cold, warm_s=warm,
-                          warm_runs=max(1, warm_runs))
+        warm_bytes = _bytes() - b0
+    return ColdWarmResult(
+        name=name, cold_s=cold, warm_s=warm, warm_runs=max(1, warm_runs),
+        cold_d2h_bytes=cold_bytes if stage is not None else None,
+        warm_d2h_bytes=warm_bytes if stage is not None else None,
+    )
 
 
 @dataclasses.dataclass

@@ -86,6 +86,44 @@ def test_pallas_fold_matches_scan_on_hard_semantics():
     _parity(mod._hard_mergetree_docs())
 
 
+def test_padded_block_dims_satisfy_mosaic_rule():
+    """The round-5 recorded Mosaic failure was a block whose dims violate
+    the (8, 128) divisibility rule (``block shape (1, 96)`` vs array
+    ``(1024, 96)``).  Every BlockSpec the kernel builds is (DOC_BLOCK,
+    lanes) with lanes from _padded_dims — pin the invariant directly."""
+    from fluidframework_tpu.ops.pallas_fold import (
+        DOC_BLOCK,
+        LANE,
+        _padded_dims,
+    )
+
+    assert DOC_BLOCK % 8 == 0 and LANE % 128 == 0
+    for D, S, T in [(1, 1, 1), (24, 96, 48), (11, 48, 24),
+                    (1024, 96, 96), (8, 128, 128), (1000, 192, 130)]:
+        Dp, Sp, Tp = _padded_dims(D, S, T)
+        assert Dp % DOC_BLOCK == 0 and Dp >= D
+        assert Sp % LANE == 0 and Sp >= S, (S, Sp)
+        assert Tp % LANE == 0 and Tp >= T, (T, Tp)
+
+
+def test_pallas_fold_parity_on_nondivisible_buckets():
+    """Interpret-mode parity on exactly the shapes the recorded error
+    names: lane dims (S, T) that are NOT multiples of 128 and a doc
+    count that is not a multiple of 8 — the pad lanes/rows must be
+    masked to inertness."""
+    docs = [bench.synth_doc(i, 24) for i in range(11)]
+    # The natural buckets must genuinely violate the rule on EVERY
+    # padded axis (or the test would prove nothing): D not a multiple
+    # of 8, S and T not multiples of 128.
+    state, ops, _meta = pack_mergetree_batch(docs)
+    D, S = state.tstart.shape
+    T = ops.kind.shape[1]
+    assert D % 8 != 0, f"D={D} accidentally 8-aligned"
+    assert S % 128 != 0, f"S={S} accidentally 128-aligned"
+    assert T % 128 != 0, f"T={T} accidentally 128-aligned"
+    _parity(docs)
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_pallas_fold_matches_scan_on_fuzz_logs(seed):
     from fluidframework_tpu.ops.mergetree_kernel import MergeTreeDocInput

@@ -45,6 +45,10 @@ def test_pipelined_matches_one_batch_replay():
     assert stats.get("device_docs", 0) > 0
     assert stats.get("fallback_docs", 0) == base_stats.get("fallback_docs", 0)
     assert stage.get("pack", 0) > 0 and stage.get("download", 0) >= 0
+    # Honest stage attribution (ISSUE 6): the async fold wait is split
+    # out of "download", and the d2h byte counter records real traffic.
+    assert "device_wait" in stage
+    assert stage.get("d2h_bytes", 0) > 0
 
 
 def test_pipelined_schedule_returns_caller_order():

@@ -263,12 +263,13 @@ def oracle_tree(doc: TreeDocInput):
 # -- the measurement loop -----------------------------------------------------
 
 
-def _pipelined_string(docs, stats=None):
+def _pipelined_string(docs, stats=None, stage=None):
     """Config #1/#3 device path = the PRODUCT pipeline (the same chunked
     single-device-thread fold the catch-up service runs)."""
     from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
 
-    return pipelined_mergetree_replay(docs, chunk_docs=CHUNK, stats=stats)
+    return pipelined_mergetree_replay(docs, chunk_docs=CHUNK, stats=stats,
+                                      stage=stage)
 
 
 def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
@@ -288,9 +289,12 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
     # call and chunk/overlap internally.
     device_batch_fn(docs[:CHUNK])
     stats: dict = {}
+    stage: dict = {}
     t0 = time.time()
     if self_chunked:
-        summaries = list(device_batch_fn(docs, stats=stats))
+        # The product pipeline carries the honest stage split
+        # (device_wait vs download) + the d2h byte counter.
+        summaries = list(device_batch_fn(docs, stats=stats, stage=stage))
     else:
         summaries = []
         for i in range(0, len(docs), CHUNK):
@@ -311,6 +315,12 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
         "device_sec": round(dev_t, 3),
         "fallback_docs": stats.get("fallback_docs", 0),
         "device_docs": stats.get("device_docs", 0),
+        # Null-stable on non-pipeline configs (no stage instrumentation).
+        "stages_busy_sec": ({
+            k: round(v, 3) for k, v in sorted(stage.items())
+            if k != "d2h_bytes"
+        } if stage else None),
+        "d2h_bytes": (int(stage.get("d2h_bytes", 0)) if stage else None),
     }
     print(
         f"{name:12s} docs={len(docs):5d} ops={total_ops:7d} "
