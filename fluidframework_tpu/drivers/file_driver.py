@@ -68,28 +68,15 @@ class FileSummaryStorage(SummaryStorage):
         # Written ATOMICALLY (temp + rename), and an empty file — a crash
         # between create and write — is rewritten rather than silently
         # minting a fresh epoch on every restart.
-        epoch_path = os.path.join(root, "epoch")
+        self._epoch_path = os.path.join(root, "epoch")
         stored = ""
-        if os.path.exists(epoch_path):
-            with open(epoch_path, "r", encoding="utf-8") as f:
+        if os.path.exists(self._epoch_path):
+            with open(self._epoch_path, "r", encoding="utf-8") as f:
                 stored = f.read().strip()
         if stored:
             self.epoch = stored
         else:
-            tmp_path = epoch_path + ".tmp"
-            with open(tmp_path, "w", encoding="utf-8") as f:
-                f.write(self.epoch)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp_path, epoch_path)
-            # fsync the DIRECTORY too: the rename itself must be durable,
-            # or a crash could lose the epoch file and a reopen would mint
-            # a new generation for a store whose data survived.
-            dfd = os.open(root, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            self._persist_epoch()
         # Repair crash-torn tails BEFORE appends resume: without this the
         # next append merges onto a torn line, silently losing the new
         # record on the following reopen (review r4 finding).
@@ -111,6 +98,31 @@ class FileSummaryStorage(SummaryStorage):
             # (torn write) is dropped rather than left to KeyError readers.
             if rec["commit"] in self._commit_objects:
                 self._set_ref(rec["doc"], rec["ref"], rec["commit"])
+
+    def _persist_epoch(self) -> None:
+        tmp_path = self._epoch_path + ".tmp"
+        with open(tmp_path, "w", encoding="utf-8") as f:
+            f.write(self.epoch)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, self._epoch_path)
+        # fsync the DIRECTORY too: the rename itself must be durable,
+        # or a crash could lose the epoch file and a reopen would mint
+        # a new generation for a store whose data survived.
+        dfd = os.open(self.root, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    def bump_epoch(self, token: str) -> str:
+        """Generation fence, persisted: a restart after a shard failover
+        must reopen into the POST-fence generation, or clients that
+        already reconnected through the fence would be told their fresh
+        caches are stale (or worse, pre-fence pins would validate)."""
+        super().bump_epoch(token)
+        self._persist_epoch()
+        return token
 
     # -- persistence hooks -----------------------------------------------------
 

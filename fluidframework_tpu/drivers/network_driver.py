@@ -26,7 +26,8 @@ import threading
 import weakref
 from typing import Callable, Dict, List, Optional
 
-from ..protocol.messages import NackError, RawOperation, SequencedMessage
+from ..protocol.messages import (NackError, RawOperation, SequencedMessage,
+                                 ShardFencedError)
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
 from ..protocol.wire import (LEN as _LEN, WIRE_VERSION,
                              decode_sequenced_message,
@@ -144,6 +145,13 @@ class _RpcClient:
             frame = self._events.get()
             if frame is None:
                 return
+            if frame["event"] == "fence":
+                # Shard failover (server push): the storage generation
+                # changed.  Unpin + drop every cache on this connection
+                # BEFORE delivering to per-doc subscribers — proactive
+                # reconnect-through-the-fence, so the next RPC adopts the
+                # new epoch instead of tripping over epochMismatch.
+                self._invalidate_epoch_state()
             key = f"{frame['event']}:{frame.get('doc', '')}"
             # Snapshot under the lock, deliver outside it: a handler that
             # issues further RPCs (or re-subscribes) must not deadlock or
@@ -222,31 +230,44 @@ class _RpcClient:
             if frame.get("code") == "epochMismatch":
                 # Dead generation: unpin and drop EVERY cache riding this
                 # connection before anyone can retry unpinned against the
-                # new generation with stale state still live.  Same
-                # discipline as the dispatcher: snapshot under the lock,
-                # invoke the callbacks OUTSIDE it (a listener that
-                # re-registers must not self-deadlock on the plain Lock),
-                # then prune dead weakrefs by re-reading the LIVE list —
-                # never by writing back the stale snapshot, which would
-                # drop listeners registered during delivery.
-                self.epoch = None
-                with self._state_lock:
-                    listeners = list(self._epoch_listeners)
-                for ref in listeners:
-                    invalidate = ref()
-                    if invalidate is not None:
-                        invalidate()
-                with self._state_lock:
-                    self._epoch_listeners[:] = [
-                        r for r in self._epoch_listeners
-                        if r() is not None
-                    ]
+                # new generation with stale state still live.
+                self._invalidate_epoch_state()
                 raise EpochMismatchError(
                     frame.get("error", "storage epoch mismatch"),
                     frame.get("epoch"),
                 )
+            if frame.get("code") == "shardFenced":
+                # Mid-failover race on the server: the router has (or is
+                # about to have) a recovered owner — typed so callers can
+                # re-resolve/retry instead of failing like a dead server.
+                raise ShardFencedError(
+                    frame.get("doc", ""),
+                    frame.get("error", "shard fenced"),
+                )
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
+
+    def _invalidate_epoch_state(self) -> None:
+        """Unpin the connection's storage generation and invalidate every
+        per-doc cache riding it — shared by the epochMismatch error path
+        and the proactive server-push fence event.  Same discipline as
+        the dispatcher: snapshot under the lock, invoke the callbacks
+        OUTSIDE it (a listener that re-registers must not self-deadlock
+        on the plain Lock), then prune dead weakrefs by re-reading the
+        LIVE list — never by writing back the stale snapshot, which
+        would drop listeners registered during delivery."""
+        self.epoch = None
+        with self._state_lock:
+            listeners = list(self._epoch_listeners)
+        for ref in listeners:
+            invalidate = ref()
+            if invalidate is not None:
+                invalidate()
+        with self._state_lock:
+            self._epoch_listeners[:] = [
+                r for r in self._epoch_listeners
+                if r() is not None
+            ]
 
     def on(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
         with self._state_lock:
@@ -301,8 +322,14 @@ class NetworkConnection:
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._signal_subscribers: List[Callable[[dict], None]] = []
         self._tapped = False
+        #: diagnostics for hosts/tests: server-pushed backpressure and
+        #: failover notifications observed on this document.
+        self.demotions_seen = 0
+        self.fences_seen = 0
         rpc.on("op", doc_id, self._on_op_event)
         rpc.on("signal", doc_id, self._on_signal_event)
+        rpc.on("demoted", doc_id, self._on_demoted_event)
+        rpc.on("fence", doc_id, self._on_fence_event)
 
     def _ensure_tap(self) -> None:
         if not self._tapped:
@@ -317,6 +344,37 @@ class NetworkConnection:
     def _on_signal_event(self, frame: dict) -> None:
         for fn in list(self._signal_subscribers):
             fn(frame["signal"])
+
+    def _on_demoted_event(self, frame: dict) -> None:
+        """The server demoted this connection's live tap (our buffer was
+        the laggard): re-subscribe, then KICK the backfill — deliver the
+        current head op through the live path so the DeltaManager's gap
+        repair fetches the whole missed range from durable delta storage
+        NOW (catch-up-from-oplog).  Without the kick, a document that
+        goes quiet after the demoting burst would stay missing the
+        dropped span forever (gap repair only fires on a later live
+        message).  Subscribers dedup by their delivery watermark, so the
+        kick is harmless when nothing was missed.  Runs on the
+        dispatcher thread, which may issue blocking requests by design."""
+        self.demotions_seen += 1
+        try:
+            head = self._rpc.request("subscribe_doc", {"doc": self.doc_id})
+            head = max(int(head or 0), int(frame.get("head") or 0))
+            if head > 0:
+                for msg in self.deltas(from_seq=head - 1, to_seq=head):
+                    for fn in list(self._subscribers):
+                        fn(msg)
+        except RpcError:
+            # Connection is going away; reconnect handles resubscription.
+            self._tapped = False
+
+    def _on_fence_event(self, frame: dict) -> None:
+        """Shard failover notification.  The epoch unpin/cache sweep
+        already ran centrally in the dispatcher (_invalidate_epoch_state);
+        the live broadcast continues from the recovered owner on the
+        server side, so the op stream needs no client action — the
+        counter is for hosts that want to log/alert."""
+        self.fences_seen += 1
 
     # -- DocumentEndpoint surface ----------------------------------------------
 

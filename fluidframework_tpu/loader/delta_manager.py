@@ -25,7 +25,8 @@ from typing import Callable, List, Optional
 
 import time
 
-from ..protocol.messages import NackError, RawOperation, SequencedMessage
+from ..protocol.messages import (NackError, RawOperation, SequencedMessage,
+                                 ShardFencedError)
 
 _session_counter = itertools.count(1)
 
@@ -67,6 +68,13 @@ class DeltaManager:
         # which discards the stale encodings and REBASES pending ops to a
         # fresh view (the existing reconnect machinery).
         self.rebase_required = False
+        # The document's orderer shard was fenced (failover): retrying
+        # the same connection can never succeed — the host must
+        # re-resolve the document service (the router now hands out the
+        # recovered owner) and reconnect with it.  Mirrors
+        # rebase_required: a flag the pump reads, because the error
+        # itself is a ConnectionError the wire-drain rightly swallows.
+        self.fence_required = False
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._ahead: dict = {}  # seq -> parked out-of-order message
         self._live_fn = None
@@ -130,6 +138,12 @@ class DeltaManager:
                             retry_after=self.nacked_until - now)
         try:
             return self._service.connection().submit(op)
+        except ShardFencedError:
+            # Dead shard: the op stays queued (ConnectionError contract),
+            # but flag that only a reconnect against a re-resolved
+            # service can drain it.
+            self.fence_required = True
+            raise
         except NackError as nack:
             # The service refused the op (throttle / stale view): hold
             # sends for retryAfter; the runtime keeps the encoded ops
@@ -175,6 +189,9 @@ class DeltaManager:
         if document_service is not None:
             self._service = document_service
         self.connect(client_id if client_id is not None else self.client_id)
+        # A successful (re)connect clears the fence flag: either the host
+        # handed us the re-resolved service, or the old one still works.
+        self.fence_required = False
 
     def close(self) -> None:
         self.disconnect()

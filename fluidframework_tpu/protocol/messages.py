@@ -69,6 +69,28 @@ class NackError(ConnectionError):
         self.code = code
 
 
+class ShardFencedError(ConnectionError):
+    """The orderer instance behind this connection was fenced (its shard
+    was marked dead and its documents re-owned elsewhere): nothing it
+    stamps can reach the durable log anymore, so the submit was refused.
+
+    Subclasses ConnectionError for the same reason NackError does — the
+    runtime's wire-drain keeps the encoded ops queued — but recovery is
+    NOT "resend later on the same connection": the caller must re-resolve
+    the document (the router now hands out the recovered owner's
+    endpoint) and reconnect; the DeltaManager raises its
+    ``fence_required`` flag so hosts know a plain retry cannot succeed.
+    """
+
+    def __init__(self, doc_id: str, reason: str = "") -> None:
+        super().__init__(
+            reason or f"orderer for {doc_id!r} is fenced (shard died; "
+                      f"the document was re-owned — re-resolve and "
+                      f"reconnect)"
+        )
+        self.doc_id = doc_id
+
+
 @dataclasses.dataclass
 class RawOperation:
     """An op as submitted by a client, before sequencing."""

@@ -168,6 +168,16 @@ class SummaryStorage:
         # persistence step.
         self._lock = threading.RLock()
 
+    def bump_epoch(self, token: str) -> str:
+        """Advance the storage generation in place (shard-failover fence):
+        every cached snapshot/delta/fold pinned to the old epoch becomes
+        unservable, and pinned clients hit the epochMismatch reconnect
+        path on their next request.  ``token`` is caller-supplied so the
+        fence can be deterministic (replay/test harnesses derive it from
+        the old epoch).  File-backed stores persist the bump."""
+        self.epoch = token
+        return token
+
     def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
                message: str = "") -> str:
         with self._lock:

@@ -9,10 +9,19 @@ deployment:
   sequencing with checkpoints, multi-document front door, signal fan-out.
 - :mod:`catchup`  — the scriptorium-fed bulk catch-up service that routes
   replay through the TPU backend (the north-star service path).
+- :mod:`sharding` — document-partitioned orderer shards (rendezvous
+  routing, epoch-fenced failover) behind the same service surface.
+- :mod:`broadcaster` — serialize-once broadcast fan-out with laggard
+  demotion (the per-doc delta/signal distribution tier).
 """
 
+from .broadcaster import Broadcaster
 from .oplog import OpLog
 from .orderer import DocumentOrderer, LocalOrderingService
 from .scribe import Scribe
+from .sharding import ShardedOrderingService, ShardRouter
 
-__all__ = ["OpLog", "DocumentOrderer", "LocalOrderingService", "Scribe"]
+__all__ = [
+    "Broadcaster", "OpLog", "DocumentOrderer", "LocalOrderingService",
+    "Scribe", "ShardRouter", "ShardedOrderingService",
+]

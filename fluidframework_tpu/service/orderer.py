@@ -18,13 +18,18 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..protocol.messages import RawOperation, SequencedMessage
+from ..protocol.messages import RawOperation, SequencedMessage, ShardFencedError
 from ..protocol.sequencer import Sequencer
 from ..protocol.summary import SummaryStorage
 from .oplog import OpLog
 from .scribe import Scribe
 
 SignalListener = Callable[[dict], None]
+
+#: bound for a recovery follower's wait on the leading replay (the same
+#: crashed-leader discipline as CatchupResultCache.DEFAULT_JOIN_TIMEOUT:
+#: a waiter must never hang forever on a leader that died mid-replay).
+RECOVERY_JOIN_TIMEOUT = 60.0
 
 
 class DocumentOrderer:
@@ -42,31 +47,74 @@ class DocumentOrderer:
         self.oplog = oplog
         self.storage = storage
         self.sequencer = sequencer or Sequencer(throttle=throttle)
+        #: fenced = this orderer's shard was marked dead and the document
+        #: re-owned elsewhere.  The flag is checked by the durable-append
+        #: subscriber below UNDER the fence lock, so ANY stamp attempt
+        #: (submit, tick, scribe ack) aborts before the log — the
+        #: log-append-before-broadcast invariant is what keeps sequencing
+        #: from forking: a fenced orderer can advance its private counters
+        #: but nothing it stamps becomes durable or visible.
+        self._fence_lock = threading.Lock()
+        self.fenced = False  # guarded-by: _fence_lock
         # Durable append rides first in the broadcast chain: by the time any
         # client sees a message it is already in the log (scriptorium-before-
         # broadcast, collapsing the reference's Kafka fan-out).
-        self.sequencer.subscribe(lambda msg: oplog.append(doc_id, msg))
+        self.sequencer.subscribe(self._durable_append)
         self.scribe = Scribe(doc_id, self.sequencer, storage)
-        self._signal_listeners: List[SignalListener] = []
+        # Listener list is mutated by caller threads (server sessions
+        # subscribe/unsubscribe) while fan-out iterates it; snapshot under
+        # the lock, deliver outside it (the server's broadcaster is the
+        # usual single listener — per-client fan-out happens there).
+        self._signal_lock = threading.Lock()
+        self._signal_listeners: List[SignalListener] = []  # guarded-by: _signal_lock
+
+    def _durable_append(self, msg: SequencedMessage) -> None:
+        # Check-and-append in ONE fence-lock critical section: a submit
+        # that raced fence() either completes its append before the fence
+        # is set (the failover replay then includes it) or observes the
+        # fence and aborts — there is no window where a fenced orderer's
+        # stamp lands in the log after the new owner started replaying.
+        with self._fence_lock:
+            if self.fenced:
+                raise ShardFencedError(self.doc_id)
+            self.oplog.append(self.doc_id, msg)
+
+    def fence(self) -> None:
+        """Mark this orderer dead (shard failover): every later stamp
+        aborts before the durable log, so the re-owned orderer recovered
+        from that log is the single continuation of the sequence.  Takes
+        the fence lock — by the time this returns, any in-flight append
+        has either landed (and is part of what the new owner replays) or
+        will abort; the log is quiescent for this document."""
+        with self._fence_lock:
+            self.fenced = True
 
     # -- signals (unsequenced ephemeral broadcast — presence rides this) -------
 
     def submit_signal(self, client_id: str, content,
                       target_client_id: Optional[str] = None) -> None:
+        with self._fence_lock:
+            fenced = self.fenced
+        if fenced:
+            return  # signals are ephemeral: a dead shard's are dropped
         signal = {
             "clientId": client_id,
             "content": content,
             "targetClientId": target_client_id,
         }
-        for fn in list(self._signal_listeners):
+        with self._signal_lock:
+            listeners = list(self._signal_listeners)
+        for fn in listeners:
             fn(signal)
 
     def subscribe_signals(self, fn: SignalListener) -> None:
-        self._signal_listeners.append(fn)
+        with self._signal_lock:
+            self._signal_listeners.append(fn)
 
     def unsubscribe_signals(self, fn: SignalListener) -> None:
-        if fn in self._signal_listeners:
-            self._signal_listeners.remove(fn)
+        with self._signal_lock:
+            if fn in self._signal_listeners:
+                self._signal_listeners.remove(fn)
 
     # -- checkpoint / crash-resume ---------------------------------------------
 
@@ -134,17 +182,38 @@ class DocumentEndpoint:
     def log(self) -> List[SequencedMessage]:
         return self._orderer.oplog.get(self._orderer.doc_id)
 
+    # The endpoint-level fence checks below are advisory fast-fails for
+    # clean errors; they read the flag without the fence lock.  The
+    # AUTHORITATIVE gate is DocumentOrderer._durable_append, which
+    # re-checks under the lock — a submit that slips past an endpoint
+    # check mid-kill still aborts before the durable log.
+
     @property
     def head_seq(self) -> int:
+        if self._orderer.fenced:
+            # A dead shard's counter is stale the moment the re-owned
+            # orderer stamps: refuse rather than serve a head the durable
+            # log has moved past.
+            raise ShardFencedError(self.doc_id)
         return self._orderer.sequencer.seq
 
     def connect(self, client_id: str, session: Optional[str] = None) -> None:
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
         self._orderer.sequencer.connect(client_id, session)
 
     def disconnect(self, client_id: str) -> None:
+        if self._orderer.fenced:
+            # Leaving a dead shard needs no LEAVE: the recovered owner's
+            # quorum governs now, and a fenced orderer could not make the
+            # LEAVE durable anyway.  No-op so reconnect teardown of the
+            # stale connection never trips over the fence.
+            return
         self._orderer.sequencer.disconnect(client_id)
 
     def submit(self, op: RawOperation) -> Optional[SequencedMessage]:
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
         return self._orderer.sequencer.submit(op)
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
@@ -154,6 +223,8 @@ class DocumentEndpoint:
         self._orderer.sequencer.unsubscribe(fn)
 
     def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
+        if self._orderer.fenced:
+            return  # heartbeat to a dead shard: the new owner tracks MSN
         self._orderer.sequencer.update_ref_seq(client_id, ref_seq)
 
     def deltas(self, from_seq: int = 0,
@@ -169,6 +240,15 @@ class DocumentEndpoint:
 
     def unsubscribe_signals(self, fn: SignalListener) -> None:
         self._orderer.unsubscribe_signals(fn)
+
+
+class _RecoveryFlight:
+    """One in-flight log replay: the leader publishes the recovered
+    orderer into ``_orderers``; waiters block on the event and re-claim
+    (the single-flight begin/publish/abandon shape of catchup_cache)."""
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
 
 
 class LocalOrderingService:
@@ -194,14 +274,40 @@ class LocalOrderingService:
         #: summary eviction; entries are per-node and tiny.
         self.handle_tenants: Dict[str, set] = {}  # guarded-by: state_lock
         self._orderers: Dict[str, DocumentOrderer] = {}  # guarded-by: state_lock
+        #: doc_id -> in-flight recovery; a herd of connects to a document
+        #: present only in the durable log costs ONE replay (the same
+        #: single-flight discipline as the catch-up cache).
+        self._recoveries: Dict[str, _RecoveryFlight] = {}  # guarded-by: state_lock
+        #: shard-level fence (set by ShardedOrderingService.kill_shard via
+        #: fence_all): once set, no NEW orderer can be created or
+        #: published unfenced — closes the window where a single-flight
+        #: recovery in flight at kill time would install a live orderer
+        #: on a dead-routed shard after the per-orderer fence sweep ran.
+        self._fenced = False  # guarded-by: state_lock
         #: guards handle_tenants and lazy orderer creation: the network
         #: front door offloads catchup/upload_summary to executor THREADS
         #: that mutate these maps concurrently with event-loop dispatches
         #: (ADVICE r3) — GIL atomicity alone is not a contract.
         self.state_lock = threading.RLock()
 
+    def fence_all(self) -> List[str]:
+        """Shard failover: refuse new orderers, then fence every live one.
+        The flag flips under state_lock FIRST, so a racing recovery either
+        published before this (its orderer is in the sweep snapshot) or
+        publishes after (and is born fenced in _recover_publish) — there
+        is no interleaving that leaves a live orderer on a dead shard.
+        Returns the fenced doc ids."""
+        with self.state_lock:
+            self._fenced = True
+            orderers = sorted(self._orderers.items())
+        for _doc_id, orderer in orderers:
+            orderer.fence()
+        return [doc_id for doc_id, _ in orderers]
+
     def create_document(self, doc_id: str) -> DocumentEndpoint:
         with self.state_lock:
+            if self._fenced:
+                raise ShardFencedError(doc_id)
             if doc_id in self._orderers:
                 raise ValueError(f"document {doc_id!r} already exists")
             self._orderers[doc_id] = DocumentOrderer(
@@ -214,25 +320,90 @@ class LocalOrderingService:
             known = doc_id in self._orderers
         return known or self.oplog.head(doc_id) > 0
 
-    def endpoint(self, doc_id: str) -> DocumentEndpoint:
-        """Connect-or-recover: an existing orderer is reused; a document
-        present only in the durable log (service restart) is recovered by
-        replaying the log into a fresh orderer."""
+    # -- single-flight recovery (begin/publish/abandon, catchup_cache shape) ---
+
+    def _recover_begin(self, doc_id: str):
+        """One atomic claim: ``("have", orderer)`` when live,
+        ``("lead", flight)`` when this caller must replay the log, or
+        ``("wait", flight)`` when another caller already is."""
         with self.state_lock:
             orderer = self._orderers.get(doc_id)
-        if orderer is None:
-            if self.oplog.head(doc_id) == 0:
-                raise KeyError(f"document {doc_id!r} does not exist")
-            # Recover OUTSIDE the lock: a full log replay can take seconds
-            # and the lock must stay a dict-operations-only lock.  Two
-            # racing recoveries replay the same immutable log prefix; the
-            # first insert wins.
-            recovered = DocumentOrderer.recover(
-                doc_id, self.oplog, self.storage
-            )
-            with self.state_lock:
-                orderer = self._orderers.setdefault(doc_id, recovered)
-        return DocumentEndpoint(orderer)
+            if orderer is not None:
+                return "have", orderer
+            flight = self._recoveries.get(doc_id)
+            if flight is not None:
+                return "wait", flight
+            flight = _RecoveryFlight()
+            self._recoveries[doc_id] = flight
+            return "lead", flight
+
+    def _recover_publish(self, doc_id: str,
+                         orderer: DocumentOrderer) -> DocumentOrderer:
+        """Leader succeeded: install the orderer, wake every waiter.  The
+        install re-validates via setdefault — if create_document landed in
+        the replay window, its orderer wins and the replay is discarded.
+        A shard fenced mid-replay installs the orderer FENCED: waiters get
+        clean ShardFencedErrors and re-resolve through the router instead
+        of sequencing on a dead shard."""
+        with self.state_lock:
+            fenced = self._fenced
+            installed = self._orderers.setdefault(doc_id, orderer)
+            flight = self._recoveries.pop(doc_id, None)
+        if fenced:
+            installed.fence()
+        if flight is not None:
+            flight.done.set()
+        return installed
+
+    def _recover_abandon(self, doc_id: str) -> None:
+        """Leader failed: wake waiters empty-handed (one re-claims and
+        replays itself).  Safe on an already-published key."""
+        with self.state_lock:
+            flight = self._recoveries.pop(doc_id, None)
+        if flight is not None:
+            flight.done.set()
+
+    def _recover_reap(self, doc_id: str, flight: _RecoveryFlight) -> None:
+        """A waiter timed out: presume the leader crashed without reaching
+        its finally-abandon and remove the flight — only if it is still
+        the identical object this waiter waited on, so a fresh leader's
+        flight is never popped (the identity-guard discipline of
+        CatchupResultCache.join)."""
+        with self.state_lock:
+            if self._recoveries.get(doc_id) is flight:
+                self._recoveries.pop(doc_id)
+                flight.done.set()
+
+    def endpoint(self, doc_id: str) -> DocumentEndpoint:
+        """Connect-or-recover: an existing orderer is reused; a document
+        present only in the durable log (service restart, shard failover)
+        is recovered by replaying the log into a fresh orderer.  A herd of
+        concurrent connects costs ONE replay: the first caller leads and
+        replays outside the lock (seconds of work; state_lock stays a
+        dict-operations-only lock), everyone else waits on the flight and
+        re-claims once it resolves."""
+        while True:
+            state, val = self._recover_begin(doc_id)
+            if state == "have":
+                return DocumentEndpoint(val)
+            if state == "lead":
+                try:
+                    if self.oplog.head(doc_id) == 0:
+                        raise KeyError(f"document {doc_id!r} does not exist")
+                    recovered = DocumentOrderer.recover(
+                        doc_id, self.oplog, self.storage
+                    )
+                except BaseException:
+                    self._recover_abandon(doc_id)
+                    raise
+                return DocumentEndpoint(
+                    self._recover_publish(doc_id, recovered)
+                )
+            # wait: bounded — a leader that died without its
+            # finally-abandon must not hang followers forever; on timeout
+            # reap the dead flight (identity-guarded) and re-claim.
+            if not val.done.wait(RECOVERY_JOIN_TIMEOUT):
+                self._recover_reap(doc_id, val)
 
     def doc_ids(self) -> List[str]:
         with self.state_lock:

@@ -35,11 +35,12 @@ import json
 import threading
 from typing import Dict, Optional, Set
 
-from ..protocol.messages import NackError, SequencedMessage
+from ..protocol.messages import NackError, ShardFencedError
 from ..protocol.summary import tree_from_obj, tree_to_obj
 from ..protocol.wire import (LEN as _LEN, MAX_FRAME, WIRE_VERSION,
                              decode_raw_operation,
                              encode_sequenced_message, frame_bytes)
+from .broadcaster import Broadcaster
 from .orderer import LocalOrderingService
 
 
@@ -81,7 +82,10 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
 
 
 class _ClientSession:
-    """One TCP connection's server-side state."""
+    """One TCP connection's server-side state — and the production
+    broadcast SINK: sequenced frames arrive already encoded from the
+    shared :class:`Broadcaster` (one serialization per message for every
+    subscriber on the server), this class only meters and writes them."""
 
     def __init__(self, server: "OrderingServer",
                  writer: asyncio.StreamWriter) -> None:
@@ -90,13 +94,21 @@ class _ClientSession:
         self.subscribed_docs: Set[str] = set()
         self.signal_docs: Set[str] = set()
         self.connected_clients: Dict[str, str] = {}  # client_id -> doc_id
-        self._fns: Dict[str, tuple] = {}  # doc -> (op_fn, signal_fn)
+        self._tapped_by_wire: Dict[str, str] = {}  # out_doc -> internal doc
         self.tenant: Optional[str] = None  # set by a successful "auth"
         self._closed = False
+        # Broadcast-frame accounting: bytes accepted by write_frame but
+        # not yet handed to the transport (the cross-thread hop).  The
+        # transport's own buffer is added at admission time, so the
+        # budget covers the whole path to the socket.
+        self._pending_lock = threading.Lock()
+        self._pending_bytes = 0  # guarded-by: _pending_lock
 
-    #: Disconnect a session whose unread broadcast backlog exceeds this
-    #: (a stalled reader must not grow the server's buffers without bound;
-    #: the client reconnects and backfills from durable storage).
+    #: Disconnect a session whose unread RESPONSE backlog exceeds this
+    #: (broadcast frames never ride this path anymore — they are metered
+    #: by ``write_frame`` and demoted at ``server.broadcast_high_water``;
+    #: this hard cap only guards the request/response and notification
+    #: writes, which are client-paced).
     WRITE_HIGH_WATER = 32 << 20
 
     def send(self, obj: dict) -> None:
@@ -115,6 +127,61 @@ class _ClientSession:
             return
         self.writer.write(frame_bytes(obj))
 
+    # -- broadcast sink (Broadcaster protocol) ---------------------------------
+
+    def write_frame(self, data: bytes) -> bool:
+        """Accept one pre-encoded broadcast frame, or report saturation.
+        Admission is metered against transport backlog + in-flight bytes:
+        a stalled reader saturates here and gets DEMOTED by the
+        broadcaster instead of growing the server's buffers or stalling
+        the other subscribers of its documents."""
+        if self._closed:
+            return True  # connection is tearing down; drop silently
+        transport = self.writer.transport
+        buffered = (transport.get_write_buffer_size()
+                    if transport is not None else 0)
+        with self._pending_lock:
+            if (buffered + self._pending_bytes + len(data)
+                    > self.server.broadcast_high_water):
+                return False
+            self._pending_bytes += len(data)
+        self.server.loop.call_soon_threadsafe(self._write_bytes, data)
+        return True
+
+    def _write_bytes(self, data: bytes) -> None:
+        with self._pending_lock:
+            self._pending_bytes -= len(data)
+        if self.writer.is_closing():
+            return
+        self.writer.write(data)
+
+    def write_signal(self, data: bytes, signal: dict) -> bool:
+        """Signal frames share the encoded bytes across sessions; the
+        per-client TARGET filter is the only per-session work left."""
+        target = signal.get("targetClientId")
+        if target is not None and target not in self.connected_clients:
+            return True  # not addressed to this session — filtered, not lagging
+        return self.write_frame(data)
+
+    def on_demoted(self, out_doc: str, head_seq: int) -> None:
+        """Broadcaster removed this session (buffer budget exceeded):
+        tell the client once — it backfills the missed range from the
+        durable op log (``deltas``) and re-subscribes when it catches
+        up.  The notification rides the response path (small frame)."""
+        doc_id = self._tapped_by_wire.get(out_doc)
+        if doc_id is not None:
+            self.subscribed_docs.discard(doc_id)
+        self.send({"v": WIRE_VERSION, "event": "demoted", "doc": out_doc,
+                   "head": head_seq})
+
+    def on_fence(self, out_doc: str, epoch: str, head_seq: int) -> None:
+        """Shard failover: the storage generation changed and this doc's
+        broadcast now rides the recovered owner.  Push the new epoch so
+        pinned clients unpin/drop caches proactively instead of tripping
+        over epochMismatch on their next request."""
+        self.send({"v": WIRE_VERSION, "event": "fence", "doc": out_doc,
+                   "epoch": epoch, "head": head_seq})
+
     # -- broadcast taps --------------------------------------------------------
 
     def tap(self, doc_id: str, wire_doc: Optional[str] = None) -> None:
@@ -122,22 +189,10 @@ class _ClientSession:
             return
         endpoint = self.server.service.endpoint(doc_id)
         out_doc = wire_doc if wire_doc is not None else doc_id
-
-        def on_op(msg: SequencedMessage) -> None:
-            self.send({"v": WIRE_VERSION, "event": "op", "doc": out_doc,
-                       "msg": encode_sequenced_message(msg)})
-
-        def on_signal(signal: dict) -> None:
-            target = signal.get("targetClientId")
-            if target is not None and target not in self.connected_clients:
-                return
-            self.send({"v": WIRE_VERSION, "event": "signal", "doc": out_doc,
-                       "signal": signal})
-
-        endpoint.subscribe(on_op)
-        endpoint.subscribe_signals(on_signal)
-        self._fns[doc_id] = (on_op, on_signal)
+        self.server.broadcaster.attach(doc_id, endpoint, self,
+                                       out_doc=out_doc)
         self.subscribed_docs.add(doc_id)
+        self._tapped_by_wire[out_doc] = doc_id
 
     def close(self) -> None:
         # Idempotent (fluidleak FL-LEAK-DOUBLE-CLOSE): the laggard-drop
@@ -147,14 +202,9 @@ class _ClientSession:
         if self._closed:
             return
         self._closed = True
-        for doc_id, (op_fn, signal_fn) in self._fns.items():
-            try:
-                endpoint = self.server.service.endpoint(doc_id)
-                endpoint.unsubscribe(op_fn)
-                endpoint.unsubscribe_signals(signal_fn)
-            except KeyError:
-                pass
-        self._fns.clear()
+        self.server.broadcaster.detach_all(self)
+        self.subscribed_docs.clear()
+        self._tapped_by_wire.clear()
         for client_id, doc_id in list(self.connected_clients.items()):
             try:
                 self.server.service.endpoint(doc_id).disconnect(client_id)
@@ -168,7 +218,11 @@ class OrderingServer:
 
     def __init__(self, service: Optional[LocalOrderingService] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 tenants: Optional[Dict[str, str]] = None) -> None:
+                 tenants: Optional[Dict[str, str]] = None,
+                 broadcast_high_water: int = 8 << 20) -> None:
+        #: any object with the LocalOrderingService surface — including
+        #: ShardedOrderingService (the front door dispatches by its
+        #: router transparently: every access goes through endpoint()).
         self.service = service if service is not None else \
             LocalOrderingService()
         self.host = host
@@ -177,6 +231,16 @@ class OrderingServer:
         #: every connection must "auth" first; document ids are namespaced
         #: per tenant so tenants cannot see each other's documents.
         self.tenants = tenants
+        #: serialize-once broadcast fan-out: sessions are sinks, one
+        #: encode per sequenced message regardless of subscriber count.
+        self.broadcaster = Broadcaster()
+        #: per-session broadcast buffer budget; a session exceeding it is
+        #: demoted to catch-up-from-oplog instead of stalling the shard.
+        self.broadcast_high_water = int(broadcast_high_water)
+        if hasattr(self.service, "add_fence_listener"):
+            # Sharded tier: on failover, move live broadcast channels to
+            # the recovered owners and push fence events to subscribers.
+            self.service.add_fence_listener(self._on_shard_fence)
 
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
@@ -184,6 +248,24 @@ class OrderingServer:
         # race the init.
         self._catchup = None  # guarded-by: _catchup_init
         self._catchup_init = threading.Lock()
+
+    def _on_shard_fence(self, shard_id: str, doc_ids, epoch: str) -> None:
+        """A shard died: every affected document with live subscribers is
+        recovered NOW (endpoint() on the new owner replays the durable
+        log) and its broadcast channel re-attached; sessions get a fence
+        event carrying the new storage epoch.  Documents WITHOUT live
+        channels are skipped — they recover lazily on next touch, so a
+        shard full of idle documents fails over in O(live subscriptions),
+        not O(documents × log replay)."""
+        live = set(self.broadcaster.docs_with_channels())
+        for doc_id in doc_ids:
+            if doc_id not in live:
+                continue
+            try:
+                endpoint = self.service.endpoint(doc_id)
+            except KeyError:
+                continue  # summary-only doc; recovered lazily on next use
+            self.broadcaster.refence(doc_id, endpoint, epoch)
 
     # -- tenancy scoping -------------------------------------------------------
 
@@ -468,6 +550,17 @@ class OrderingServer:
                                     "ok": False, "error": str(em),
                                     "code": "epochMismatch",
                                     "epoch": em.server_epoch}
+                    except ShardFencedError as sf:
+                        # Mid-failover race: the request reached an
+                        # orderer in the instant between its fence and
+                        # the router flip.  Typed so drivers retry
+                        # through the re-resolved owner instead of
+                        # treating it as a generic server error.
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": False, "error": str(sf),
+                                    "code": "shardFenced",
+                                    "doc": sf.doc_id}
                     except NackError as nack:
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
@@ -532,6 +625,12 @@ def main(argv=None) -> None:
              "(documents survive server restarts)",
     )
     parser.add_argument(
+        "--shards", type=int, default=0,
+        help="run a document-partitioned ordering tier with this many "
+             "orderer shards (0 = single orderer); shards share the "
+             "durable log/store, so --dir persistence works unchanged",
+    )
+    parser.add_argument(
         "--platform", default=None,
         help="pin the jax platform for the device catch-up path (e.g. "
              "'cpu').  Must be applied before the first backend use: a "
@@ -556,7 +655,14 @@ def main(argv=None) -> None:
         oplog = OpLog(path=os.path.join(args.dir, "oplog.ndjson"),
                       autoflush=True)
         storage = FileSummaryStorage(os.path.join(args.dir, "summaries"))
-    service = LocalOrderingService(oplog=oplog, storage=storage)
+    if args.shards > 0:
+        from .sharding import ShardedOrderingService
+
+        service = ShardedOrderingService(
+            n_shards=args.shards, oplog=oplog, storage=storage
+        )
+    else:
+        service = LocalOrderingService(oplog=oplog, storage=storage)
     server = OrderingServer(service, host=args.host, port=args.port)
 
     async def _run():

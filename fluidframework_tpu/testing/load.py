@@ -218,6 +218,254 @@ def run_load(spec: LoadSpec) -> LoadResult:
     )
 
 
+# --- sharded ordering tier: multi-shard traffic with mid-run failover --------
+
+
+@dataclasses.dataclass
+class ShardedLoadSpec:
+    """A deterministic multi-document, multi-client schedule over the
+    sharded ordering tier (ISSUE 7), with an optional mid-run shard kill.
+
+    The same spec driven with ``shards=1`` (single ``LocalOrderingService``)
+    and ``scripted_reconnect_at`` set to the killed run's fence step is the
+    byte-identity ORACLE: a voluntary reconnect stamps the same LEAVE+JOIN
+    the fence reconnect does, so the two runs sequence identical per-doc
+    logs and must produce identical summaries."""
+
+    seed: int = 0
+    shards: int = 4
+    docs: int = 8
+    clients_per_doc: int = 2
+    steps: int = 240
+    #: step AFTER which one shard is killed (None = no kill).  The victim
+    #: is the owner of the first doc unless ``kill_shard`` names one.
+    kill_at: Optional[int] = None
+    kill_shard: Optional[str] = None
+    #: "eager" = fenced clients reconnect at the kill step (the fence
+    #: event); "lazy" = clients keep editing until a submit raises the
+    #: fence flag, then reconnect (exercises the in-flight fence path).
+    fence_reaction: str = "eager"
+    #: oracle-twin knob: at this step, voluntarily reconnect the clients
+    #: of ``scripted_docs`` (no kill) — mirrors the killed run's fence
+    #: reconnects so both runs stamp identical LEAVE+JOIN schedules.
+    scripted_reconnect_at: Optional[int] = None
+    scripted_docs: tuple = ()
+    #: attach a serialize-once Broadcaster probe with this many recorder
+    #: sinks per document (0 = off); latencies are in virtual-clock ticks.
+    probe_sinks: int = 0
+
+
+@dataclasses.dataclass
+class ShardedLoadResult:
+    per_doc_digest: Dict[str, str]
+    per_doc_head: Dict[str, int]
+    sequenced_ops: int
+    edits: int
+    reconnects: int
+    fenced_docs: List[str]
+    killed_shard: Optional[str]
+    epoch_bumped: bool
+    shard_docs: Dict[str, int]      # live docs per surviving shard
+    shard_ops: Dict[str, int]       # sequenced ops per surviving shard
+    broadcast_encodes: int = 0
+    broadcast_latencies: Optional[List[float]] = None
+
+
+class _ProbeSink:
+    """Recorder sink for the Broadcaster probe: accepts every frame and
+    records delivery latency in virtual-clock ticks against the
+    scenario's current submit timestamp."""
+
+    def __init__(self, clock: VirtualClock, submit_t0: dict,
+                 latencies: List[float]) -> None:
+        self._clock = clock
+        self._submit_t0 = submit_t0
+        self._latencies = latencies
+
+    def write_frame(self, data: bytes) -> bool:
+        self._latencies.append(self._clock() - self._submit_t0["t"])
+        return True
+
+    def write_signal(self, data: bytes, signal: dict) -> bool:
+        return True
+
+    def on_demoted(self, doc_id: str, head_seq: int) -> None:
+        raise AssertionError("probe sink accepts everything")
+
+    def on_fence(self, doc_id: str, epoch: str, head_seq: int) -> None:
+        pass
+
+
+def run_sharded_load(spec: ShardedLoadSpec) -> ShardedLoadResult:
+    from ..protocol.messages import ShardFencedError
+    from ..service.broadcaster import Broadcaster
+    from ..service.sharding import ShardedOrderingService
+
+    rng = random.Random(spec.seed)
+    clock = VirtualClock()
+    if spec.shards > 1:
+        service = ShardedOrderingService(n_shards=spec.shards)
+    else:
+        service = LocalOrderingService()
+    factory = LocalDocumentServiceFactory(service)
+    loader = Loader(factory, clock=clock)
+
+    doc_ids = [f"shard-doc-{i:02d}" for i in range(spec.docs)]
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+        ds.create_channel("map-tpu", "kv")
+
+    containers: Dict[tuple, object] = {}
+    for doc_id in doc_ids:
+        for c in range(spec.clients_per_doc):
+            cid = f"ld{spec.seed}-{doc_id}-c{c}"
+            if c == 0:
+                containers[(doc_id, c)] = loader.create(doc_id, cid, build)
+            else:
+                containers[(doc_id, c)] = loader.resolve(doc_id, cid)
+
+    # Broadcaster probe: serialize-once fan-out over every doc, recorder
+    # sinks timing delivery in virtual ticks.
+    broadcaster = latencies = None
+    submit_t0 = {"t": 0.0}
+    if spec.probe_sinks > 0:
+        broadcaster = Broadcaster()
+        latencies = []
+        for doc_id in doc_ids:
+            for _ in range(spec.probe_sinks):
+                broadcaster.attach(doc_id, service.endpoint(doc_id),
+                                   _ProbeSink(clock, submit_t0, latencies))
+        if hasattr(service, "add_fence_listener"):
+            service.add_fence_listener(
+                lambda _sid, docs, epoch: [
+                    broadcaster.refence(d, service.endpoint(d), epoch)
+                    for d in docs
+                ]
+            )
+
+    edits = reconnects = 0
+    fenced_docs: List[str] = []
+    killed: Optional[str] = None
+    epoch0 = service.storage.epoch
+
+    def do_edit(container):
+        nonlocal edits
+        ds = container.runtime.get_datastore("ds")
+        if rng.random() < 0.7:
+            text = ds.get_channel("text")
+            n = len(text.text)
+            if n < 4 or rng.random() < 0.7:
+                text.insert_text(rng.randint(0, n),
+                                 rng.choice("abcdef") * rng.randint(1, 3))
+            else:
+                start = rng.randint(0, n - 2)
+                text.remove_range(start, min(n, start + 2))
+        else:
+            ds.get_channel("kv").set(f"k{rng.randint(0, 15)}",
+                                     rng.randint(0, 999))
+        edits += 1
+
+    def reconnect(key):
+        nonlocal reconnects
+        doc_id, _ = key
+        containers[key].reconnect(
+            document_service=factory.resolve(doc_id))
+        reconnects += 1
+
+    def reconnect_doc_clients(docs):
+        for key in sorted(containers):
+            if key[0] in docs:
+                reconnect(key)
+
+    for step in range(spec.steps):
+        key = (rng.choice(doc_ids), rng.randrange(spec.clients_per_doc))
+        container = containers[key]
+        submit_t0["t"] = clock.now  # probe anchor: do not advance
+        try:
+            do_edit(container)
+        except ShardFencedError:
+            # Lazy reaction: the edit's flush hit the fence before the
+            # wire-drain could swallow it (connect paths raise through).
+            reconnect(key)
+            container.drain()
+        if container.delta_manager.fence_required:
+            # The wire-drain swallowed the fence (ConnectionError
+            # contract) and flagged it: re-resolve through the router
+            # and reconnect — the queued ops ride out on the new owner.
+            reconnect(key)
+        if step % 4 == 3:
+            for c in containers.values():
+                c.drain()
+        if spec.kill_at is not None and step == spec.kill_at:
+            victim = spec.kill_shard or service.shard_of(doc_ids[0])
+            fenced_docs = service.kill_shard(victim)
+            killed = victim
+            if spec.fence_reaction == "eager":
+                reconnect_doc_clients(set(fenced_docs))
+        if spec.scripted_reconnect_at is not None \
+                and step == spec.scripted_reconnect_at:
+            reconnect_doc_clients(set(spec.scripted_docs))
+
+    # Quiescence: same discipline as run_load, per document.
+    for _round in range(64):
+        for c in containers.values():
+            if c.delta_manager.fence_required:
+                reconnect_doc_clients({c.doc_id})
+            c.runtime.flush()
+            c.drain()
+        if all(
+            c.runtime.ref_seq == service.oplog.head(c.doc_id)
+            and not c.runtime._pending_wire
+            and not c.runtime._outbox
+            for c in containers.values()
+        ):
+            break
+    else:
+        raise AssertionError("sharded load never quiesced after 64 rounds")
+
+    per_doc_digest: Dict[str, str] = {}
+    per_doc_head: Dict[str, int] = {}
+    for doc_id in doc_ids:
+        digests = {
+            c.runtime.summarize().digest()
+            for key, c in containers.items() if key[0] == doc_id
+        }
+        if len(digests) != 1:
+            raise AssertionError(
+                f"{doc_id} diverged: {len(digests)} distinct summaries")
+        per_doc_digest[doc_id] = next(iter(digests))
+        head = service.oplog.head(doc_id)
+        per_doc_head[doc_id] = head
+        seqs = [m.seq for m in service.oplog.get(doc_id)]
+        if seqs != list(range(1, head + 1)):
+            raise AssertionError(
+                f"{doc_id} seq numbers not contiguous: {seqs[:10]}...")
+
+    shard_docs: Dict[str, int] = {}
+    shard_ops: Dict[str, int] = {}
+    if isinstance(service, ShardedOrderingService):
+        for sid, (n_docs, n_ops) in service.shard_load().items():
+            shard_docs[sid] = n_docs
+            shard_ops[sid] = n_ops
+    return ShardedLoadResult(
+        per_doc_digest=per_doc_digest,
+        per_doc_head=per_doc_head,
+        sequenced_ops=sum(per_doc_head.values()),
+        edits=edits,
+        reconnects=reconnects,
+        fenced_docs=list(fenced_docs),
+        killed_shard=killed,
+        epoch_bumped=service.storage.epoch != epoch0,
+        shard_docs=shard_docs,
+        shard_ops=shard_ops,
+        broadcast_encodes=(broadcaster.stats()["encodes"]
+                           if broadcaster is not None else 0),
+        broadcast_latencies=latencies,
+    )
+
+
 # --- wire soak: many docs through the standalone server's catchup RPC --------
 
 

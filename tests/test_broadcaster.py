@@ -1,0 +1,205 @@
+"""Single-serialization broadcaster (ISSUE 7): the counter-pinned
+serialize-once contract, laggard demotion without collateral damage,
+targeted-signal filtering on shared bytes, and failover re-attach."""
+
+import json
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.protocol.wire import LEN
+from fluidframework_tpu.service.broadcaster import Broadcaster
+from fluidframework_tpu.service.orderer import LocalOrderingService
+
+
+def _op(client, client_seq, ref_seq=0, contents=None):
+    return RawOperation(client_id=client, client_seq=client_seq,
+                        ref_seq=ref_seq, type=MessageType.OP,
+                        contents=contents or {})
+
+
+class RecorderSink:
+    """Accepts up to ``capacity`` frames, then reports saturation."""
+
+    def __init__(self, capacity=10 ** 9):
+        self.capacity = capacity
+        self.frames = []
+        self.signals = []
+        self.demotions = []
+        self.fences = []
+
+    def write_frame(self, data):
+        if len(self.frames) >= self.capacity:
+            return False
+        self.frames.append(data)
+        return True
+
+    def write_signal(self, data, signal):
+        target = signal.get("targetClientId")
+        if target is not None and target != getattr(self, "client_id", None):
+            return True  # filtered — NOT saturation
+        if len(self.frames) >= self.capacity:
+            return False
+        self.signals.append((data, signal))
+        return True
+
+    def on_demoted(self, doc_id, head_seq):
+        self.demotions.append((doc_id, head_seq))
+
+    def on_fence(self, doc_id, epoch, head_seq):
+        self.fences.append((doc_id, epoch, head_seq))
+
+
+def _decode(frame_bytes_):
+    (length,) = LEN.unpack(frame_bytes_[:LEN.size])
+    assert length == len(frame_bytes_) - LEN.size
+    return json.loads(frame_bytes_[LEN.size:])
+
+
+def _seeded_doc(n_sinks, broadcaster=None, capacity=10 ** 9):
+    service = LocalOrderingService()
+    service.create_document("doc")
+    endpoint = service.endpoint("doc")
+    endpoint.connect("c")
+    bc = broadcaster or Broadcaster()
+    sinks = [RecorderSink(capacity) for _ in range(n_sinks)]
+    for sink in sinks:
+        bc.attach("doc", endpoint, sink)
+    return service, endpoint, bc, sinks
+
+
+def test_serialize_once_counter_pin():
+    """M clients x K ops -> exactly K encodes, and every sink receives
+    the IDENTICAL bytes object (shared, not re-serialized)."""
+    M, K = 7, 23
+    _service, endpoint, bc, sinks = _seeded_doc(M)
+    ref = endpoint.head_seq
+    for i in range(K):
+        ref = endpoint.submit(_op("c", i + 1, ref_seq=ref)).seq
+    assert bc.stats()["encodes"] == K
+    assert bc.stats()["writes"] == M * K
+    for sink in sinks:
+        assert len(sink.frames) == K
+    for i in range(K):
+        first = sinks[0].frames[i]
+        for sink in sinks[1:]:
+            assert sink.frames[i] is first  # same object, zero re-encode
+    # the frames decode to the wire op events, in sequence order
+    seqs = [_decode(f)["msg"]["sequenceNumber"] for f in sinks[0].frames]
+    assert seqs == sorted(seqs)
+
+
+def test_laggard_demoted_without_stalling_others():
+    service, endpoint, bc, sinks = _seeded_doc(3)
+    laggard = sinks[1]
+    laggard.capacity = 4
+    ref = endpoint.head_seq
+    for i in range(10):
+        ref = endpoint.submit(_op("c", i + 1, ref_seq=ref)).seq
+    # laggard took its 4 frames, was demoted ONCE, got no more
+    assert len(laggard.frames) == 4
+    assert len(laggard.demotions) == 1
+    doc, head = laggard.demotions[0]
+    assert doc == "doc" and head > 0
+    assert bc.stats()["demotions"] == 1
+    # the healthy sinks saw every op, undisturbed
+    for sink in (sinks[0], sinks[2]):
+        assert len(sink.frames) == 10
+        assert not sink.demotions
+    assert bc.subscriber_count("doc") == 2
+    # ...and the demoted client can re-subscribe (catch-up-from-oplog
+    # happens in its DeltaManager; here we just verify re-attach works)
+    laggard.capacity = 10 ** 9
+    bc.attach("doc", endpoint, laggard)
+    endpoint.submit(_op("c", 11, ref_seq=ref))
+    assert len(laggard.frames) == 5
+
+
+def test_signal_fanout_encodes_once_and_filters_targets():
+    _service, endpoint, bc, sinks = _seeded_doc(3)
+    for i, sink in enumerate(sinks):
+        sink.client_id = f"client{i}"
+    endpoint.submit_signal("client0", {"hello": 1})  # broadcast signal
+    endpoint.submit_signal("client0", {"psst": 2},
+                           target_client_id="client2")
+    assert bc.stats()["signal_encodes"] == 2
+    assert [s["content"] for _b, s in sinks[0].signals] == [{"hello": 1}]
+    assert [s["content"] for _b, s in sinks[1].signals] == [{"hello": 1}]
+    assert [s["content"] for _b, s in sinks[2].signals] == [{"hello": 1},
+                                                           {"psst": 2}]
+    # shared bytes for the broadcast signal
+    assert sinks[0].signals[0][0] is sinks[2].signals[0][0]
+    # target filtering is NOT demotion
+    assert bc.stats()["demotions"] == 0
+
+
+def test_empty_channel_unwires_from_the_sequencer():
+    _service, endpoint, bc, sinks = _seeded_doc(2)
+    for sink in sinks:
+        bc.detach("doc", sink)
+    assert bc.subscriber_count("doc") == 0
+    ref = endpoint.head_seq
+    endpoint.submit(_op("c", 1, ref_seq=ref))
+    assert bc.stats()["encodes"] == 0  # no channel left to encode for
+    for sink in sinks:
+        assert sink.frames == []
+
+
+def test_detach_all_removes_a_sink_everywhere():
+    service = LocalOrderingService()
+    bc = Broadcaster()
+    sink = RecorderSink()
+    endpoints = {}
+    for doc in ("a", "b"):
+        service.create_document(doc)
+        endpoints[doc] = service.endpoint(doc)
+        endpoints[doc].connect("c")
+        bc.attach(doc, endpoints[doc], sink)
+    assert bc.stats()["channels"] == 2
+    bc.detach_all(sink)
+    assert bc.stats()["channels"] == 0
+    endpoints["a"].submit(_op("c", 1, ref_seq=endpoints["a"].head_seq))
+    assert sink.frames == []
+
+
+def test_refence_moves_channel_to_recovered_endpoint():
+    """Shard failover: the channel re-attaches to the new owner's
+    endpoint, sinks get on_fence with the new epoch, and subsequent ops
+    (stamped by the recovered orderer) keep flowing."""
+    service = LocalOrderingService()
+    service.create_document("doc")
+    old_endpoint = service.endpoint("doc")
+    old_endpoint.connect("c")
+    bc = Broadcaster()
+    sink = RecorderSink()
+    bc.attach("doc", old_endpoint, sink)
+    ref = old_endpoint.submit(_op("c", 1, ref_seq=0)).seq
+    # simulate the failover: fence the old orderer, recover a fresh one
+    # from the shared log (a second service instance over the same log)
+    with service.state_lock:
+        service._orderers["doc"].fence()
+    recovered = LocalOrderingService(oplog=service.oplog,
+                                     storage=service.storage)
+    new_endpoint = recovered.endpoint("doc")
+    notified = bc.refence("doc", new_endpoint, "epoch-2")
+    assert notified == 1
+    assert sink.fences == [("doc", "epoch-2", ref)]
+    msg = new_endpoint.submit(_op("c", 2, ref_seq=ref))
+    assert msg.seq == ref + 1
+    assert len(sink.frames) == 2  # pre-fence op + post-fence op
+    assert bc.stats()["fences"] == 1
+
+
+def test_probe_latencies_are_deterministic():
+    """The VirtualClock broadcast probe yields the same latency samples
+    on every run of the same spec (replay determinism of the harness)."""
+    from fluidframework_tpu.testing.load import (ShardedLoadSpec,
+                                                 run_sharded_load)
+
+    spec = ShardedLoadSpec(seed=5, shards=4, docs=4, clients_per_doc=2,
+                           steps=60, probe_sinks=2)
+    a = run_sharded_load(spec)
+    b = run_sharded_load(spec)
+    assert a.broadcast_latencies == b.broadcast_latencies
+    assert a.broadcast_encodes == b.broadcast_encodes > 0
+    assert a.per_doc_digest == b.per_doc_digest

@@ -5,9 +5,16 @@ and assert no lost updates plus clean shutdown (threads joined, pending
 map drained, no daemon leaks).  Budgeted for the `not slow` tier: the
 pack leg is stubbed (locking is under test, not the C++ pack) and the
 network leg is a few hundred localhost round-trips.
+
+ISSUE 7 adds the broadcaster backpressure stress: a subscriber that
+stops reading must be DEMOTED (catch-up-from-oplog) without stalling
+the shard or the other subscribers.
 """
 
+import json
+import socket
 import threading
+import time
 
 import numpy as np
 
@@ -18,6 +25,7 @@ from fluidframework_tpu.ops import pipeline as pipeline_mod
 from fluidframework_tpu.ops.mergetree_kernel import MergeTreeDocInput
 from fluidframework_tpu.ops.pipeline import PackCache
 from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.protocol.wire import LEN, frame_bytes
 from fluidframework_tpu.runtime.container import ContainerRuntime
 from fluidframework_tpu.service.server import OrderingServer
 
@@ -147,3 +155,171 @@ def test_network_pending_map_threaded_and_clean_shutdown():
     rpc._dispatcher.join(timeout=10)
     assert not rpc._reader.is_alive(), "reader thread leaked"
     assert not rpc._dispatcher.is_alive(), "dispatcher thread leaked"
+
+
+# --- broadcaster backpressure: laggard demotion under load --------------------
+
+
+def _raw_read_frame(sock_file):
+    header = sock_file.read(LEN.size)
+    if len(header) != LEN.size:
+        return None
+    (length,) = LEN.unpack(header)
+    payload = sock_file.read(length)
+    return json.loads(payload)
+
+
+def test_broadcast_laggard_demoted_without_stalling(tmp_path):
+    """One subscriber stops reading while others stay hot: the server
+    must demote the laggard at its broadcast buffer budget (never stall
+    the shard, never buffer unboundedly, never punish the healthy
+    subscribers), deliver every op to the fast clients, and hand the
+    laggard a 'demoted' event it can act on when it wakes up."""
+    # Budget sized so a READING subscriber never trips it even under
+    # full-suite GC-pause jitter (~90 frames of headroom; the writer is
+    # RPC-paced and the fast reader drains localhost promptly) while the
+    # sleeping laggard — whose backlog only ever grows — reliably does
+    # within the op cap below.
+    srv = OrderingServer(port=0, broadcast_high_water=1_500_000)
+    srv.start_in_thread()
+    seed_factory = NetworkDocumentServiceFactory(port=srv.port)
+    fast_factory = NetworkDocumentServiceFactory(port=srv.port)
+    laggard_sock = None
+    try:
+        seeded = ContainerRuntime()
+        seeded.create_datastore("ds").create_channel("sequence-tpu", "t")
+        svc = seed_factory.create_document("lag", seeded.summarize())
+        conn = svc.connection()
+        conn.connect("writer")
+
+        # Laggard: a raw-protocol subscriber that reads its subscribe
+        # response and then goes to sleep with the firehose on.
+        laggard_sock = socket.create_connection(("127.0.0.1", srv.port),
+                                                timeout=10)
+        laggard_file = laggard_sock.makefile("rb")
+        laggard_sock.sendall(frame_bytes(
+            {"v": 1, "id": 1, "method": "subscribe_doc",
+             "params": {"doc": "lag"}}))
+        assert _raw_read_frame(laggard_file)["ok"]
+
+        # Healthy subscriber on its own socket.
+        fast_conn = fast_factory.resolve("lag").connection()
+        fast_seqs = []
+        fast_conn.subscribe(lambda m: fast_seqs.append(m.seq))
+        fast_conn.connect("fastreader")
+
+        # Firehose: chunky ops until the server demotes the laggard (or
+        # a generous cap trips the assertion).
+        payload = "x" * 16384
+        submitted = []
+        ref = conn.head_seq
+        for i in range(400):
+            msg = conn.submit(RawOperation(
+                client_id="writer", client_seq=i + 1, ref_seq=ref,
+                type=MessageType.OP, contents={"blob": payload}))
+            ref = msg.seq
+            submitted.append(msg.seq)
+            if srv.broadcaster.stats()["demotions"] >= 1:
+                break
+        stats = srv.broadcaster.stats()
+        assert stats["demotions"] >= 1, \
+            f"laggard never demoted after {len(submitted)} chunky ops"
+
+        # The shard never stalled: post-demotion traffic flows...
+        for i in range(10):
+            msg = conn.submit(RawOperation(
+                client_id="writer", client_seq=len(submitted) + i + 1,
+                ref_seq=ref, type=MessageType.OP, contents={"i": i}))
+            ref = msg.seq
+            submitted.append(msg.seq)
+        # ...and the healthy subscriber receives EVERY op.  (fast_seqs
+        # also carries JOIN/LEAVE broadcasts, so compare by CONTENT, not
+        # length.)
+        deadline = time.time() + 20
+        while not set(submitted) <= set(fast_seqs) \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert set(submitted) <= set(fast_seqs), (
+            f"fast subscriber missing "
+            f"{sorted(set(submitted) - set(fast_seqs))[:5]}")
+        # ...with no collateral demotion: one laggard cost ONLY itself.
+        assert fast_conn.demotions_seen == 0
+
+        # The woken laggard drains its backlog and finds the demotion
+        # notice — its cue to backfill from the op log and re-subscribe.
+        events = []
+        deadline = time.time() + 20
+        laggard_sock.settimeout(20)
+        while time.time() < deadline:
+            frame = _raw_read_frame(laggard_file)
+            assert frame is not None, "server dropped the laggard's socket"
+            if frame.get("event") == "demoted":
+                events.append(frame)
+                break
+        assert events and events[0]["doc"] == "lag"
+        assert events[0]["head"] > 0
+        # re-subscribe works: the demotion was a state reset, not a ban
+        laggard_sock.sendall(frame_bytes(
+            {"v": 1, "id": 2, "method": "subscribe_doc",
+             "params": {"doc": "lag"}}))
+        while True:
+            frame = _raw_read_frame(laggard_file)
+            assert frame is not None
+            if frame.get("re") == 2:
+                assert frame["ok"]
+                break
+    finally:
+        if laggard_sock is not None:
+            laggard_sock.close()
+        fast_factory.close()
+        seed_factory.close()
+
+
+def test_demoted_client_backfills_even_if_doc_goes_quiet():
+    """The demotion contract's hard case: the burst that demoted the
+    client was the document's LAST activity.  Gap repair only fires on a
+    later live message, so the driver's demoted handler must kick the
+    backfill itself (re-subscribe + deliver the head op) or the dropped
+    span would be missing forever."""
+    srv = OrderingServer(port=0)
+    srv.start_in_thread()
+    factory = NetworkDocumentServiceFactory(port=srv.port)
+    try:
+        seeded = ContainerRuntime()
+        seeded.create_datastore("ds").create_channel("sequence-tpu", "t")
+        svc = factory.create_document("quiet", seeded.summarize())
+        conn = svc.connection()
+        got = []
+        conn.subscribe(lambda m: got.append(m.seq))
+        conn.connect("w")
+        ref = conn.head_seq
+        ref = conn.submit(RawOperation(
+            client_id="w", client_seq=1, ref_seq=ref,
+            type=MessageType.OP, contents={"i": 0})).seq
+        deadline = time.time() + 10
+        while ref not in got and time.time() < deadline:
+            time.sleep(0.02)
+        assert ref in got
+        # Force the NEXT broadcast to demote this session, then restore.
+        srv.broadcast_high_water = 0
+        last = conn.submit(RawOperation(
+            client_id="w", client_seq=2, ref_seq=ref,
+            type=MessageType.OP, contents={"i": 1})).seq
+        srv.broadcast_high_water = 8 << 20
+        # No further traffic: the kicked backfill alone must deliver the
+        # dropped head op.
+        deadline = time.time() + 20
+        while last not in got and time.time() < deadline:
+            time.sleep(0.02)
+        assert last in got, "demoted client never backfilled the quiet doc"
+        assert conn.demotions_seen >= 1
+        # ...and the restored tap is live for future traffic.
+        nxt = conn.submit(RawOperation(
+            client_id="w", client_seq=3, ref_seq=last,
+            type=MessageType.OP, contents={"i": 2})).seq
+        deadline = time.time() + 10
+        while nxt not in got and time.time() < deadline:
+            time.sleep(0.02)
+        assert nxt in got
+    finally:
+        factory.close()

@@ -51,13 +51,21 @@ def test_session_close_idempotent():
         return real_endpoint(doc_id)
 
     service.endpoint = counting_endpoint
+    assert server.broadcaster.subscriber_count("doc") == 1
     session.close()
     assert endpoint_calls, "first close must run the release sweep"
-    assert not session._fns and not session.connected_clients
+    assert not session.subscribed_docs and not session.connected_clients
+    assert server.broadcaster.subscriber_count("doc") == 0
 
+    # A "reconnected session" re-registers between the two closes (the
+    # double-close hazard this pins): the broadcaster tap of the NEW
+    # session must survive the old session's second close.
+    session2 = _ClientSession(server, writer=None)
+    session2.tap("doc")
     endpoint_calls.clear()
     session.close()
     assert endpoint_calls == [], "second close must be a no-op"
+    assert server.broadcaster.subscriber_count("doc") == 1
 
 
 # --- _RpcClient.close (drivers/network_driver.py) ----------------------------

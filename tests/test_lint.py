@@ -43,6 +43,22 @@ def test_package_lints_clean():
         + "\n".join(problems))
 
 
+def test_sharding_tier_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 7 acceptance pin: the two new serving modules pass ALL
+    module rules (fluidlint + fluidrace + fluidleak families) with zero
+    findings AND zero baseline entries — the package gate would let a
+    reviewed suppression through; this test would not."""
+    new_modules = [
+        "fluidframework_tpu/service/sharding.py",
+        "fluidframework_tpu/service/broadcaster.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "new modules must stay suppression-free"
+
+
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5)

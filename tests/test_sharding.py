@@ -1,0 +1,496 @@
+"""Sharded ordering tier (ISSUE 7): rendezvous router determinism and
+stability, the sharded service behind the single-service surface,
+epoch-fenced failover (in-proc and over TCP), and the single-flight
+log-replay recovery.
+
+The load-bearing oracle: the SAME deterministic op schedule driven
+through ``ShardedOrderingService(n=4)`` with a mid-run shard kill and
+through a never-killed single ``LocalOrderingService`` must produce
+byte-identical per-document summaries and strictly contiguous seq
+numbers — the log-append-before-broadcast invariant means failover can
+never fork or lose sequencing.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.file_driver import FileSummaryStorage
+from fluidframework_tpu.drivers.network_driver import (
+    NetworkDocumentServiceFactory,
+)
+from fluidframework_tpu.protocol.messages import (MessageType, RawOperation,
+                                                  ShardFencedError)
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service import orderer as orderer_mod
+from fluidframework_tpu.service.orderer import LocalOrderingService
+from fluidframework_tpu.service.server import OrderingServer
+from fluidframework_tpu.service.sharding import (ShardedOrderingService,
+                                                 ShardRouter)
+from fluidframework_tpu.testing.load import (ShardedLoadSpec,
+                                             run_sharded_load)
+
+
+def _op(client, client_seq, ref_seq=0, contents=None):
+    return RawOperation(client_id=client, client_seq=client_seq,
+                        ref_seq=ref_seq, type=MessageType.OP,
+                        contents=contents or {})
+
+
+# --- router -------------------------------------------------------------------
+
+
+def test_router_deterministic_across_instances():
+    ids = ["s0", "s1", "s2", "s3"]
+    a, b = ShardRouter(ids), ShardRouter(list(reversed(ids)))
+    for i in range(200):
+        doc = f"doc{i}"
+        assert a.owner(doc) == b.owner(doc)  # order-independent too
+
+
+def test_router_spreads_documents():
+    router = ShardRouter([f"s{i}" for i in range(4)])
+    counts = {}
+    for i in range(400):
+        counts[router.owner(f"doc{i}")] = \
+            counts.get(router.owner(f"doc{i}"), 0) + 1
+    assert len(counts) == 4
+    assert min(counts.values()) > 400 // 4 // 3  # no starved shard
+
+
+def test_router_add_shard_moves_about_one_over_n():
+    ids = [f"s{i}" for i in range(4)]
+    before = ShardRouter(ids)
+    after = ShardRouter(ids + ["s4"])
+    docs = [f"doc{i}" for i in range(1000)]
+    moved = [d for d in docs if before.owner(d) != after.owner(d)]
+    # Rendezvous: exactly the docs whose top choice is the new shard move
+    # (every moved doc moves TO s4), expectation 1/5 — assert a generous
+    # band and the direction invariant.
+    assert 100 <= len(moved) <= 320
+    assert all(after.owner(d) == "s4" for d in moved)
+
+
+def test_router_kill_moves_only_dead_shards_docs():
+    router = ShardRouter([f"s{i}" for i in range(4)])
+    docs = [f"doc{i}" for i in range(300)]
+    before = {d: router.owner(d) for d in docs}
+    assert router.mark_dead("s2")
+    for d in docs:
+        if before[d] == "s2":
+            assert router.owner(d) != "s2"  # re-owned
+        else:
+            assert router.owner(d) == before[d]  # untouched
+    assert router.mark_dead("s2") is False  # idempotent
+
+
+def test_router_refuses_to_kill_last_shard():
+    router = ShardRouter(["a", "b"])
+    router.mark_dead("a")
+    with pytest.raises(RuntimeError):
+        router.mark_dead("b")
+    with pytest.raises(ValueError):
+        ShardRouter(["x", "x"])
+
+
+# --- sharded service surface --------------------------------------------------
+
+
+def test_sharded_service_routes_and_lists():
+    svc = ShardedOrderingService(n_shards=4)
+    docs = [f"d{i}" for i in range(10)]
+    for d in docs:
+        svc.create_document(d)
+        ep = svc.endpoint(d)
+        ep.connect("c")
+        ep.submit(_op("c", 1, ref_seq=ep.head_seq))
+    assert svc.doc_ids() == sorted(docs)
+    assert all(svc.has_document(d) for d in docs)
+    assert not svc.has_document("nope")
+    # every doc's orderer lives on exactly the shard the router names
+    for d in docs:
+        shard = svc.shard_service(svc.shard_of(d))
+        with shard.state_lock:
+            assert d in shard._orderers
+    load = svc.shard_load()
+    assert sum(n for n, _ in load.values()) == len(docs)
+    assert sum(ops for _, ops in load.values()) == \
+        sum(svc.oplog.head(d) for d in docs)
+
+
+def test_sharded_vs_single_shard_oracle_no_kill():
+    """Same deterministic schedule, 4 shards vs 1 service: per-document
+    sequencing is independent, so the final summaries must be
+    byte-identical per doc."""
+    spec = dict(seed=7, docs=6, clients_per_doc=2, steps=100)
+    sharded = run_sharded_load(ShardedLoadSpec(shards=4, **spec))
+    single = run_sharded_load(ShardedLoadSpec(shards=1, **spec))
+    assert sharded.per_doc_head == single.per_doc_head
+    assert sharded.per_doc_digest == single.per_doc_digest
+    assert sharded.killed_shard is None and not sharded.epoch_bumped
+    # the docs really were spread: more than one shard holds orderers
+    assert len([s for s, n in sharded.shard_docs.items() if n > 0]) >= 2
+
+
+def test_failover_byte_identical_to_never_killed_oracle():
+    """THE acceptance gate: kill 1 of 4 shards mid-traffic under
+    VirtualClock; fenced clients reconnect through the epoch fence; final
+    per-doc state is byte-identical to the never-killed single-shard
+    oracle and seq numbers stay strictly contiguous per doc (contiguity
+    is asserted inside run_sharded_load)."""
+    spec = dict(seed=3, docs=8, clients_per_doc=2, steps=120)
+    killed = run_sharded_load(
+        ShardedLoadSpec(shards=4, kill_at=60, **spec))
+    assert killed.killed_shard is not None
+    assert killed.fenced_docs, "victim shard owned no documents"
+    assert killed.epoch_bumped
+    assert killed.reconnects >= len(killed.fenced_docs)
+    # Oracle twin: no kill, ONE service — but the same clients perform a
+    # voluntary reconnect at the same step (a reconnect stamps the same
+    # LEAVE+JOIN whether it crosses a fence or not).
+    oracle = run_sharded_load(ShardedLoadSpec(
+        shards=1, scripted_reconnect_at=60,
+        scripted_docs=tuple(killed.fenced_docs), **spec))
+    assert killed.per_doc_head == oracle.per_doc_head
+    assert killed.per_doc_digest == oracle.per_doc_digest
+
+
+def test_failover_lazy_fence_reaction_converges():
+    """Clients that DON'T get a fence event (in-proc, no push channel)
+    discover the fence on their next submit via the DeltaManager's
+    fence_required flag, reconnect through the router, and still
+    converge with contiguous sequencing."""
+    result = run_sharded_load(ShardedLoadSpec(
+        seed=11, shards=4, docs=6, clients_per_doc=2, steps=160,
+        kill_at=40, fence_reaction="lazy"))
+    assert result.killed_shard is not None
+    assert result.epoch_bumped
+    assert result.reconnects >= 1
+
+
+def test_fenced_endpoint_cannot_sequence_or_serve_head():
+    svc = ShardedOrderingService(n_shards=4)
+    svc.create_document("d")
+    ep = svc.endpoint("d")
+    ep.connect("c")
+    ep.submit(_op("c", 1, ref_seq=ep.head_seq))
+    stale = svc.endpoint("d")
+    head_before = svc.oplog.head("d")
+    svc.kill_shard(svc.shard_of("d"))
+    with pytest.raises(ShardFencedError):
+        stale.submit(_op("c", 2))
+    with pytest.raises(ShardFencedError):
+        stale.head_seq
+    with pytest.raises(ShardFencedError):
+        stale.connect("c2")
+    stale.disconnect("c")          # teardown of a dead shard: no-op
+    stale.update_ref_seq("c", 1)   # heartbeat to a dead shard: no-op
+    stale.submit_signal("c", {"x": 1})  # ephemeral: dropped
+    # nothing the fenced orderer did reached the durable log
+    assert svc.oplog.head("d") == head_before
+    # the recovered owner continues the sequence exactly
+    fresh = svc.endpoint("d")
+    msg = fresh.submit(_op("c", 2, ref_seq=fresh.head_seq))
+    assert msg.seq == head_before + 1
+
+
+def test_kill_shard_is_idempotent_and_fence_token_deterministic():
+    svc = ShardedOrderingService(n_shards=4)
+    svc.create_document("d")
+    ep = svc.endpoint("d")
+    ep.connect("c")
+    victim = svc.shard_of("d")
+    expected = svc.fence_token(victim)
+    affected = svc.kill_shard(victim)
+    assert affected == ["d"]
+    assert svc.storage.epoch == expected  # derived, replayable fence
+    assert svc.kill_shard(victim) == []
+    assert svc.fences == 1
+
+
+def test_summary_only_document_survives_failover():
+    """A document created and summarized but never opped has nothing in
+    the durable log; after its shard dies the new owner re-creates the
+    orderer from the (shared, content-addressed) summary store."""
+    svc = ShardedOrderingService(n_shards=4)
+    svc.create_document("quiet")
+    seeded = ContainerRuntime()
+    seeded.create_datastore("ds").create_channel("sequence-tpu", "t")
+    svc.storage.upload("quiet", seeded.summarize(), 0)
+    svc.kill_shard(svc.shard_of("quiet"))
+    assert svc.has_document("quiet")
+    ep = svc.endpoint("quiet")  # re-owned from storage, empty orderer
+    ep.connect("c")
+    assert ep.submit(_op("c", 1, ref_seq=ep.head_seq)).seq >= 1
+
+
+def test_sharded_checkpoint_restore_roundtrip():
+    svc = ShardedOrderingService(n_shards=4)
+    for i in range(5):
+        doc = f"d{i}"
+        svc.create_document(doc)
+        ep = svc.endpoint(doc)
+        ep.connect("c")
+        for j in range(3):
+            ep.submit(_op("c", j + 1, ref_seq=ep.head_seq))
+    ckpt = svc.checkpoint()
+    restored = ShardedOrderingService.restore(
+        svc.oplog, svc.storage, ckpt,
+        shard_ids=svc.router.shard_ids())
+    for i in range(5):
+        doc = f"d{i}"
+        assert restored.endpoint(doc).head_seq == svc.endpoint(doc).head_seq
+        # ownership re-derives identically (same shard list)
+        assert restored.shard_of(doc) == svc.shard_of(doc)
+        # sequencing resumes without re-stamping
+        msg = restored.endpoint(doc).submit(
+            _op("c", 4, ref_seq=restored.endpoint(doc).head_seq))
+        assert msg.seq == svc.oplog.head(doc)
+
+
+def test_epoch_bump_persists_in_file_storage(tmp_path):
+    storage = FileSummaryStorage(str(tmp_path / "store"))
+    svc = ShardedOrderingService(n_shards=2, storage=storage)
+    svc.create_document("d")
+    ep = svc.endpoint("d")
+    ep.connect("c")
+    ep.submit(_op("c", 1, ref_seq=ep.head_seq))
+    svc.kill_shard(svc.shard_of("d"))
+    bumped = storage.epoch
+    reopened = FileSummaryStorage(str(tmp_path / "store"))
+    assert reopened.epoch == bumped  # restart lands POST-fence
+
+
+# --- single-flight recovery ---------------------------------------------------
+
+
+def test_recovery_is_single_flight_under_a_connect_herd(monkeypatch):
+    """N concurrent endpoint() calls for a log-only document replay the
+    log ONCE: the first caller leads, everyone else joins its flight —
+    the restructured begin/publish/abandon shape that burned the
+    FL-RACE-CHECKACT suppression."""
+    svc = LocalOrderingService()
+    svc.create_document("doc")
+    ep = svc.endpoint("doc")
+    ep.connect("c")
+    for i in range(10):
+        ep.submit(_op("c", i + 1, ref_seq=ep.head_seq))
+    # Simulate a restart: same durable log, fresh service.
+    fresh = LocalOrderingService(oplog=svc.oplog, storage=svc.storage)
+
+    calls = []
+    real_recover = orderer_mod.DocumentOrderer.recover
+
+    def slow_recover(doc_id, oplog, storage):
+        calls.append(doc_id)
+        time.sleep(0.15)  # widen the herd window
+        return real_recover(doc_id, oplog, storage)
+
+    monkeypatch.setattr(orderer_mod.DocumentOrderer, "recover",
+                        staticmethod(slow_recover))
+    endpoints = []
+    errors = []
+
+    def connect():
+        try:
+            endpoints.append(fresh.endpoint("doc"))
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=connect) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert errors == []
+    assert len(calls) == 1, f"herd replayed {len(calls)} times"
+    assert len(endpoints) == 8
+    assert {e.head_seq for e in endpoints} == {svc.oplog.head("doc")}
+    with fresh.state_lock:
+        assert fresh._recoveries == {}  # no flight survives
+
+
+def test_recovery_abandon_on_leader_failure(monkeypatch):
+    """A leader that dies mid-replay wakes waiters, and the next claimer
+    replays successfully (abandon/retry, not a wedged flight)."""
+    svc = LocalOrderingService()
+    svc.create_document("doc")
+    ep = svc.endpoint("doc")
+    ep.connect("c")
+    ep.submit(_op("c", 1, ref_seq=ep.head_seq))
+    fresh = LocalOrderingService(oplog=svc.oplog, storage=svc.storage)
+
+    real_recover = orderer_mod.DocumentOrderer.recover
+    boom = {"armed": True}
+
+    def flaky_recover(doc_id, oplog, storage):
+        if boom.pop("armed", False):
+            raise RuntimeError("leader died mid-replay")
+        return real_recover(doc_id, oplog, storage)
+
+    monkeypatch.setattr(orderer_mod.DocumentOrderer, "recover",
+                        staticmethod(flaky_recover))
+    with pytest.raises(RuntimeError):
+        fresh.endpoint("doc")
+    with fresh.state_lock:
+        assert fresh._recoveries == {}  # abandoned, not leaked
+    assert fresh.endpoint("doc").head_seq == svc.oplog.head("doc")
+
+
+def test_kill_mid_recovery_publishes_a_fenced_orderer(monkeypatch):
+    """A single-flight recovery in flight on the victim shard when
+    kill_shard runs must NOT install a live orderer after the fence
+    sweep: the shard-level fence makes the late publish land fenced, so
+    the recovering client gets ShardFencedError and re-resolves through
+    the router — sequencing cannot fork."""
+    seed_svc = ShardedOrderingService(n_shards=4)
+    seed_svc.create_document("d")
+    ep = seed_svc.endpoint("d")
+    ep.connect("c")
+    for i in range(4):
+        ep.submit(_op("c", i + 1, ref_seq=ep.head_seq))
+    # Fresh sharded service over the same durable log: every doc is
+    # log-only, recovery pending.
+    svc = ShardedOrderingService(
+        n_shards=4, oplog=seed_svc.oplog, storage=seed_svc.storage)
+    victim = svc.shard_of("d")
+
+    real_recover = orderer_mod.DocumentOrderer.recover
+    started = threading.Event()
+    release = threading.Event()
+
+    def gated_recover(doc_id, oplog, storage):
+        started.set()
+        assert release.wait(timeout=30)
+        return real_recover(doc_id, oplog, storage)
+
+    monkeypatch.setattr(orderer_mod.DocumentOrderer, "recover",
+                        staticmethod(gated_recover))
+    results = {}
+
+    def recover_on_victim():
+        try:
+            results["ep"] = svc.endpoint("d")
+        except Exception as exc:
+            results["err"] = exc
+
+    t = threading.Thread(target=recover_on_victim)
+    t.start()
+    assert started.wait(timeout=30)
+    # Kill the victim while its recovery replay is mid-flight; the
+    # orderer map is still empty, so the per-orderer sweep sees nothing.
+    monkeypatch.setattr(orderer_mod.DocumentOrderer, "recover",
+                        staticmethod(real_recover))  # new owner replays live
+    svc.kill_shard(victim)
+    release.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # The late-published orderer must be fenced: its endpoint refuses.
+    if "ep" in results:
+        with pytest.raises(ShardFencedError):
+            results["ep"].submit(_op("c", 5, ref_seq=0))
+    # The re-resolved owner sequences, contiguously.
+    fresh = svc.endpoint("d")
+    msg = fresh.submit(_op("c", 5, ref_seq=fresh.head_seq))
+    seqs = [m.seq for m in svc.oplog.get("d")]
+    assert seqs == list(range(1, len(seqs) + 1))
+    assert msg.seq == seqs[-1]
+
+
+def test_server_fence_recovers_only_subscribed_docs(monkeypatch):
+    """Failover cost scales with LIVE subscriptions, not shard size: the
+    front door's fence handler re-attaches (and therefore replays) only
+    documents with broadcast channels; idle documents recover lazily on
+    next touch."""
+    svc = ShardedOrderingService(n_shards=2, shard_ids=["sa", "sb"])
+    srv = OrderingServer(svc, port=0)
+    # find ≥2 docs owned by one shard; subscribe a fake session to ONE
+    docs_on = {"sa": [], "sb": []}
+    for i in range(12):
+        doc = f"d{i}"
+        svc.create_document(doc)
+        ep = svc.endpoint(doc)
+        ep.connect("c")
+        ep.submit(_op("c", 1, ref_seq=ep.head_seq))
+        docs_on[svc.shard_of(doc)].append(doc)
+    victim = "sa" if len(docs_on["sa"]) >= 2 else "sb"
+    hot, *idle = docs_on[victim]
+
+    class _Sink:
+        def write_frame(self, data):
+            return True
+
+        def write_signal(self, data, signal):
+            return True
+
+        def on_demoted(self, doc_id, head):
+            pass
+
+        def on_fence(self, doc_id, epoch, head):
+            self.fenced = (doc_id, epoch)
+
+    sink = _Sink()
+    srv.broadcaster.attach(hot, svc.endpoint(hot), sink)
+
+    recovers = []
+    real_recover = orderer_mod.DocumentOrderer.recover
+    monkeypatch.setattr(
+        orderer_mod.DocumentOrderer, "recover",
+        staticmethod(lambda d, o, s: (recovers.append(d),
+                                      real_recover(d, o, s))[1]))
+    svc.kill_shard(victim)
+    assert recovers == [hot], (
+        f"fence replayed idle docs eagerly: {recovers}")
+    assert sink.fenced[0] == hot
+    # idle docs still recover fine — just lazily
+    assert svc.endpoint(idle[0]).head_seq == svc.oplog.head(idle[0])
+    assert sorted(recovers) == sorted([hot, idle[0]])
+
+
+# --- failover over TCP --------------------------------------------------------
+
+
+def test_tcp_fence_event_unpins_and_broadcast_survives_failover():
+    """Network clients ride the fence: the server pushes a fence event
+    (driver unpins the dead generation centrally), the broadcast channel
+    re-attaches to the recovered owner, and the SAME connection keeps
+    submitting and receiving — reconnect-through-the-fence without a
+    torn op stream."""
+    svc = ShardedOrderingService(n_shards=4)
+    srv = OrderingServer(svc, port=0)
+    srv.start_in_thread()
+    factory = NetworkDocumentServiceFactory(port=srv.port)
+    try:
+        seeded = ContainerRuntime()
+        seeded.create_datastore("ds").create_channel("sequence-tpu", "t")
+        doc = factory.create_document("net-doc", seeded.summarize())
+        conn = doc.connection()
+        got = []
+        conn.subscribe(lambda m: got.append(m.seq))
+        conn.connect("cA")
+        doc.storage.latest()  # pin the pre-fence epoch
+        rpc = factory._rpc
+        pinned = rpc.epoch
+        assert pinned is not None
+        ref = conn.head_seq
+        for i in range(3):
+            ref = conn.submit(_op("cA", i + 1, ref_seq=ref)).seq
+        svc.kill_shard(svc.shard_of("net-doc"))
+        deadline = time.time() + 10
+        while rpc.epoch is not None and time.time() < deadline:
+            time.sleep(0.02)
+        assert rpc.epoch is None, "fence event never unpinned the driver"
+        assert conn.fences_seen == 1
+        # same connection, recovered owner, contiguous sequencing
+        msg = conn.submit(_op("cA", 4, ref_seq=ref))
+        assert msg.seq == ref + 1
+        deadline = time.time() + 10
+        while msg.seq not in got and time.time() < deadline:
+            time.sleep(0.02)
+        assert msg.seq in got, "live broadcast lost across failover"
+        # next storage RPC adopts the POST-fence generation
+        doc.storage.latest()
+        assert rpc.epoch == svc.storage.epoch != pinned
+    finally:
+        factory.close()

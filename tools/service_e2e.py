@@ -12,6 +12,13 @@ Prints ONE JSON line:
     {"metric": "service_bulk_catchup_ops_per_sec", "value": ..., ...}
 
 Env knobs: SVC_DOCS (default 2048), SVC_OPS (default 96).
+
+``--shard-bench`` instead runs the ISSUE-7 multi-shard scenario (sharded
+ordering tier under VirtualClock, mid-run shard kill, broadcaster probe)
+and prints ONE JSON line with aggregate ops/sec, per-shard balance, and
+p50/p99 broadcast latency in deterministic virtual ticks.  Env knobs:
+SVC_SHARDS (4), SVC_SHARD_DOCS (32), SVC_SHARD_CLIENTS (2),
+SVC_SHARD_STEPS (2000), SVC_SHARD_SINKS (2).
 """
 
 from __future__ import annotations
@@ -30,6 +37,81 @@ from fluidframework_tpu.service.orderer import LocalOrderingService  # noqa: E40
 
 N_DOCS = int(os.environ.get("SVC_DOCS", "2048"))
 OPS = int(os.environ.get("SVC_OPS", "96"))
+
+SHARDS = int(os.environ.get("SVC_SHARDS", "4"))
+SHARD_DOCS = int(os.environ.get("SVC_SHARD_DOCS", "32"))
+SHARD_CLIENTS = int(os.environ.get("SVC_SHARD_CLIENTS", "2"))
+SHARD_STEPS = int(os.environ.get("SVC_SHARD_STEPS", "2000"))
+SHARD_SINKS = int(os.environ.get("SVC_SHARD_SINKS", "2"))
+
+
+def _percentile(sorted_values, q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, int(len(sorted_values) * q) - 1))
+    return sorted_values[idx]
+
+
+def shard_bench() -> None:
+    """The multi-shard serving scenario: SHARDS orderer shards, SHARD_DOCS
+    documents x SHARD_CLIENTS clients of deterministic mixed traffic with
+    ONE mid-run shard kill, a serialize-once Broadcaster probe fanning
+    every sequenced message to SHARD_SINKS recorder sinks per doc."""
+    from fluidframework_tpu.testing.load import (ShardedLoadSpec,
+                                                 run_sharded_load)
+
+    spec = ShardedLoadSpec(
+        seed=1007, shards=SHARDS, docs=SHARD_DOCS,
+        clients_per_doc=SHARD_CLIENTS, steps=SHARD_STEPS,
+        kill_at=SHARD_STEPS // 2, probe_sinks=SHARD_SINKS,
+    )
+    t0 = time.time()
+    result = run_sharded_load(spec)
+    wall = time.time() - t0
+    lat = sorted(result.broadcast_latencies or [])
+    docs_per_shard = sorted(result.shard_docs.values())
+    ops_per_shard = sorted(result.shard_ops.values())
+    print(
+        f"sharded scenario: {result.sequenced_ops} ops across "
+        f"{SHARD_DOCS} docs / {len(result.shard_docs)} surviving shards "
+        f"in {wall:.2f}s; killed {result.killed_shard} "
+        f"({len(result.fenced_docs)} docs re-owned, "
+        f"{result.reconnects} reconnects)",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "service_shard_ops_per_sec",
+        "value": round(result.sequenced_ops / wall, 1),
+        "unit": "ops/sec",
+        "shards": SHARDS,
+        "docs": SHARD_DOCS,
+        "clients_per_doc": SHARD_CLIENTS,
+        "steps": SHARD_STEPS,
+        "sequenced_ops": result.sequenced_ops,
+        "edits": result.edits,
+        "wall_sec": round(wall, 3),
+        # balance over SURVIVING shards (one was killed mid-run)
+        "shard_docs": result.shard_docs,
+        "shard_ops": result.shard_ops,
+        "doc_balance_max_over_min": (
+            round(docs_per_shard[-1] / docs_per_shard[0], 2)
+            if docs_per_shard and docs_per_shard[0] else None),
+        "op_balance_max_over_min": (
+            round(ops_per_shard[-1] / ops_per_shard[0], 2)
+            if ops_per_shard and ops_per_shard[0] else None),
+        # failover
+        "killed_shard": result.killed_shard,
+        "fenced_docs": len(result.fenced_docs),
+        "reconnects": result.reconnects,
+        "epoch_bumped": result.epoch_bumped,
+        # broadcaster probe: serialize-once + latency in VIRTUAL ticks
+        # (deterministic per seed — schedule distance, not wall time)
+        "broadcast_encodes": result.broadcast_encodes,
+        "broadcast_sinks_per_doc": SHARD_SINKS,
+        "broadcast_deliveries": len(lat),
+        "broadcast_latency_p50_ticks": _percentile(lat, 0.50),
+        "broadcast_latency_p99_ticks": _percentile(lat, 0.99),
+    }))
 
 
 def seed(service: LocalOrderingService):
@@ -103,4 +185,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--shard-bench" in sys.argv[1:]:
+        shard_bench()
+    else:
+        main()
