@@ -54,15 +54,34 @@ def _serialize_node(node: Union[SummaryTree, SummaryBlob]) -> bytes:
 
 
 class FileSummaryStorage(SummaryStorage):
-    """Content-addressed summary store persisted to a directory."""
+    """Content-addressed summary store persisted to a directory.
 
-    def __init__(self, root: str) -> None:
+    Durability discipline (SEMANTICS.md "Durability & retry"): object
+    writes are write-then-rename (a reader can never observe a partial
+    object), crash-orphaned ``.tmp`` files are swept on reopen, and cold
+    loads verify the content digest against the handle — a corrupt object
+    file is QUARANTINED (moved aside, surfaced as the missing-handle
+    ``KeyError`` contract) rather than served or crashed on.  ``faults``
+    (a ``testing.faults.FaultInjector``) arms the ``storage.store`` /
+    ``storage.read`` fault sites."""
+
+    def __init__(self, root: str, faults=None) -> None:
         super().__init__()
         self.root = root
+        self._faults = faults
         self._objects_dir = os.path.join(root, "objects")
+        self._quarantine_dir = os.path.join(root, "quarantine")
         self._commits_path = os.path.join(root, "commits.jsonl")
         self._refs_path = os.path.join(root, "refs.jsonl")
         os.makedirs(self._objects_dir, exist_ok=True)
+        # Crash hygiene: a publish that died between tmp-write and rename
+        # leaves an orphan no read can ever reach — sweep, don't accrete.
+        for name in sorted(os.listdir(self._objects_dir)):
+            if ".tmp." in name:
+                try:
+                    os.remove(os.path.join(self._objects_dir, name))
+                except OSError:
+                    pass
         # Persist the storage epoch: a reopened store keeps its generation;
         # a wiped/recreated directory mints a new one (odsp EpochTracker).
         # Written ATOMICALLY (temp + rename), and an empty file — a crash
@@ -152,14 +171,36 @@ class FileSummaryStorage(SummaryStorage):
         digest = super()._store(node)
         path = os.path.join(self._objects_dir, digest)
         if not os.path.exists(path):  # content-addressed: write-once
+            fault = (self._faults.fire("storage.store")
+                     if self._faults is not None else None)
             # Atomic publish: executor-thread uploads run concurrently
             # with event-loop reads of the same content-addressed object —
             # a reader must never observe a partially-written file.
             tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            if fault is not None:
+                self._faulted_store(fault, tmp, node)
             with open(tmp, "wb") as f:
                 f.write(_serialize_node(node))
             os.replace(tmp, path)
         return digest
+
+    def _faulted_store(self, fault, tmp: str,
+                       node: Union[SummaryTree, SummaryBlob]) -> None:
+        """Injected upload failure: ``fail`` dies before any bytes,
+        ``torn`` leaves a partial ``.tmp`` (the pre-rename crash shape —
+        never visible to reads, swept on reopen).  Either way the object
+        file does not exist and the upload surfaces an OSError the
+        caller's retry re-publishes cleanly."""
+        from ..testing.faults import FaultError
+
+        if fault.kind == "torn":
+            data = _serialize_node(node)
+            frac = fault.arg if 0.0 < fault.arg < 1.0 else 0.5
+            with open(tmp, "wb") as f:
+                f.write(data[:max(1, int(len(data) * frac))])
+                f.flush()
+                os.fsync(f.fileno())
+        raise FaultError("storage.store", fault.kind)
 
     # -- lazy reads from disk (latest() inherits these via read()) -------------
 
@@ -178,29 +219,83 @@ class FileSummaryStorage(SummaryStorage):
         with self._lock:
             return self._objects.setdefault(handle, node)
 
+    def latest_with_handle(self, doc_id: str, at_or_below: int = None):
+        fault = (self._faults.fire("storage.read", doc=doc_id)
+                 if self._faults is not None else None)
+        if fault is not None and fault.kind == "fail":
+            from ..testing.faults import FaultError
+
+            raise FaultError("storage.read", "fail", doc_id)
+        if fault is not None and fault.kind == "stale":
+            # A lagging replica: serve the PARENT summary when one exists
+            # — the client replays a longer op tail and must converge to
+            # the same state (the catch-up path's whole correctness
+            # claim; pinned by the chaos oracle).
+            newest = True
+            for commit in self._walk(self.head(doc_id)):
+                if at_or_below is not None and commit.ref_seq > at_or_below:
+                    continue
+                if newest and commit.parent is not None:
+                    newest = False
+                    continue
+                return self.read(commit.tree), commit.ref_seq, commit.tree
+            return None, 0, None
+        return super().latest_with_handle(doc_id, at_or_below=at_or_below)
+
+    def _quarantine(self, digest: str, path: str, why: str) -> None:
+        """A corrupt content-addressed object: move it aside (forensics,
+        and so the next write-once publish can heal the handle) and
+        surface the store's missing-handle contract — callers already
+        treat KeyError as 'fetch it another way', which is exactly what a
+        torn record must degrade to.  Never serve, never crash."""
+        os.makedirs(self._quarantine_dir, exist_ok=True)
+        dest = os.path.join(self._quarantine_dir, digest)
+        try:
+            os.replace(path, dest)
+        except OSError:
+            pass  # best-effort: losing the evidence must not mask the miss
+        raise KeyError(
+            f"summary object {digest} was corrupt ({why}); quarantined")
+
     def _load_from_disk(self, digest: str) -> Union[SummaryTree, SummaryBlob]:
         path = os.path.join(self._objects_dir, digest)
         if not os.path.exists(path):
             raise KeyError(digest)
         with open(path, "rb") as f:
-            obj = json.loads(f.read())
-        if obj["kind"] == "blob":
-            return SummaryBlob(base64.b64decode(obj["content"]))
-        tree = SummaryTree()
-        for name, child_digest in obj["children"].items():
-            tree.children[name] = self.read(child_digest)
-        return tree
+            raw = f.read()
+        try:
+            obj = json.loads(raw)
+            if obj["kind"] == "blob":
+                node: Union[SummaryTree, SummaryBlob] = SummaryBlob(
+                    base64.b64decode(obj["content"]))
+                children = {}
+            else:
+                node = SummaryTree()
+                children = dict(obj["children"])
+        except (ValueError, KeyError, TypeError) as exc:
+            self._quarantine(digest, path, f"undecodable: {exc!r}")
+        for name, child_digest in children.items():
+            # A missing/quarantined CHILD propagates its own KeyError —
+            # this (structurally valid) parent is not the corrupt record.
+            node.children[name] = self.read(child_digest)
+        # Checksum gate: content-addressing means the handle IS the
+        # checksum — a decodable-but-wrong object (bit rot, torn write
+        # that still parses) must not be served under a digest it does
+        # not hash to.
+        if node.digest() != digest:
+            self._quarantine(digest, path, "digest mismatch")
+        return node
 
 
 class FileDocumentServiceFactory(LocalDocumentServiceFactory):
     """The whole service stack rooted in one directory; reopen to resume."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, faults=None) -> None:
         os.makedirs(root, exist_ok=True)
         self.root = root
         service = LocalOrderingService(
-            oplog=OpLog(os.path.join(root, "ops.jsonl")),
-            storage=FileSummaryStorage(root),
+            oplog=OpLog(os.path.join(root, "ops.jsonl"), faults=faults),
+            storage=FileSummaryStorage(root, faults=faults),
         )
         super().__init__(service)
 

@@ -35,7 +35,32 @@ from ..protocol.wire import (LEN as _LEN, WIRE_VERSION,
 
 
 class RpcError(RuntimeError):
-    """Server-side error surfaced to the caller."""
+    """Server-side error surfaced to the caller.  The PLAIN class means a
+    deterministic server rejection (auth failure, unknown method, a
+    server-side exception): retrying the same bytes cannot help, so the
+    retry layer never touches it — only the transport-shaped subclasses
+    below are retried."""
+
+
+class RpcTransportError(RpcError, ConnectionError):
+    """Transport-level failure (send faulted, frame lost): the request
+    may never have reached the server — resending the same bytes is the
+    correct recovery, and the sequencer's client_seq dedup makes it safe
+    even for submits.  A ConnectionError, so the runtime's wire-drain
+    keeps encoded ops queued."""
+
+
+class ConnectionLostError(RpcTransportError):
+    """The transport under this client DIED (socket closed, send failed
+    at the fd, reader drained the pending map).  Like any transport
+    error the queued ops survive, but a blind in-place retry is
+    pointless: the host must reconnect first."""
+
+
+class RpcTimeoutError(RpcError, TimeoutError):
+    """No response within the client timeout: the server may be slow or
+    the response frame lost.  Retried — the resend either dedups
+    (response was lost after sequencing) or lands fresh."""
 
 
 class EpochMismatchError(RpcError):
@@ -50,13 +75,38 @@ class EpochMismatchError(RpcError):
 
 
 class _RpcClient:
-    """Shared framed-JSON socket with response routing + event dispatch."""
+    """Shared framed-JSON socket with response routing + event dispatch.
+
+    ``retry`` (a :class:`~..service.retry.RetryPolicy`) bounds-retries
+    the initial connect and every request on transient transport
+    failures — safe for submits too, because the sequencer dedups by
+    (client_id, client_seq), so a response lost on the wire resends the
+    same bytes and gets the duplicate dropped server-side.  Nacks, epoch
+    mismatches, and shard fences are NEVER retried here: those belong to
+    the DeltaManager/loader layer.  ``faults`` arms the ``rpc.send`` /
+    ``rpc.recv`` injection sites (testing/faults.py)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 mc=None) -> None:
-        from ..utils.telemetry import MonitoringContext
+                 mc=None, faults=None, retry=None, rng=None) -> None:
+        import random as _random
 
-        self._sock = socket.create_connection((host, port), timeout=10)
+        from ..utils.telemetry import LockedCounterSet, MonitoringContext
+
+        self._faults = faults
+        self._retry = retry
+        self._retry_rng = rng if rng is not None else _random.Random(0)
+        #: retry.* counters (attempts/retries/exhausted) — bench surface
+        self.retry_counters = LockedCounterSet()
+        if retry is not None:
+            self._sock = retry.run(
+                lambda: socket.create_connection((host, port), timeout=10),
+                operation=f"connect {host}:{port}",
+                rng=self._retry_rng,
+                retry_on=(OSError,),
+                counters=self.retry_counters,
+            )
+        else:
+            self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
         self._timeout = timeout
         self._mc = (mc or MonitoringContext()).child("rpc")
@@ -72,6 +122,10 @@ class _RpcClient:
         self._handlers: Dict[str, List[Callable[[dict], None]]] = {}  # guarded-by: _state_lock
         self._closed = False
         self._sock_closed = False  # guarded-by: _state_lock
+        # injected-'delay' one-frame reorder buffer (reader thread +
+        # safety timer race for the flush)
+        self._held_lock = threading.Lock()
+        self._held: Optional[dict] = None  # guarded-by: _held_lock
         #: last exception a telemetry sink raised from the dispatcher
         #: (dispatcher-thread-confined write; read via last_sink_error
         #: for post-mortem — a dead sink must not also hide ITS failure)
@@ -114,20 +168,49 @@ class _RpcClient:
             while True:
                 (length,) = _LEN.unpack(read_exact(_LEN.size))
                 frame = json.loads(read_exact(length))
-                if "re" in frame:
-                    with self._pending_lock:
-                        slot = self._pending.pop(frame["re"], None)
-                    if slot is not None:
-                        slot.put(frame)
-                elif "event" in frame:
-                    self._events.put(frame)
+                # Event frames carry their doc id — a doc-scoped plan
+                # point counts ONLY that document's broadcast frames,
+                # which is what makes "drop the 3rd op event of doc X"
+                # replayable (response frames count globally).
+                fault = (self._faults.fire("rpc.recv",
+                                           doc=frame.get("doc"))
+                         if self._faults is not None else None)
+                if fault is not None:
+                    if fault.kind == "disconnect":
+                        raise ConnectionError("injected rpc disconnect")
+                    if fault.kind == "drop":
+                        continue  # lost on the wire: waiters time out /
+                        # subscribers gap-repair from durable storage
+                    if fault.kind == "duplicate":
+                        self._route(frame)  # delivered twice: watermarks
+                        # and response-slot idempotence absorb the copy
+                    if fault.kind == "delay":
+                        # Reorder, never loss: delivered after the next
+                        # frame — or by the timer if the connection goes
+                        # idle (a delay on the FINAL frame must not turn
+                        # into a permanent drop).  Check-and-hold in one
+                        # critical section; an occupied buffer delivers
+                        # this frame normally.
+                        with self._held_lock:
+                            holding = self._held is None
+                            if holding:
+                                self._held = frame
+                        if holding:
+                            timer = threading.Timer(
+                                self.HELD_FLUSH_SECONDS, self._flush_held)
+                            timer.daemon = True
+                            timer.start()
+                            continue
+                self._route(frame)
+                self._flush_held()
         except (ConnectionError, OSError, ValueError) as exc:
             self._closed = True
             # Fail every waiter so no caller hangs on a dead socket.
             with self._pending_lock:
                 pending, self._pending = self._pending, {}
             for slot in pending.values():
-                slot.put({"ok": False, "error": f"connection lost: {exc}"})
+                slot.put({"ok": False, "code": "connectionLost",
+                          "error": f"connection lost: {exc}"})
             self._events.put(None)
         finally:
             # The buffered reader pins the socket's io refcount; a reader
@@ -139,6 +222,37 @@ class _RpcClient:
                     rfile.close()
                 except OSError:
                     pass
+
+    #: how long an injected 'delay' holds a frame when NO later frame
+    #: arrives to release it (idle connection): reorder semantics with a
+    #: bounded worst case, never a permanent drop.
+    HELD_FLUSH_SECONDS = 0.25
+
+    def _flush_held(self) -> None:
+        """Release the one-frame reorder buffer: called by the reader
+        after routing the NEXT frame, and by the safety timer when the
+        connection went idle — the None-swap under the lock makes
+        exactly one of them deliver it."""
+        with self._held_lock:
+            frame, self._held = self._held, None
+        if frame is not None:
+            self._route(frame)
+
+    def _route(self, frame: dict) -> None:
+        """Deliver one inbound frame: responses to their waiting slot,
+        events to the dispatcher queue.  Duplicate-delivery safe: a
+        response whose slot is gone (already answered) is dropped, and
+        event consumers dedup by delivery watermark."""
+        if "re" in frame:
+            with self._pending_lock:
+                slot = self._pending.pop(frame["re"], None)
+            if slot is not None:
+                try:
+                    slot.put_nowait(frame)
+                except queue.Full:
+                    pass  # duplicated response already delivered
+        elif "event" in frame:
+            self._events.put(frame)
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -189,8 +303,41 @@ class _RpcClient:
         return self._last_sink_error
 
     def request(self, method: str, params: dict):
+        if self._retry is None or self._closed:
+            # A dead socket can never heal by resending — fail fast
+            # rather than burn the budget against a closed fd.
+            return self._request_once(method, params)
+        return self._retry.run(
+            lambda: self._request_once(method, params),
+            operation=f"rpc {method}",
+            rng=self._retry_rng,
+            # Only TRANSPORT-shaped failures resend the same bytes
+            # (duplicates dedup server-side).  A plain RpcError is a
+            # deterministic server rejection — retrying would burn the
+            # budget and then mask the real error as a ConnectionError.
+            retry_on=(RpcTransportError, RpcTimeoutError, OSError,
+                      TimeoutError),
+            # These are not transport noise: nack holds belong to the
+            # DeltaManager, mismatches/fences to the loader's re-resolve
+            # — and a DEAD socket (ConnectionLostError) can never heal by
+            # resending in place: fail fast so the host reconnects,
+            # instead of sleeping out the budget against a closed fd.
+            no_retry=(EpochMismatchError, NackError, ShardFencedError,
+                      ConnectionLostError),
+            counters=self.retry_counters,
+        )
+
+    def _request_once(self, method: str, params: dict):
         if self._closed:
-            raise RpcError("connection lost")
+            raise ConnectionLostError("connection lost")
+        fault = (self._faults.fire("rpc.send", doc=params.get("doc"))
+                 if self._faults is not None else None)
+        if fault is not None:
+            if fault.kind == "disconnect":
+                self.close()
+                raise ConnectionLostError("injected disconnect before send")
+            if fault.kind == "fail":
+                raise RpcTransportError("injected send failure")
         rid = next(self._ids)
         slot: queue.Queue = queue.Queue(maxsize=1)
         with self._pending_lock:
@@ -201,7 +348,7 @@ class _RpcClient:
             # instead of waiting out the timeout on a dead socket.
             with self._pending_lock:
                 self._pending.pop(rid, None)
-            raise RpcError("connection lost")
+            raise ConnectionLostError("connection lost")
         if self.epoch is not None and method not in ("auth", "ping"):
             params = {**params, "epoch": self.epoch}
         frame = frame_bytes(
@@ -209,18 +356,21 @@ class _RpcClient:
              "params": params}
         )
         try:
-            with self._write_lock:
-                self._sock.sendall(frame)
+            if fault is not None and fault.kind == "drop":
+                pass  # lost on the wire: the slot wait below times out
+            else:
+                with self._write_lock:
+                    self._sock.sendall(frame)
         except OSError as exc:
             with self._pending_lock:
                 self._pending.pop(rid, None)
-            raise RpcError(f"send failed: {exc}")
+            raise ConnectionLostError(f"send failed: {exc}")
         try:
             frame = slot.get(timeout=self._timeout)
         except queue.Empty:
             with self._pending_lock:
                 self._pending.pop(rid, None)
-            raise RpcError(f"timeout waiting for {method}")
+            raise RpcTimeoutError(f"timeout waiting for {method}")
         if not frame.get("ok"):
             nack = frame.get("nack")
             if nack is not None:
@@ -244,30 +394,35 @@ class _RpcClient:
                     frame.get("doc", ""),
                     frame.get("error", "shard fenced"),
                 )
+            if frame.get("code") == "connectionLost":
+                # The reader died and drained this waiter: transport
+                # death, not a server rejection — queued ops survive.
+                raise ConnectionLostError(
+                    frame.get("error", "connection lost"))
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
 
     def _invalidate_epoch_state(self) -> None:
         """Unpin the connection's storage generation and invalidate every
         per-doc cache riding it — shared by the epochMismatch error path
-        and the proactive server-push fence event.  Same discipline as
-        the dispatcher: snapshot under the lock, invoke the callbacks
-        OUTSIDE it (a listener that re-registers must not self-deadlock
-        on the plain Lock), then prune dead weakrefs by re-reading the
-        LIVE list — never by writing back the stale snapshot, which
-        would drop listeners registered during delivery."""
+        and the proactive server-push fence event.  ONE critical section
+        does both the snapshot and the dead-weakref prune (resolving the
+        refs pins each live listener for the delivery below), then the
+        callbacks run OUTSIDE the lock — a listener that re-registers
+        must not self-deadlock on the plain Lock, and anything registered
+        during delivery simply appends to the live list untouched."""
         self.epoch = None
+        callbacks = []
         with self._state_lock:
-            listeners = list(self._epoch_listeners)
-        for ref in listeners:
-            invalidate = ref()
-            if invalidate is not None:
-                invalidate()
-        with self._state_lock:
-            self._epoch_listeners[:] = [
-                r for r in self._epoch_listeners
-                if r() is not None
-            ]
+            live = []
+            for ref in list(self._epoch_listeners):
+                invalidate = ref()
+                if invalidate is not None:
+                    live.append(ref)
+                    callbacks.append(invalidate)
+            self._epoch_listeners[:] = live
+        for invalidate in callbacks:
+            invalidate()
 
     def on(self, event: str, doc_id: str, fn: Callable[[dict], None]) -> None:
         with self._state_lock:
@@ -574,8 +729,10 @@ class NetworkDocumentServiceFactory:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
                  timeout: float = 30.0, tenant: Optional[str] = None,
-                 secret: Optional[str] = None, mc=None) -> None:
-        self._rpc = _RpcClient(host, port, timeout=timeout, mc=mc)
+                 secret: Optional[str] = None, mc=None, faults=None,
+                 retry=None, retry_rng=None) -> None:
+        self._rpc = _RpcClient(host, port, timeout=timeout, mc=mc,
+                               faults=faults, retry=retry, rng=retry_rng)
         self._connections: Dict[str, NetworkConnection] = {}
         if tenant is not None:
             # Riddler capability: authenticate the connection before any

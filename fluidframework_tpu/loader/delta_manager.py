@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import enum
 import itertools
+import threading
 from typing import Callable, List, Optional
 
 import time
@@ -49,9 +50,33 @@ class DeltaManager:
     """
 
     def __init__(self, document_service,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 resolver: Optional[Callable[[], object]] = None,
+                 retry=None, rng=None,
+                 sleep: Optional[Callable[[float], None]] = None) -> None:
+        import random as _random
+
+        from ..utils.telemetry import LockedCounterSet
+
         self._service = document_service
         self._clock = clock or time.time
+        #: re-resolves a fresh document service through the factory — the
+        #: fence recovery path: after a shard failover the router hands
+        #: out the recovered owner, and THIS manager re-resolves and
+        #: replays its held outbound ops itself (no host polling of
+        #: fence_required required; the Loader always wires this).
+        self._resolver = resolver
+        #: RetryPolicy for outbound submits/connects: transient transport
+        #: or durability failures resend the same op (the sequencer
+        #: dedups by client_seq); nacks and fences keep their own paths.
+        self._retry = retry
+        self._rng = rng if rng is not None else _random.Random(0)
+        # Backoff actuator: a VirtualClock injects its own sleep (virtual
+        # time advances, nothing blocks), live sessions really sleep.
+        self._sleep = sleep if sleep is not None \
+            else getattr(clock, "sleep", None) or time.sleep
+        #: retry.* counters — the chaos oracle's budget-respected surface
+        self.retry_counters = LockedCounterSet()
         self.state = ConnectionState.DISCONNECTED
         self.client_id: Optional[str] = None
         self.read_only = False
@@ -76,7 +101,14 @@ class DeltaManager:
         # itself is a ConnectionError the wire-drain rightly swallows.
         self.fence_required = False
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
-        self._ahead: dict = {}  # seq -> parked out-of-order message
+        # Delivery is serialized: live messages arrive on the driver's
+        # dispatcher thread while backfills (reconnect catch-up, the log
+        # property) run on the app thread — an unserialized interleave
+        # can park a message in _ahead that the other thread's watermark
+        # already passed, wedging the state at CATCHING_UP forever.
+        # Re-entrant: gap repair delivers from inside a locked delivery.
+        self._delivery_lock = threading.RLock()
+        self._ahead: dict = {}  # guarded-by: _delivery_lock
         self._live_fn = None
         # Connection epoch: reconnects from THIS manager resume the same
         # sequencer-side record (dedup floor preserved); a different
@@ -93,12 +125,13 @@ class DeltaManager:
         returned, so delivery accounting advances here — otherwise the
         next live message would misread the backfilled span as a gap and
         re-fetch it all."""
-        tail = self._service.delta_storage.get(
-            from_seq=self.last_delivered_seq
-        )
-        if tail:
-            self.last_delivered_seq = tail[-1].seq
-        return tail
+        with self._delivery_lock:
+            tail = self._service.delta_storage.get(
+                from_seq=self.last_delivered_seq
+            )
+            if tail:
+                self.last_delivered_seq = tail[-1].seq
+            return tail
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         self._subscribers.append(fn)
@@ -108,11 +141,40 @@ class DeltaManager:
             raise RuntimeError("delta manager is closed")
         self.state = ConnectionState.CONNECTING
         self.client_id = client_id
-        conn = self._service.connection()
-        self._live_fn = self._on_live
-        conn.subscribe(self._live_fn)
-        conn.connect(client_id, self._session)
+
+        def _attach():
+            conn = self._service.connection()
+            self._live_fn = self._on_live
+            conn.subscribe(self._live_fn)
+            try:
+                conn.connect(client_id, self._session)
+            except BaseException:
+                # Retry hygiene: a failed attach must not leave the live
+                # subscription behind, or each retry would stack another
+                # delivery path onto the same manager.
+                conn.unsubscribe(self._live_fn)
+                self._live_fn = None
+                raise
+        if self._retry is not None:
+            self._retry.run(
+                _attach, operation="connect",
+                sleep=self._sleep, rng=self._rng,
+                no_retry=(NackError,),
+                # A fence DURING connect is retryable exactly when we can
+                # re-resolve the recovered owner through the router.
+                on_fence=(self._re_resolve if self._resolver is not None
+                          else None),
+                counters=self.retry_counters,
+            )
+        else:
+            _attach()
         self.state = ConnectionState.CONNECTED
+
+    def _re_resolve(self) -> None:
+        """Swap in a freshly-resolved document service (the router's
+        current owner for this document) — the ShardFencedError recovery
+        the retry policy invokes between attempts."""
+        self._service = self._resolver()
 
     @property
     def can_send(self) -> bool:
@@ -137,6 +199,21 @@ class DeltaManager:
             raise NackError("held by retryAfter",
                             retry_after=self.nacked_until - now)
         try:
+            if self._retry is not None:
+                # Bounded inline retry for transient transport/durability
+                # failures (an injected oplog-append fault, a lost RPC
+                # send): the same bytes resend and the sequencer's
+                # client_seq dedup absorbs any duplicate.  Exhaustion
+                # surfaces RetryBudgetExhaustedError — a ConnectionError,
+                # so the runtime keeps the op queued for a later flush.
+                # Nacks and fences fall through to the handlers below.
+                return self._retry.run(
+                    lambda: self._service.connection().submit(op),
+                    operation="submit",
+                    sleep=self._sleep, rng=self._rng,
+                    no_retry=(NackError, ShardFencedError),
+                    counters=self.retry_counters,
+                )
             return self._service.connection().submit(op)
         except ShardFencedError:
             # Dead shard: the op stays queued (ConnectionError contract),
@@ -173,22 +250,46 @@ class DeltaManager:
         if self.state in (ConnectionState.DISCONNECTED, ConnectionState.CLOSED):
             return
         conn = self._service.connection()
-        if self._live_fn is not None:
-            conn.unsubscribe(self._live_fn)
+        try:
+            if self._live_fn is not None:
+                conn.unsubscribe(self._live_fn)
+            if self.client_id is not None:
+                conn.disconnect(self.client_id)
+        except (ConnectionError, OSError, TimeoutError):
+            # Tearing down a DEAD transport must not block moving to a
+            # live one (reconnect after an RPC disconnect / fence): the
+            # server reaps the dead session's quorum membership itself
+            # when the socket closes.
+            pass
+        finally:
             self._live_fn = None
-        if self.client_id is not None:
-            conn.disconnect(self.client_id)
         self.state = ConnectionState.DISCONNECTED
 
     def reconnect(self, client_id: Optional[str] = None,
                   document_service=None) -> None:
         """Drop the old connection (if any) and establish a fresh one,
         optionally against a new resolved service (new endpoint after a
-        service restart)."""
+        service restart).  After a fence, no explicit service is needed:
+        the manager re-resolves through its factory resolver itself —
+        the router hands out the recovered owner."""
         self.disconnect()
         if document_service is not None:
             self._service = document_service
+        elif self.fence_required and self._resolver is not None:
+            self._re_resolve()
         self.connect(client_id if client_id is not None else self.client_id)
+        # Deterministic catch-up: pull the span missed while disconnected
+        # from durable storage NOW, instead of waiting for the next live
+        # message to trigger gap repair.  Over an async transport (TCP)
+        # the live tail lags the connect response — and the container's
+        # reconnect protocol needs acks for already-sequenced pending ops
+        # to land BEFORE it resubmits the rest, or the resubmit would
+        # double-apply them.  The delivery watermark dedups any overlap
+        # with the (sync or async) live feed.
+        with self._delivery_lock:
+            for msg in self._service.delta_storage.get(
+                    from_seq=self.last_delivered_seq):
+                self._deliver(msg)
         # A successful (re)connect clears the fence flag: either the host
         # handed us the re-resolved service, or the old one still works.
         self.fence_required = False
@@ -202,32 +303,49 @@ class DeltaManager:
     def note_delivered(self, seq: int) -> None:
         """The container loaded a summary / replayed storage up to ``seq``
         outside the live path; future live delivery resumes after it."""
-        self.last_delivered_seq = max(self.last_delivered_seq, seq)
+        with self._delivery_lock:
+            self.last_delivered_seq = max(self.last_delivered_seq, seq)
 
     def _on_live(self, msg: SequencedMessage) -> None:
-        if msg.seq <= self.last_delivered_seq:
-            return  # duplicate of something storage already served
-        if msg.seq > self.last_delivered_seq + 1:
-            # A gap: park this message, repair from durable storage.
-            self._ahead[msg.seq] = msg
-            self.state = ConnectionState.CATCHING_UP
-            missing = self._service.delta_storage.get(
-                from_seq=self.last_delivered_seq, to_seq=msg.seq - 1
-            )
-            self.gaps_repaired += 1
-            for m in missing:
-                self._deliver(m)
-        else:
-            self._deliver(msg)
-        # Drain any parked messages that are now contiguous.
-        while self.last_delivered_seq + 1 in self._ahead:
-            self._deliver(self._ahead.pop(self.last_delivered_seq + 1))
-        if self.state is ConnectionState.CATCHING_UP and not self._ahead:
-            self.state = ConnectionState.CONNECTED
+        with self._delivery_lock:
+            if msg.seq <= self.last_delivered_seq:
+                return  # duplicate of something storage already served
+            if msg.seq > self.last_delivered_seq + 1:
+                # A gap: park this message, repair from durable storage.
+                self._ahead[msg.seq] = msg
+                self.state = ConnectionState.CATCHING_UP
+                missing = self._service.delta_storage.get(
+                    from_seq=self.last_delivered_seq, to_seq=msg.seq - 1
+                )
+                self.gaps_repaired += 1
+                for m in missing:
+                    self._deliver(m)
+            else:
+                self._deliver(msg)
+            # Drain parked messages that are now contiguous — and purge
+            # stale parks a backfill already covered (a park below the
+            # watermark would otherwise pin the state at CATCHING_UP
+            # with no later message ever draining it).
+            while self._ahead:
+                nxt = min(self._ahead)
+                if nxt <= self.last_delivered_seq:
+                    self._ahead.pop(nxt)
+                elif nxt == self.last_delivered_seq + 1:
+                    self._deliver(self._ahead.pop(nxt))
+                else:
+                    break
+            if self.state is ConnectionState.CATCHING_UP \
+                    and not self._ahead:
+                self.state = ConnectionState.CONNECTED
 
     def _deliver(self, msg: SequencedMessage) -> None:
-        if msg.seq <= self.last_delivered_seq:
-            return
-        self.last_delivered_seq = msg.seq
-        for fn in list(self._subscribers):
+        with self._delivery_lock:
+            if msg.seq <= self.last_delivered_seq:
+                return
+            self.last_delivered_seq = msg.seq
+            subscribers = list(self._subscribers)
+        # Deliver outside any state mutation but still inside the outer
+        # serialization (the lock is re-entrant): subscribers only append
+        # to the runtime's inbound queue by contract.
+        for fn in subscribers:
             fn(msg)

@@ -94,10 +94,19 @@ class Container:
         A staleView op-nack (queued wire bytes referencing a view below the
         collaboration window) is repaired here by reconnecting: the
         reconnect discards the stale encodings and rebases pending ops to
-        a fresh view — resending identical bytes would livelock."""
+        a fresh view — resending identical bytes would livelock.
+
+        A shard fence is repaired the same way, WITHOUT host polling: the
+        DeltaManager flagged ``fence_required`` when a submit hit the
+        dead shard, and ``reconnect()`` re-resolves the recovered owner
+        through the manager's factory resolver and replays the held
+        outbound ops (discard + resubmit) itself."""
         n = self.runtime.drain()
         if self.delta_manager.rebase_required:
             self.delta_manager.rebase_required = False
+            self.reconnect()
+            n += self.runtime.drain()
+        if self.delta_manager.fence_required:
             self.reconnect()
             n += self.runtime.drain()
         return n
@@ -204,7 +213,8 @@ class Loader:
                  registry: Optional[ChannelRegistry] = None,
                  mc: Optional[MonitoringContext] = None,
                  runtime_options=None,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 retry=None) -> None:
         self.factory = factory
         self.registry = registry
         self.runtime_options = runtime_options
@@ -213,6 +223,22 @@ class Loader:
         # (None = wall clock).  Replay harnesses pass a virtual clock so
         # nack retryAfter holds resolve identically on every run.
         self.clock = clock
+        #: RetryPolicy threaded into every DeltaManager (None = no
+        #: inline retries; the runtime's flush-requeue contract still
+        #: applies).  Backoff rides ``clock.sleep`` when the injected
+        #: clock provides one (VirtualClock), so replay stays exact.
+        self.retry = retry
+
+    def _delta_manager(self, doc_id: str, service) -> DeltaManager:
+        """One place wires every DeltaManager: the clock, the retry
+        policy, and the fence resolver (re-resolving through the factory
+        reaches the router's CURRENT owner — the self-healing reconnect
+        after a shard failover)."""
+        return DeltaManager(
+            service, clock=self.clock,
+            resolver=lambda: self.factory.resolve(doc_id),
+            retry=self.retry,
+        )
 
     def _new_runtime(self) -> ContainerRuntime:
         return ContainerRuntime(self.registry, options=self.runtime_options)
@@ -330,7 +356,7 @@ class Loader:
         runtime.load(summary)
 
         container = Container(doc_id, runtime,
-                              DeltaManager(service, clock=self.clock))
+                              self._delta_manager(doc_id, service))
 
         # Catch-up replay: one fetch of the whole tail, split at the
         # earliest replayed authoring point and at the stash point.  THE
@@ -579,7 +605,7 @@ class Loader:
     def _wire(self, doc_id: str, runtime: ContainerRuntime, service,
               client_id: str) -> Container:
         container = Container(doc_id, runtime,
-                              DeltaManager(service, clock=self.clock))
+                              self._delta_manager(doc_id, service))
         container.delta_manager.note_delivered(runtime.ref_seq)
         container.runtime.connect(container.delta_manager, client_id)
         container.drain()

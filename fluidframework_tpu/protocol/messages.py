@@ -91,6 +91,32 @@ class ShardFencedError(ConnectionError):
         self.doc_id = doc_id
 
 
+class RetryBudgetExhaustedError(ConnectionError):
+    """A bounded retry loop gave up: the policy's attempt count or delay
+    budget ran out before the operation succeeded.
+
+    Subclasses ConnectionError so the runtime wire-drain's queued-op
+    contract still holds (the encoded ops stay queued; a LATER flush —
+    with a fresh budget — may drain them), but the type is distinct so
+    hosts and tests can pin "the budget was respected" versus "the op
+    happened to fail".  Carries the forensic trail: how many attempts,
+    how much injected-clock time was spent sleeping, and the last
+    underlying error.
+    """
+
+    def __init__(self, operation: str, attempts: int, slept: float,
+                 last_error: Optional[BaseException]) -> None:
+        super().__init__(
+            f"retry budget exhausted for {operation}: {attempts} "
+            f"attempt(s), {slept:.3f}s of backoff; last error: "
+            f"{last_error!r}"
+        )
+        self.operation = operation
+        self.attempts = attempts
+        self.slept = slept
+        self.last_error = last_error
+
+
 @dataclasses.dataclass
 class RawOperation:
     """An op as submitted by a client, before sequencing."""

@@ -63,6 +63,13 @@ class Sequencer:
         # and drained by the outermost broadcast.
         self._delivery: List[SequencedMessage] = []
         self._delivering = False
+        #: set by _stamp's exception path: True iff the exception unwound
+        #: the CALLER's message (it never became durable or visible).
+        #: Callers restore their own optimistic state (dedup floor,
+        #: quorum membership) ONLY in that case — a failure in a LATER
+        #: subscriber leaves the message durably sequenced, and rolling
+        #: the floor back then would let a retry double-sequence it.
+        self._last_stamp_unwound = False
 
     # -- connection management -------------------------------------------------
 
@@ -98,27 +105,46 @@ class Sequencer:
         conn = ClientConnection(client_id=client_id, ref_seq=self._seq,
                                 session=session)
         self._clients[client_id] = conn
-        self._stamp(
-            client_id=None,
-            client_seq=-1,
-            ref_seq=self._seq,
-            type_=MessageType.JOIN,
-            contents={"clientId": client_id},
-        )
+        try:
+            self._stamp(
+                client_id=None,
+                client_seq=-1,
+                ref_seq=self._seq,
+                type_=MessageType.JOIN,
+                contents={"clientId": client_id},
+            )
+        except BaseException:
+            # A JOIN whose durable append failed (unwound) must not
+            # leave the client in the quorum: the retry's connect would
+            # resume the record and never stamp the JOIN at all.  A JOIN
+            # that landed durably (a later subscriber raised) keeps the
+            # membership — it matches the log.
+            if self._last_stamp_unwound:
+                self._clients.pop(client_id, None)
+            raise
         return conn
 
     def disconnect(self, client_id: str) -> None:
         """Remove a client from the quorum; emits LEAVE and recomputes MSN."""
         if client_id not in self._clients:
             return
-        del self._clients[client_id]
-        self._stamp(
-            client_id=None,
-            client_seq=-1,
-            ref_seq=self._seq,
-            type_=MessageType.LEAVE,
-            contents={"clientId": client_id},
-        )
+        conn = self._clients.pop(client_id)
+        try:
+            self._stamp(
+                client_id=None,
+                client_seq=-1,
+                ref_seq=self._seq,
+                type_=MessageType.LEAVE,
+                contents={"clientId": client_id},
+            )
+        except BaseException:
+            # Same unwind discipline as connect: an un-stamped LEAVE must
+            # leave the quorum membership (and its MSN contribution)
+            # exactly as it was, so the retry re-stamps cleanly; a LEAVE
+            # that landed durably keeps the member removed.
+            if self._last_stamp_unwound:
+                self._clients[client_id] = conn
+            raise
 
     # -- sequencing ------------------------------------------------------------
 
@@ -149,15 +175,30 @@ class Sequencer:
                 f"(minSeq {self.min_seq})", retry_after=0.0,
                 code="staleView",
             )
+        prev_client_seq = conn.last_client_seq
+        prev_ref_seq = conn.ref_seq
         conn.last_client_seq = op.client_seq
         conn.ref_seq = max(conn.ref_seq, op.ref_seq)
-        return self._stamp(
-            client_id=op.client_id,
-            client_seq=op.client_seq,
-            ref_seq=op.ref_seq,
-            type_=op.type,
-            contents=op.contents,
-        )
+        try:
+            return self._stamp(
+                client_id=op.client_id,
+                client_seq=op.client_seq,
+                ref_seq=op.ref_seq,
+                type_=op.type,
+                contents=op.contents,
+            )
+        except BaseException:
+            # A failed stamp that UNWOUND (durable append refused the
+            # message — see _stamp's rollback) must also restore the
+            # dedup floor, or the caller's RETRY of the same client_seq
+            # would be treated as a duplicate and silently dropped.  A
+            # failure that did NOT unwind (a later subscriber raised
+            # after the append landed) keeps the floor: the op is
+            # durable, and the resend must dedup, not double-sequence.
+            if self._last_stamp_unwound:
+                conn.last_client_seq = prev_client_seq
+                conn.ref_seq = prev_ref_seq
+            raise
 
     def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
         """Heartbeat path: a client reports processed-up-to without an op."""
@@ -279,6 +320,8 @@ class Sequencer:
         type_: MessageType,
         contents,
     ) -> SequencedMessage:
+        self._last_stamp_unwound = False
+        prev_min_seq = self._min_seq
         self._seq += 1
         self._recompute_min_seq()
         msg = SequencedMessage(
@@ -299,8 +342,42 @@ class Sequencer:
             try:
                 while self._delivery:
                     queued = self._delivery.pop(0)
-                    for fn in list(self._subscribers):
-                        fn(queued)
+                    delivered_to = 0
+                    try:
+                        for fn in list(self._subscribers):
+                            fn(queued)
+                            delivered_to += 1
+                    except BaseException:
+                        # The FIRST subscriber is the durability gate
+                        # (DocumentOrderer's log append rides there): if
+                        # it refused the NEWEST stamp and nobody else saw
+                        # the message, un-stamp it completely — seq,
+                        # clock, MSN, and the in-memory log roll back so
+                        # the caller's retry re-sequences at the SAME
+                        # number instead of leaving a durable-log hole
+                        # no catch-up could ever repair.  (MSN restore is
+                        # only exact for the outermost stamp; a rolled-
+                        # back re-entrant stamp keeps the monotone MSN it
+                        # observed.)  A failure after any delivery, or of
+                        # a message with later stamps behind it, cannot
+                        # be unwound and propagates as-is — and then the
+                        # caller's message IS durable, so the unwound
+                        # flag stays False and the caller must NOT
+                        # restore its dedup floor (a restored floor would
+                        # re-sequence the retry as a second op).
+                        rolled_back = (delivered_to == 0
+                                       and not self._delivery
+                                       and self._log
+                                       and self._log[-1] is queued)
+                        if rolled_back:
+                            self._log.pop()
+                            self._seq -= 1
+                            self._clock -= 1
+                            if queued is msg:
+                                self._min_seq = prev_min_seq
+                        self._last_stamp_unwound = (rolled_back
+                                                    and queued is msg)
+                        raise
             finally:
                 self._delivering = False
         return msg

@@ -18,10 +18,11 @@ deployment:
 from .broadcaster import Broadcaster
 from .oplog import OpLog
 from .orderer import DocumentOrderer, LocalOrderingService
+from .retry import RetryPolicy
 from .scribe import Scribe
 from .sharding import ShardedOrderingService, ShardRouter
 
 __all__ = [
     "Broadcaster", "OpLog", "DocumentOrderer", "LocalOrderingService",
-    "Scribe", "ShardRouter", "ShardedOrderingService",
+    "RetryPolicy", "Scribe", "ShardRouter", "ShardedOrderingService",
 ]

@@ -11,6 +11,13 @@ on ``flush()``), append-only — reopening a log replays the file.  This is
 the host-side feed that gets packed into ragged device tensors; keeping it
 as a flat append-only byte stream is what makes the native packer able to
 mmap and scan it without touching Python objects.
+
+Durability contract (SEMANTICS.md "Durability & retry"): ``append`` is
+exception-safe — a write that fails (injected fault or real OSError)
+leaves NEITHER the in-memory view NOR the file holding the record, so the
+caller's retry re-appends cleanly instead of being silently deduplicated
+against a half-applied state.  A crash can tear only the final line;
+reopen repairs it (``repair_jsonl_tail``) before reads or appends resume.
 """
 
 from __future__ import annotations
@@ -32,13 +39,16 @@ class OpLog:
 
     In-memory by default; pass ``path`` for a durable file-backed log that
     survives process restarts (the crash-resume tests reopen it).
+    ``faults`` (a ``testing.faults.FaultInjector``) arms the
+    ``oplog.append`` / ``oplog.flush`` fault sites.
     """
 
     def __init__(self, path: Optional[str] = None,
-                 autoflush: bool = False) -> None:
+                 autoflush: bool = False, faults=None) -> None:
         self._docs: Dict[str, List[SequencedMessage]] = {}
         self._path = path
         self._autoflush = autoflush
+        self._faults = faults
         self._file: Optional[io.TextIOWrapper] = None
         if path is not None:
             # The op log is the highest-write-rate file in the store: a
@@ -48,9 +58,21 @@ class OpLog:
             # would merge onto the partial line.
             repair_jsonl_tail(path)
             for rec in iter_jsonl_tolerant(path):
-                self._docs.setdefault(rec["doc"], []).append(
-                    decode_sequenced_message(rec["msg"])
-                )
+                msg = decode_sequenced_message(rec["msg"])
+                log = self._docs.setdefault(rec["doc"], [])
+                if log and msg.seq <= log[-1].seq:
+                    if msg.seq == log[-1].seq:
+                        # Duplicate seq on disk: either a failed-then-
+                        # retried append re-wrote the identical record,
+                        # or a PHANTOM — an append whose bytes landed but
+                        # whose fsync failed was rolled back, and a
+                        # different op later won the same seq.  The LAST
+                        # line is what the live history actually
+                        # broadcast in both cases; the first would
+                        # resurrect a message no client ever saw.
+                        log[-1] = msg
+                    continue
+                log.append(msg)
             self._file = open(path, "a", encoding="utf-8")
 
     # -- write side (the scriptorium lambda) -----------------------------------
@@ -59,20 +81,87 @@ class OpLog:
         log = self._docs.setdefault(doc_id, [])
         if log and msg.seq <= log[-1].seq:
             return  # exactly-once: replays after crash-resume are idempotent
+        fault = (self._faults.fire("oplog.append", doc=doc_id)
+                 if self._faults is not None else None)
+        if fault is not None and (fault.kind == "fail"
+                                  or self._file is None):
+            # In-memory logs have no bytes to tear: every armed kind
+            # degrades to a plain append failure.
+            from ..testing.faults import FaultError
+
+            raise FaultError("oplog.append", fault.kind, doc_id)
         log.append(msg)
         if self._file is not None:
             rec = {"doc": doc_id, "msg": encode_sequenced_message(msg)}
-            self._file.write(canonical_json(rec).decode("utf-8") + "\n")
-            if self._autoflush:
-                # Durable-before-broadcast: the append rides first in the
-                # sequencer broadcast chain, so flushing here means no
-                # client ever sees an op the log could lose (the
-                # reference's scriptorium-durability property).
-                self.flush()
+            line = canonical_json(rec).decode("utf-8") + "\n"
+            if fault is not None and fault.kind == "torn":
+                self._torn_append(log, line, fault)
+            try:
+                self._file.write(line)
+                if self._autoflush:
+                    # Durable-before-broadcast: the append rides first in
+                    # the sequencer broadcast chain, so flushing here means
+                    # no client ever sees an op the log could lose (the
+                    # reference's scriptorium-durability property).
+                    self.flush()
+            except OSError:
+                # Exception safety: the record is not durable, so it must
+                # not stay visible in memory either — a retry would be
+                # deduped against it and the durable log would keep a
+                # hole.  Best-effort tail repair clears any partial bytes
+                # (a record torn at the newline may instead be SEALED
+                # complete — then the reopen-dedup above absorbs the
+                # retry's duplicate line).
+                log.pop()
+                self._repair_open_tail()
+                raise
+
+    def _torn_append(self, log: List[SequencedMessage], line: str,
+                     fault) -> None:
+        """Injected torn partial write: a strict prefix of the record
+        reaches the disk (fsynced — the tear is as durable as a real
+        crash would make it), then the append fails and the log
+        self-repairs by truncating back to the record start.  The caller
+        sees an OSError; the file never serves the torn bytes."""
+        from ..testing.faults import FaultError
+
+        self._file.flush()
+        start = os.fstat(self._file.fileno()).st_size
+        frac = fault.arg if 0.0 < fault.arg < 1.0 else 0.5
+        cut = max(1, min(len(line) - 2, int(len(line) * frac)))
+        self._file.write(line[:cut])
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        with open(self._path, "r+b") as g:
+            g.truncate(start)
+        log.pop()
+        raise FaultError("oplog.append", "torn",
+                         f"{cut}/{len(line)} bytes")
+
+    def _repair_open_tail(self) -> None:
+        """Best-effort: clear a partial final line left by a failed write
+        so later appends do not merge onto it.  The append handle is
+        O_APPEND — its next write lands at the repaired EOF."""
+        try:
+            self._file.flush()
+        except OSError:
+            pass
+        try:
+            repair_jsonl_tail(self._path)
+        except OSError:
+            pass
 
     def flush(self) -> None:
         if self._file is not None:
+            fault = (self._faults.fire("oplog.flush")
+                     if self._faults is not None else None)
+            if fault is not None and fault.kind == "fail":
+                from ..testing.faults import FaultError
+
+                raise FaultError("oplog.flush", "fail")
             self._file.flush()
+            if fault is not None and fault.kind == "skip_fsync":
+                return  # delayed fsync: bytes sit in the page cache
             os.fsync(self._file.fileno())
 
     def close(self) -> None:

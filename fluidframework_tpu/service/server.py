@@ -137,6 +137,13 @@ class _ClientSession:
         the other subscribers of its documents."""
         if self._closed:
             return True  # connection is tearing down; drop silently
+        fault = (self.server.faults.fire("session.write")
+                 if self.server.faults is not None else None)
+        if fault is not None and fault.kind == "stall":
+            # Injected stalled client: report saturation exactly as a
+            # full transport buffer would — the broadcaster demotes this
+            # session and the client backfills from the durable log.
+            return False
         transport = self.writer.transport
         buffered = (transport.get_write_buffer_size()
                     if transport is not None else 0)
@@ -219,7 +226,9 @@ class OrderingServer:
     def __init__(self, service: Optional[LocalOrderingService] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  tenants: Optional[Dict[str, str]] = None,
-                 broadcast_high_water: int = 8 << 20) -> None:
+                 broadcast_high_water: int = 8 << 20,
+                 catchup_max_inflight: int = 4,
+                 faults=None) -> None:
         #: any object with the LocalOrderingService surface — including
         #: ShardedOrderingService (the front door dispatches by its
         #: router transparently: every access goes through endpoint()).
@@ -242,6 +251,22 @@ class OrderingServer:
             # the recovered owners and push fence events to subscribers.
             self.service.add_fence_listener(self._on_shard_fence)
 
+        #: faultline hook for the ``session.write`` stall site
+        #: (testing/faults.py); None in production.
+        self.faults = faults
+        #: admission control for the catchup RPC: device folds are the
+        #: most expensive op the server runs — beyond this many in
+        #: flight, new requests are SHED with an "overloaded" nack
+        #: (clients catch up from the durable op log instead) rather
+        #: than piling onto the executor until every connection stalls.
+        self.catchup_max_inflight = int(catchup_max_inflight)
+        self._catchup_slots = threading.BoundedSemaphore(
+            self.catchup_max_inflight)
+        from ..utils.telemetry import LockedCounterSet
+
+        #: ``catchup.admitted`` / ``catchup.shed`` — the overload surface
+        self.admission = LockedCounterSet("catchup.admitted",
+                                          "catchup.shed")
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
         # lazy CatchupService (the "catchup" method); executor threads
@@ -397,69 +422,25 @@ class OrderingServer:
             )
             return True
         if method == "catchup":
-            # The north-star maintenance op in the deployed server shape:
-            # fold the named documents' op tails (or every document of the
-            # caller's namespace) into fresh summaries centrally, routing
-            # kernel-backed channels through the device (service.catchup).
-            # (_handle runs this method on an executor thread — the fold
-            # can take seconds and must not stall the event loop.)
-            from .catchup import CatchupService
-
-            with self._catchup_init:
-                if self._catchup is None:
-                    self._catchup = CatchupService(service)
-                # Hand the instance out of the critical section as a
-                # local: every later use reads the local, not the guarded
-                # attribute (fluidrace FL-RACE-GUARD — the instance is
-                # immutable-once-set, the attribute slot is not).
-                catchup = self._catchup
-            if catchup.cache is not None:
-                # Epoch-keyed invalidation (EpochTracker parity for the
-                # SERVER's own fold cache): entries are keyed by the
-                # storage generation so a recreated store can never be
-                # served a stale fold — dropping dead-generation entries
-                # here just frees the budget immediately.
-                catchup.cache.invalidate_epoch(
-                    service.storage.epoch)
-            if catchup.delta_cache is not None:
-                # Tier 0 (delta download) is epoch-keyed the same way.
-                catchup.delta_cache.invalidate_epoch(
-                    service.storage.epoch)
-            doc_ids = params.get("docs")
-            prefix = f"{session.tenant}/" if self.tenants is not None else ""
-            if doc_ids is not None:
-                doc_ids = [f"{prefix}{d}" for d in doc_ids]
-            else:
-                doc_ids = [d for d in service.doc_ids()
-                           if d.startswith(prefix)]
-            stats: dict = {}
-            results = catchup.catch_up(doc_ids, stats=stats)
-            out = {}
-            for doc_id, (handle, seq) in results.items():
-                self._grant_tree(service.storage.read(handle),
-                                 session.tenant)
-                out[doc_id[len(prefix):]] = [handle, seq]
-            return {
-                "docs": out,
-                # Explicitly-requested documents the fold could not serve
-                # (unknown id, or nothing to fold from): callers must be
-                # able to tell success from a typo.
-                "skipped": sorted(
-                    d[len(prefix):] for d in doc_ids if d not in results
-                ),
-                "deviceDocs": stats.get("deviceDocs", 0),
-                "cpuDocs": stats.get("cpuDocs", 0),
-                # Cumulative fold-cache health (hits/misses/evictions/
-                # waits + bytes) — operators watching a herd of loading
-                # clients see the single-flight amortization here.
-                "cache": (catchup.cache.stats()
-                          if catchup.cache is not None else None),
-                # Tier-0 delta-download health: documents whose rows
-                # never crossed the d2h link + the bytes that saved.
-                "deltaCache": (catchup.delta_cache.stats()
-                               if catchup.delta_cache is not None
-                               else None),
-            }
+            # Admission control: the fold is the most expensive op this
+            # server runs.  Beyond catchup_max_inflight concurrent folds,
+            # SHED with a typed "overloaded" nack (retry_after carries
+            # the pacing hint) — the caller falls back to catch-up from
+            # the durable op log, which always works, instead of this
+            # queue collapsing under a herd.
+            admitted = False
+            try:
+                admitted = self._catchup_slots.acquire(blocking=False)
+                if not admitted:
+                    self.admission.bump("catchup.shed")
+                    raise NackError(
+                        "catch-up tier overloaded; backfill from deltas "
+                        "or retry", retry_after=0.5, code="overloaded")
+                self.admission.bump("catchup.admitted")
+                return self._catchup_rpc(session, params)
+            finally:
+                if admitted:
+                    self._catchup_slots.release()
         if method == "latest_summary":
             epoch = service.storage.epoch
             tree, ref_seq = service.storage.latest(
@@ -509,6 +490,74 @@ class OrderingServer:
                 return {"v": 1, **_encode_blob(node)}
             return tree_to_obj(node)
         raise ValueError(f"unknown method {method!r}")
+
+    def _catchup_rpc(self, session: _ClientSession, params: dict):
+        """The catchup method body, run under an admission slot.
+
+        The north-star maintenance op in the deployed server shape:
+        fold the named documents' op tails (or every document of the
+        caller's namespace) into fresh summaries centrally, routing
+        kernel-backed channels through the device (service.catchup).
+        (_handle runs this method on an executor thread — the fold
+        can take seconds and must not stall the event loop.)"""
+        service = self.service
+        from .catchup import CatchupService
+
+        with self._catchup_init:
+            if self._catchup is None:
+                self._catchup = CatchupService(service)
+            # Hand the instance out of the critical section as a
+            # local: every later use reads the local, not the guarded
+            # attribute (fluidrace FL-RACE-GUARD — the instance is
+            # immutable-once-set, the attribute slot is not).
+            catchup = self._catchup
+        if catchup.cache is not None:
+            # Epoch-keyed invalidation (EpochTracker parity for the
+            # SERVER's own fold cache): entries are keyed by the
+            # storage generation so a recreated store can never be
+            # served a stale fold — dropping dead-generation entries
+            # here just frees the budget immediately.
+            catchup.cache.invalidate_epoch(
+                service.storage.epoch)
+        if catchup.delta_cache is not None:
+            # Tier 0 (delta download) is epoch-keyed the same way.
+            catchup.delta_cache.invalidate_epoch(
+                service.storage.epoch)
+        doc_ids = params.get("docs")
+        prefix = f"{session.tenant}/" if self.tenants is not None else ""
+        if doc_ids is not None:
+            doc_ids = [f"{prefix}{d}" for d in doc_ids]
+        else:
+            doc_ids = [d for d in service.doc_ids()
+                       if d.startswith(prefix)]
+        stats: dict = {}
+        results = catchup.catch_up(doc_ids, stats=stats)
+        out = {}
+        for doc_id, (handle, seq) in results.items():
+            self._grant_tree(service.storage.read(handle),
+                             session.tenant)
+            out[doc_id[len(prefix):]] = [handle, seq]
+        return {
+            "docs": out,
+            # Explicitly-requested documents the fold could not serve
+            # (unknown id, or nothing to fold from): callers must be
+            # able to tell success from a typo.
+            "skipped": sorted(
+                d[len(prefix):] for d in doc_ids if d not in results
+            ),
+            "deviceDocs": stats.get("deviceDocs", 0),
+            "cpuDocs": stats.get("cpuDocs", 0),
+            # Cumulative fold-cache health (hits/misses/evictions/
+            # waits + bytes) — operators watching a herd of loading
+            # clients see the single-flight amortization here.
+            "cache": (catchup.cache.stats()
+                      if catchup.cache is not None else None),
+            # Tier-0 delta-download health: documents whose rows
+            # never crossed the d2h link + the bytes that saved.
+            "deltaCache": (catchup.delta_cache.stats()
+                           if catchup.delta_cache is not None
+                           else None),
+        }
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

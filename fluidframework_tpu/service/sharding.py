@@ -144,6 +144,7 @@ class ShardedOrderingService:
         storage: Optional[SummaryStorage] = None,
         throttle=None,
         shard_ids: Optional[List[str]] = None,
+        faults=None,
     ) -> None:
         ids = (list(shard_ids) if shard_ids is not None
                else [f"shard{i:02d}" for i in range(n_shards)])
@@ -165,6 +166,10 @@ class ShardedOrderingService:
         self._fence_listeners: List[FenceListener] = []  # guarded-by: state_lock
         #: monotone count of completed failovers (introspection/benches)
         self.fences = 0  # guarded-by: state_lock
+        #: faultline hook: ``tick()`` consults this injector's scheduled
+        #: ``shard.kill`` points (testing/faults.py) — failovers fire at
+        #: deterministic virtual ticks instead of hand-placed test calls.
+        self._faults = faults
         # Serializes kill_shard end-to-end: the fence-then-flip sequence
         # must not interleave with another kill (two racing kills could
         # both pass the last-live-shard check, fence their orderers, and
@@ -304,6 +309,35 @@ class ShardedOrderingService:
             for fn in listeners:
                 fn(shard_id, affected, new_epoch)
             return affected
+
+    def tick(self, now: int) -> List[str]:
+        """Fault-plan driver: execute every scheduled ``shard.kill``
+        whose virtual tick has arrived (the chaos harness calls this once
+        per step).  The victim is the point's named shard, else the
+        current owner of its named document, else the first live shard —
+        all deterministic under rendezvous routing.  Returns the affected
+        doc ids across all kills this tick."""
+        if self._faults is None:
+            return []
+        affected: List[str] = []
+        for point in self._faults.due("shard.kill", now):
+            if point.shard is not None:
+                victim = point.shard
+            elif point.doc is not None:
+                victim = self.router.owner(point.doc)
+            else:
+                victim = self.router.alive()[0]
+            if (victim in self.router.dead()
+                    or len(self.router.alive()) <= 1):
+                # Unexecutable kill: the victim already died, or it is
+                # the last live shard (unkillable by contract).  Roll the
+                # point's fired mark back so the coverage oracle REPORTS
+                # it unfired, instead of crashing the harness step loop
+                # or silently claiming a failover that never happened.
+                self._faults.mark_unfired(point)
+                continue
+            affected.extend(self.kill_shard(victim))
+        return affected
 
     # -- introspection ---------------------------------------------------------
 

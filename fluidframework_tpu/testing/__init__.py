@@ -5,6 +5,10 @@ test-dds-utils/stochastic-test-utils (SURVEY.md §4; upstream paths UNVERIFIED
 — empty reference mount).
 """
 
+from .faults import FaultError, FaultInjector, FaultPlan, FaultPoint
 from .mocks import MockContainerRuntimeFactory, MockClientRuntime
 
-__all__ = ["MockContainerRuntimeFactory", "MockClientRuntime"]
+__all__ = [
+    "FaultError", "FaultInjector", "FaultPlan", "FaultPoint",
+    "MockContainerRuntimeFactory", "MockClientRuntime",
+]

@@ -54,6 +54,13 @@ class VirtualClock:
         self.now += self.tick
         return self.now
 
+    def sleep(self, seconds: float) -> None:
+        """Virtual sleep: advance time without blocking — the backoff
+        actuator RetryPolicy uses, so a retry schedule is a pure function
+        of the run's decision sequence (the DeltaManager picks this up
+        automatically when its injected clock has a ``sleep``)."""
+        self.now += max(0.0, seconds)
+
 
 @dataclasses.dataclass
 class LoadResult:
@@ -464,6 +471,348 @@ def run_sharded_load(spec: ShardedLoadSpec) -> ShardedLoadResult:
                            if broadcaster is not None else 0),
         broadcast_latencies=latencies,
     )
+
+
+# --- chaos load: mixed traffic under a generated fault schedule --------------
+
+
+@dataclasses.dataclass
+class ChaosLoadSpec:
+    """A deterministic multi-shard schedule driven UNDER a fault plan
+    (testing/faults.py): every seam failure — durable-append outages,
+    torn writes, stale summary serves, laggard clients, a shard kill —
+    is injected by occurrence/tick, so the whole run is a pure function
+    of ``(seed, plan)``.
+
+    The acceptance oracle (:func:`run_chaos_with_oracle`) re-drives the
+    SAME scenario fault-free on a single shard, with the kill's
+    fence-forced reconnects mirrored as scripted voluntary reconnects
+    and the laggard (client-behavior) stalls kept — final per-document
+    summaries must be byte-identical: faults may cost retries, never
+    state."""
+
+    seed: int = 0
+    shards: int = 4
+    docs: int = 6
+    clients_per_doc: int = 2
+    steps: int = 240
+    #: None → ``FaultPlan.generate(seed, docs, steps)``
+    plan: Optional[object] = None
+    #: directory for the durable tier (file-backed oplog + summary
+    #: store); required when the plan carries file-level fault points
+    #: (torn appends, storage store/read faults)
+    dir: Optional[str] = None
+    #: None → a deterministic small-backoff RetryPolicy
+    retry: Optional[object] = None
+    #: one scripted late-join per document (exercises the cold-load /
+    #: stale-summary-serve path mid-run); identical in the oracle twin
+    late_joins: bool = True
+    #: oracle-twin knob: ((step, (doc, ...)), ...) voluntary reconnects
+    #: mirroring the chaos run's fence reconnects
+    scripted_reconnects: tuple = ()
+
+
+@dataclasses.dataclass
+class ChaosLoadResult:
+    per_doc_digest: Dict[str, str]
+    per_doc_head: Dict[str, int]
+    sequenced_ops: int
+    edits: int
+    reconnects: int
+    #: (step, killed shard id, (affected doc, ...)) per executed kill
+    kills: List[tuple]
+    #: injector ``site:kind`` observation counts (replay-identity surface)
+    fault_counts: Dict[str, int]
+    #: summed DeltaManager ``retry.*`` counters across every client
+    retry_counts: Dict[str, int]
+    #: labels of plan points that never fired (must be [] for a run that
+    #: claims its plan's coverage)
+    unfired: List[str]
+    #: virtual ticks from each kill to every affected doc re-converging
+    recovery_ticks: List[float]
+    stalled_steps: int
+
+
+def chaos_doc_ids(docs: int) -> List[str]:
+    """The chaos harness's document naming scheme — public so plan
+    builders (tools/chaos.py, plan files) target real ids; a doc-scoped
+    point naming a nonexistent id would silently never fire."""
+    return [f"chaos-doc-{i:02d}" for i in range(docs)]
+
+
+def _chaos_doc_ids(spec: ChaosLoadSpec) -> List[str]:
+    return chaos_doc_ids(spec.docs)
+
+
+def percentile(sorted_values, q: float) -> float:
+    """Index-clamped percentile over an already-sorted sample — the one
+    shared implementation for every bench reporter (service_e2e, chaos)."""
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1,
+              max(0, int(len(sorted_values) * q) - 1))
+    return sorted_values[idx]
+
+
+def run_chaos_load(spec: ChaosLoadSpec) -> ChaosLoadResult:
+    import os as _os
+
+    from ..drivers.file_driver import FileSummaryStorage
+    from ..protocol.messages import ShardFencedError
+    from ..service.oplog import OpLog
+    from ..service.retry import RetryPolicy
+    from ..service.sharding import ShardedOrderingService
+    from .faults import FaultInjector, FaultPlan
+
+    doc_ids = _chaos_doc_ids(spec)
+    plan = spec.plan if spec.plan is not None \
+        else FaultPlan.generate(spec.seed, doc_ids, spec.steps)
+    wire_sites = [p.site for p in plan.points
+                  if p.site.startswith("rpc.") or p.site == "session.write"]
+    if wire_sites:
+        raise ValueError(
+            f"plan points at {sorted(set(wire_sites))} need the TCP "
+            "stack, which this in-process harness does not drive — they "
+            "would silently never fire and fail the coverage oracle; "
+            "exercise them via tools/chaos.py's tcp_smoke or the "
+            "directed wire tests (tests/test_faultline.py)")
+    file_sites = ("storage.store", "storage.read", "oplog.flush")
+    needs_dir = any(
+        p.site in file_sites or (p.site == "oplog.append"
+                                 and p.kind == "torn")
+        for p in plan.points)
+    if needs_dir and spec.dir is None:
+        raise ValueError(
+            "this plan injects file-level faults (torn appends, flush, "
+            "summary store/read); pass ChaosLoadSpec.dir for the "
+            "durable tier")
+    injector = FaultInjector(plan)
+    rng = random.Random(spec.seed)
+    clock = VirtualClock()
+    retry = spec.retry if spec.retry is not None else RetryPolicy(
+        max_attempts=5, base_delay=0.01, max_delay=0.5, budget=5.0)
+
+    if spec.dir is not None:
+        _os.makedirs(spec.dir, exist_ok=True)
+        # autoflush = the deployed durable-before-broadcast shape (the
+        # standalone server's): every append fsyncs before the
+        # broadcast, so flush faults fire on the real cadence.
+        oplog = OpLog(_os.path.join(spec.dir, "chaos-ops.jsonl"),
+                      autoflush=True, faults=injector)
+        storage = FileSummaryStorage(
+            _os.path.join(spec.dir, "chaos-summaries"), faults=injector)
+    else:
+        oplog, storage = OpLog(faults=injector), None
+    if spec.shards > 1:
+        service = ShardedOrderingService(
+            n_shards=spec.shards, oplog=oplog, storage=storage,
+            faults=injector)
+    else:
+        service = LocalOrderingService(oplog=oplog, storage=storage)
+    factory = LocalDocumentServiceFactory(service)
+    loader = Loader(factory, clock=clock, retry=retry)
+
+    def build(rt):
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+        ds.create_channel("map-tpu", "kv")
+
+    containers: Dict[tuple, object] = {}
+    for doc_id in doc_ids:
+        for c in range(spec.clients_per_doc):
+            cid = f"ch{spec.seed}-{doc_id}-c{c}"
+            if c == 0:
+                containers[(doc_id, c)] = loader.create(doc_id, cid, build)
+            else:
+                containers[(doc_id, c)] = loader.resolve(doc_id, cid)
+
+    # Scripted late-joins: doc i gains a fresh client at a deterministic
+    # step — identical in the oracle twin (scenario, not fault); the cold
+    # resolve is where a stale-summary serve lands mid-run.
+    late_join_step = {}
+    if spec.late_joins:
+        for i, doc_id in enumerate(doc_ids):
+            late_join_step[spec.steps // 3 + 2 * i] = doc_id
+
+    edits = reconnects = stalled_steps = 0
+    kills: List[tuple] = []
+    #: (doc, client index) -> stalled until step (exclusive)
+    stalled: Dict[tuple, int] = {}
+    #: (kill step, t0 virtual, remaining affected docs) under recovery
+    recovering: List[list] = []
+    recovery_ticks: List[float] = []
+
+    def do_edit(container):
+        nonlocal edits
+        ds = container.runtime.get_datastore("ds")
+        if rng.random() < 0.7:
+            text = ds.get_channel("text")
+            n = len(text.text)
+            if n < 4 or rng.random() < 0.7:
+                text.insert_text(rng.randint(0, n),
+                                 rng.choice("abcdef") * rng.randint(1, 3))
+            else:
+                start = rng.randint(0, n - 2)
+                text.remove_range(start, min(n, start + 2))
+        else:
+            ds.get_channel("kv").set(f"k{rng.randint(0, 15)}",
+                                     rng.randint(0, 999))
+        edits += 1
+
+    def reconnect_docs(docs) -> None:
+        nonlocal reconnects
+        for key in sorted(containers):
+            if key[0] in docs:
+                # No explicit service: a fence reconnect re-resolves the
+                # recovered owner through the DeltaManager's retry
+                # (ShardFencedError → on_fence → router); a voluntary
+                # (oracle-twin) reconnect just re-attaches.  Both stamp
+                # the same LEAVE+JOIN.
+                containers[key].reconnect()
+                reconnects += 1
+
+    for step in range(spec.steps):
+        key = (rng.choice(doc_ids), rng.randrange(spec.clients_per_doc))
+        container = containers[key]
+        try:
+            do_edit(container)
+        except ShardFencedError:
+            container.drain()  # self-heal: re-resolve + replay held ops
+        doc_id = late_join_step.get(step)
+        if doc_id is not None:
+            # Mid-run service-side summary at the current durable head
+            # (scenario behavior, identical in the oracle twin: no ops
+            # are stamped).  This is what makes a stale-read fault on
+            # the late join REAL — a lagging replica then serves the
+            # PARENT summary and the joiner replays the longer tail.
+            ro = loader.resolve(doc_id)
+            service.storage.upload(doc_id, ro.runtime.summarize(),
+                                   ro.runtime.ref_seq)
+            ro.close()
+            idx = spec.clients_per_doc
+            containers[(doc_id, idx)] = loader.resolve(
+                doc_id, f"ch{spec.seed}-{doc_id}-c{idx}")
+        for p in injector.due("client.stall", step):
+            victim = (p.doc, 1 % spec.clients_per_doc)
+            stalled[victim] = step + int(p.arg)
+            stalled_steps += int(p.arg)
+        if step % 4 == 3:
+            for ckey in sorted(containers):
+                if stalled.get(ckey, 0) > step:
+                    continue  # laggard: inbound queue grows, no drain
+                containers[ckey].drain()
+        if isinstance(service, ShardedOrderingService):
+            before_dead = set(service.router.dead())
+            affected = service.tick(step)
+            newly_dead = [s for s in service.router.dead()
+                          if s not in before_dead]
+            if newly_dead:
+                kills.append((step, newly_dead[0], tuple(affected)))
+                recovering.append([step, clock.now, set(affected)])
+                reconnect_docs(set(affected))
+        for when, docs in spec.scripted_reconnects:
+            if when == step:
+                reconnect_docs(set(docs))
+        # Recovery metric: a doc counts recovered once every one of its
+        # clients is back at the durable head; the sample is the virtual
+        # ticks elapsed since its shard was killed.
+        for entry in recovering:
+            done = {
+                d for d in entry[2]
+                if all(c.runtime.ref_seq >= service.oplog.head(d)
+                       for k, c in containers.items() if k[0] == d)
+            }
+            for _d in sorted(done):
+                recovery_ticks.append(clock.now - entry[1])
+            entry[2] -= done
+        recovering = [e for e in recovering if e[2]]
+
+    # Quiescence: flush+drain rounds; Container.drain self-heals fences.
+    for _round in range(64):
+        for c in containers.values():
+            c.runtime.flush()
+            c.drain()
+        if all(
+            c.runtime.ref_seq == service.oplog.head(c.doc_id)
+            and not c.runtime._pending_wire
+            and not c.runtime._outbox
+            for c in containers.values()
+        ):
+            break
+    else:
+        raise AssertionError("chaos load never quiesced after 64 rounds")
+
+    # Docs still marked recovering when the step loop ended converged in
+    # the quiescence rounds: sample them at the post-quiescence clock.
+    for entry in recovering:
+        for _d in sorted(entry[2]):
+            recovery_ticks.append(clock.now - entry[1])
+
+    per_doc_digest: Dict[str, str] = {}
+    per_doc_head: Dict[str, int] = {}
+    for doc_id in doc_ids:
+        digests = {
+            c.runtime.summarize().digest()
+            for key, c in containers.items() if key[0] == doc_id
+        }
+        if len(digests) != 1:
+            raise AssertionError(
+                f"{doc_id} diverged: {len(digests)} distinct summaries")
+        per_doc_digest[doc_id] = next(iter(digests))
+        head = service.oplog.head(doc_id)
+        per_doc_head[doc_id] = head
+        seqs = [m.seq for m in service.oplog.get(doc_id)]
+        if seqs != list(range(1, head + 1)):
+            raise AssertionError(
+                f"{doc_id} seq numbers not contiguous under faults: "
+                f"{seqs[:10]}...")
+
+    retry_counts: Dict[str, int] = {}
+    for ckey in sorted(containers):
+        counters = containers[ckey].delta_manager.retry_counters
+        for name, value in sorted(counters.snapshot().items()):
+            retry_counts[name] = retry_counts.get(name, 0) + value
+    return ChaosLoadResult(
+        per_doc_digest=per_doc_digest,
+        per_doc_head=per_doc_head,
+        sequenced_ops=sum(per_doc_head.values()),
+        edits=edits,
+        reconnects=reconnects,
+        kills=kills,
+        fault_counts=injector.snapshot(),
+        retry_counts=retry_counts,
+        unfired=[p.label() for p in injector.unfired()],
+        recovery_ticks=recovery_ticks,
+        stalled_steps=stalled_steps,
+    )
+
+
+def run_chaos_with_oracle(spec: ChaosLoadSpec):
+    """THE acceptance harness: drive ``spec`` under its fault plan, then
+    re-drive the identical scenario FAULT-FREE on a single shard — the
+    kill's fence reconnects mirrored as scripted voluntary reconnects at
+    the same steps (a reconnect stamps the same LEAVE+JOIN either way),
+    laggard stalls kept (client behavior, not a service fault) — and
+    return ``(chaos, oracle)``.  Callers assert per-doc digests/heads
+    byte-identical: the entire fault schedule may cost retries and
+    recoveries, but never state."""
+    from .faults import FaultPlan
+
+    chaos = run_chaos_load(spec)
+    doc_ids = _chaos_doc_ids(spec)
+    plan = spec.plan if spec.plan is not None \
+        else FaultPlan.generate(spec.seed, doc_ids, spec.steps)
+    stall_points = tuple(p for p in plan.points
+                         if p.site == "client.stall")
+    oracle_spec = dataclasses.replace(
+        spec,
+        shards=1,
+        dir=None,  # fault-free: the in-memory durable tier suffices
+        plan=FaultPlan(seed=spec.seed, points=stall_points),
+        scripted_reconnects=tuple(
+            (step, docs) for step, _shard, docs in chaos.kills),
+    )
+    return chaos, run_chaos_load(oracle_spec)
 
 
 # --- wire soak: many docs through the standalone server's catchup RPC --------

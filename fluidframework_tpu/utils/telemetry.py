@@ -16,6 +16,7 @@ import contextlib
 import json
 import os
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -121,6 +122,30 @@ class CounterSet:
 
     def snapshot(self) -> Dict[str, int]:
         return dict(self._counts)
+
+
+class LockedCounterSet(CounterSet):
+    """A :class:`CounterSet` with its own lock: for subsystems whose
+    bumps arrive from several threads with no natural owning lock (the
+    fault injector fires from client threads, the TCP reader, and server
+    executor threads; retry loops bump from any caller).  Snapshot is a
+    consistent point-in-time copy."""
+
+    def __init__(self, *names: str) -> None:
+        super().__init__(*names)
+        self._lock = threading.Lock()
+
+    def bump(self, name: str, by: int = 1) -> int:
+        with self._lock:
+            return super().bump(name, by)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return super().get(name)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return super().snapshot()
 
 
 class ConfigProvider:

@@ -59,6 +59,33 @@ def test_sharding_tier_modules_lint_clean_with_zero_suppressions():
     assert offenders == [], "new modules must stay suppression-free"
 
 
+def test_faultline_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 9 acceptance pin: the fault-injection engine and the retry
+    policy pass ALL module rules (fluidlint + fluidrace + fluidleak
+    families) with zero findings AND zero baseline entries — robustness
+    machinery must hold itself to the discipline it enforces (bounded
+    waits, no swallowed failures, no wall-clock on replay paths)."""
+    new_modules = [
+        "fluidframework_tpu/testing/faults.py",
+        "fluidframework_tpu/service/retry.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "new modules must stay suppression-free"
+
+
+def test_baseline_is_down_to_two_reviewed_entries():
+    """ISSUE 9 satellite pin: PR 9 burned the network_driver
+    FL-RACE-CHECKACT (the epoch-listener sweep now snapshots AND prunes
+    in one critical section); the baseline may only shrink from here."""
+    entries = load_baseline(BASELINE)
+    assert len(entries) <= 2, [e.get("path") for e in entries]
+    assert not any("network_driver" in (e.get("path") or "")
+                   for e in entries)
+
+
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5)

@@ -1,0 +1,289 @@
+"""chaos: run named fault plans against the serving stack and report.
+
+The CLI front end of the faultline engine (ISSUE 9): each named plan is a
+deterministic fault schedule driven through ``run_chaos_with_oracle`` —
+mixed multi-shard traffic under injected durable-append outages, torn
+writes, stale summary serves, laggard clients, and shard kills — and a
+scenario only counts as SURVIVED when the final per-document summaries
+are byte-identical to the fault-free oracle twin, every plan point fired,
+and no retry loop exceeded its budget.
+
+    python -m tools.chaos                         # all plans, 3 seeds
+    python -m tools.chaos --plan kill-quake --seeds 5
+    python -m tools.chaos --out BENCH_chaos_cpu_r09.json
+
+Emits ONE JSON document: per-plan scenarios survived, retries/op, p99
+recovery ticks (virtual — schedule distance, not wall time), fault and
+retry counter totals, plus a TCP smoke section that exercises the wire
+seams (rpc send/recv faults, session-write stall → demotion) against an
+in-thread standalone server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.service.sharding import ShardRouter  # noqa: E402
+from fluidframework_tpu.testing.faults import (  # noqa: E402
+    FaultPlan, FaultPoint,
+)
+from fluidframework_tpu.testing.load import (  # noqa: E402
+    ChaosLoadSpec, chaos_doc_ids, percentile as _percentile,
+    run_chaos_with_oracle,
+)
+
+DOCS = 8
+STEPS = 240
+SHARD_IDS = [f"shard{i:02d}" for i in range(4)]
+
+
+def _doc_ids():
+    return chaos_doc_ids(DOCS)
+
+
+def _two_docs_on_distinct_shards():
+    """Two documents whose rendezvous owners differ — so a double-kill
+    plan really takes down two shards."""
+    router = ShardRouter(SHARD_IDS)
+    docs = _doc_ids()
+    first = docs[0]
+    for other in docs[1:]:
+        if router.owner(other) != router.owner(first):
+            return first, other
+    return first, docs[-1]
+
+
+def build_plan(name: str, seed: int) -> FaultPlan:
+    docs = _doc_ids()
+    if name == "mixed":
+        return FaultPlan.generate(seed, docs, STEPS)
+    if name == "append-storm":
+        points = []
+        for i, doc in enumerate(docs):
+            points.append(FaultPoint("oplog.append", "fail", doc=doc,
+                                     at=2 + i, count=2))
+        points.append(FaultPoint("oplog.append", "torn", at=10, arg=0.3))
+        points.append(FaultPoint("oplog.append", "torn", at=40, arg=0.7))
+        points.append(FaultPoint("oplog.flush", "skip_fsync", at=5))
+        return FaultPlan(seed=seed, points=tuple(points))
+    if name == "kill-quake":
+        a, b = _two_docs_on_distinct_shards()
+        return FaultPlan(seed=seed, points=(
+            FaultPoint("shard.kill", "kill", doc=a, at=STEPS // 3),
+            FaultPoint("shard.kill", "kill", doc=b, at=2 * STEPS // 3),
+            FaultPoint("oplog.append", "fail", doc=a, at=3),
+        ))
+    if name == "laggard-town":
+        points = [
+            FaultPoint("client.stall", "stall", doc=doc,
+                       at=STEPS // 4 + 3 * i, arg=8.0)
+            for i, doc in enumerate(docs[:4])
+        ]
+        # windowed so the LATE JOIN's load is really served stale (see
+        # FaultPlan.generate — at=1 alone fires vacuously at setup)
+        points.append(FaultPoint("storage.read", "stale", doc=docs[0],
+                                 at=1, count=3))
+        return FaultPlan(seed=seed, points=tuple(points))
+    raise SystemExit(f"unknown plan {name!r} (have: {', '.join(PLANS)})")
+
+
+PLANS = ("mixed", "append-storm", "kill-quake", "laggard-town")
+
+
+def load_plan_file(path: str, seed: int) -> FaultPlan:
+    """A plan file is JSON: ``{"points": [{"site": ..., "kind": ...,
+    "at": N, "count": N, "doc": ..., "shard": ..., "arg": X}, ...]}`` —
+    unknown sites/kinds fail loudly via FaultPoint.validate."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    points = tuple(
+        FaultPoint(
+            site=p["site"], kind=p["kind"], at=int(p.get("at", 1)),
+            count=int(p.get("count", 1)), doc=p.get("doc"),
+            shard=p.get("shard"), arg=float(p.get("arg", 0.0)),
+        )
+        for p in doc.get("points", ())
+    )
+    return FaultPlan(seed=doc.get("seed", seed), points=points)
+
+
+def run_plan(name: str, seeds: int, workdir: str,
+             plan_file: str = None) -> dict:
+    survived = 0
+    recovery: list = []
+    fault_totals: dict = {}
+    retry_totals: dict = {}
+    ops = retries = 0
+    failures: list = []
+    for seed in range(seeds):
+        spec = ChaosLoadSpec(
+            seed=seed, shards=4, docs=DOCS, clients_per_doc=2,
+            steps=STEPS,
+            plan=(load_plan_file(plan_file, seed) if plan_file
+                  else build_plan(name, seed)),
+            dir=os.path.join(workdir, f"{name}-{seed}"),
+        )
+        chaos, oracle = run_chaos_with_oracle(spec)
+        ok = (chaos.per_doc_digest == oracle.per_doc_digest
+              and chaos.per_doc_head == oracle.per_doc_head
+              and chaos.unfired == [])
+        if ok:
+            survived += 1
+        else:
+            failures.append({
+                "seed": seed,
+                "digest_match": chaos.per_doc_digest == oracle.per_doc_digest,
+                "unfired": chaos.unfired,
+            })
+        recovery.extend(chaos.recovery_ticks)
+        ops += chaos.sequenced_ops
+        retries += chaos.retry_counts.get("retry.retries", 0)
+        for k, v in sorted(chaos.fault_counts.items()):
+            fault_totals[k] = fault_totals.get(k, 0) + v
+        for k, v in sorted(chaos.retry_counts.items()):
+            retry_totals[k] = retry_totals.get(k, 0) + v
+    recovery.sort()
+    return {
+        "scenarios": seeds,
+        "survived": survived,
+        "failures": failures,
+        "sequenced_ops": ops,
+        "retries_per_op": round(retries / ops, 5) if ops else 0.0,
+        "budget_exhaustions": retry_totals.get("retry.exhausted", 0),
+        "recovery_samples": len(recovery),
+        "recovery_ticks_p50": round(_percentile(recovery, 0.50), 4),
+        "recovery_ticks_p99": round(_percentile(recovery, 0.99), 4),
+        "fault_counts": fault_totals,
+        "retry_counts": retry_totals,
+    }
+
+
+def tcp_smoke() -> dict:
+    """One wire scenario against an in-thread standalone server: client
+    rpc send failures (retried), a duplicated and a delayed broadcast
+    frame (watermark dedup + park/repair), and a server-side
+    session-write stall (demotion → backfill-from-oplog)."""
+    from fluidframework_tpu.drivers.network_driver import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader.delta_manager import DeltaManager
+    from fluidframework_tpu.protocol.messages import (MessageType,
+                                                      RawOperation)
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.orderer import LocalOrderingService
+    from fluidframework_tpu.service.retry import RetryPolicy
+    from fluidframework_tpu.service.server import OrderingServer
+    from fluidframework_tpu.testing.faults import FaultInjector
+
+    server_faults = FaultInjector(FaultPlan(points=(
+        FaultPoint("session.write", "stall", at=2, count=2),)))
+    server = OrderingServer(LocalOrderingService(), port=0,
+                            faults=server_faults)
+    server.start_in_thread()
+    client_faults = FaultInjector(FaultPlan(points=(
+        FaultPoint("rpc.send", "fail", at=4, count=2),
+        FaultPoint("rpc.recv", "duplicate", doc="smoke", at=3),
+        FaultPoint("rpc.recv", "delay", doc="smoke", at=5),
+    )))
+    factory = NetworkDocumentServiceFactory(
+        port=server.port, faults=client_faults,
+        retry=RetryPolicy(max_attempts=4, base_delay=0.01))
+    try:
+        runtime = ContainerRuntime()
+        runtime.create_datastore("ds")
+        doc = factory.create_document("smoke", runtime.summarize())
+        conn = doc.connection()
+        dm = DeltaManager(factory.resolve("smoke"))
+        dm.connect("cA")
+        dm.note_delivered(doc.delta_storage.head())
+        got = []
+        dm.subscribe(lambda m: got.append(m.seq))
+        ref = conn.head_seq
+        for i in range(10):
+            ref = conn.submit(RawOperation(
+                client_id="cA", client_seq=i + 1, ref_seq=ref,
+                type=MessageType.OP, contents={"i": i})).seq
+        deadline = time.time() + 15
+        while time.time() < deadline and dm.last_delivered_seq < ref:
+            time.sleep(0.02)
+        return {
+            "converged": dm.last_delivered_seq == ref,
+            "in_order": got == sorted(set(got)),
+            "demotions": server.broadcaster.counters.get("demotions"),
+            "client_demotions_seen": conn.demotions_seen,
+            "rpc_retries": factory._rpc.retry_counters.get("retry.retries"),
+            "unfired_client": [p.label()
+                               for p in client_faults.unfired()],
+            "unfired_server": [p.label()
+                               for p in server_faults.unfired()],
+        }
+    finally:
+        factory.close()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="run named fault plans against the serving stack")
+    parser.add_argument("--plan", choices=PLANS + ("all",), default="all")
+    parser.add_argument("--plan-file", default=None,
+                        help="run a custom JSON fault plan instead of "
+                             "the named ones")
+    parser.add_argument("--seeds", type=int, default=3)
+    parser.add_argument("--out", default=None,
+                        help="write the JSON report here (default stdout)")
+    parser.add_argument("--no-tcp", action="store_true",
+                        help="skip the TCP smoke section")
+    args = parser.parse_args(argv)
+
+    t0 = time.time()
+    plans = PLANS if args.plan == "all" else (args.plan,)
+    if args.plan_file:
+        plans = (os.path.basename(args.plan_file),)
+    report: dict = {
+        "bench": "chaos",
+        "platform": "cpu",
+        "docs": DOCS,
+        "steps": STEPS,
+        "shards": 4,
+        "seeds_per_plan": args.seeds,
+        "plans": {},
+    }
+    with tempfile.TemporaryDirectory(prefix="fluid-chaos-") as workdir:
+        for name in plans:
+            plan_t0 = time.time()
+            result = run_plan(name, args.seeds, workdir,
+                              plan_file=args.plan_file)
+            result["wall_sec"] = round(time.time() - plan_t0, 3)
+            report["plans"][name] = result
+            print(f"{name}: {result['survived']}/{result['scenarios']} "
+                  f"survived, {result['retries_per_op']} retries/op, "
+                  f"p99 recovery {result['recovery_ticks_p99']} ticks",
+                  file=sys.stderr)
+    if not args.no_tcp:
+        report["tcp_smoke"] = tcp_smoke()
+        print(f"tcp_smoke: converged={report['tcp_smoke']['converged']} "
+              f"demotions={report['tcp_smoke']['demotions']}",
+              file=sys.stderr)
+    report["total_survived"] = sum(
+        p["survived"] for p in report["plans"].values())
+    report["total_scenarios"] = sum(
+        p["scenarios"] for p in report["plans"].values())
+    report["wall_sec"] = round(time.time() - t0, 3)
+    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()

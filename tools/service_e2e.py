@@ -45,11 +45,9 @@ SHARD_STEPS = int(os.environ.get("SVC_SHARD_STEPS", "2000"))
 SHARD_SINKS = int(os.environ.get("SVC_SHARD_SINKS", "2"))
 
 
-def _percentile(sorted_values, q: float) -> float:
-    if not sorted_values:
-        return 0.0
-    idx = min(len(sorted_values) - 1, max(0, int(len(sorted_values) * q) - 1))
-    return sorted_values[idx]
+from fluidframework_tpu.testing.load import (  # noqa: E402
+    percentile as _percentile,
+)
 
 
 def shard_bench() -> None:
