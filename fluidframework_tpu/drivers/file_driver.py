@@ -205,17 +205,29 @@ class FileSummaryStorage(SummaryStorage):
     # -- lazy reads from disk (latest() inherits these via read()) -------------
 
     def read(self, handle: str) -> Union[SummaryTree, SummaryBlob]:
-        # Same guarded-by: _lock discipline as the base class (fluidrace)
-        # for the memo dict — but the disk read happens OUTSIDE the lock:
-        # holding the store-wide lock across I/O would serialize every
-        # head()/upload() behind one cold load.  Content-addressing makes
-        # the race benign: two threads loading the same handle produce
-        # identical nodes, and setdefault keeps exactly one.
-        with self._lock:
-            cached = self._objects.get(handle)
+        # Probe / load / publish, each a SINGLE critical section (the
+        # begin/publish shape of the orderer's single-flight recovery):
+        # the disk read happens OUTSIDE the lock — holding the store-wide
+        # lock across I/O would serialize every head()/upload() behind
+        # one cold load.  Content-addressing makes the load race benign:
+        # two threads loading the same handle produce identical nodes,
+        # and the publish's setdefault atomically re-validates so exactly
+        # one survives.
+        cached = self._probe_memo(handle)
         if cached is not None:
             return cached
-        node = self._load_from_disk(handle)
+        return self._publish_memo(handle, self._load_from_disk(handle))
+
+    def _probe_memo(self, handle: str
+                    ) -> Optional[Union[SummaryTree, SummaryBlob]]:
+        with self._lock:
+            return self._objects.get(handle)
+
+    def _publish_memo(self, handle: str,
+                      node: Union[SummaryTree, SummaryBlob]
+                      ) -> Union[SummaryTree, SummaryBlob]:
+        """One atomic claim: install-or-adopt — a racing duplicate load
+        loses to whichever byte-identical node published first."""
         with self._lock:
             return self._objects.setdefault(handle, node)
 

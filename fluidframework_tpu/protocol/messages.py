@@ -91,6 +91,31 @@ class ShardFencedError(ConnectionError):
         self.doc_id = doc_id
 
 
+class BatchAbortedError(ConnectionError):
+    """A batched submit (``Sequencer.submit_many``) stopped partway.
+
+    Ops ``[0, consumed)`` of the batch were fully handled — ``stamped``
+    holds their sequenced messages (dedup'd duplicates excluded) and they
+    are durable; the op at ``consumed`` failed with ``cause`` and every
+    later op was left untouched.  The recovery contract is the same as a
+    client reconnect: resubmit the WHOLE batch after the failure clears —
+    the sequencer's per-client dedup floors absorb the stamped prefix, so
+    a blanket resubmit can never double-sequence.
+
+    Subclasses ConnectionError so callers that treat batched ingress like
+    any transport (keep the ops queued, retry later) need no special case.
+    """
+
+    def __init__(self, consumed: int, stamped: list,
+                 cause: BaseException) -> None:
+        super().__init__(
+            f"batched submit aborted at op {consumed}: {cause!r}"
+        )
+        self.consumed = consumed
+        self.stamped = stamped
+        self.cause = cause
+
+
 class RetryBudgetExhaustedError(ConnectionError):
     """A bounded retry loop gave up: the policy's attempt count or delay
     budget ran out before the operation succeeded.

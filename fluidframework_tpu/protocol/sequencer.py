@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional
 
 from .messages import (
     INITIAL_SEQ,
+    BatchAbortedError,
     MessageType,
     NackError,
     RawOperation,
@@ -124,6 +125,44 @@ class Sequencer:
             raise
         return conn
 
+    def connect_many(self, client_ids: List[str],
+                     session: Optional[str] = None) -> None:
+        """Batch JOIN: admit ``client_ids`` in order with one MSN
+        recomputation at the end instead of one per JOIN — connecting N
+        clients sequentially is O(N²) in the per-stamp min-scan, which is
+        what makes a 10⁵-client ramp phase unaffordable one at a time.
+        Each JOIN message carries the batch-start MSN (conservative, same
+        argument as :meth:`submit_many`).  Semantics are otherwise
+        exactly N :meth:`connect` calls: same-session reconnects resume,
+        stale records are dropped via LEAVE+JOIN."""
+        try:
+            for client_id in client_ids:
+                existing = self._clients.get(client_id)
+                if existing is not None:
+                    if session is not None and existing.session == session:
+                        continue
+                    self.disconnect(client_id)
+                conn = ClientConnection(client_id=client_id,
+                                        ref_seq=self._seq, session=session)
+                self._clients[client_id] = conn
+                try:
+                    self._stamp(
+                        client_id=None,
+                        client_seq=-1,
+                        ref_seq=self._seq,
+                        type_=MessageType.JOIN,
+                        contents={"clientId": client_id},
+                        recompute_msn=False,
+                    )
+                except BaseException:
+                    # Same unwind discipline as connect(): an un-stamped
+                    # JOIN must not leave the client in the quorum.
+                    if self._last_stamp_unwound:
+                        self._clients.pop(client_id, None)
+                    raise
+        finally:
+            self._recompute_min_seq()
+
     def disconnect(self, client_id: str) -> None:
         """Remove a client from the quorum; emits LEAVE and recomputes MSN."""
         if client_id not in self._clients:
@@ -155,6 +194,45 @@ class Sequencer:
         (already-sequenced client_seq, e.g. a redundant resubmit after
         reconnect).
         """
+        return self._submit_one(op, recompute_msn=True)
+
+    def submit_many(self, ops: List[RawOperation]
+                    ) -> List[SequencedMessage]:
+        """Batch ticket(): sequence ``ops`` in order with ONE MSN
+        recomputation for the whole batch instead of one per op — the
+        per-op O(connected clients) min-scan is what caps single-op
+        ingress at swarm populations.
+
+        Each message is stamped with the MSN as of the batch START (the
+        monotone floor is conservative: it may lag by one batch, which
+        only delays zamboni collection — it can never exceed a live
+        client's view).  Validation (connection, dedup, throttle, stale
+        view) is per op and identical to :meth:`submit`; duplicates are
+        skipped, not returned.  A failure mid-batch recomputes the MSN
+        over what landed and raises :class:`BatchAbortedError` carrying
+        the stamped prefix — the caller resubmits the whole batch after
+        recovery and dedup absorbs the prefix.
+        """
+        stamped: List[SequencedMessage] = []
+        consumed = 0
+        try:
+            for op in ops:
+                msg = self._submit_one(op, recompute_msn=False)
+                if msg is not None:
+                    stamped.append(msg)
+                consumed += 1
+        except BaseException as err:
+            self._recompute_min_seq()
+            if not isinstance(err, Exception):
+                # KeyboardInterrupt/SystemExit must never be converted
+                # into a per-document outcome a retry loop would swallow.
+                raise
+            raise BatchAbortedError(consumed, stamped, err) from err
+        self._recompute_min_seq()
+        return stamped
+
+    def _submit_one(self, op: RawOperation,
+                    recompute_msn: bool) -> Optional[SequencedMessage]:
         conn = self._clients.get(op.client_id)
         if conn is None:
             raise ValueError(f"client {op.client_id!r} is not connected")
@@ -186,6 +264,7 @@ class Sequencer:
                 ref_seq=op.ref_seq,
                 type_=op.type,
                 contents=op.contents,
+                recompute_msn=recompute_msn,
             )
         except BaseException:
             # A failed stamp that UNWOUND (durable append refused the
@@ -319,11 +398,17 @@ class Sequencer:
         ref_seq: int,
         type_: MessageType,
         contents,
+        recompute_msn: bool = True,
     ) -> SequencedMessage:
+        """``recompute_msn=False`` is the batch path (submit_many /
+        connect_many): the message carries the current monotone MSN and
+        the caller recomputes once per batch — a conservative floor, not
+        a stale one."""
         self._last_stamp_unwound = False
         prev_min_seq = self._min_seq
         self._seq += 1
-        self._recompute_min_seq()
+        if recompute_msn:
+            self._recompute_min_seq()
         msg = SequencedMessage(
             seq=self._seq,
             client_id=client_id,

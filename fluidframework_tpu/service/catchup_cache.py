@@ -236,17 +236,26 @@ class CatchupResultCache:
                 return None  # probe only: begin() counts the miss
             self.counters.bump("waits")
         if not flight.done.wait(timeout):
-            with self._lock:
-                if self._flights.get(key) is flight:
-                    self._flights.pop(key)
-                    # set() only for the flight this caller reaped: when
-                    # the guard fails, whoever popped it (finish/abandon/
-                    # another reaper) sets the event once the result is
-                    # in place — setting it here would wake the other
-                    # waiters to result=None on a COMPLETED fold.
-                    flight.done.set()
+            self._reap_flight(key, flight)
             return None
         return flight.result
+
+    def _reap_flight(self, key: tuple, flight: _Flight) -> None:
+        """A waiter timed out: presume the leader crashed without its
+        finally-abandon and remove the flight — one critical section,
+        identity-guarded: the re-validation pops the flight only if it is
+        still THE object this waiter waited on, so a fresh leader's
+        flight is never reaped (pinned by
+        test_join_timeout_pop_is_identity_guarded)."""
+        with self._lock:
+            if self._flights.get(key) is flight:
+                self._flights.pop(key)
+                # set() only for the flight this caller reaped: when the
+                # guard fails, whoever popped it (finish/abandon/another
+                # reaper) sets the event once the result is in place —
+                # setting it here would wake the other waiters to
+                # result=None on a COMPLETED fold.
+                flight.done.set()
 
     # -- epoch invalidation ----------------------------------------------------
 

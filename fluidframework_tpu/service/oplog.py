@@ -22,6 +22,7 @@ reopen repairs it (``repair_jsonl_tail``) before reads or appends resume.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import os
@@ -49,6 +50,10 @@ class OpLog:
         self._path = path
         self._autoflush = autoflush
         self._faults = faults
+        #: >0 while inside batch(): per-append autoflush is deferred to
+        #: ONE flush at outermost batch exit (group commit)
+        self._batch_depth = 0
+        self._batch_dirty = False
         self._file: Optional[io.TextIOWrapper] = None
         if path is not None:
             # The op log is the highest-write-rate file in the store: a
@@ -99,11 +104,20 @@ class OpLog:
             try:
                 self._file.write(line)
                 if self._autoflush:
-                    # Durable-before-broadcast: the append rides first in
-                    # the sequencer broadcast chain, so flushing here means
-                    # no client ever sees an op the log could lose (the
-                    # reference's scriptorium-durability property).
-                    self.flush()
+                    if self._batch_depth:
+                        # Group commit (batched ingress): defer the fsync
+                        # to the single flush at batch() exit — see the
+                        # SEMANTICS.md batched-ingress note for what this
+                        # weakens (in-process subscribers may observe a
+                        # record before the batch's fsync lands).
+                        self._batch_dirty = True
+                    else:
+                        # Durable-before-broadcast: the append rides first
+                        # in the sequencer broadcast chain, so flushing
+                        # here means no client ever sees an op the log
+                        # could lose (the reference's scriptorium-
+                        # durability property).
+                        self.flush()
             except OSError:
                 # Exception safety: the record is not durable, so it must
                 # not stay visible in memory either — a retry would be
@@ -150,6 +164,29 @@ class OpLog:
             repair_jsonl_tail(self._path)
         except OSError:
             pass
+
+    @contextlib.contextmanager
+    def batch(self):
+        """Group commit: appends inside the block skip their per-append
+        autoflush; the outermost exit pays ONE flush (fsync) for the whole
+        batch — the per-batch durability point of the batched ingress
+        surface (``ShardedOrderingService.submit_many``).  Exception-safe:
+        a batch that aborts partway still flushes the records that landed
+        (they were broadcast; they must not be losable), and a FAILED
+        deferred flush keeps the batch marked dirty — the records' bytes
+        were already written to the file object, so the next successful
+        flush (a later batch exit, an explicit ``flush()``, or ``close``)
+        makes them durable; the failure itself propagates so no caller
+        mistakes the batch for committed.  Nests: inner batches defer to
+        the outermost.  In-memory logs (no file) make this a no-op."""
+        self._batch_depth += 1
+        try:
+            yield self
+        finally:
+            self._batch_depth -= 1
+            if self._batch_depth == 0 and self._batch_dirty:
+                self.flush()
+                self._batch_dirty = False
 
     def flush(self) -> None:
         if self._file is not None:

@@ -15,16 +15,68 @@ state (exactly-once: ``replay`` never re-stamps).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..protocol.messages import RawOperation, SequencedMessage, ShardFencedError
+from ..protocol.messages import (BatchAbortedError, RawOperation,
+                                 SequencedMessage, ShardFencedError)
 from ..protocol.sequencer import Sequencer
 from ..protocol.summary import SummaryStorage
 from .oplog import OpLog
 from .scribe import Scribe
 
 SignalListener = Callable[[dict], None]
+
+
+@dataclasses.dataclass
+class SubmitOutcome:
+    """Per-document result of a batched submit (``submit_many``).
+
+    ``stamped`` holds the sequenced messages (duplicates dedup'd away);
+    ``consumed`` counts ops fully handled (stamped OR dedup'd) — on
+    success it equals the batch length.  ``error`` is the underlying
+    failure when the batch stopped early (fence, injected append fault):
+    ops ``[consumed:]`` were untouched, and the recovery contract is a
+    whole-batch resubmit once the failure clears (dedup absorbs the
+    stamped prefix)."""
+
+    stamped: List[SequencedMessage]
+    consumed: int
+    error: Optional[BaseException] = None
+
+
+def submit_batches(service, batches: Dict[str, List[RawOperation]]
+                   ) -> Dict[str, "SubmitOutcome"]:
+    """THE batched-ingress loop, shared by both services' ``submit_many``:
+    documents in sorted order, each through ``service.endpoint(doc)``'s
+    batch stamping, the whole call under ONE durable-log flush (group
+    commit over the shared ``service.oplog``).  Failures are isolated per
+    document — a fenced or faulted document reports its
+    :class:`SubmitOutcome.error` while every other document's batch lands
+    normally; the caller resubmits the failed documents' whole batches
+    after recovery (dedup absorbs stamped prefixes).  Per-document
+    sequencers make cross-document order irrelevant to the stamped bytes,
+    so sorted-by-doc is both deterministic and sufficient."""
+    out: Dict[str, SubmitOutcome] = {}
+    with service.oplog.batch():
+        for doc_id in sorted(batches):
+            ops = batches[doc_id]
+            try:
+                stamped = service.endpoint(doc_id).submit_batch(ops)
+            except BatchAbortedError as err:
+                out[doc_id] = SubmitOutcome(
+                    stamped=err.stamped, consumed=err.consumed,
+                    error=err.cause)
+            except (ConnectionError, OSError, KeyError) as err:
+                # Fence fast-fail / unrecovered document: nothing of this
+                # batch was consumed.
+                out[doc_id] = SubmitOutcome(stamped=[], consumed=0,
+                                            error=err)
+            else:
+                out[doc_id] = SubmitOutcome(stamped=stamped,
+                                            consumed=len(ops))
+    return out
 
 #: bound for a recovery follower's wait on the leading replay (the same
 #: crashed-leader discipline as CatchupResultCache.DEFAULT_JOIN_TIMEOUT:
@@ -78,6 +130,17 @@ class DocumentOrderer:
             if self.fenced:
                 raise ShardFencedError(self.doc_id)
             self.oplog.append(self.doc_id, msg)
+
+    def submit_batch(self, ops: List[RawOperation]
+                     ) -> List[SequencedMessage]:
+        """Batch stamping: the whole batch sequences through
+        ``Sequencer.submit_many`` (one MSN recomputation); each message
+        still rides the durable-append-first broadcast chain.  The
+        one-flush-per-batch group commit lives one level up, in the
+        services' ``submit_many`` (the flush is a property of the SHARED
+        log, not of one document).  Raises :class:`BatchAbortedError` on
+        a mid-batch failure."""
+        return self.sequencer.submit_many(ops)
 
     def fence(self) -> None:
         """Mark this orderer dead (shard failover): every later stamp
@@ -215,6 +278,18 @@ class DocumentEndpoint:
         if self._orderer.fenced:
             raise ShardFencedError(self.doc_id)
         return self._orderer.sequencer.submit(op)
+
+    def submit_batch(self, ops: List[RawOperation]
+                     ) -> List[SequencedMessage]:
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
+        return self._orderer.submit_batch(ops)
+
+    def connect_many(self, client_ids: List[str],
+                     session: Optional[str] = None) -> None:
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
+        self._orderer.sequencer.connect_many(client_ids, session)
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         self._orderer.sequencer.subscribe(fn)
@@ -404,6 +479,13 @@ class LocalOrderingService:
             # reap the dead flight (identity-guarded) and re-claim.
             if not val.done.wait(RECOVERY_JOIN_TIMEOUT):
                 self._recover_reap(doc_id, val)
+
+    def submit_many(self, batches: Dict[str, List[RawOperation]]
+                    ) -> Dict[str, SubmitOutcome]:
+        """Batched ingress — see :func:`submit_batches` (the swarm-scale
+        submit surface: per-document batch stamping, one durable flush,
+        per-document failure isolation)."""
+        return submit_batches(self, batches)
 
     def doc_ids(self) -> List[str]:
         with self.state_lock:

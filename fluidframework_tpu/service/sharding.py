@@ -45,9 +45,11 @@ import hashlib
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..protocol.messages import RawOperation
 from ..protocol.summary import SummaryStorage
 from .oplog import OpLog
-from .orderer import DocumentEndpoint, DocumentOrderer, LocalOrderingService
+from .orderer import (DocumentEndpoint, DocumentOrderer,
+                      LocalOrderingService, SubmitOutcome, submit_batches)
 
 #: fence listener: (dead shard id, affected doc ids, new storage epoch)
 FenceListener = Callable[[str, List[str], str], None]
@@ -218,6 +220,18 @@ class ShardedOrderingService:
                 return owner.create_document(doc_id)
             except ValueError:
                 return owner.endpoint(doc_id)  # lost a benign create race
+
+    def submit_many(self, batches: Dict[str, List[RawOperation]]
+                    ) -> Dict[str, SubmitOutcome]:
+        """Batched ingress across the shard tier — see
+        :func:`~fluidframework_tpu.service.orderer.submit_batches`: the
+        per-document ``endpoint()`` route lands each batch on its
+        rendezvous owner (one MSN recomputation per doc batch) and the
+        whole call pays ONE flush of the shared durable log.  A document
+        whose owner died re-routes and recovers lazily inside
+        ``endpoint()``, so the NEXT submit after a failover lands on the
+        recovered owner with no caller-side special case."""
+        return submit_batches(self, batches)
 
     def doc_ids(self) -> List[str]:
         ids = set(self.oplog.doc_ids())

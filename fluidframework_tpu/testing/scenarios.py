@@ -1,0 +1,856 @@
+"""fluidscale: a vectorized 10⁵–10⁶-client scenario engine over the REAL
+serving stack (ISSUE 10).
+
+``testing/load.py`` drives dozens of puppet clients, each a full
+Container + DeltaManager — which makes the north star's "millions of
+users" claim unfalsifiable: nothing could ever drive enough clients to
+measure it.  This module simulates swarm populations **columnar**: all
+per-client state (document assignment, op cadence, next-fire tick,
+connect / laggard / catch-up state, consumption cursor) lives in numpy
+arrays stepped O(population) per virtual tick, while every generated op
+is submitted through the *real* path — the sharded ordering tier's
+batched ingress (``ShardedOrderingService.submit_many`` → per-document
+batch stamping → one durable-log flush per batch), the serialize-once
+:class:`~fluidframework_tpu.service.broadcaster.Broadcaster`, and the
+durable :class:`~fluidframework_tpu.service.oplog.OpLog`.  Nothing in
+the serving path is mocked; only the CLIENTS are virtual.
+
+Determinism (see SEMANTICS.md "Swarm determinism"): a run is a pure
+function of ``(seed, spec)`` — op content and cadence come from counter-
+based hash mixing, consumption is modeled in virtual ticks, faults are
+``FaultPlan``-scheduled, and the single-threaded step loop gives the
+batched ingress a deterministic submission order.  Replaying the same
+spec reproduces every metric, fault observation, and telemetry counter
+bit-identically.
+
+The acceptance oracle (:func:`run_swarm_with_oracle`) re-drives the SAME
+scenario fault-free on a single shard, mirroring any batch deferrals the
+faulted run recorded (``scripted_defers``) so both runs stamp
+byte-identical per-document logs — final summaries of sampled documents,
+loaded through the real Loader, must match byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..drivers import LocalDocumentServiceFactory
+from ..loader import Loader
+from ..service import LocalOrderingService
+from ..service.broadcaster import Broadcaster
+from ..service.oplog import OpLog
+from ..service.sharding import ShardedOrderingService
+from ..protocol.messages import MessageType, RawOperation
+from ..runtime.op_pipeline import BATCH_WIRE_VERSION
+from ..utils.telemetry import CounterSet
+from .faults import FaultInjector, FaultPlan, FaultPoint
+from .load import VirtualClock, percentile
+
+# -- client states (int8 column) ----------------------------------------------
+
+_UNBORN = 0     # not yet connected (pre-ramp)
+_STEADY = 1     # connected, typing and draining on its fire cadence
+_DARK = 2       # herd cohort: neither submits nor drains (gone dark)
+_LAGGARD = 3    # keeps typing against a FROZEN view; never drains
+_CATCHUP = 4    # draining a backlog at catchup_rate ops/tick
+
+
+def _u64(x) -> np.uint64:
+    return np.uint64(x & 0xFFFFFFFFFFFFFFFF)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over a uint64 array: the counter-based hash
+    every swarm decision draws from — no PRNG object state, so any
+    (client, op index) decision is recomputable from the seed alone."""
+    x = (x ^ (x >> np.uint64(30))) * _u64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * _u64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _hash_clients(seed: int, salt: int, idx: np.ndarray,
+                  extra: Optional[np.ndarray] = None) -> np.ndarray:
+    h = (idx.astype(np.uint64) * _u64(0x9E3779B97F4A7C15)
+         + _u64(seed * 0x100000001B3 + salt * 0xD1B54A32D192ED03 + 1))
+    if extra is not None:
+        h = h + extra.astype(np.uint64) * _u64(0xA0761D6478BD642F)
+    return _mix64(h)
+
+
+# -- scenario DSL -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One scenario phase.  ``kind``:
+
+    - ``ramp``     — the population connects, spread over the phase
+      (batched JOINs through ``connect_many``);
+    - ``steady``   — steady typing traffic on per-client cadences;
+    - ``herd``     — ``frac`` of the steady population goes DARK for the
+      phase, then re-enters together as a catch-up herd at its end;
+    - ``laggards`` — ``frac`` get individual stop-draining windows inside
+      the phase (they keep typing against frozen views — the MSN-pinning
+      shape), each recovering through a catch-up burst;
+    - ``election`` — instant event (``ticks`` may be 0): a service-side
+      summarizer loads each sampled document at the durable head and
+      uploads a summary (the summary-election capability at scale).
+    """
+
+    kind: str
+    ticks: int = 0
+    frac: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("ramp", "steady", "herd", "laggards",
+                             "election"):
+            raise ValueError(f"unknown phase kind {self.kind!r}")
+        if self.ticks < 0 or not (0.0 <= self.frac <= 1.0):
+            raise ValueError(f"bad phase {self}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """A fully deterministic swarm scenario: the run is a pure function
+    of this value (``seed`` included)."""
+
+    name: str
+    phases: Tuple[Phase, ...]
+    seed: int = 0
+    clients: int = 1000
+    docs: int = 16
+    shards: int = 4
+    #: mean client ops over the whole run; op cadence is derived from it
+    #: (total ops ≈ clients × ops_per_client, independent of population)
+    ops_per_client: float = 3.0
+    #: ops a catching-up client consumes per tick
+    catchup_rate: int = 256
+    #: every Nth document is sampled for elections + the digest oracle
+    sample_every: int = 8
+    #: scheduled faults (shard kills, durable-append outages) driven at
+    #: virtual ticks through testing/faults.py
+    plan: Optional[FaultPlan] = None
+    #: oracle-twin knob: ``((tick, doc_index, consumed), ...)`` — split
+    #: that document's tick batch at ``consumed`` and defer the whole
+    #: batch to the next tick, mirroring a faulted run's recorded
+    #: deferrals so both runs stamp identical logs
+    scripted_defers: Tuple[tuple, ...] = ()
+    #: same mirror for batched JOINs: ``((tick, doc_index, joined), ...)``
+    #: — connect only the first ``joined`` clients of that document's
+    #: tick cohort, the rest re-try next tick
+    scripted_join_defers: Tuple[tuple, ...] = ()
+    #: directory for a durable file-backed op log (None = in-memory);
+    #: group commit makes the fsync cost one flush per tick batch
+    dir: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.clients < self.docs:
+            raise ValueError("need at least one client per document")
+        if self.docs < 1 or self.shards < 1:
+            raise ValueError(f"bad docs/shards on {self.name!r}")
+
+    @property
+    def ticks(self) -> int:
+        return sum(p.ticks for p in self.phases)
+
+    def doc_id(self, d: int) -> str:
+        return f"sw-{d:04d}"
+
+
+@dataclasses.dataclass
+class SwarmResult:
+    """Everything a run measures — all of it deterministic, so the whole
+    value doubles as the replay-identity surface."""
+
+    name: str
+    seed: int
+    clients: int
+    docs: int
+    shards: int
+    ticks: int
+    #: sequenced messages across all documents (JOIN/LEAVE included)
+    sequenced_ops: int
+    #: client OP messages stamped / submitted / dedup'd on resubmit
+    ops_stamped: int
+    ops_submitted: int
+    ops_deduped: int
+    joins: int
+    #: virtual-tick latency until the SLOWEST steady client consumed a
+    #: message (per sequenced message)
+    delivery_p50_ticks: float
+    delivery_p99_ticks: float
+    delivery_samples: int
+    #: virtual ticks from catch-up start to reaching the head
+    catchup_p50_ticks: float
+    catchup_p99_ticks: float
+    catchup_samples: int
+    #: deepest head-minus-cursor backlog any client reached
+    max_pending_depth: int
+    #: (tick, doc_index, ops consumed) per deferred batch
+    defers: Tuple[tuple, ...]
+    #: (tick, doc_index, clients joined) per deferred JOIN cohort
+    join_defers: Tuple[tuple, ...]
+    #: (tick, killed shard, docs re-owned) per executed failover
+    kills: Tuple[tuple, ...]
+    per_doc_head: Dict[str, int]
+    #: sampled doc -> final summary digest (real Loader load at the end)
+    sampled_digests: Dict[str, str]
+    #: injector ``site:kind`` observations (empty when no plan)
+    fault_counts: Dict[str, int]
+    #: swarm + broadcaster counters
+    counters: Dict[str, int]
+    #: per-phase counter attribution (CounterSet.delta over each phase)
+    phase_counters: Dict[str, Dict[str, int]]
+
+    def identity(self) -> dict:
+        """The bit-identity surface: every field, canonically shaped."""
+        return dataclasses.asdict(self)
+
+
+# -- named scenarios ----------------------------------------------------------
+
+
+def _steady_typing(seed, clients, docs, shards) -> ScenarioSpec:
+    """Ramp to full population, then steady typing traffic end to end."""
+    return ScenarioSpec(
+        name="steady-typing", seed=seed, clients=clients, docs=docs,
+        shards=shards,
+        phases=(Phase("ramp", 24), Phase("steady", 120)),
+    )
+
+
+def _catchup_herd(seed, clients, docs, shards) -> ScenarioSpec:
+    """A cohort goes dark mid-run and returns as one catch-up herd.
+
+    The bursty reconnect-storm shape: 30% of the steady population stops
+    submitting and draining for a window, then re-enters together and
+    drains its backlog at the catch-up rate."""
+    return ScenarioSpec(
+        name="catchup-herd", seed=seed, clients=clients, docs=docs,
+        shards=shards,
+        phases=(Phase("ramp", 16), Phase("steady", 48),
+                Phase("herd", 40, frac=0.3), Phase("steady", 48)),
+    )
+
+
+def _laggard_window(seed, clients, docs, shards) -> ScenarioSpec:
+    """Staggered laggards keep typing against frozen views (MSN pin).
+
+    20% of the swarm stops draining in individually-staggered windows
+    while still submitting — their frozen views pin the MSN — and each
+    recovers through a catch-up burst."""
+    return ScenarioSpec(
+        name="laggard-window", seed=seed, clients=clients, docs=docs,
+        shards=shards,
+        phases=(Phase("ramp", 16), Phase("steady", 32),
+                Phase("laggards", 80, frac=0.2), Phase("steady", 32)),
+    )
+
+
+def _failover_drill(seed, clients, docs, shards) -> ScenarioSpec:
+    """Mid-run shard kill between summary elections, under live traffic.
+
+    A FaultPlan-scheduled kill fences one shard's orderers, bumps the
+    storage epoch, and lazily re-owns its documents while the swarm keeps
+    typing; summary elections bracket the failover."""
+    phases = (Phase("ramp", 16), Phase("steady", 40), Phase("election"),
+              Phase("steady", 40), Phase("election"), Phase("steady", 40))
+    total = sum(p.ticks for p in phases)
+    plan = FaultPlan(seed=seed, points=(
+        FaultPoint("shard.kill", "kill", doc="sw-0000", at=total // 2),
+    ))
+    return ScenarioSpec(
+        name="failover-drill", seed=seed, clients=clients, docs=docs,
+        shards=shards, phases=phases, plan=plan,
+    )
+
+
+#: name -> builder(seed, clients, docs, shards); the builder docstring's
+#: first line is the one-line doc ``tools/loadgen.py --list`` prints.
+SCENARIOS: Dict[str, Callable[..., ScenarioSpec]] = {
+    "steady-typing": _steady_typing,
+    "catchup-herd": _catchup_herd,
+    "laggard-window": _laggard_window,
+    "failover-drill": _failover_drill,
+}
+
+
+def build_scenario(name: str, seed: int = 0, clients: int = 1000,
+                   docs: int = 16, shards: int = 4) -> ScenarioSpec:
+    builder = SCENARIOS.get(name)
+    if builder is None:
+        raise KeyError(
+            f"unknown scenario {name!r} (have: {', '.join(SCENARIOS)})")
+    return builder(seed, clients, docs, shards)
+
+
+def scenario_docs() -> Dict[str, str]:
+    """{name: one-line description} for CLI listings."""
+    return {
+        name: (builder.__doc__ or "").strip().splitlines()[0]
+        for name, builder in SCENARIOS.items()
+    }
+
+
+# -- the engine ---------------------------------------------------------------
+
+
+class _SwarmSink:
+    """Broadcaster sink for the swarm: accepts every frame (counting
+    them — the serialize-once pin) and rides fences quietly; per-client
+    delivery is modeled columnar, not per sink."""
+
+    def __init__(self, counters: CounterSet) -> None:
+        self._counters = counters
+
+    def write_frame(self, data: bytes) -> bool:
+        self._counters.bump("swarm.frames")
+        return True
+
+    def write_signal(self, data: bytes, signal: dict) -> bool:
+        return True
+
+    def on_demoted(self, doc_id: str, head_seq: int) -> None:
+        raise AssertionError("swarm sink accepts everything")
+
+    def on_fence(self, doc_id: str, epoch: str, head_seq: int) -> None:
+        self._counters.bump("swarm.sink_fences")
+
+
+class ClientSwarm:
+    """The columnar client population plus the real service it drives.
+
+    One instance = one run; :func:`run_swarm` is the entry point.
+    """
+
+    def __init__(self, spec: ScenarioSpec) -> None:
+        self.spec = spec
+        n, docs = spec.clients, spec.docs
+        self.counters = CounterSet(
+            "swarm.ops_submitted", "swarm.ops_stamped", "swarm.ops_deduped",
+            "swarm.joins", "swarm.defers", "swarm.join_defers",
+            "swarm.elections",
+            "swarm.catchup_completions", "swarm.delivery_samples",
+            "swarm.frames", "swarm.sink_fences", "swarm.kills",
+        )
+        # -- columnar per-client state (the whole point) ----------------
+        idx = np.arange(n, dtype=np.int64)
+        #: contiguous doc blocks: doc d owns clients [starts[d], starts[d+1])
+        self.doc_of = (idx * docs // n).astype(np.int32)
+        self.doc_starts = np.searchsorted(self.doc_of, np.arange(docs))
+        self.state = np.zeros(n, dtype=np.int8)   # _UNBORN
+        self.cursor = np.zeros(n, dtype=np.int64)
+        self.client_seq = np.zeros(n, dtype=np.int64)
+        self.op_count = np.zeros(n, dtype=np.int64)
+        self.next_fire = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
+        self.catchup_start = np.zeros(n, dtype=np.int64)
+        self.lag_start = np.full(n, -1, dtype=np.int64)
+        self.lag_end = np.full(n, -1, dtype=np.int64)
+        # cadence: period ≈ active ticks / ops_per_client, jittered per
+        # client so fires de-synchronize (independent of population)
+        active = max(1, sum(p.ticks for p in self.spec.phases
+                            if p.kind in ("steady", "herd", "laggards")))
+        base = max(3, int(round(active / max(0.25, spec.ops_per_client))))
+        jitter = _hash_clients(spec.seed, 11, idx) % np.uint64(base)
+        self.period = (base + jitter.astype(np.int64)).astype(np.int64)
+        # ramp schedule: spread connects over the FIRST ramp phase (or
+        # connect everyone at tick 0 when the scenario has none)
+        ramp_at, ramp_ticks = 0, 0
+        at = 0
+        for p in spec.phases:
+            if p.kind == "ramp":
+                ramp_at, ramp_ticks = at, p.ticks
+                break
+            at += p.ticks
+        if ramp_ticks > 0:
+            spread = _hash_clients(spec.seed, 13, idx) % np.uint64(ramp_ticks)
+            self.connect_at = ramp_at + spread.astype(np.int64)
+        else:
+            self.connect_at = np.zeros(n, dtype=np.int64)
+        #: precomputed wire client ids (also the JOIN batch payload)
+        within = (idx - self.doc_starts[self.doc_of]).astype(np.int64)
+        self.client_ids = [
+            f"sw{spec.seed}-d{int(d):04d}-c{int(c)}"
+            for d, c in zip(self.doc_of, within)
+        ]
+        # -- the real service -------------------------------------------
+        self.injector = (FaultInjector(spec.plan)
+                         if spec.plan is not None else None)
+        if spec.dir is not None:
+            import os as _os
+
+            _os.makedirs(spec.dir, exist_ok=True)
+            oplog = OpLog(_os.path.join(spec.dir, "swarm-ops.jsonl"),
+                          autoflush=True, faults=self.injector)
+        else:
+            oplog = OpLog(faults=self.injector)
+        if spec.shards > 1:
+            self.service = ShardedOrderingService(
+                n_shards=spec.shards, oplog=oplog, faults=self.injector)
+        else:
+            self.service = LocalOrderingService(oplog=oplog)
+        self.factory = LocalDocumentServiceFactory(self.service)
+        self.loader = Loader(self.factory, clock=VirtualClock())
+        self.broadcaster = Broadcaster()
+        self._sink = _SwarmSink(self.counters)
+        # -- per-doc bookkeeping ----------------------------------------
+        self.doc_ids = [spec.doc_id(d) for d in range(docs)]
+        self.head_arr = np.zeros(docs, dtype=np.int64)
+        #: per doc: tick each seq was stamped at (index seq-1)
+        self.stamp_ticks: List[List[int]] = [[] for _ in range(docs)]
+        #: per doc: seqs (exclusive floor) already sampled for delivery
+        self.delivered_floor = np.zeros(docs, dtype=np.int64)
+        self.delivery_lat: List[int] = []
+        self.catchup_lat: List[int] = []
+        self.max_pending_depth = 0
+        self.defers: List[tuple] = []
+        self.join_defers: List[tuple] = []
+        self.kills: List[tuple] = []
+        self.pending: Dict[int, List[RawOperation]] = {}
+        self._scripted = {(t, d): k for t, d, k in spec.scripted_defers}
+        self._scripted_joins = {(t, d): k
+                                for t, d, k in spec.scripted_join_defers}
+        self.sampled = [d for d in range(docs)
+                        if d % max(1, spec.sample_every) == 0]
+
+    # -- setup -----------------------------------------------------------------
+
+    def _build(self, rt) -> None:
+        ds = rt.create_datastore("ds")
+        ds.create_channel("sequence-tpu", "text")
+        ds.create_channel("map-tpu", "kv")
+        ds.create_channel("counter-tpu", "count")
+
+    def setup(self) -> None:
+        """Create every document through the real Loader (attach summary
+        with the three channels), then close the boot client — swarm
+        clients JOIN the quorum directly, they never materialize
+        containers."""
+        for d, doc_id in enumerate(self.doc_ids):
+            c = self.loader.create(doc_id, f"boot-{doc_id}", self._build)
+            c.drain()
+            c.close()
+            self.broadcaster.attach(doc_id, self.service.endpoint(doc_id),
+                                    self._sink)
+        if isinstance(self.service, ShardedOrderingService):
+            self.service.add_fence_listener(
+                lambda _sid, docs, epoch: [
+                    self.broadcaster.refence(
+                        doc, self.service.endpoint(doc), epoch)
+                    for doc in docs
+                ]
+            )
+        self._sync_heads(range(self.spec.docs), tick=0)
+
+    def _sync_heads(self, doc_indices, tick: int) -> None:
+        """Record stamp ticks for every new seq and refresh head_arr."""
+        for d in doc_indices:
+            head = self.service.oplog.head(self.doc_ids[d])
+            ticks = self.stamp_ticks[d]
+            if head > len(ticks):
+                ticks.extend([tick] * (head - len(ticks)))
+            self.head_arr[d] = head
+
+    # -- per-tick steps --------------------------------------------------------
+
+    def _defer_joins(self, t: int, d: int, members: np.ndarray,
+                     joined: int) -> None:
+        self.connect_at[members[joined:]] = t + 1
+        self.join_defers.append((t, d, joined))
+        self.counters.bump("swarm.join_defers")
+
+    def _connect_due(self, t: int) -> None:
+        """Batched JOINs for every client whose ramp slot is this tick,
+        one ``connect_many`` per document.  A mid-batch failure (injected
+        durable fault) defers the unjoined suffix to the next tick — the
+        JOIN count is read back from the durable head (one message per
+        JOIN), the same whole-truth the oracle twin's scripted mirror
+        replays."""
+        due = np.flatnonzero((self.state == _UNBORN)
+                             & (self.connect_at == t))
+        if due.size == 0:
+            return
+        touched = []
+        joined_chunks = []
+        session = f"sw{self.spec.seed}"
+        with self.service.oplog.batch():  # JOINs group-commit like ops
+            for d in np.unique(self.doc_of[due]).tolist():
+                members = due[self.doc_of[due] == d]
+                ids = [self.client_ids[i] for i in members.tolist()]
+                doc_id = self.doc_ids[int(d)]
+                k = self._scripted_joins.get((t, int(d)))
+                if k is not None:
+                    self.service.endpoint(doc_id).connect_many(
+                        ids[:k], session)
+                    self._defer_joins(t, int(d), members, k)
+                    joined = members[:k]
+                else:
+                    before = self.service.oplog.head(doc_id)
+                    try:
+                        self.service.endpoint(doc_id).connect_many(
+                            ids, session)
+                        joined = members
+                    except (ConnectionError, OSError):
+                        landed = self.service.oplog.head(doc_id) - before
+                        self._defer_joins(t, int(d), members, landed)
+                        joined = members[:landed]
+                touched.append(int(d))
+                if joined.size:
+                    joined_chunks.append(joined)
+                    self.counters.bump("swarm.joins", int(joined.size))
+        self._sync_heads(touched, t)
+        if not joined_chunks:
+            return
+        now = np.concatenate(joined_chunks)
+        self.state[now] = _STEADY
+        self.cursor[now] = self.head_arr[self.doc_of[now]]
+        h = _hash_clients(self.spec.seed, 17, now)
+        self.next_fire[now] = (
+            t + 1 + (h % self.period[now].astype(np.uint64)).astype(np.int64)
+        )
+
+    def _generate_ops(self, t: int) -> Dict[int, List[RawOperation]]:
+        """This tick's client ops, columnar-planned then materialized:
+        numpy picks who fires and what each op is; Python only boxes the
+        final wire envelopes."""
+        firing = np.flatnonzero(
+            ((self.state == _STEADY) | (self.state == _LAGGARD))
+            & (self.next_fire <= t))
+        out: Dict[int, List[RawOperation]] = {}
+        if firing.size == 0:
+            return out
+        self.next_fire[firing] = t + self.period[firing]
+        h = _hash_clients(self.spec.seed, 19, firing,
+                          extra=self.op_count[firing])
+        kind = (h % np.uint64(100)).astype(np.int64)
+        key_i = ((h >> np.uint64(8)) % np.uint64(32)).astype(np.int64)
+        val = ((h >> np.uint64(16)) % np.uint64(1000)).astype(np.int64)
+        ch_i = ((h >> np.uint64(24)) % np.uint64(26)).astype(np.int64)
+        self.op_count[firing] += 1
+        self.client_seq[firing] += 1
+        docs = self.doc_of[firing]
+        seqs = self.client_seq[firing]
+        refs = self.cursor[firing]
+        self.counters.bump("swarm.ops_submitted", int(firing.size))
+        for j, i in enumerate(firing.tolist()):
+            k = int(kind[j])
+            if k < 60:
+                contents = {"kind": "set", "key": f"k{int(key_i[j])}",
+                            "value": int(val[j])}
+                channel = "kv"
+            elif k < 85:
+                contents = {"kind": "increment",
+                            "delta": int(val[j] % 7) - 3 or 1}
+                channel = "count"
+            else:
+                contents = {"kind": "insert", "pos": 0,
+                            "text": chr(97 + int(ch_i[j]))}
+                channel = "text"
+            sub = {"clientSeq": int(seqs[j]), "refSeq": int(refs[j]),
+                   "ds": "ds", "channel": channel, "contents": contents}
+            op = RawOperation(
+                client_id=self.client_ids[i],
+                client_seq=int(seqs[j]),
+                ref_seq=int(refs[j]),
+                type=MessageType.OP,
+                contents={"type": "groupedBatch", "v": BATCH_WIRE_VERSION,
+                          "ops": [sub]},
+            )
+            out.setdefault(int(docs[j]), []).append(op)
+        return out
+
+    def _submit(self, t: int, new_ops: Dict[int, List[RawOperation]]
+                ) -> List[int]:
+        """Submit this tick's batches (deferred batches first) through the
+        service's batched ingress; record deferrals — from real mid-batch
+        failures or from the oracle twin's scripted mirror — for the next
+        tick's whole-batch resubmit."""
+        full: Dict[int, List[RawOperation]] = {}
+        for d, ops in self.pending.items():
+            full[d] = list(ops)
+        for d, ops in new_ops.items():
+            full.setdefault(d, []).extend(ops)
+        if not full:
+            self.pending = {}
+            return []
+        submit: Dict[str, List[RawOperation]] = {}
+        defer_now: Dict[int, List[RawOperation]] = {}
+        for d in sorted(full):
+            k = self._scripted.get((t, d))
+            if k is None:
+                submit[self.doc_ids[d]] = full[d]
+            else:
+                # Oracle-twin mirror of a recorded deferral: stamp the
+                # same prefix this tick, re-run the whole batch next tick
+                # (dedup absorbs the prefix — identical to the faulted
+                # run's recovery), so both logs split identically.
+                submit[self.doc_ids[d]] = full[d][:k]
+                defer_now[d] = full[d]
+                self.defers.append((t, d, k))
+                self.counters.bump("swarm.defers")
+        outcomes = self.service.submit_many(submit)
+        for d in sorted(full):
+            outcome = outcomes[self.doc_ids[d]]
+            self.counters.bump("swarm.ops_stamped", len(outcome.stamped))
+            self.counters.bump(
+                "swarm.ops_deduped",
+                outcome.consumed - len(outcome.stamped)
+                if outcome.error is None else 0)
+            if outcome.error is not None:
+                defer_now[d] = full[d]
+                self.defers.append((t, d, outcome.consumed))
+                self.counters.bump("swarm.defers")
+        self.pending = defer_now
+        touched = sorted(full)
+        self._sync_heads(touched, t)
+        return touched
+
+    def _drive_faults(self, t: int) -> None:
+        if self.injector is None or not isinstance(
+                self.service, ShardedOrderingService):
+            return
+        before = set(self.service.router.dead())
+        affected = self.service.tick(t)
+        newly = [s for s in self.service.router.dead() if s not in before]
+        if newly:
+            self.kills.append((t, newly[0], len(affected)))
+            self.counters.bump("swarm.kills")
+
+    def _election(self, t: int) -> None:
+        """Service-side summarizer pass over the sampled documents: load
+        read-only at the durable head, upload the summary — mid-run late
+        joiners (and the final verification) then load summary + tail
+        through the real catch-up path."""
+        for d in self.sampled:
+            doc_id = self.doc_ids[d]
+            ro = self.loader.resolve(doc_id)
+            self.service.storage.upload(doc_id, ro.runtime.summarize(),
+                                        ro.runtime.ref_seq)
+            ro.close()
+            self.counters.bump("swarm.elections")
+
+    def _consume(self, t: int, final: bool = False) -> None:
+        """Columnar consumption: steady clients that fired this tick
+        drain to the head; catch-up clients advance ``catchup_rate`` per
+        tick and complete when they reach it.  ``final`` drains everyone
+        (the end-of-run quiescence)."""
+        heads = self.head_arr[self.doc_of]
+        if final:
+            drain = np.flatnonzero(self.state == _STEADY)
+        else:
+            drain = np.flatnonzero((self.state == _STEADY)
+                                   & (self.next_fire == t + self.period))
+        self.cursor[drain] = heads[drain]
+        catching = np.flatnonzero(self.state == _CATCHUP)
+        if catching.size:
+            self.cursor[catching] = np.minimum(
+                heads[catching],
+                self.cursor[catching] + self.spec.catchup_rate)
+            done = catching[self.cursor[catching] >= heads[catching]]
+            if done.size:
+                self.catchup_lat.extend(
+                    (t - self.catchup_start[done]).tolist())
+                self.state[done] = _STEADY
+                h = _hash_clients(self.spec.seed, 23, done)
+                self.next_fire[done] = (
+                    t + 1
+                    + (h % self.period[done].astype(np.uint64)).astype(
+                        np.int64))
+                self.counters.bump("swarm.catchup_completions",
+                                   int(done.size))
+        connected = self.state != _UNBORN
+        if connected.any():
+            depth = int((heads - self.cursor)[connected].max())
+            self.max_pending_depth = max(self.max_pending_depth, depth)
+
+    def _sample_delivery(self, t: int, final: bool = False) -> None:
+        """Advance each document's delivered floor to the slowest steady
+        client's cursor and sample one latency per newly-covered seq."""
+        docs = self.spec.docs
+        masked = np.where(self.state == _STEADY, self.cursor,
+                          np.iinfo(np.int64).max)
+        mins = np.minimum.reduceat(masked, self.doc_starts)
+        counts = np.add.reduceat((self.state == _STEADY).astype(np.int64),
+                                 self.doc_starts)
+        floors = np.where(counts > 0, np.minimum(mins, self.head_arr),
+                          self.delivered_floor)
+        if final:
+            floors = self.head_arr.copy()
+        for d in range(docs):
+            lo, hi = int(self.delivered_floor[d]), int(floors[d])
+            if hi > lo:
+                ticks = self.stamp_ticks[d]
+                self.delivery_lat.extend(t - s for s in ticks[lo:hi])
+                self.delivered_floor[d] = hi
+                self.counters.bump("swarm.delivery_samples", hi - lo)
+
+    def _phase_transitions(self, t: int, phase: Phase,
+                           phase_start: int) -> None:
+        n = self.spec.clients
+        idx = np.arange(n, dtype=np.int64)
+        if phase.kind == "herd":
+            if t == phase_start and phase.frac > 0:
+                h = _hash_clients(self.spec.seed, 29 + phase_start, idx)
+                cohort = np.flatnonzero(
+                    (self.state == _STEADY)
+                    & ((h % np.uint64(1000)).astype(np.int64)
+                       < int(phase.frac * 1000)))
+                self.state[cohort] = _DARK
+            if t == phase_start + phase.ticks - 1:
+                dark = np.flatnonzero(self.state == _DARK)
+                self.state[dark] = _CATCHUP
+                self.catchup_start[dark] = t
+        elif phase.kind == "laggards":
+            if t == phase_start and phase.frac > 0:
+                h = _hash_clients(self.spec.seed, 31 + phase_start, idx)
+                cohort = np.flatnonzero(
+                    (self.state == _STEADY)
+                    & ((h % np.uint64(1000)).astype(np.int64)
+                       < int(phase.frac * 1000)))
+                h2 = _hash_clients(self.spec.seed, 37, cohort)
+                span = max(2, phase.ticks // 2)
+                start = t + (h2 % np.uint64(span)).astype(np.int64)
+                length = 1 + (
+                    (h2 >> np.uint64(17)) % np.uint64(span)).astype(np.int64)
+                self.lag_start[cohort] = start
+                self.lag_end[cohort] = np.minimum(
+                    start + length, t + phase.ticks - 1)
+            starting = np.flatnonzero((self.state == _STEADY)
+                                      & (self.lag_start == t))
+            self.state[starting] = _LAGGARD
+            ending = np.flatnonzero((self.state == _LAGGARD)
+                                    & (self.lag_end == t))
+            self.state[ending] = _CATCHUP
+            self.catchup_start[ending] = t
+
+    # -- the run ---------------------------------------------------------------
+
+    def run(self) -> SwarmResult:
+        self.setup()
+        t = 0
+        phase_counters: Dict[str, Dict[str, int]] = {}
+        for p_i, phase in enumerate(self.spec.phases):
+            phase_start = t
+            since = self.counters.snapshot()
+            if phase.kind == "election":
+                self._election(t)
+            for _ in range(phase.ticks):
+                self._phase_transitions(t, phase, phase_start)
+                self._connect_due(t)
+                self._submit(t, self._generate_ops(t))
+                self._drive_faults(t)
+                self._consume(t)
+                self._sample_delivery(t)
+                t += 1
+            phase_counters[f"{p_i}:{phase.kind}"] = \
+                self.counters.delta(since)
+        # Quiescence: land any deferred JOIN cohorts and batches
+        # (fault-free tail), then drain every client to the head.
+        for _round in range(8):
+            if not self.pending and not np.any(self.state == _UNBORN):
+                break
+            t += 1
+            self._connect_due(t)
+            self._submit(t, {})
+        if self.pending or np.any(self.state == _UNBORN):
+            raise AssertionError(
+                f"swarm never drained its deferred work: "
+                f"pending={sorted(self.pending)} "
+                f"unborn={int(np.count_nonzero(self.state == _UNBORN))}")
+        catching = np.flatnonzero((self.state == _CATCHUP)
+                                  | (self.state == _DARK)
+                                  | (self.state == _LAGGARD))
+        if catching.size:
+            self.catchup_start[catching] = np.where(
+                self.state[catching] == _CATCHUP,
+                self.catchup_start[catching], t)
+            self.state[catching] = _CATCHUP
+        while int(np.count_nonzero(self.state == _CATCHUP)):
+            t += 1
+            self._consume(t)
+            self._sample_delivery(t)
+        self._consume(t, final=True)
+        self._sample_delivery(t, final=True)
+        return self._result(t, phase_counters)
+
+    def _result(self, t: int,
+                phase_counters: Dict[str, Dict[str, int]]) -> SwarmResult:
+        per_doc_head = {doc: self.service.oplog.head(doc)
+                        for doc in self.doc_ids}
+        for doc in self.doc_ids:
+            seqs = [m.seq for m in self.service.oplog.get(doc)]
+            if seqs != list(range(1, per_doc_head[doc] + 1)):
+                raise AssertionError(
+                    f"{doc} seq numbers not contiguous: {seqs[:10]}...")
+        digests = {}
+        for d in self.sampled:
+            ro = self.loader.resolve(self.doc_ids[d])
+            digests[self.doc_ids[d]] = ro.runtime.summarize().digest()
+            ro.close()
+        counters = self.counters.snapshot()
+        for k, v in sorted(self.broadcaster.stats().items()):
+            counters[f"broadcast.{k}"] = v
+        delivery = sorted(self.delivery_lat)
+        catchup = sorted(self.catchup_lat)
+        return SwarmResult(
+            name=self.spec.name,
+            seed=self.spec.seed,
+            clients=self.spec.clients,
+            docs=self.spec.docs,
+            shards=self.spec.shards,
+            ticks=t,
+            sequenced_ops=sum(per_doc_head.values()),
+            ops_stamped=counters["swarm.ops_stamped"],
+            ops_submitted=counters["swarm.ops_submitted"],
+            ops_deduped=counters["swarm.ops_deduped"],
+            joins=counters["swarm.joins"],
+            delivery_p50_ticks=float(percentile(delivery, 0.50)),
+            delivery_p99_ticks=float(percentile(delivery, 0.99)),
+            delivery_samples=len(delivery),
+            catchup_p50_ticks=float(percentile(catchup, 0.50)),
+            catchup_p99_ticks=float(percentile(catchup, 0.99)),
+            catchup_samples=len(catchup),
+            max_pending_depth=self.max_pending_depth,
+            defers=tuple(self.defers),
+            join_defers=tuple(self.join_defers),
+            kills=tuple(self.kills),
+            per_doc_head=per_doc_head,
+            sampled_digests=digests,
+            fault_counts=(self.injector.snapshot()
+                          if self.injector is not None else {}),
+            counters=counters,
+            phase_counters=phase_counters,
+        )
+
+
+def run_swarm(spec: ScenarioSpec) -> SwarmResult:
+    """Drive one scenario end to end; pure function of ``spec``."""
+    return ClientSwarm(spec).run()
+
+
+def oracle_spec(spec: ScenarioSpec, result: SwarmResult) -> ScenarioSpec:
+    """The fault-free single-shard twin of a completed run: same seed and
+    phases, no faults, with the run's recorded op/JOIN deferrals replayed
+    as scripted splits so both runs stamp byte-identical logs."""
+    return dataclasses.replace(
+        spec,
+        shards=1,
+        plan=None,
+        dir=None,
+        scripted_defers=tuple(result.defers),
+        scripted_join_defers=tuple(result.join_defers),
+    )
+
+
+def run_swarm_with_oracle(spec: ScenarioSpec
+                          ) -> Tuple[SwarmResult, SwarmResult]:
+    """THE acceptance harness: run ``spec`` (shards, faults and all),
+    then re-drive the identical scenario FAULT-FREE on a single shard —
+    see :func:`oracle_spec` — and return ``(result, oracle)``.  Callers
+    assert ``sampled_digests`` and ``per_doc_head`` equal: failovers and
+    injected faults may cost deferrals and recoveries, never state."""
+    result = run_swarm(spec)
+    return result, run_swarm(oracle_spec(spec, result))

@@ -13,7 +13,9 @@ empty reference mount).
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import sys
 import time
 import tracemalloc
 from typing import Callable, List, Optional
@@ -90,6 +92,34 @@ def benchmark(
         samples.append(dt)
         total += dt
     return BenchmarkResult(name=name, runs=len(samples), samples=samples)
+
+
+def render_bench_json(report: dict, compact: bool = False) -> str:
+    """THE one BENCH-JSON serialization: sorted keys, stable layout
+    (indent-2 document, or one line for ``compact`` single-metric
+    benches), trailing newline — so every ``BENCH_*.json`` in the
+    trajectory diffs cleanly run over run.  Metrics a run skipped must
+    already be present as ``None`` in ``report`` (schema-stable nulls);
+    this is the serialization point, not a schema checker."""
+    if compact:
+        return json.dumps(report, sort_keys=True) + "\n"
+    return json.dumps(report, indent=2, sort_keys=True) + "\n"
+
+
+def write_bench_json(report: dict, out: Optional[str] = None,
+                     compact: bool = False) -> str:
+    """Render ``report`` (see :func:`render_bench_json`) and write it to
+    the ``out`` path, or to stdout when ``out`` is None.  Returns the
+    rendered text.  Shared by tools/service_e2e.py, tools/chaos.py and
+    tools/loadgen.py — one writer, one schema discipline."""
+    text = render_bench_json(report, compact=compact)
+    if out is not None:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return text
 
 
 @dataclasses.dataclass

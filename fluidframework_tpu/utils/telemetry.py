@@ -123,6 +123,24 @@ class CounterSet:
     def snapshot(self) -> Dict[str, int]:
         return dict(self._counts)
 
+    def delta(self, since: Dict[str, int]) -> Dict[str, int]:
+        """Counts accumulated since an earlier :meth:`snapshot`: current
+        minus ``since`` per counter, zero-delta counters dropped — the
+        one subtraction every per-phase attribution and replay-identity
+        assertion shares instead of hand-rolling dict arithmetic.
+        Counters are monotonic, so a negative delta means ``since`` came
+        from a different counter set — fail loudly, not quietly."""
+        out: Dict[str, int] = {}
+        for name, value in self.snapshot().items():
+            diff = value - since.get(name, 0)
+            if diff < 0:
+                raise ValueError(
+                    f"counter {name!r} went backwards ({diff}): 'since' "
+                    "is not an earlier snapshot of this counter set")
+            if diff:
+                out[name] = diff
+        return out
+
 
 class LockedCounterSet(CounterSet):
     """A :class:`CounterSet` with its own lock: for subsystems whose

@@ -1,0 +1,375 @@
+"""fluidscale (ISSUE 10): the batched ingress surface and the columnar
+swarm scenario engine.
+
+Layers covered:
+
+- ``Sequencer.submit_many`` / ``connect_many`` — batch stamping semantics
+  (per-batch MSN, dedup, abort-and-resubmit contract);
+- ``OpLog.batch`` — ONE fsync per batch on an autoflush durable log;
+- service ``submit_many`` — per-document failure isolation and post-
+  failover recovery with zero caller-side special cases;
+- the swarm engine — same-seed replay bit-identity for EVERY named
+  scenario, byte-identity of sampled docs against the fault-free
+  single-shard oracle twin (mid-run shard kill included), and the
+  deferred-batch mirror under injected durable faults;
+- ``tools/loadgen.py`` — scenario listing and the BENCH JSON schema.
+
+The 10³-client smokes are tier-1 (wall-budgeted); the 10⁵ matrix is
+``slow``-marked.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (BatchAbortedError,
+                                                  MessageType, RawOperation,
+                                                  ShardFencedError)
+from fluidframework_tpu.protocol.sequencer import Sequencer
+from fluidframework_tpu.service.oplog import OpLog
+from fluidframework_tpu.service.orderer import LocalOrderingService
+from fluidframework_tpu.service.sharding import ShardedOrderingService
+from fluidframework_tpu.testing.faults import FaultPlan, FaultPoint
+from fluidframework_tpu.testing.scenarios import (SCENARIOS, build_scenario,
+                                                  run_swarm,
+                                                  run_swarm_with_oracle,
+                                                  scenario_docs)
+
+
+def _op(cid, cs, ref=0, payload=None):
+    return RawOperation(client_id=cid, client_seq=cs, ref_seq=ref,
+                        type=MessageType.OP,
+                        contents=payload or {"n": cs})
+
+
+# -- sequencer batch stamping --------------------------------------------------
+
+
+def test_submit_many_stamps_in_order_with_batch_msn():
+    seq = Sequencer()
+    seq.connect_many(["a", "b"])
+    msgs = seq.submit_many([_op("a", 1), _op("b", 1), _op("a", 2)])
+    assert [m.seq for m in msgs] == [3, 4, 5]  # after 2 JOINs
+    # batch messages carry the BATCH-START MSN (conservative floor)...
+    assert {m.min_seq for m in msgs} == {msgs[0].min_seq}
+    # ...and the end-of-batch recompute folds the new ref_seqs in
+    before = seq.min_seq
+    seq.submit_many([_op("a", 3, ref=5), _op("b", 2, ref=5)])
+    assert seq.min_seq >= before
+
+
+def test_submit_many_skips_duplicates_and_resubmit_dedups():
+    seq = Sequencer()
+    seq.connect_many(["a"])
+    batch = [_op("a", 1), _op("a", 2)]
+    first = seq.submit_many(batch)
+    assert len(first) == 2
+    # whole-batch resubmit (the recovery contract): nothing re-stamps
+    again = seq.submit_many(batch + [_op("a", 3)])
+    assert [m.client_seq for m in again] == [3]
+    assert seq.seq == first[-1].seq + 1
+
+
+def test_submit_many_abort_carries_prefix_and_unwinds_cleanly():
+    seq = Sequencer()
+    seq.connect_many(["a"])
+    boom = RuntimeError("durable refused")
+    calls = {"n": 0}
+
+    def durability_gate(msg):
+        calls["n"] += 1
+        if calls["n"] == 3:  # the 3rd batch message fails
+            raise boom
+
+    seq.subscribe(durability_gate)
+    batch = [_op("a", i + 1) for i in range(4)]
+    with pytest.raises(BatchAbortedError) as err:
+        seq.submit_many(batch)
+    assert err.value.consumed == 2
+    assert [m.client_seq for m in err.value.stamped] == [1, 2]
+    assert err.value.cause is boom
+    # the failed stamp unwound: the whole batch resubmits, ops 1-2 dedup,
+    # ops 3-4 stamp fresh at the SAME next seq numbers
+    seq.unsubscribe(durability_gate)
+    retry = seq.submit_many(batch)
+    assert [m.client_seq for m in retry] == [3, 4]
+    assert [m.seq for m in retry] == [err.value.stamped[-1].seq + 1,
+                                      err.value.stamped[-1].seq + 2]
+
+
+def test_connect_many_matches_sequential_connects():
+    batched, serial = Sequencer(), Sequencer()
+    batched.connect_many(["a", "b", "c"])
+    for cid in ("a", "b", "c"):
+        serial.connect(cid)
+    assert [m.contents for m in batched.log] == \
+        [m.contents for m in serial.log]
+    assert batched.checkpoint()["clients"].keys() == \
+        serial.checkpoint()["clients"].keys()
+    # same-session re-connect resumes without a duplicate JOIN
+    batched.connect_many(["b"], session=None)  # no session: LEAVE+JOIN
+    head = batched.seq
+    batched.connect_many(["b"], session="s1")  # fresh session: LEAVE+JOIN
+    assert batched.seq == head + 2
+    batched.connect_many(["b"], session="s1")  # resume: stamps nothing
+    assert batched.seq == head + 2
+
+
+# -- oplog group commit --------------------------------------------------------
+
+
+def test_oplog_batch_pays_one_fsync(tmp_path, monkeypatch):
+    flushes = {"n": 0}
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        flushes["n"] += 1
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    log = OpLog(str(tmp_path / "ops.jsonl"), autoflush=True)
+    service = LocalOrderingService(oplog=log)
+    ep = service.create_document("doc")
+    ep.connect_many(["a"])
+    flushes["n"] = 0
+    service.submit_many({"doc": [_op("a", i + 1) for i in range(16)]})
+    assert flushes["n"] == 1, "16 appends must group-commit as ONE fsync"
+    # outside a batch, autoflush still fsyncs per append
+    flushes["n"] = 0
+    ep.submit(_op("a", 17))
+    assert flushes["n"] == 1
+    log.close()
+    # the grouped records are durable and replayable
+    reopened = OpLog(str(tmp_path / "ops.jsonl"))
+    assert reopened.head("doc") == service.oplog.head("doc")
+
+
+def test_oplog_batch_flushes_landed_prefix_on_abort(tmp_path):
+    log = OpLog(str(tmp_path / "ops.jsonl"), autoflush=True)
+    try:
+        with log.batch():
+            from fluidframework_tpu.protocol.messages import \
+                SequencedMessage
+
+            log.append("d", SequencedMessage(
+                seq=1, client_id="a", client_seq=1, ref_seq=0, min_seq=0,
+                type=MessageType.OP, contents={}))
+            raise RuntimeError("mid-batch crash")
+    except RuntimeError:
+        pass
+    log.close()
+    assert OpLog(str(tmp_path / "ops.jsonl")).head("d") == 1
+
+
+def test_submit_many_never_swallows_interrupts():
+    """KeyboardInterrupt mid-batch must propagate — not be converted
+    into a per-document SubmitOutcome a retry loop would swallow."""
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect_many(["a"])
+
+    def interrupter(msg):
+        raise KeyboardInterrupt
+
+    ep.subscribe(interrupter)
+    with pytest.raises(KeyboardInterrupt):
+        service.submit_many({"doc": [_op("a", 1)]})
+
+
+def test_failed_deferred_flush_stays_dirty_and_retries(tmp_path):
+    """A group-commit flush that fails at batch exit keeps the batch
+    dirty: the records' bytes are already written, so the next
+    successful flush (here: close) makes them durable — no silent
+    unrepairable hole."""
+    from fluidframework_tpu.testing.faults import FaultInjector
+
+    # occurrence 1 is the JOIN's autoflush; occurrence 2 is the batch-
+    # exit group-commit flush — the one under test
+    plan = FaultPlan(points=(FaultPoint("oplog.flush", "fail", at=2),))
+    log = OpLog(str(tmp_path / "ops.jsonl"), autoflush=True,
+                faults=FaultInjector(plan))
+    service = LocalOrderingService(oplog=log)
+    ep = service.create_document("doc")
+    ep.connect_many(["a"])
+    with pytest.raises(OSError):
+        with log.batch():
+            ep.submit(_op("a", 1))
+    log.close()  # retries the flush (fault is spent) — records land
+    assert OpLog(str(tmp_path / "ops.jsonl")).head("doc") == \
+        service.oplog.head("doc")
+
+
+# -- service-level batched ingress --------------------------------------------
+
+
+def test_service_submit_many_isolates_fenced_documents():
+    service = ShardedOrderingService(n_shards=4)
+    for doc in ("d0", "d1", "d2", "d3"):
+        service.create_document(doc).connect_many([f"{doc}-c"])
+    victim = service.shard_of("d0")
+    fenced = set(service.kill_shard(victim))
+    batches = {doc: [_op(f"{doc}-c", 1)] for doc in ("d0", "d1", "d2", "d3")}
+    out = service.submit_many(batches)
+    # every document lands — the fenced ones recover lazily inside the
+    # endpoint() route, so there is no caller-visible error at all
+    for doc, outcome in out.items():
+        assert outcome.error is None, (doc, outcome.error)
+        assert len(outcome.stamped) == 1
+    assert fenced  # the kill really re-owned something
+
+
+def test_endpoint_submit_batch_fails_fast_on_fenced_orderer():
+    service = ShardedOrderingService(n_shards=2)
+    ep = service.create_document("doc")
+    ep.connect_many(["c"])
+    service.kill_shard(service.shard_of("doc"))
+    with pytest.raises(ShardFencedError):
+        ep.submit_batch([_op("c", 1)])  # the OLD endpoint object
+
+
+# -- the swarm: smokes, replay identity, oracle -------------------------------
+
+#: wall budget per 10³-client smoke (generous: measured ~0.3s each; the
+#: budget exists to catch an accidental O(population²) inner loop)
+SMOKE_BUDGET_SEC = 60.0
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_smoke_1k_clients_under_budget(name):
+    t0 = time.monotonic()
+    spec = build_scenario(name, seed=2, clients=1000, docs=8, shards=4)
+    result = run_swarm(spec)
+    assert time.monotonic() - t0 < SMOKE_BUDGET_SEC
+    assert result.joins == 1000
+    assert result.ops_stamped > 0
+    assert result.delivery_samples == result.sequenced_ops
+    assert result.sampled_digests
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_replay_is_bit_identical(name):
+    spec = build_scenario(name, seed=6, clients=600, docs=6, shards=4)
+    a, b = run_swarm(spec), run_swarm(spec)
+    # the whole result — metrics, per-site fault observations, telemetry
+    # counters, per-phase attribution — is the identity surface
+    assert a.identity() == b.identity()
+
+
+def test_failover_drill_converges_to_oracle_twin():
+    spec = build_scenario("failover-drill", seed=5, clients=800, docs=8,
+                          shards=4)
+    result, oracle = run_swarm_with_oracle(spec)
+    assert result.kills, "the scheduled shard kill must execute"
+    assert result.fault_counts.get("shard.kill:kill") == 1
+    assert oracle.kills == () and oracle.fault_counts == {}
+    assert result.sampled_digests == oracle.sampled_digests
+    assert result.per_doc_head == oracle.per_doc_head
+
+
+def test_injected_append_faults_defer_and_still_match_oracle():
+    """Mid-batch durable failures defer whole batches; the oracle twin
+    replays the recorded deferral schedule and the logs still converge
+    byte-identically — faults cost deferrals, never state."""
+    spec = build_scenario("failover-drill", seed=9, clients=600, docs=6,
+                          shards=4)
+    plan = FaultPlan(seed=9, points=spec.plan.points + (
+        FaultPoint("oplog.append", "fail", doc="sw-0002", at=5, count=2),
+        FaultPoint("oplog.append", "fail", at=200, count=1),
+    ))
+    spec = dataclasses.replace(spec, plan=plan)
+    result, oracle = run_swarm_with_oracle(spec)
+    assert result.defers or result.join_defers, \
+        "the injected faults must actually defer a batch"
+    assert result.fault_counts.get("oplog.append:fail", 0) >= 2
+    assert oracle.defers == result.defers
+    assert oracle.join_defers == result.join_defers
+    assert result.sampled_digests == oracle.sampled_digests
+    assert result.per_doc_head == oracle.per_doc_head
+
+
+def test_herd_and_laggards_produce_catchup_samples():
+    for name in ("catchup-herd", "laggard-window"):
+        spec = build_scenario(name, seed=7, clients=600, docs=6, shards=4)
+        spec = dataclasses.replace(spec, catchup_rate=16)
+        result = run_swarm(spec)
+        assert result.catchup_samples > 0, name
+        assert result.max_pending_depth > 0, name
+        # per-phase counter attribution (CounterSet.delta): the cohort
+        # phase is where the catch-up completions land
+        phase_keys = [k for k in result.phase_counters
+                      if k.endswith(("herd", "laggards"))]
+        assert phase_keys, result.phase_counters.keys()
+
+
+def test_durable_swarm_group_commits(tmp_path, monkeypatch):
+    """A file-backed swarm run: group commit keeps the fsync count at
+    O(ticks), not O(messages) — the serving-side win the batched ingress
+    exists for."""
+    flushes = {"n": 0}
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        flushes["n"] += 1
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    spec = build_scenario("steady-typing", seed=3, clients=400, docs=4,
+                          shards=4)
+    spec = dataclasses.replace(spec, dir=str(tmp_path))
+    result = run_swarm(spec)
+    assert result.sequenced_ops > 800
+    assert flushes["n"] < result.sequenced_ops / 2, (
+        flushes["n"], result.sequenced_ops)
+
+
+# -- loadgen CLI ---------------------------------------------------------------
+
+
+def test_loadgen_list_prints_every_scenario(capsys):
+    from tools.loadgen import main
+
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name, doc in scenario_docs().items():
+        assert name in out
+        assert doc.split()[0] in out
+
+
+def test_loadgen_writes_schema_stable_bench_json(tmp_path, capsys):
+    from tools.loadgen import main
+
+    out = tmp_path / "bench.json"
+    rc = main(["--scenario", "steady-typing", "--clients", "400",
+               "--docs", "4", "--seed", "3", "--no-oracle",
+               "--out", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    scenario = doc["scenarios"]["steady-typing"]
+    # schema-stable nulls: skipped checks are present, not absent
+    assert scenario["oracle_match"] is None
+    assert scenario["replay_identical"] is None
+    assert scenario["passed"] is True
+    assert scenario["ops_per_sec"] > 0
+    # the shared writer sorts keys — the file round-trips canonically
+    assert out.read_text() == json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+# -- the 10⁵ matrix (slow tier) -----------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scale_matrix_100k_clients(name):
+    """The acceptance run: 10⁵ virtual clients through the real 4-shard
+    service on CPU, oracle-converged, within the slow budget."""
+    spec = build_scenario(name, seed=10, clients=100_000, docs=128,
+                          shards=4)
+    result, oracle = run_swarm_with_oracle(spec)
+    assert result.joins == 100_000
+    assert result.sequenced_ops > 200_000
+    assert result.sampled_digests == oracle.sampled_digests
+    assert result.per_doc_head == oracle.per_doc_head

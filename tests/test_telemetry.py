@@ -8,11 +8,42 @@ import pytest
 from fluidframework_tpu.utils.telemetry import (
     CollectingLogger,
     ConfigProvider,
+    CounterSet,
+    LockedCounterSet,
     MonitoringContext,
     PerformanceEvent,
     StreamLogger,
     create_child_logger,
 )
+
+
+def test_counter_delta_subtracts_an_earlier_snapshot():
+    counters = CounterSet("a", "b")
+    counters.bump("a", 2)
+    since = counters.snapshot()
+    counters.bump("a")
+    counters.bump("b", 3)
+    counters.bump("c", 4)  # counter born after the snapshot
+    assert counters.delta(since) == {"a": 1, "b": 3, "c": 4}
+    # zero-delta counters are dropped, not reported as 0
+    assert "a" not in counters.delta(counters.snapshot())
+    # a fresh snapshot against itself is empty
+    assert counters.delta(counters.snapshot()) == {}
+
+
+def test_counter_delta_rejects_a_foreign_snapshot():
+    counters = CounterSet("a")
+    other = CounterSet("a")
+    other.bump("a", 5)
+    with pytest.raises(ValueError):
+        counters.delta(other.snapshot())
+
+
+def test_locked_counter_delta_inherits_consistent_snapshot():
+    counters = LockedCounterSet("x")
+    since = counters.snapshot()
+    counters.bump("x", 7)
+    assert counters.delta(since) == {"x": 7}
 
 
 def test_child_logger_namespaces_and_properties():

@@ -31,6 +31,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from fluidframework_tpu.service.sharding import ShardRouter  # noqa: E402
+from fluidframework_tpu.tools.bench_harness import write_bench_json  # noqa: E402
 from fluidframework_tpu.testing.faults import (  # noqa: E402
     FaultPlan, FaultPoint,
 )
@@ -276,13 +277,7 @@ def main(argv=None) -> None:
     report["total_scenarios"] = sum(
         p["scenarios"] for p in report["plans"].values())
     report["wall_sec"] = round(time.time() - t0, 3)
-    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
-    if args.out:
-        with open(args.out, "w", encoding="utf-8") as f:
-            f.write(text)
-        print(f"wrote {args.out}", file=sys.stderr)
-    else:
-        sys.stdout.write(text)
+    write_bench_json(report, out=args.out)
 
 
 if __name__ == "__main__":

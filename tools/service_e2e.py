@@ -23,7 +23,6 @@ SVC_SHARD_STEPS (2000), SVC_SHARD_SINKS (2).
 
 from __future__ import annotations
 
-import json
 import os
 import random
 import sys
@@ -34,6 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from fluidframework_tpu.runtime.container import ContainerRuntime  # noqa: E402
 from fluidframework_tpu.service.catchup import CatchupService  # noqa: E402
 from fluidframework_tpu.service.orderer import LocalOrderingService  # noqa: E402
+from fluidframework_tpu.tools.bench_harness import write_bench_json  # noqa: E402
 
 N_DOCS = int(os.environ.get("SVC_DOCS", "2048"))
 OPS = int(os.environ.get("SVC_OPS", "96"))
@@ -77,7 +77,7 @@ def shard_bench() -> None:
         f"{result.reconnects} reconnects)",
         file=sys.stderr,
     )
-    print(json.dumps({
+    write_bench_json({
         "metric": "service_shard_ops_per_sec",
         "value": round(result.sequenced_ops / wall, 1),
         "unit": "ops/sec",
@@ -109,7 +109,7 @@ def shard_bench() -> None:
         "broadcast_deliveries": len(lat),
         "broadcast_latency_p50_ticks": _percentile(lat, 0.50),
         "broadcast_latency_p99_ticks": _percentile(lat, 0.99),
-    }))
+    }, compact=True)
 
 
 def seed(service: LocalOrderingService):
@@ -169,7 +169,7 @@ def main() -> None:
         f"{svc.host_channels}); {checked} sampled digests == oracle",
         file=sys.stderr,
     )
-    print(json.dumps({
+    write_bench_json({
         "metric": "service_bulk_catchup_ops_per_sec",
         "value": round(total_ops / wall, 1),
         "unit": "ops/sec",
@@ -179,7 +179,7 @@ def main() -> None:
         "device_docs": svc.device_docs,
         "cpu_docs": svc.cpu_docs,
         "sampled_digests_ok": checked,
-    }))
+    }, compact=True)
 
 
 if __name__ == "__main__":
