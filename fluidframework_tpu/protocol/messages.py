@@ -116,6 +116,29 @@ class BatchAbortedError(ConnectionError):
         self.cause = cause
 
 
+class ColumnAppendError(ConnectionError):
+    """A bulk columnar durable append (``OpLog.append_columns``) stopped
+    partway: rows ``[0, landed)`` of the segment are durable, row
+    ``landed`` failed with ``cause``, and no later row was attempted.
+
+    This is the column-path twin of the per-op append failure inside
+    :class:`BatchAbortedError`'s contract: the sequencer unwinds the
+    un-landed suffix (seq counter, clock, dedup floors, ref_seqs) and
+    re-raises the structured batch abort, so callers see exactly the
+    whole-batch-resubmit recovery surface they already implement.
+
+    Subclasses ConnectionError for the same queued-ops-survive reason as
+    every other ingress failure type in this module.
+    """
+
+    def __init__(self, landed: int, cause: BaseException) -> None:
+        super().__init__(
+            f"columnar append aborted at row {landed}: {cause!r}"
+        )
+        self.landed = landed
+        self.cause = cause
+
+
 class RetryBudgetExhaustedError(ConnectionError):
     """A bounded retry loop gave up: the policy's attempt count or delay
     budget ran out before the operation succeeded.

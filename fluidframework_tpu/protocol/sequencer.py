@@ -12,31 +12,75 @@ no Kafka.  Responsibilities:
 - broadcast sequenced messages to subscribers in order and append them to the
   durable op log (the scriptorium-equivalent feed that catch-up replay and the
   TPU batch-replay path consume).
+
+Quorum state is COLUMNAR (ISSUE 11): per-client ``ref_seq`` and dedup
+floors live in slot-indexed numpy arrays behind a ``client_id → slot``
+dict, so the MSN recompute is a vectorized ``min`` over one array
+instead of a Python scan of N connection objects — the scan that made
+10⁶-client quorums unaffordable — and the batched columnar ingress
+(:meth:`Sequencer.submit_columns`) can gather/scatter floors for a whole
+batch in a handful of numpy calls.
 """
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Callable, Dict, List, Optional
+
+import numpy as np
 
 from .messages import (
     INITIAL_SEQ,
     BatchAbortedError,
+    ColumnAppendError,
     MessageType,
     NackError,
     RawOperation,
     SequencedMessage,
 )
+from .wire import ColumnBatch, JoinColumnSegment, OpColumnSegment
+
+#: ref_seq sentinel for a freed slot: never the min of a live quorum.
+_DEAD_REF = np.iinfo(np.int64).max
 
 
-@dataclasses.dataclass
 class ClientConnection:
-    """Sequencer-side record of a connected client."""
+    """Read view of one connected client's quorum state.
 
-    client_id: str
-    ref_seq: int
-    last_client_seq: int = 0  # highest client_seq sequenced (dedup floor)
-    session: Optional[str] = None  # connection epoch (crash-resume identity)
+    The authoritative state is the sequencer's columnar arrays; this
+    object is the stable façade ``connect()`` hands back (and the shape
+    the pre-columnar dataclass exposed): ``client_id``, ``ref_seq``,
+    ``last_client_seq`` (dedup floor), ``session``.
+    """
+
+    __slots__ = ("_sequencer", "client_id")
+
+    def __init__(self, sequencer: "Sequencer", client_id: str) -> None:
+        self._sequencer = sequencer
+        self.client_id = client_id
+
+    def _slot(self) -> int:
+        slot = self._sequencer._slots.get(self.client_id)
+        if slot is None:
+            raise KeyError(f"client {self.client_id!r} is not connected")
+        return slot
+
+    @property
+    def ref_seq(self) -> int:
+        return int(self._sequencer._ref[self._slot()])
+
+    @property
+    def last_client_seq(self) -> int:
+        return int(self._sequencer._floor[self._slot()])
+
+    @property
+    def session(self) -> Optional[str]:
+        return self._sequencer._session[self._slot()]
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"ClientConnection(client_id={self.client_id!r}, "
+                f"ref_seq={self.ref_seq}, "
+                f"last_client_seq={self.last_client_seq}, "
+                f"session={self.session!r})")
 
 
 class Sequencer:
@@ -54,7 +98,12 @@ class Sequencer:
         #: this submit should be NACKed (throttling), else None.
         self.throttle = throttle
         self.nacks_issued = 0
-        self._clients: Dict[str, ClientConnection] = {}
+        # -- columnar quorum state (client_id -> slot into the arrays) --
+        self._slots: Dict[str, int] = {}
+        self._ref = np.empty(0, dtype=np.int64)
+        self._floor = np.empty(0, dtype=np.int64)
+        self._session: List[Optional[str]] = []
+        self._free: List[int] = []
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         self._log: List[SequencedMessage] = []
         self._clock = 0
@@ -72,6 +121,34 @@ class Sequencer:
         #: the floor back then would let a retry double-sequence it.
         self._last_stamp_unwound = False
 
+    # -- quorum slot management ------------------------------------------------
+
+    def _alloc(self, client_id: str, session: Optional[str],
+               ref_seq: int, floor: int = 0) -> int:
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = len(self._session)
+            if slot >= self._ref.shape[0]:
+                grow = max(16, self._ref.shape[0])
+                self._ref = np.concatenate(
+                    [self._ref, np.full(grow, _DEAD_REF, np.int64)])
+                self._floor = np.concatenate(
+                    [self._floor, np.zeros(grow, np.int64)])
+            self._session.append(None)
+        self._slots[client_id] = slot
+        self._ref[slot] = ref_seq
+        self._floor[slot] = floor
+        self._session[slot] = session
+        return slot
+
+    def _drop(self, client_id: str) -> None:
+        slot = self._slots.pop(client_id)
+        self._ref[slot] = _DEAD_REF
+        self._floor[slot] = 0
+        self._session[slot] = None
+        self._free.append(slot)
+
     # -- connection management -------------------------------------------------
 
     @property
@@ -84,7 +161,13 @@ class Sequencer:
 
     @property
     def log(self) -> List[SequencedMessage]:
-        """The durable op log (scriptorium feed)."""
+        """The durable op log (scriptorium feed).
+
+        Columnar stamps (:meth:`submit_columns` / :meth:`connect_columns`)
+        do NOT ride this list — their feed is the service-side
+        :class:`~fluidframework_tpu.service.oplog.OpLog` the durable gate
+        appends to (the in-proc drivers that read this list never drive
+        the columnar path)."""
         return self._log
 
     def connect(self, client_id: str,
@@ -98,14 +181,12 @@ class Sequencer:
         (or absent) session is a *fresh* runtime whose counter restarts:
         the stale record is dropped (LEAVE+JOIN) so its dedup floor cannot
         silently swallow the new session's ops."""
-        existing = self._clients.get(client_id)
-        if existing is not None:
-            if session is not None and existing.session == session:
-                return existing
+        slot = self._slots.get(client_id)
+        if slot is not None:
+            if session is not None and self._session[slot] == session:
+                return ClientConnection(self, client_id)
             self.disconnect(client_id)
-        conn = ClientConnection(client_id=client_id, ref_seq=self._seq,
-                                session=session)
-        self._clients[client_id] = conn
+        self._alloc(client_id, session, self._seq)
         try:
             self._stamp(
                 client_id=None,
@@ -120,31 +201,31 @@ class Sequencer:
             # resume the record and never stamp the JOIN at all.  A JOIN
             # that landed durably (a later subscriber raised) keeps the
             # membership — it matches the log.
-            if self._last_stamp_unwound:
-                self._clients.pop(client_id, None)
+            if self._last_stamp_unwound and client_id in self._slots:
+                self._drop(client_id)
             raise
-        return conn
+        return ClientConnection(self, client_id)
 
     def connect_many(self, client_ids: List[str],
                      session: Optional[str] = None) -> None:
         """Batch JOIN: admit ``client_ids`` in order with one MSN
-        recomputation at the end instead of one per JOIN — connecting N
-        clients sequentially is O(N²) in the per-stamp min-scan, which is
-        what makes a 10⁵-client ramp phase unaffordable one at a time.
-        Each JOIN message carries the batch-start MSN (conservative, same
+        recomputation at the end instead of one per JOIN (the vectorized
+        array ``min`` of ``_recompute_min_seq`` — connecting N clients
+        one at a time used to be O(N²) in the per-stamp min-scan).  Each
+        JOIN message carries the batch-start MSN (conservative, same
         argument as :meth:`submit_many`).  Semantics are otherwise
         exactly N :meth:`connect` calls: same-session reconnects resume,
-        stale records are dropped via LEAVE+JOIN."""
+        stale records are dropped via LEAVE+JOIN.  The fully-columnar
+        fresh-cohort form is :meth:`connect_columns`."""
         try:
             for client_id in client_ids:
-                existing = self._clients.get(client_id)
-                if existing is not None:
-                    if session is not None and existing.session == session:
+                slot = self._slots.get(client_id)
+                if slot is not None:
+                    if session is not None \
+                            and self._session[slot] == session:
                         continue
                     self.disconnect(client_id)
-                conn = ClientConnection(client_id=client_id,
-                                        ref_seq=self._seq, session=session)
-                self._clients[client_id] = conn
+                self._alloc(client_id, session, self._seq)
                 try:
                     self._stamp(
                         client_id=None,
@@ -157,17 +238,96 @@ class Sequencer:
                 except BaseException:
                     # Same unwind discipline as connect(): an un-stamped
                     # JOIN must not leave the client in the quorum.
-                    if self._last_stamp_unwound:
-                        self._clients.pop(client_id, None)
+                    if self._last_stamp_unwound \
+                            and client_id in self._slots:
+                        self._drop(client_id)
                     raise
         finally:
             self._recompute_min_seq()
 
+    def connect_columns(self, client_ids: List[str],
+                        session: Optional[str],
+                        gate: Callable[[JoinColumnSegment], None]) -> bool:
+        """Fully-columnar JOIN cohort: admit a FRESH batch of clients with
+        one vectorized quorum insert, one lazy
+        :class:`JoinColumnSegment` stamp, and one durable-gate call —
+        no per-client :class:`SequencedMessage` objects.
+
+        Returns False (taking no action) when any id is already known:
+        resume/LEAVE+JOIN semantics stay with the boxed
+        :meth:`connect_many`, which the caller then uses.  ``gate`` must
+        make the segment durable; a :class:`ColumnAppendError` unwinds
+        the un-landed suffix (those clients leave the quorum, the seq
+        counter rolls back) and re-raises the underlying cause — the
+        exact per-JOIN unwind discipline of the boxed path.
+        """
+        n = len(client_ids)
+        if any(cid in self._slots for cid in client_ids) \
+                or len(set(client_ids)) != n:
+            # Known ids (resume/LEAVE+JOIN) and duplicate ids within the
+            # cohort both need the boxed per-id path — the bulk insert
+            # would leak a quorum slot for the shadowed duplicate and
+            # that slot's frozen ref would pin the MSN forever.
+            return False
+        if n == 0:
+            return True
+        start_ref = self._seq
+        if not self._free:
+            # Bulk quorum insert: grow the arrays once, vectorize the
+            # per-client ref init, and extend the slot map in one update.
+            base = len(self._session)
+            need = base + n
+            if need > self._ref.shape[0]:
+                grow = max(need - self._ref.shape[0], self._ref.shape[0],
+                           16)
+                self._ref = np.concatenate(
+                    [self._ref, np.full(grow, _DEAD_REF, np.int64)])
+                self._floor = np.concatenate(
+                    [self._floor, np.zeros(grow, np.int64)])
+            self._ref[base:need] = start_ref + np.arange(n, dtype=np.int64)
+            self._floor[base:need] = 0
+            self._session.extend([session] * n)
+            self._slots.update(zip(client_ids, range(base, need)))
+        else:
+            for i, cid in enumerate(client_ids):
+                self._alloc(cid, session, start_ref + i)
+        start = self._seq + 1
+        clock0 = self._clock
+        self._seq += n
+        self._clock += n
+        segment = JoinColumnSegment(tuple(client_ids), start,
+                                    self._min_seq, clock0)
+        try:
+            gate(segment)
+        except ColumnAppendError as err:
+            landed = err.landed
+            self._seq = start - 1 + landed
+            self._clock = clock0 + landed
+            for cid in client_ids[landed:]:
+                self._drop(cid)
+            self._recompute_min_seq()
+            raise err.cause from err
+        except BaseException:
+            # Gate refused before any row landed (e.g. fenced): unwind
+            # the whole cohort.
+            self._seq = start - 1
+            self._clock = clock0
+            for cid in client_ids:
+                self._drop(cid)
+            self._recompute_min_seq()
+            raise
+        self._recompute_min_seq()
+        return True
+
     def disconnect(self, client_id: str) -> None:
         """Remove a client from the quorum; emits LEAVE and recomputes MSN."""
-        if client_id not in self._clients:
+        slot = self._slots.get(client_id)
+        if slot is None:
             return
-        conn = self._clients.pop(client_id)
+        prev_ref = int(self._ref[slot])
+        prev_floor = int(self._floor[slot])
+        prev_session = self._session[slot]
+        self._drop(client_id)
         try:
             self._stamp(
                 client_id=None,
@@ -182,7 +342,7 @@ class Sequencer:
             # exactly as it was, so the retry re-stamps cleanly; a LEAVE
             # that landed durably keeps the member removed.
             if self._last_stamp_unwound:
-                self._clients[client_id] = conn
+                self._alloc(client_id, prev_session, prev_ref, prev_floor)
             raise
 
     # -- sequencing ------------------------------------------------------------
@@ -231,12 +391,121 @@ class Sequencer:
         self._recompute_min_seq()
         return stamped
 
+    def submit_columns(self, batch: ColumnBatch, rows: np.ndarray,
+                       gate: Callable[[OpColumnSegment], None]
+                       ) -> Optional[OpColumnSegment]:
+        """Vectorized batch ticket() over a :class:`ColumnBatch` slice —
+        :meth:`submit_many`'s contract without per-op Python objects.
+
+        ``rows`` selects this document's batch rows in submission order.
+        Stamping is columnar end to end: dedup floors gather/compare/
+        scatter through the quorum arrays (numpy compare-and-max), seq
+        numbers are an ``arange`` over the kept rows, every message
+        carries the batch-start MSN, and the MSN recomputes ONCE at the
+        end.  The stamped rows become one lazy
+        :class:`OpColumnSegment`; ``gate`` (the durable-append-first
+        subscriber's columnar form) must make it durable before this
+        method returns — messages are never visible anywhere before the
+        gate accepts them.
+
+        Returns None — taking NO action — when the slice needs boxed
+        semantics the caller must provide via materialize+
+        :meth:`submit_many`: a throttle policy is installed, a client is
+        unknown, a client appears twice in the slice, client_seqs are
+        not fresh-monotone, or a ref_seq sits below the collaboration
+        window (NackError shapes).  A :class:`ColumnAppendError` from
+        the gate unwinds the un-landed suffix (seq/clock/floors/
+        ref_seqs) and raises :class:`BatchAbortedError` with the landed
+        prefix — byte-for-byte the boxed abort-and-resubmit contract.
+        """
+        n = int(rows.shape[0])
+        if n == 0:
+            return OpColumnSegment(batch, rows.astype(np.int64),
+                                   self._seq + 1, self._min_seq,
+                                   self._clock)
+        if self.throttle is not None:
+            return None
+        ids = batch.client_ids
+        try:
+            # C-level map chain: table index -> client id -> slot; an
+            # unknown client raises out to the boxed path (which owes
+            # the caller its ValueError shape).
+            slot_list = list(map(self._slots.__getitem__,
+                                 map(ids.__getitem__,
+                                     batch.client_index[rows].tolist())))
+        except KeyError:
+            return None  # unknown client: boxed path raises its ValueError
+        if n > 1 and len(set(slot_list)) != n:
+            return None  # same client twice: running-floor dedup is boxed
+        slots = np.array(slot_list, np.int64)
+        cs = batch.client_seq[rows].astype(np.int64, copy=False)
+        rs = batch.ref_seq[rows].astype(np.int64, copy=False)
+        # Conservative stale-view probe over ALL rows (a dup row with a
+        # stale view forces the boxed path, which silently dedups it —
+        # correct either way, never a missed nack).
+        if int(rs.min()) < self._min_seq:
+            return None  # stale view: boxed path owes a staleView nack
+        floors = self._floor[slots]
+        keep = cs > floors
+        if bool(keep.all()):
+            # Steady-state fast path: nothing to dedup — skip the
+            # boolean gathers entirely.
+            kept_rows = rows.astype(np.int64, copy=False)
+            kept_slots = slots
+            prev_floors = floors
+        else:
+            kept_rows = rows[keep].astype(np.int64, copy=False)
+            kept_slots = slots[keep]
+            prev_floors = floors[keep]
+            cs = cs[keep]
+            rs = rs[keep]
+        m = int(kept_rows.shape[0])
+        prev_refs = self._ref[kept_slots].copy()
+        self._floor[kept_slots] = cs
+        self._ref[kept_slots] = np.maximum(prev_refs, rs)
+        start = self._seq + 1
+        clock0 = self._clock
+        self._seq += m
+        self._clock += m
+        segment = OpColumnSegment(batch, kept_rows, start,
+                                  self._min_seq, clock0)
+        try:
+            gate(segment)
+        except ColumnAppendError as err:
+            landed = err.landed
+            self._seq = start - 1 + landed
+            self._clock = clock0 + landed
+            self._floor[kept_slots[landed:]] = prev_floors[landed:]
+            self._ref[kept_slots[landed:]] = prev_refs[landed:]
+            self._recompute_min_seq()
+            kept_positions = np.flatnonzero(keep)
+            consumed = (int(kept_positions[landed])
+                        if landed < kept_positions.shape[0] else n)
+            stamped = [segment.materialize(j) for j in range(landed)]
+            cause = err.cause
+            if not isinstance(cause, Exception):
+                raise cause
+            raise BatchAbortedError(consumed, stamped, cause) from cause
+        except BaseException as err:
+            # Gate refused before any row landed (e.g. fenced mid-kill):
+            # unwind the whole stamp, report zero consumed.
+            self._seq = start - 1
+            self._clock = clock0
+            self._floor[kept_slots] = prev_floors
+            self._ref[kept_slots] = prev_refs
+            self._recompute_min_seq()
+            if not isinstance(err, Exception):
+                raise
+            raise BatchAbortedError(0, [], err) from err
+        self._recompute_min_seq()
+        return segment
+
     def _submit_one(self, op: RawOperation,
                     recompute_msn: bool) -> Optional[SequencedMessage]:
-        conn = self._clients.get(op.client_id)
-        if conn is None:
+        slot = self._slots.get(op.client_id)
+        if slot is None:
             raise ValueError(f"client {op.client_id!r} is not connected")
-        if op.client_seq <= conn.last_client_seq:
+        if op.client_seq <= int(self._floor[slot]):
             return None  # duplicate — dedup by clientSeq
         if self.throttle is not None:
             retry_after = self.throttle(op.client_id)
@@ -253,10 +522,10 @@ class Sequencer:
                 f"(minSeq {self.min_seq})", retry_after=0.0,
                 code="staleView",
             )
-        prev_client_seq = conn.last_client_seq
-        prev_ref_seq = conn.ref_seq
-        conn.last_client_seq = op.client_seq
-        conn.ref_seq = max(conn.ref_seq, op.ref_seq)
+        prev_client_seq = int(self._floor[slot])
+        prev_ref_seq = int(self._ref[slot])
+        self._floor[slot] = op.client_seq
+        self._ref[slot] = max(prev_ref_seq, op.ref_seq)
         try:
             return self._stamp(
                 client_id=op.client_id,
@@ -275,16 +544,16 @@ class Sequencer:
             # after the append landed) keeps the floor: the op is
             # durable, and the resend must dedup, not double-sequence.
             if self._last_stamp_unwound:
-                conn.last_client_seq = prev_client_seq
-                conn.ref_seq = prev_ref_seq
+                self._floor[slot] = prev_client_seq
+                self._ref[slot] = prev_ref_seq
             raise
 
     def update_ref_seq(self, client_id: str, ref_seq: int) -> None:
         """Heartbeat path: a client reports processed-up-to without an op."""
-        conn = self._clients.get(client_id)
-        if conn is None:
+        slot = self._slots.get(client_id)
+        if slot is None:
             return
-        conn.ref_seq = max(conn.ref_seq, ref_seq)
+        self._ref[slot] = max(int(self._ref[slot]), ref_seq)
         self._recompute_min_seq()
 
     def tick(self) -> SequencedMessage:
@@ -306,6 +575,17 @@ class Sequencer:
     def unsubscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         if fn in self._subscribers:
             self._subscribers.remove(fn)
+
+    def is_connected(self, client_id: str) -> bool:
+        """Quorum membership probe (reap/monitoring surfaces)."""
+        return client_id in self._slots
+
+    def has_subscribers_besides(self, *known) -> bool:
+        """True when anything OTHER than the given callbacks subscribes —
+        the columnar fast path's "does this document have live broadcast
+        consumers" probe (the durable gate and the scribe are known
+        passives for client OP columns)."""
+        return any(fn not in known for fn in self._subscribers)
 
     def server_message(self, type_: MessageType, contents) -> SequencedMessage:
         """Stamp a server-originated message (scribe summaryAck/Nack — the
@@ -332,17 +612,18 @@ class Sequencer:
         self._clock = max(self._clock, int(msg.timestamp) + 1)
         if msg.type is MessageType.JOIN:
             cid = msg.contents["clientId"]
-            self._clients.setdefault(
-                cid, ClientConnection(client_id=cid, ref_seq=msg.ref_seq)
-            )
+            if cid not in self._slots:
+                self._alloc(cid, None, msg.ref_seq)
         elif msg.type is MessageType.LEAVE:
-            self._clients.pop(msg.contents["clientId"], None)
+            cid = msg.contents["clientId"]
+            if cid in self._slots:
+                self._drop(cid)
         elif msg.client_id is not None:
-            conn = self._clients.get(msg.client_id)
-            if conn is not None:
-                conn.last_client_seq = max(conn.last_client_seq,
-                                           msg.client_seq)
-                conn.ref_seq = max(conn.ref_seq, msg.ref_seq)
+            slot = self._slots.get(msg.client_id)
+            if slot is not None:
+                self._floor[slot] = max(int(self._floor[slot]),
+                                        msg.client_seq)
+                self._ref[slot] = max(int(self._ref[slot]), msg.ref_seq)
 
     # -- checkpointing (Deli CheckpointManager capability) ---------------------
 
@@ -355,9 +636,10 @@ class Sequencer:
             "minSeq": self._min_seq,
             "clock": self._clock,
             "clients": {
-                cid: {"refSeq": c.ref_seq, "lastClientSeq": c.last_client_seq,
-                      "session": c.session}
-                for cid, c in sorted(self._clients.items())
+                cid: {"refSeq": int(self._ref[slot]),
+                      "lastClientSeq": int(self._floor[slot]),
+                      "session": self._session[slot]}
+                for cid, slot in sorted(self._slots.items())
             },
         }
 
@@ -372,20 +654,18 @@ class Sequencer:
         seq._min_seq = state["minSeq"]
         seq._clock = state["clock"]
         seq._log = list(log) if log is not None else []
-        for cid, c in state["clients"].items():
-            seq._clients[cid] = ClientConnection(
-                client_id=cid,
-                ref_seq=c["refSeq"],
-                last_client_seq=c["lastClientSeq"],
-                session=c.get("session"),
-            )
+        for cid, c in sorted(state["clients"].items()):
+            seq._alloc(cid, c.get("session"), c["refSeq"],
+                       c["lastClientSeq"])
         return seq
 
     # -- internals -------------------------------------------------------------
 
     def _recompute_min_seq(self) -> None:
-        if self._clients:
-            msn = min(c.ref_seq for c in self._clients.values())
+        if self._slots:
+            # Vectorized over the slot arrays; freed slots hold a
+            # max-int sentinel so they never win the min.
+            msn = int(self._ref[:len(self._session)].min())
         else:
             msn = self._seq
         # MSN is monotone.

@@ -28,10 +28,10 @@ import json
 import os
 from typing import Dict, List, Optional
 
-from ..protocol.messages import SequencedMessage
+from ..protocol.messages import ColumnAppendError, SequencedMessage
 from ..protocol.summary import canonical_json
-from ..protocol.wire import (decode_sequenced_message,
-                             encode_sequenced_message)
+from ..protocol.wire import (ColumnSegment, decode_sequenced_message,
+                             encode_sequenced_message, entry_last_seq)
 from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 
 
@@ -84,7 +84,7 @@ class OpLog:
 
     def append(self, doc_id: str, msg: SequencedMessage) -> None:
         log = self._docs.setdefault(doc_id, [])
-        if log and msg.seq <= log[-1].seq:
+        if log and msg.seq <= entry_last_seq(log[-1]):
             return  # exactly-once: replays after crash-resume are idempotent
         fault = (self._faults.fire("oplog.append", doc=doc_id)
                  if self._faults is not None else None)
@@ -129,6 +129,66 @@ class OpLog:
                 log.pop()
                 self._repair_open_tail()
                 raise
+
+    def append_columns(self, doc_id: str, segment: ColumnSegment) -> None:
+        """Bulk columnar append: one in-memory entry and ONE bulk line
+        encode for a whole stamped segment — the durable half of the
+        columnar ingress path (``Sequencer.submit_columns``'s gate).
+
+        Failure contract: raises :class:`ColumnAppendError` carrying how
+        many rows landed durably; rows ``[0, landed)`` stay in the log
+        (they may already be fsync-scheduled), everything later was
+        never written.  With a fault injector armed the bulk path drops
+        to per-row boxed appends so every ``oplog.append`` occurrence
+        fires exactly as it would under per-op ingress — fault schedules
+        line up byte-for-byte across the columnar and boxed modes.
+        """
+        n = len(segment)
+        if n == 0:
+            return
+        log = self._docs.setdefault(doc_id, [])
+        if self._faults is not None or (
+                log and segment.start_seq <= entry_last_seq(log[-1])):
+            # Fault-exact (or replayed-prefix dedup) slow path: per-row
+            # boxed appends keep occurrence counting and exactly-once
+            # semantics identical to per-op ingress.
+            for j in range(n):
+                try:
+                    self.append(doc_id, segment.materialize(j))
+                except BaseException as err:
+                    if not isinstance(err, Exception):
+                        raise
+                    raise ColumnAppendError(j, err) from err
+            return
+        log.append(segment)
+        if self._file is None:
+            return
+        # ONE bulk encode; the writes ride the shared buffered handle so
+        # a failure isolates to the row it hit, like per-op appends.
+        lines = [canonical_json({"doc": doc_id,
+                                 "msg": segment.wire_dict(j)}
+                                ).decode("utf-8") + "\n"
+                 for j in range(n)]
+        landed = 0
+        try:
+            for line in lines:
+                self._file.write(line)
+                landed += 1
+            if self._autoflush:
+                if self._batch_depth:
+                    self._batch_dirty = True
+                else:
+                    self.flush()
+        except OSError as err:
+            # Keep the landed prefix (its bytes are written and may be
+            # durable), drop the failed row and everything after it,
+            # repair any partial final line.
+            if landed:
+                log[-1] = segment.prefix(landed)
+            else:
+                log.pop()
+            self._repair_open_tail()
+            raise ColumnAppendError(landed, err) from err
 
     def _torn_append(self, log: List[SequencedMessage], line: str,
                      fault) -> None:
@@ -215,20 +275,54 @@ class OpLog:
     def head(self, doc_id: str) -> int:
         """Highest sequenced seq for the document (0 if none)."""
         log = self._docs.get(doc_id)
-        return log[-1].seq if log else 0
+        return entry_last_seq(log[-1]) if log else 0
 
     def get(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
     ) -> List[SequencedMessage]:
         """Ranged read: messages with ``from_seq < seq <= to_seq`` in order
         (the loader's catch-up fetch; half-open so ``from_seq`` is 'the seq
-        my summary already covers')."""
+        my summary already covers').  Columnar segments materialize their
+        in-range rows on the fly — readers always see plain
+        :class:`SequencedMessage` objects."""
         log = self._docs.get(doc_id, [])
         out = []
-        for msg in log:
-            if msg.seq <= from_seq:
+        for entry in log:
+            if isinstance(entry, ColumnSegment):
+                if entry.last_seq <= from_seq:
+                    continue
+                if to_seq is not None and entry.start_seq > to_seq:
+                    break
+                for j in range(len(entry)):
+                    s = entry.start_seq + j
+                    if s <= from_seq:
+                        continue
+                    if to_seq is not None and s > to_seq:
+                        break
+                    out.append(entry.materialize(j))
                 continue
-            if to_seq is not None and msg.seq > to_seq:
+            if entry.seq <= from_seq:
+                continue
+            if to_seq is not None and entry.seq > to_seq:
                 break
-            out.append(msg)
+            out.append(entry)
         return out
+
+    def is_contiguous(self, doc_id: str) -> bool:
+        """True iff the document's seqs are exactly 1..head with no gap
+        or duplicate — O(entries), not O(messages): columnar segments
+        are contiguous by construction (seqs are an arange), so only
+        their boundaries need checking."""
+        prev = 0
+        for entry in self._docs.get(doc_id, []):
+            if isinstance(entry, ColumnSegment):
+                if len(entry) == 0:
+                    continue
+                if entry.start_seq != prev + 1:
+                    return False
+                prev = entry.last_seq
+            else:
+                if entry.seq != prev + 1:
+                    return False
+                prev = entry.seq
+        return True

@@ -19,10 +19,13 @@ import dataclasses
 import threading
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from ..protocol.messages import (BatchAbortedError, RawOperation,
                                  SequencedMessage, ShardFencedError)
 from ..protocol.sequencer import Sequencer
 from ..protocol.summary import SummaryStorage
+from ..protocol.wire import ColumnBatch, ColumnSegment, OpColumnSegment
 from .oplog import OpLog
 from .scribe import Scribe
 
@@ -39,31 +42,75 @@ class SubmitOutcome:
     failure when the batch stopped early (fence, injected append fault):
     ops ``[consumed:]`` were untouched, and the recovery contract is a
     whole-batch resubmit once the failure clears (dedup absorbs the
-    stamped prefix)."""
+    stamped prefix).
+
+    Columnar outcomes (``submit_columns``) leave ``stamped`` EMPTY and
+    set ``stamped_count`` instead — the lazy-materialization contract:
+    the stamped messages exist only as a column segment in the op log,
+    so counting them must not box them.  Use :meth:`n_stamped` to count
+    either shape."""
 
     stamped: List[SequencedMessage]
     consumed: int
     error: Optional[BaseException] = None
+    stamped_count: Optional[int] = None
+
+    def n_stamped(self) -> int:
+        return (self.stamped_count if self.stamped_count is not None
+                else len(self.stamped))
 
 
-def submit_batches(service, batches: Dict[str, List[RawOperation]]
-                   ) -> Dict[str, "SubmitOutcome"]:
-    """THE batched-ingress loop, shared by both services' ``submit_many``:
-    documents in sorted order, each through ``service.endpoint(doc)``'s
-    batch stamping, the whole call under ONE durable-log flush (group
-    commit over the shared ``service.oplog``).  Failures are isolated per
-    document — a fenced or faulted document reports its
-    :class:`SubmitOutcome.error` while every other document's batch lands
-    normally; the caller resubmits the failed documents' whole batches
-    after recovery (dedup absorbs stamped prefixes).  Per-document
-    sequencers make cross-document order irrelevant to the stamped bytes,
-    so sorted-by-doc is both deterministic and sufficient."""
+def submit_mixed_batches(service,
+                         batches: Optional[Dict[str, List[RawOperation]]],
+                         batch: Optional[ColumnBatch],
+                         doc_rows: Optional[Dict[str, np.ndarray]],
+                         endpoint_of=None) -> Dict[str, "SubmitOutcome"]:
+    """THE batched-ingress loop, shared by both services and BOTH wire
+    shapes: every document — boxed op lists from ``batches`` and
+    :class:`ColumnBatch` row slices from ``doc_rows`` — in ONE globally
+    sorted order, under ONE durable-log flush (group commit over the
+    shared ``service.oplog``).  The single sorted interleaving is a
+    parity requirement, not a style choice: occurrence-indexed fault
+    schedules (the Nth ``oplog.append`` overall) must hit the same op
+    whether a given document rode the boxed or the columnar shape this
+    tick.  Failures are isolated per document — a fenced or faulted
+    document reports its :class:`SubmitOutcome.error` while every other
+    document's batch lands normally; the caller resubmits the failed
+    documents' whole batches after recovery (dedup absorbs stamped
+    prefixes).  Per-document sequencers make cross-document order
+    irrelevant to the stamped bytes, so sorted-by-doc is both
+    deterministic and sufficient.  ``endpoint_of`` overrides endpoint
+    resolution (the sharded service passes its fence-refreshed
+    assignment cache).  A document may appear in only ONE of the two
+    shapes per call."""
+    if endpoint_of is None:
+        endpoint_of = service.endpoint
+    batches = batches if batches is not None else {}
+    doc_rows = doc_rows if doc_rows is not None else {}
+    both = set(batches) & set(doc_rows)
+    if both:
+        raise ValueError(
+            f"documents submitted in both shapes: {sorted(both)}")
     out: Dict[str, SubmitOutcome] = {}
     with service.oplog.batch():
-        for doc_id in sorted(batches):
-            ops = batches[doc_id]
+        for doc_id in sorted(set(batches) | set(doc_rows)):
             try:
-                stamped = service.endpoint(doc_id).submit_batch(ops)
+                endpoint = endpoint_of(doc_id)
+                if doc_id in batches:
+                    ops = batches[doc_id]
+                    out[doc_id] = SubmitOutcome(
+                        stamped=endpoint.submit_batch(ops),
+                        consumed=len(ops))
+                else:
+                    rows = doc_rows[doc_id]
+                    stamped = endpoint.submit_columns(batch, rows)
+                    if isinstance(stamped, ColumnSegment):
+                        out[doc_id] = SubmitOutcome(
+                            stamped=[], consumed=int(rows.shape[0]),
+                            stamped_count=len(stamped))
+                    else:
+                        out[doc_id] = SubmitOutcome(
+                            stamped=stamped, consumed=int(rows.shape[0]))
             except BatchAbortedError as err:
                 out[doc_id] = SubmitOutcome(
                     stamped=err.stamped, consumed=err.consumed,
@@ -73,10 +120,24 @@ def submit_batches(service, batches: Dict[str, List[RawOperation]]
                 # batch was consumed.
                 out[doc_id] = SubmitOutcome(stamped=[], consumed=0,
                                             error=err)
-            else:
-                out[doc_id] = SubmitOutcome(stamped=stamped,
-                                            consumed=len(ops))
     return out
+
+
+def submit_batches(service, batches: Dict[str, List[RawOperation]]
+                   ) -> Dict[str, "SubmitOutcome"]:
+    """Boxed-only form of :func:`submit_mixed_batches` (``submit_many``)."""
+    return submit_mixed_batches(service, batches, None, None)
+
+
+def submit_column_batches(service, batch: ColumnBatch,
+                          doc_rows: Dict[str, np.ndarray],
+                          endpoint_of=None) -> Dict[str, "SubmitOutcome"]:
+    """Columnar-only form of :func:`submit_mixed_batches`
+    (``submit_columns``)."""
+    return submit_mixed_batches(service, None, batch, doc_rows,
+                                endpoint_of=endpoint_of)
+
+
 
 #: bound for a recovery follower's wait on the leading replay (the same
 #: crashed-leader discipline as CatchupResultCache.DEFAULT_JOIN_TIMEOUT:
@@ -119,6 +180,12 @@ class DocumentOrderer:
         # usual single listener — per-client fan-out happens there).
         self._signal_lock = threading.Lock()
         self._signal_listeners: List[SignalListener] = []  # guarded-by: _signal_lock
+        #: the subscribers KNOWN passive for client OP columns (the
+        #: durable gate handles columns in bulk; the scribe ignores OP) —
+        #: precomputed once so the per-batch fast-path probe allocates
+        #: no bound methods.
+        self._op_passive_subscribers = (self._durable_append,
+                                        self.scribe._on_message)
 
     def _durable_append(self, msg: SequencedMessage) -> None:
         # Check-and-append in ONE fence-lock critical section: a submit
@@ -141,6 +208,57 @@ class DocumentOrderer:
         log, not of one document).  Raises :class:`BatchAbortedError` on
         a mid-batch failure."""
         return self.sequencer.submit_many(ops)
+
+    def _append_columns(self, segment: ColumnSegment) -> None:
+        # The columnar form of _durable_append: same one-critical-section
+        # fence-check-and-append discipline, one bulk log call for the
+        # whole stamped segment.
+        with self._fence_lock:
+            if self.fenced:
+                raise ShardFencedError(self.doc_id)
+            self.oplog.append_columns(self.doc_id, segment)
+
+    def columnar_ready(self) -> bool:
+        """True when client OP columns can stamp without materializing
+        messages: the only subscribers are the durable gate and the
+        scribe (a no-op for OP messages), and no throttle policy needs
+        per-op consultation.  A live broadcast subscriber (a client
+        session, the Broadcaster) makes the document materialize
+        per-message — through the boxed path, which IS the
+        materialization."""
+        return (self.sequencer.throttle is None
+                and not self.sequencer.has_subscribers_besides(
+                    *self._op_passive_subscribers))
+
+    def submit_columns(self, batch: ColumnBatch, rows: np.ndarray):
+        """Columnar batch stamping for one document's row slice.
+
+        Fast path: ``Sequencer.submit_columns`` (vectorized dedup/stamp,
+        lazy segment, bulk durable append) — returns the
+        :class:`OpColumnSegment`.  Documents with live broadcast
+        subscribers, or slices the vectorized validator refuses, fall
+        back to materialize + :meth:`submit_batch` — returning the boxed
+        stamped list — so semantics (and bytes) never depend on which
+        path ran."""
+        if self.columnar_ready():
+            segment = self.sequencer.submit_columns(
+                batch, rows, self._append_columns)
+            if segment is not None:
+                return segment
+        ops = [batch.materialize(int(i)) for i in rows.tolist()]
+        return self.submit_batch(ops)
+
+    def connect_columns(self, client_ids: List[str],
+                        session: Optional[str] = None) -> None:
+        """Columnar JOIN cohort (fresh clients): vectorized quorum insert
+        + one lazy JOIN segment through the bulk durable gate; falls back
+        to the boxed ``connect_many`` for resume/re-join semantics or
+        documents with live broadcast subscribers."""
+        if self.columnar_ready():
+            if self.sequencer.connect_columns(client_ids, session,
+                                              self._append_columns):
+                return
+        self.sequencer.connect_many(client_ids, session)
 
     def fence(self) -> None:
         """Mark this orderer dead (shard failover): every later stamp
@@ -290,6 +408,17 @@ class DocumentEndpoint:
         if self._orderer.fenced:
             raise ShardFencedError(self.doc_id)
         self._orderer.sequencer.connect_many(client_ids, session)
+
+    def submit_columns(self, batch: ColumnBatch, rows: np.ndarray):
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
+        return self._orderer.submit_columns(batch, rows)
+
+    def connect_columns(self, client_ids: List[str],
+                        session: Optional[str] = None) -> None:
+        if self._orderer.fenced:
+            raise ShardFencedError(self.doc_id)
+        self._orderer.connect_columns(client_ids, session)
 
     def subscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         self._orderer.sequencer.subscribe(fn)
@@ -486,6 +615,20 @@ class LocalOrderingService:
         submit surface: per-document batch stamping, one durable flush,
         per-document failure isolation)."""
         return submit_batches(self, batches)
+
+    def submit_columns(self, batch: ColumnBatch,
+                       doc_rows: Dict[str, np.ndarray]
+                       ) -> Dict[str, SubmitOutcome]:
+        """Columnar batched ingress — see :func:`submit_column_batches`."""
+        return submit_column_batches(self, batch, doc_rows)
+
+    def submit_mixed(self, batches: Optional[Dict[str, List[RawOperation]]],
+                     batch: Optional[ColumnBatch],
+                     doc_rows: Optional[Dict[str, np.ndarray]]
+                     ) -> Dict[str, SubmitOutcome]:
+        """Both ingress shapes in one sorted pass — see
+        :func:`submit_mixed_batches`."""
+        return submit_mixed_batches(self, batches, batch, doc_rows)
 
     def doc_ids(self) -> List[str]:
         with self.state_lock:

@@ -43,13 +43,17 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..protocol.messages import RawOperation
 from ..protocol.summary import SummaryStorage
+from ..protocol.wire import ColumnBatch
 from .oplog import OpLog
 from .orderer import (DocumentEndpoint, DocumentOrderer,
-                      LocalOrderingService, SubmitOutcome, submit_batches)
+                      LocalOrderingService, SubmitOutcome, submit_batches,
+                      submit_column_batches, submit_mixed_batches)
 
 #: fence listener: (dead shard id, affected doc ids, new storage epoch)
 FenceListener = Callable[[str, List[str], str], None]
@@ -82,6 +86,16 @@ class ShardRouter:
         self._lock = threading.Lock()
         self._shard_ids: List[str] = list(shard_ids)  # guarded-by: _lock
         self._dead: set = set()  # guarded-by: _lock
+        #: bumped on every liveness/topology change — the invalidation
+        #: token for cached doc→shard assignments
+        self._version = 0  # guarded-by: _lock
+
+    @property
+    def version(self) -> int:
+        """Monotone topology version: changes exactly when ``owner``
+        results may change (shard death, shard add)."""
+        with self._lock:
+            return self._version
 
     def shard_ids(self) -> List[str]:
         with self._lock:
@@ -117,6 +131,7 @@ class ShardRouter:
             if len(self._dead) == len(self._shard_ids):
                 self._dead.discard(shard_id)
                 raise RuntimeError("cannot kill the last live shard")
+            self._version += 1
             return True
 
     def add_shard(self, shard_id: str) -> None:
@@ -124,6 +139,7 @@ class ShardRouter:
             if shard_id in self._shard_ids:
                 raise ValueError(f"shard {shard_id!r} already exists")
             self._shard_ids.append(shard_id)
+            self._version += 1
 
 
 class ShardedOrderingService:
@@ -164,6 +180,17 @@ class ShardedOrderingService:
         #: tenant grant map is service-global (content-addressed nodes are
         #: shared across shards), mutated by executor threads.
         self.handle_tenants: Dict[str, set] = {}  # guarded-by: state_lock
+        #: doc_id -> owning shard id, valid while the router topology is
+        #: unchanged; refreshed wholesale on fence/epoch events (shard
+        #: kill, shard add) via the router version token — the columnar
+        #: ingress consults this instead of rendezvous-hashing every
+        #: document on every tick.
+        self._owner_cache: Dict[str, str] = {}  # guarded-by: state_lock
+        #: doc_id -> resolved endpoint on the cached owner, same
+        #: invalidation discipline (one endpoint construction per doc
+        #: per topology epoch instead of one per tick)
+        self._endpoint_cache: Dict[str, DocumentEndpoint] = {}  # guarded-by: state_lock
+        self._owner_cache_version = -1  # guarded-by: state_lock
         self.state_lock = threading.RLock()
         self._fence_listeners: List[FenceListener] = []  # guarded-by: state_lock
         #: monotone count of completed failovers (introspection/benches)
@@ -204,8 +231,35 @@ class ShardedOrderingService:
         return (self._owner(doc_id).has_document(doc_id)
                 or self.storage.head(doc_id) is not None)
 
-    def endpoint(self, doc_id: str) -> DocumentEndpoint:
-        owner = self._owner(doc_id)
+    def _cached_owner(self, doc_id: str) -> str:
+        """Owner lookup through the fence-refreshed assignment cache: a
+        topology change (kill/add — the same events that bump the storage
+        epoch) invalidates the whole cache via the router version, so a
+        stale entry can survive at most until the next call."""
+        version = self.router.version
+        with self.state_lock:
+            if self._owner_cache_version != version:
+                self._owner_cache = {}
+                self._endpoint_cache = {}
+                self._owner_cache_version = version
+            owner = self._owner_cache.get(doc_id)
+            if owner is None:
+                owner = self.router.owner(doc_id)
+                self._owner_cache[doc_id] = owner
+        return owner
+
+    def shard_assignment(self, doc_ids: Sequence[str]) -> np.ndarray:
+        """Vectorized doc→shard assignment: for each document, the
+        ordinal of its owning shard in ``router.shard_ids()`` order —
+        int32, aligned with ``doc_ids``.  Backed by the same
+        fence-refreshed cache the columnar ingress routes through."""
+        order = {sid: i for i, sid in enumerate(self.router.shard_ids())}
+        return np.fromiter(
+            (order[self._cached_owner(d)] for d in doc_ids),
+            np.int32, count=len(doc_ids))
+
+    def _endpoint_on(self, owner: LocalOrderingService,
+                     doc_id: str) -> DocumentEndpoint:
         try:
             return owner.endpoint(doc_id)
         except KeyError:
@@ -221,6 +275,40 @@ class ShardedOrderingService:
             except ValueError:
                 return owner.endpoint(doc_id)  # lost a benign create race
 
+    def endpoint(self, doc_id: str) -> DocumentEndpoint:
+        return self._endpoint_on(self._owner(doc_id), doc_id)
+
+    def _endpoint_probe(self, doc_id: str) -> Optional[DocumentEndpoint]:
+        with self.state_lock:
+            return self._endpoint_cache.get(doc_id)
+
+    def _endpoint_install(self, doc_id: str,
+                          endpoint: DocumentEndpoint) -> DocumentEndpoint:
+        # Re-validate the topology under the lock before caching: an
+        # endpoint resolved against a pre-kill owner must not be
+        # installed into a cache already refreshed to the post-kill
+        # version (it would serve ShardFencedError until the NEXT
+        # topology change).  On a version mismatch the endpoint is
+        # returned uncached — worst case one fenced submit, and the
+        # resubmit re-resolves freshly.  setdefault additionally lets a
+        # concurrent resolver's endpoint win (both are stateless
+        # facades).
+        version = self.router.version
+        with self.state_lock:
+            if self._owner_cache_version != version:
+                return endpoint
+            return self._endpoint_cache.setdefault(doc_id, endpoint)
+
+    def _cached_endpoint(self, doc_id: str) -> DocumentEndpoint:
+        owner = self._cached_owner(doc_id)  # refreshes both caches
+        endpoint = self._endpoint_probe(doc_id)
+        if endpoint is not None:
+            return endpoint
+        # Resolve OUTSIDE the lock (endpoint() may replay a log on
+        # failover recovery; state_lock stays dict-operations-only).
+        return self._endpoint_install(
+            doc_id, self._endpoint_on(self._shards[owner], doc_id))
+
     def submit_many(self, batches: Dict[str, List[RawOperation]]
                     ) -> Dict[str, SubmitOutcome]:
         """Batched ingress across the shard tier — see
@@ -232,6 +320,33 @@ class ShardedOrderingService:
         ``endpoint()``, so the NEXT submit after a failover lands on the
         recovered owner with no caller-side special case."""
         return submit_batches(self, batches)
+
+    def submit_columns(self, batch: ColumnBatch,
+                       doc_rows: Dict[str, np.ndarray]
+                       ) -> Dict[str, SubmitOutcome]:
+        """Columnar batched ingress across the shard tier — the boxed
+        ``submit_many`` contract (sorted per-doc order, ONE shared-log
+        flush, per-doc :class:`SubmitOutcome` isolation,
+        whole-batch-resubmit on ``BatchAbortedError``) over
+        :class:`ColumnBatch` row slices, routed through the
+        fence-refreshed doc→shard assignment cache (array form:
+        :meth:`shard_assignment`) instead of per-call rendezvous
+        hashing.  A kill between cache refreshes surfaces as
+        a fenced per-doc outcome; the resubmit re-resolves through the
+        bumped router version."""
+        return submit_column_batches(self, batch, doc_rows,
+                                     endpoint_of=self._cached_endpoint)
+
+    def submit_mixed(self, batches: Optional[Dict[str, List[RawOperation]]],
+                     batch: Optional[ColumnBatch],
+                     doc_rows: Optional[Dict[str, np.ndarray]]
+                     ) -> Dict[str, SubmitOutcome]:
+        """Both ingress shapes in ONE sorted per-doc pass (the parity
+        requirement under occurrence-indexed fault schedules) — see
+        :func:`~fluidframework_tpu.service.orderer.submit_mixed_batches`;
+        routed through the fence-refreshed assignment cache."""
+        return submit_mixed_batches(self, batches, batch, doc_rows,
+                                    endpoint_of=self._cached_endpoint)
 
     def doc_ids(self) -> List[str]:
         ids = set(self.oplog.doc_ids())

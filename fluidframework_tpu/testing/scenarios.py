@@ -8,11 +8,17 @@ measure it.  This module simulates swarm populations **columnar**: all
 per-client state (document assignment, op cadence, next-fire tick,
 connect / laggard / catch-up state, consumption cursor) lives in numpy
 arrays stepped O(population) per virtual tick, while every generated op
-is submitted through the *real* path — the sharded ordering tier's
-batched ingress (``ShardedOrderingService.submit_many`` → per-document
-batch stamping → one durable-log flush per batch), the serialize-once
-:class:`~fluidframework_tpu.service.broadcaster.Broadcaster`, and the
-durable :class:`~fluidframework_tpu.service.oplog.OpLog`.  Nothing in
+is submitted through the *real* path — by default (ISSUE 11) the
+columnar wire path: each tick's ops planned as ONE struct-packed
+:class:`~fluidframework_tpu.protocol.wire.ColumnBatch`, shipped through
+the real ``column_batch_to_bytes``/``from_bytes`` framing, and stamped
+vectorized via ``submit_columns`` (one durable-log flush per tick);
+with ``spec.columnar=False``, the r10 boxed per-op path — the
+byte-identical parity oracle.  Broadcast sinks ride the serialize-once
+:class:`~fluidframework_tpu.service.broadcaster.Broadcaster` on the
+SAMPLED documents (identical topology in both modes), and everything
+lands in the durable
+:class:`~fluidframework_tpu.service.oplog.OpLog`.  Nothing in
 the serving path is mocked; only the CLIENTS are virtual.
 
 Determinism (see SEMANTICS.md "Swarm determinism"): a run is a pure
@@ -44,8 +50,12 @@ from ..service.broadcaster import Broadcaster
 from ..service.oplog import OpLog
 from ..service.sharding import ShardedOrderingService
 from ..protocol.messages import MessageType, RawOperation
+from ..protocol.wire import (COL_KIND_INCREMENT, COL_KIND_INSERT,
+                             COL_KIND_SET, CHAR_STRINGS, ColumnBatch,
+                             column_batch_from_bytes, column_batch_to_bytes,
+                             key_string)
 from ..runtime.op_pipeline import BATCH_WIRE_VERSION
-from ..utils.telemetry import CounterSet
+from ..utils.telemetry import CounterSet, IngressMeter
 from .faults import FaultInjector, FaultPlan, FaultPoint
 from .load import VirtualClock, percentile
 
@@ -145,6 +155,13 @@ class ScenarioSpec:
     #: directory for a durable file-backed op log (None = in-memory);
     #: group commit makes the fsync cost one flush per tick batch
     dir: Optional[str] = None
+    #: columnar wire path (ISSUE 11): plan each tick's ops as ONE
+    #: struct-packed ColumnBatch, ship it through the real wire
+    #: encode/decode, and stamp it through the services' vectorized
+    #: ``submit_columns``; the per-op boxed loop survives as the
+    #: fallback for pending/scripted/subscriber-bearing documents.
+    #: ``False`` = the r10 boxed path — the byte-identical parity oracle.
+    columnar: bool = True
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
@@ -204,10 +221,16 @@ class SwarmResult:
     counters: Dict[str, int]
     #: per-phase counter attribution (CounterSet.delta over each phase)
     phase_counters: Dict[str, Dict[str, int]]
+    #: ingress-stage accounting (IngressMeter.snapshot()): wall-derived,
+    #: NOT part of the replay-identity surface
+    ingress: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def identity(self) -> dict:
-        """The bit-identity surface: every field, canonically shaped."""
-        return dataclasses.asdict(self)
+        """The bit-identity surface: every field, canonically shaped —
+        except ``ingress``, which is wall-clock derived and excluded."""
+        out = dataclasses.asdict(self)
+        out.pop("ingress", None)
+        return out
 
 
 # -- named scenarios ----------------------------------------------------------
@@ -415,6 +438,10 @@ class ClientSwarm:
                                 for t, d, k in spec.scripted_join_defers}
         self.sampled = [d for d in range(docs)
                         if d % max(1, spec.sample_every) == 0]
+        self._doc_index = {doc_id: d
+                           for d, doc_id in enumerate(self.doc_ids)}
+        #: ingress-stage wall/byte accounting (outside replay identity)
+        self.ingress = IngressMeter()
 
     # -- setup -----------------------------------------------------------------
 
@@ -428,13 +455,22 @@ class ClientSwarm:
         """Create every document through the real Loader (attach summary
         with the three channels), then close the boot client — swarm
         clients JOIN the quorum directly, they never materialize
-        containers."""
+        containers.  Broadcast sinks attach to the SAMPLED documents
+        only (the per-message fan-out consumers the oracle verifies);
+        the rest of the population models consumption columnar with no
+        live subscribers — exactly the shape that lets the columnar
+        ingress skip per-message materialization.  The topology is
+        mode-independent, so columnar-on and columnar-off runs count
+        identical frames."""
+        sampled = set(self.sampled)
         for d, doc_id in enumerate(self.doc_ids):
             c = self.loader.create(doc_id, f"boot-{doc_id}", self._build)
             c.drain()
             c.close()
-            self.broadcaster.attach(doc_id, self.service.endpoint(doc_id),
-                                    self._sink)
+            if d in sampled:
+                self.broadcaster.attach(doc_id,
+                                        self.service.endpoint(doc_id),
+                                        self._sink)
         if isinstance(self.service, ShardedOrderingService):
             self.service.add_fence_listener(
                 lambda _sid, docs, epoch: [
@@ -476,31 +512,46 @@ class ClientSwarm:
         touched = []
         joined_chunks = []
         session = f"sw{self.spec.seed}"
+        # due is ascending and doc blocks are contiguous in client index,
+        # so per-doc cohorts are contiguous runs — boundary scan instead
+        # of a per-doc mask over the whole due set.
+        docs_due = self.doc_of[due]
+        cuts = np.flatnonzero(np.diff(docs_due)) + 1
+        starts = np.concatenate([[0], cuts])
+        ends = np.concatenate([cuts, [due.size]])
         with self.service.oplog.batch():  # JOINs group-commit like ops
-            for d in np.unique(self.doc_of[due]).tolist():
-                members = due[self.doc_of[due] == d]
+            for s, e in zip(starts.tolist(), ends.tolist()):
+                d = int(docs_due[s])
+                members = due[s:e]
                 ids = [self.client_ids[i] for i in members.tolist()]
-                doc_id = self.doc_ids[int(d)]
-                k = self._scripted_joins.get((t, int(d)))
-                if k is not None:
-                    self.service.endpoint(doc_id).connect_many(
-                        ids[:k], session)
-                    self._defer_joins(t, int(d), members, k)
-                    joined = members[:k]
-                else:
-                    before = self.service.oplog.head(doc_id)
-                    try:
-                        self.service.endpoint(doc_id).connect_many(
-                            ids, session)
-                        joined = members
-                    except (ConnectionError, OSError):
-                        landed = self.service.oplog.head(doc_id) - before
-                        self._defer_joins(t, int(d), members, landed)
-                        joined = members[:landed]
-                touched.append(int(d))
+                doc_id = self.doc_ids[d]
+                endpoint = self.service.endpoint(doc_id)
+                connect = (endpoint.connect_columns if self.spec.columnar
+                           else endpoint.connect_many)
+                k = self._scripted_joins.get((t, d))
+                with self.ingress.timed():
+                    if k is not None:
+                        connect(ids[:k], session)
+                        self._defer_joins(t, d, members, k)
+                        joined = members[:k]
+                    else:
+                        before = self.service.oplog.head(doc_id)
+                        try:
+                            connect(ids, session)
+                            joined = members
+                        except (ConnectionError, OSError):
+                            landed = (self.service.oplog.head(doc_id)
+                                      - before)
+                            self._defer_joins(t, d, members, landed)
+                            joined = members[:landed]
+                touched.append(d)
                 if joined.size:
                     joined_chunks.append(joined)
                     self.counters.bump("swarm.joins", int(joined.size))
+                    if self.spec.columnar:
+                        self.ingress.columnar_ops += int(joined.size)
+                    else:
+                        self.ingress.boxed_ops += int(joined.size)
         self._sync_heads(touched, t)
         if not joined_chunks:
             return
@@ -512,16 +563,18 @@ class ClientSwarm:
             t + 1 + (h % self.period[now].astype(np.uint64)).astype(np.int64)
         )
 
-    def _generate_ops(self, t: int) -> Dict[int, List[RawOperation]]:
-        """This tick's client ops, columnar-planned then materialized:
-        numpy picks who fires and what each op is; Python only boxes the
-        final wire envelopes."""
+    def _fire(self, t: int):
+        """Columnar decision core shared by both ingress modes: who fires
+        this tick and what each op is — every column derived from the
+        counter-based hash, population state stepped vectorized.  Returns
+        ``None`` or ``(firing, kind_code, key_i, value, ch_i)`` where
+        ``kind_code`` is the closed wire vocabulary and ``value`` carries
+        the set value / increment delta."""
         firing = np.flatnonzero(
             ((self.state == _STEADY) | (self.state == _LAGGARD))
             & (self.next_fire <= t))
-        out: Dict[int, List[RawOperation]] = {}
         if firing.size == 0:
-            return out
+            return None
         self.next_fire[firing] = t + self.period[firing]
         h = _hash_clients(self.spec.seed, 19, firing,
                           extra=self.op_count[firing])
@@ -531,23 +584,40 @@ class ClientSwarm:
         ch_i = ((h >> np.uint64(24)) % np.uint64(26)).astype(np.int64)
         self.op_count[firing] += 1
         self.client_seq[firing] += 1
+        self.counters.bump("swarm.ops_submitted", int(firing.size))
+        kind_code = np.where(
+            kind < 60, COL_KIND_SET,
+            np.where(kind < 85, COL_KIND_INCREMENT, COL_KIND_INSERT)
+        ).astype(np.int8)
+        delta = val % 7 - 3
+        delta[delta == 0] = 1
+        value = np.where(kind_code == COL_KIND_INCREMENT, delta, val)
+        return firing, kind_code, key_i, value, ch_i
+
+    def _generate_ops(self, t: int) -> Dict[int, List[RawOperation]]:
+        """Boxed ingress (``columnar=False`` — the parity oracle): the
+        same columnar plan, materialized per op into dict + RawOperation
+        envelopes before submission."""
+        out: Dict[int, List[RawOperation]] = {}
+        fired = self._fire(t)
+        if fired is None:
+            return out
+        firing, kind_code, key_i, value, ch_i = fired
         docs = self.doc_of[firing]
         seqs = self.client_seq[firing]
         refs = self.cursor[firing]
-        self.counters.bump("swarm.ops_submitted", int(firing.size))
         for j, i in enumerate(firing.tolist()):
-            k = int(kind[j])
-            if k < 60:
-                contents = {"kind": "set", "key": f"k{int(key_i[j])}",
-                            "value": int(val[j])}
+            k = int(kind_code[j])
+            if k == COL_KIND_SET:
+                contents = {"kind": "set", "key": key_string(int(key_i[j])),
+                            "value": int(value[j])}
                 channel = "kv"
-            elif k < 85:
-                contents = {"kind": "increment",
-                            "delta": int(val[j] % 7) - 3 or 1}
+            elif k == COL_KIND_INCREMENT:
+                contents = {"kind": "increment", "delta": int(value[j])}
                 channel = "count"
             else:
                 contents = {"kind": "insert", "pos": 0,
-                            "text": chr(97 + int(ch_i[j]))}
+                            "text": CHAR_STRINGS[int(ch_i[j])]}
                 channel = "text"
             sub = {"clientSeq": int(seqs[j]), "refSeq": int(refs[j]),
                    "ds": "ds", "channel": channel, "contents": contents}
@@ -562,18 +632,94 @@ class ClientSwarm:
             out.setdefault(int(docs[j]), []).append(op)
         return out
 
-    def _submit(self, t: int, new_ops: Dict[int, List[RawOperation]]
+    def _plan_columns(self, t: int) -> Optional[ColumnBatch]:
+        """Columnar ingress plan: this tick's ops as ONE
+        :class:`ColumnBatch` over the swarm's shared doc/client tables —
+        zero per-op Python objects.  Rows are client-index ascending, so
+        ``doc_index`` is non-decreasing (contiguous per-doc runs)."""
+        fired = self._fire(t)
+        if fired is None:
+            return None
+        firing, kind_code, key_i, value, ch_i = fired
+        return ColumnBatch(
+            doc_index=self.doc_of[firing].astype(np.int32, copy=False),
+            client_index=firing.astype(np.int32),
+            client_seq=self.client_seq[firing],
+            ref_seq=self.cursor[firing],
+            kind=kind_code,
+            key_index=key_i.astype(np.int16),
+            value=value.astype(np.int64, copy=False),
+            char_index=ch_i.astype(np.int16),
+            doc_ids=self.doc_ids,
+            client_ids=self.client_ids,
+            v=BATCH_WIRE_VERSION,
+        )
+
+    def _tick_ingress(self, t: int) -> List[int]:
+        """One tick's ingress through the mode-selected wire path.  The
+        ingress meter covers the WHOLE swarm→sequencer leg — op
+        planning/boxing, wire encode/decode, and batch stamping — which
+        is the r10 per-op cost the columnar path exists to kill."""
+        if not self.spec.columnar:
+            with self.ingress.timed():
+                ops = self._generate_ops(t)
+            return self._submit(t, ops)
+        with self.ingress.timed():
+            batch = self._plan_columns(t)
+        if batch is None:
+            return self._submit(t, {})
+        # Ship through the REAL wire: struct-pack to framed bytes and
+        # decode back (tables compacted to the referenced entries) — the
+        # gated runs measure the full encode→bytes→decode→stamp path,
+        # not an in-process shortcut.
+        with self.ingress.timed():
+            data = column_batch_to_bytes(batch)
+            wire_batch = column_batch_from_bytes(data)
+        self.ingress.encode_bytes += len(data)
+        self.ingress.decode_bytes += len(data)
+        self.ingress.batches += 1
+        # Contiguous per-doc row runs (rows are client-index ascending).
+        di = wire_batch.doc_index
+        cuts = np.flatnonzero(np.diff(di)) + 1
+        starts = np.concatenate([[0], cuts])
+        ends = np.concatenate([cuts, [di.shape[0]]])
+        doc_rows = {
+            wire_batch.doc_ids[int(di[s])]: np.arange(s, e, dtype=np.int64)
+            for s, e in zip(starts.tolist(), ends.tolist())
+        }
+        return self._submit(t, {}, batch=wire_batch, doc_rows=doc_rows)
+
+    def _submit(self, t: int, new_ops: Dict[int, List[RawOperation]],
+                batch: Optional[ColumnBatch] = None,
+                doc_rows: Optional[Dict[str, np.ndarray]] = None
                 ) -> List[int]:
         """Submit this tick's batches (deferred batches first) through the
         service's batched ingress; record deferrals — from real mid-batch
         failures or from the oracle twin's scripted mirror — for the next
-        tick's whole-batch resubmit."""
+        tick's whole-batch resubmit.
+
+        Columnar mode hands this tick's ops as ``(batch, doc_rows)``:
+        documents with no complications ride ``submit_columns`` as raw
+        row slices; a document that carries a deferred batch or a
+        scripted split this tick MATERIALIZES its rows (boxed fallback)
+        so the pending-first op order and the split bookkeeping stay
+        byte-identical to the boxed mode.  Both submit calls share one
+        group commit."""
         full: Dict[int, List[RawOperation]] = {}
         for d, ops in self.pending.items():
             full[d] = list(ops)
         for d, ops in new_ops.items():
             full.setdefault(d, []).extend(ops)
-        if not full:
+        col_rows: Dict[str, np.ndarray] = {}
+        if doc_rows:
+            for doc_id, rows in doc_rows.items():
+                d = self._doc_index[doc_id]
+                if d in full or (t, d) in self._scripted:
+                    full.setdefault(d, []).extend(
+                        batch.materialize(int(i)) for i in rows.tolist())
+                else:
+                    col_rows[doc_id] = rows
+        if not full and not col_rows:
             self.pending = {}
             return []
         submit: Dict[str, List[RawOperation]] = {}
@@ -591,20 +737,36 @@ class ClientSwarm:
                 defer_now[d] = full[d]
                 self.defers.append((t, d, k))
                 self.counters.bump("swarm.defers")
-        outcomes = self.service.submit_many(submit)
-        for d in sorted(full):
-            outcome = outcomes[self.doc_ids[d]]
-            self.counters.bump("swarm.ops_stamped", len(outcome.stamped))
+        with self.ingress.timed():
+            # ONE service call, ONE group commit, ONE globally sorted
+            # per-doc order across both shapes: occurrence-indexed fault
+            # schedules must fire on the same op in either mode.
+            outcomes = self.service.submit_mixed(submit, batch, col_rows)
+        self.ingress.boxed_ops += sum(len(ops) for ops in submit.values())
+        self.ingress.columnar_ops += sum(
+            int(r.shape[0]) for r in col_rows.values())
+        touched = sorted(set(full)
+                         | {self._doc_index[x] for x in col_rows})
+        for d in touched:
+            doc_id = self.doc_ids[d]
+            outcome = outcomes[doc_id]
+            self.counters.bump("swarm.ops_stamped", outcome.n_stamped())
             self.counters.bump(
                 "swarm.ops_deduped",
-                outcome.consumed - len(outcome.stamped)
+                outcome.consumed - outcome.n_stamped()
                 if outcome.error is None else 0)
             if outcome.error is not None:
-                defer_now[d] = full[d]
+                if d in full:
+                    defer_now[d] = full[d]
+                else:
+                    # Deferral recovery round-trips through the boxed
+                    # fallback: the rows materialize ONCE here and
+                    # resubmit as a plain pending batch next tick.
+                    defer_now[d] = [batch.materialize(int(i))
+                                    for i in col_rows[doc_id].tolist()]
                 self.defers.append((t, d, outcome.consumed))
                 self.counters.bump("swarm.defers")
         self.pending = defer_now
-        touched = sorted(full)
         self._sync_heads(touched, t)
         return touched
 
@@ -740,7 +902,7 @@ class ClientSwarm:
             for _ in range(phase.ticks):
                 self._phase_transitions(t, phase, phase_start)
                 self._connect_due(t)
-                self._submit(t, self._generate_ops(t))
+                self._tick_ingress(t)
                 self._drive_faults(t)
                 self._consume(t)
                 self._sample_delivery(t)
@@ -781,10 +943,10 @@ class ClientSwarm:
         per_doc_head = {doc: self.service.oplog.head(doc)
                         for doc in self.doc_ids}
         for doc in self.doc_ids:
-            seqs = [m.seq for m in self.service.oplog.get(doc)]
-            if seqs != list(range(1, per_doc_head[doc] + 1)):
-                raise AssertionError(
-                    f"{doc} seq numbers not contiguous: {seqs[:10]}...")
+            # O(log entries), not O(messages): columnar segments verify
+            # by boundary (their seqs are an arange by construction).
+            if not self.service.oplog.is_contiguous(doc):
+                raise AssertionError(f"{doc} seq numbers not contiguous")
         digests = {}
         for d in self.sampled:
             ro = self.loader.resolve(self.doc_ids[d])
@@ -823,6 +985,7 @@ class ClientSwarm:
                           if self.injector is not None else {}),
             counters=counters,
             phase_counters=phase_counters,
+            ingress=self.ingress.snapshot(),
         )
 
 

@@ -166,6 +166,55 @@ class LockedCounterSet(CounterSet):
             return super().snapshot()
 
 
+class IngressMeter:
+    """Ingress-stage accounting for batched op submission: wall time,
+    op/batch counts split by path (columnar vs boxed), and the wire
+    footprint of encoded/decoded column batches.
+
+    Wall-clock derived — deliberately OUTSIDE every replay-identity
+    surface (two bit-identical runs will disagree on wall time); callers
+    report it next to, never inside, their deterministic counters.
+    """
+
+    def __init__(self) -> None:
+        self.wall_sec = 0.0
+        self.columnar_ops = 0
+        self.boxed_ops = 0
+        self.batches = 0
+        self.encode_bytes = 0
+        self.decode_bytes = 0
+
+    @contextlib.contextmanager
+    def timed(self):
+        """Accumulate the elapsed wall time of one ingress call."""
+        start = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.wall_sec += time.perf_counter() - start
+
+    @property
+    def ops(self) -> int:
+        return self.columnar_ops + self.boxed_ops
+
+    @property
+    def us_per_op(self) -> float:
+        return (self.wall_sec * 1e6 / self.ops) if self.ops else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        """The bench-report shape (``ingress_us_per_op`` et al.)."""
+        return {
+            "ingress_us_per_op": round(self.us_per_op, 3),
+            "ingress_wall_sec": round(self.wall_sec, 6),
+            "ingress_ops": self.ops,
+            "columnar_ops": self.columnar_ops,
+            "boxed_ops": self.boxed_ops,
+            "batches": self.batches,
+            "encode_bytes": self.encode_bytes,
+            "decode_bytes": self.decode_bytes,
+        }
+
+
 class ConfigProvider:
     """Layered feature gates: explicit dict over environment variables
     (``FLUID_TPU_<KEY>``), read through typed getters — the reference's

@@ -1,0 +1,353 @@
+"""Columnar wire-path parity (ISSUE 11): the boxed per-op path is the
+byte-identical oracle for the columnar one.
+
+The tentpole contract, fuzz-pinned here: for every scenario family and
+seed, a ``columnar=True`` run and a ``columnar=False`` (boxed) run of
+the same spec produce
+
+- byte-identical per-document op logs (every stamped message, wire
+  form compared),
+- identical sampled-document digests and per-doc heads,
+- bit-identical telemetry counters and the full replay-identity surface
+  (``SwarmResult.identity()``),
+
+including under a mid-run shard kill (failover-drill) and injected
+mid-batch durable-append faults — whose deferral recovery must
+round-trip through the boxed fallback without forking the log.  A
+durable (file-backed) pair additionally pins the reopened per-doc
+records byte-for-byte.
+
+Plus the columnar unit surfaces underneath: vectorized dedup floors,
+the partial-unwind abort contract, lazy segments in the op log, and the
+live-broadcast-subscriber fallback.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.protocol.messages import (BatchAbortedError,
+                                                  MessageType)
+from fluidframework_tpu.protocol.sequencer import Sequencer
+from fluidframework_tpu.protocol.summary import canonical_json
+from fluidframework_tpu.protocol.wire import (ColumnBatch, ColumnSegment,
+                                              encode_sequenced_message)
+from fluidframework_tpu.service.oplog import OpLog
+from fluidframework_tpu.service.orderer import LocalOrderingService
+from fluidframework_tpu.service.sharding import ShardedOrderingService
+from fluidframework_tpu.testing.faults import (FaultInjector, FaultPlan,
+                                               FaultPoint)
+from fluidframework_tpu.testing.scenarios import (SCENARIOS, ClientSwarm,
+                                                  build_scenario)
+
+
+def _run(spec):
+    swarm = ClientSwarm(spec)
+    result = swarm.run()
+    return swarm, result
+
+
+def _doc_wire_log(service, doc_id):
+    return [encode_sequenced_message(m)
+            for m in service.oplog.get(doc_id)]
+
+
+def _assert_parity(spec):
+    col_swarm, col = _run(spec)
+    box_swarm, box = _run(dataclasses.replace(spec, columnar=False))
+    # the full replay-identity surface: metrics, counters, defers,
+    # fault observations, per-phase attribution
+    assert col.identity() == box.identity()
+    # byte-identical per-document op logs, JOINs and all
+    for doc_id in col_swarm.doc_ids:
+        assert _doc_wire_log(col_swarm.service, doc_id) == \
+            _doc_wire_log(box_swarm.service, doc_id), doc_id
+    return col, box
+
+
+@pytest.mark.parametrize("seed", [1, 5, 11])
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_columnar_off_is_byte_identical(name, seed):
+    spec = build_scenario(name, seed=seed, clients=500, docs=6, shards=4)
+    col, _box = _assert_parity(spec)
+    assert col.joins == 500
+    if name == "failover-drill":
+        assert col.kills, "the scheduled mid-run shard kill must execute"
+
+
+def test_parity_under_injected_midbatch_append_faults():
+    """Mid-batch durable faults abort the columnar stamp partway; the
+    deferral recovery round-trips through the boxed fallback and the
+    logs still converge byte-identically — faults cost deferrals, never
+    state, in EITHER mode."""
+    spec = build_scenario("failover-drill", seed=9, clients=600, docs=6,
+                          shards=4)
+    plan = FaultPlan(seed=9, points=spec.plan.points + (
+        FaultPoint("oplog.append", "fail", doc="sw-0002", at=5, count=2),
+        FaultPoint("oplog.append", "fail", at=200, count=1),
+    ))
+    spec = dataclasses.replace(spec, plan=plan)
+    col, box = _assert_parity(spec)
+    assert col.defers or col.join_defers, \
+        "the injected faults must actually defer a batch"
+    assert col.fault_counts.get("oplog.append:fail", 0) >= 2
+    assert col.defers == box.defers
+
+
+@pytest.mark.parametrize("unfiltered_at", [800, 1200, 1600])
+def test_parity_with_mixed_boxed_columnar_tick_and_global_fault(
+        unfiltered_at):
+    """Regression pin for the single-sorted-interleaving requirement: a
+    doc-scoped fault forces one document onto the boxed pending path
+    while its neighbours stay columnar, and an UNFILTERED
+    occurrence-indexed fault must still fire on the same global append
+    in both modes — the mixed submit runs every document in ONE sorted
+    pass, never boxed-then-columnar."""
+    spec = build_scenario("steady-typing", seed=8, clients=600, docs=6,
+                          shards=4)
+    plan = FaultPlan(seed=8, points=(
+        # past sw-0003's ~100 ramp JOINs: hits an OP batch mid-run, so
+        # the doc defers and resubmits BOXED next tick
+        FaultPoint("oplog.append", "fail", doc="sw-0003", at=150,
+                   count=2),
+        FaultPoint("oplog.append", "fail", at=unfiltered_at, count=1),
+    ))
+    spec = dataclasses.replace(spec, plan=plan)
+    col, box = _assert_parity(spec)
+    assert col.defers, "the doc-scoped fault must force an op deferral"
+    assert col.fault_counts == box.fault_counts
+
+
+def test_submit_mixed_appends_in_one_sorted_pass(tmp_path):
+    """Direct pin on the interleaving: boxed and columnar documents in
+    one submit_mixed call append to the shared durable file in ONE
+    sorted per-doc order — never all-boxed-then-all-columnar."""
+    log = OpLog(str(tmp_path / "ops.jsonl"), autoflush=True)
+    service = LocalOrderingService(oplog=log)
+    for d in ("a", "b", "c", "d"):
+        service.create_document(d).connect_columns([f"{d}-c"])
+    batch = _batch(("b-c", "d-c"), [1, 1], doc_ids=("b", "d"),
+                   doc_idx=[0, 1])
+    from fluidframework_tpu.protocol.messages import (MessageType as MT,
+                                                      RawOperation)
+
+    def op(cid):
+        return RawOperation(client_id=cid, client_seq=1, ref_seq=0,
+                            type=MT.OP, contents={"n": 1})
+
+    out = service.submit_mixed(
+        {"a": [op("a-c")], "c": [op("c-c")]},
+        batch, {"b": np.array([0]), "d": np.array([1])})
+    assert all(o.error is None for o in out.values())
+    log.close()
+    import json as _json
+
+    docs_in_file = [_json.loads(line)["doc"]
+                    for line in open(tmp_path / "ops.jsonl")]
+    # 8 JOINs (per create/connect call order), then the 4 ops sorted
+    assert docs_in_file[-4:] == ["a", "b", "c", "d"]
+
+
+def test_durable_file_records_are_byte_identical_per_doc(tmp_path):
+    """File-backed pair: reopening both durable logs yields per-doc
+    record streams whose canonical encodings match byte-for-byte (the
+    cross-doc interleaving of the shared file is NOT part of the
+    contract — per-document streams are)."""
+    spec = build_scenario("steady-typing", seed=4, clients=400, docs=4,
+                          shards=4)
+    col_spec = dataclasses.replace(spec, dir=str(tmp_path / "col"))
+    box_spec = dataclasses.replace(spec, columnar=False,
+                                   dir=str(tmp_path / "box"))
+    col_swarm, col = _run(col_spec)
+    box_swarm, box = _run(box_spec)
+    assert col.sampled_digests == box.sampled_digests
+    col_swarm.service.oplog.close()
+    box_swarm.service.oplog.close()
+    reopened_col = OpLog(str(tmp_path / "col" / "swarm-ops.jsonl"))
+    reopened_box = OpLog(str(tmp_path / "box" / "swarm-ops.jsonl"))
+    assert reopened_col.doc_ids() == reopened_box.doc_ids()
+    for doc_id in reopened_col.doc_ids():
+        col_bytes = [canonical_json(encode_sequenced_message(m))
+                     for m in reopened_col.get(doc_id)]
+        box_bytes = [canonical_json(encode_sequenced_message(m))
+                     for m in reopened_box.get(doc_id)]
+        assert col_bytes == box_bytes, doc_id
+
+
+# -- columnar unit surfaces ---------------------------------------------------
+
+
+def _batch(client_ids, cs, refs=None, doc_ids=("doc",), doc_idx=None):
+    n = len(cs)
+    return ColumnBatch(
+        doc_index=np.array(doc_idx or [0] * n, np.int32),
+        client_index=np.arange(n, dtype=np.int32),
+        client_seq=np.array(cs, np.int64),
+        ref_seq=np.array(refs or [0] * n, np.int64),
+        kind=np.zeros(n, np.int8),
+        key_index=np.zeros(n, np.int16),
+        value=np.arange(n, dtype=np.int64),
+        char_index=np.zeros(n, np.int16),
+        doc_ids=doc_ids,
+        client_ids=client_ids,
+    )
+
+
+def test_submit_columns_vectorized_dedup_floor():
+    """numpy compare-and-max dedup: a whole-batch resubmit stamps
+    nothing; a mixed batch stamps only the fresh rows."""
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect_columns(["a", "b"])
+    first = _batch(("a", "b"), [1, 1])
+    out = service.submit_columns(first, {"doc": np.arange(2)})
+    assert out["doc"].n_stamped() == 2
+    # resubmit: both rows dedup; one fresh row rides along
+    mixed = _batch(("a", "b", "a"), [1, 1, 2])
+    # same client twice -> the vectorized path refuses, boxed runs it:
+    out = service.submit_columns(mixed, {"doc": np.arange(3)})
+    assert out["doc"].n_stamped() == 1
+    assert out["doc"].consumed == 3
+    assert service.oplog.head("doc") == 5  # 2 JOINs + 3 OPs
+
+
+def test_submit_columns_abort_unwinds_suffix_and_resubmits_clean():
+    """The BatchAbortedError contract on the columnar path: landed rows
+    stay durable, the un-landed suffix unwinds (seq, floors), and the
+    whole-batch resubmit re-sequences at the SAME numbers."""
+    plan = FaultPlan(points=(
+        # occurrences 1-3 are the JOINs; the 5th append (2nd op) fails
+        FaultPoint("oplog.append", "fail", at=5, count=1),))
+    service = LocalOrderingService(oplog=OpLog(faults=FaultInjector(plan)))
+    ep = service.create_document("doc")
+    ep.connect_columns(["a", "b", "c"])
+    batch = _batch(("a", "b", "c"), [1, 1, 1])
+    out = service.submit_columns(batch, {"doc": np.arange(3)})
+    assert out["doc"].consumed == 1
+    assert out["doc"].n_stamped() == 1
+    assert out["doc"].error is not None
+    assert service.oplog.head("doc") == 4  # 3 JOINs + 1 landed op
+    retry = service.submit_columns(batch, {"doc": np.arange(3)})
+    assert retry["doc"].error is None
+    assert retry["doc"].n_stamped() == 2  # dedup absorbed the prefix
+    seqs = [m.seq for m in service.oplog.get("doc")]
+    assert seqs == list(range(1, 7))
+
+
+def test_submit_columns_with_live_subscriber_falls_back_boxed():
+    """A live broadcast subscriber forces per-message materialization:
+    the document takes the boxed path and the subscriber sees every
+    message in order."""
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    seen = []
+    ep.subscribe(seen.append)
+    ep.connect_columns(["a"])  # falls back boxed too: JOIN is broadcast
+    batch = _batch(("a",), [1])
+    out = service.submit_columns(batch, {"doc": np.arange(1)})
+    assert out["doc"].stamped_count is None  # boxed outcome shape
+    assert [m.client_id for m in seen if m.type is MessageType.OP] == ["a"]
+    # and the log holds real messages, not a lazy segment
+    entries = service.oplog._docs["doc"]
+    assert not any(isinstance(e, ColumnSegment) for e in entries)
+
+
+def test_columnar_stamps_store_lazy_segments():
+    """No live subscribers: the op log stores ONE columnar segment for
+    the batch; head() is O(1) on it and get() materializes on read."""
+    service = LocalOrderingService()
+    ep = service.create_document("doc")
+    ep.connect_columns(["a", "b"])
+    out = service.submit_columns(_batch(("a", "b"), [1, 1]),
+                                 {"doc": np.arange(2)})
+    assert out["doc"].stamped_count == 2
+    entries = service.oplog._docs["doc"]
+    assert isinstance(entries[-1], ColumnSegment)
+    assert len(entries[-1]) == 2
+    assert service.oplog.head("doc") == 4
+    assert service.oplog.is_contiguous("doc")
+    msgs = service.oplog.get("doc", from_seq=3)
+    assert [(m.seq, m.client_id, m.type) for m in msgs] == \
+        [(4, "b", MessageType.OP)]
+
+
+def test_connect_columns_matches_boxed_connect_many():
+    """Bulk JOIN cohorts stamp byte-identical to N boxed connects, and
+    re-joining (resume semantics) falls back to the boxed path."""
+    # drive through services so the durable gate exists on both sides
+    sa = LocalOrderingService()
+    sa.create_document("d").connect_columns(["x", "y"], session="s1")
+    sb = LocalOrderingService()
+    sb.create_document("d").connect_many(["x", "y"], session="s1")
+    assert [encode_sequenced_message(m) for m in sa.oplog.get("d")] == \
+        [encode_sequenced_message(m) for m in sb.oplog.get("d")]
+    # resume: columnar refuses known ids, boxed resume stamps nothing
+    head = sa.oplog.head("d")
+    sa.endpoint("d").connect_columns(["x"], session="s1")
+    assert sa.oplog.head("d") == head
+
+
+def test_sharded_assignment_refreshes_on_fence():
+    service = ShardedOrderingService(n_shards=4)
+    docs = [f"doc{i}" for i in range(8)]
+    for d in docs:
+        service.create_document(d)
+    before = service.shard_assignment(docs)
+    victim = service.shard_of("doc0")
+    service.kill_shard(victim)
+    after = service.shard_assignment(docs)
+    order = service.router.shard_ids()
+    assert order[int(before[0])] == victim
+    assert order[int(after[0])] != victim  # doc0 re-owned
+    # untouched docs keep their owner (rendezvous moves only the dead
+    # shard's documents)
+    for i, d in enumerate(docs):
+        if order[int(before[i])] != victim:
+            assert before[i] == after[i], d
+
+
+def test_submit_columns_across_shards_after_kill_recovers():
+    """Columnar ingress keeps the post-failover no-special-case
+    contract: the tick after a kill, the cached assignment refreshed and
+    every document lands on its recovered owner."""
+    service = ShardedOrderingService(n_shards=4)
+    docs = ["d0", "d1", "d2", "d3"]
+    for d in docs:
+        service.create_document(d).connect_columns([f"{d}-c"])
+    batch = _batch(tuple(f"{d}-c" for d in docs), [1, 1, 1, 1],
+                   doc_ids=tuple(docs), doc_idx=[0, 1, 2, 3])
+    out = service.submit_columns(
+        batch, {d: np.array([i]) for i, d in enumerate(docs)})
+    assert all(o.error is None for o in out.values())
+    service.kill_shard(service.shard_of("d0"))
+    batch2 = _batch(tuple(f"{d}-c" for d in docs), [2, 2, 2, 2],
+                    doc_ids=tuple(docs), doc_idx=[0, 1, 2, 3])
+    out2 = service.submit_columns(
+        batch2, {d: np.array([i]) for i, d in enumerate(docs)})
+    for d, o in out2.items():
+        assert o.error is None, (d, o.error)
+        assert o.n_stamped() == 1
+    for d in docs:
+        assert service.oplog.is_contiguous(d)
+
+
+def test_submit_columns_batch_abort_carries_boxed_consumed_semantics():
+    """consumed counts dup rows before the failing row — exactly the
+    boxed BatchAbortedError accounting."""
+    seq = Sequencer()
+
+    def gate(segment):
+        from fluidframework_tpu.protocol.messages import ColumnAppendError
+        raise ColumnAppendError(1, RuntimeError("refused"))
+
+    seq.connect_many(["a", "b", "c"])
+    # row 0 is a duplicate (floor already at 1), rows 1-2 fresh
+    first = _batch(("a",), [1])
+    seq.submit_columns(first, np.arange(1), lambda s: None)
+    batch = _batch(("a", "b", "c"), [1, 1, 1])
+    with pytest.raises(BatchAbortedError) as err:
+        seq.submit_columns(batch, np.arange(3), gate)
+    # row 0 dedup'd (consumed), row 1 landed (consumed), row 2 failed
+    assert err.value.consumed == 2
+    assert [m.client_id for m in err.value.stamped] == ["b"]

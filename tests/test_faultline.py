@@ -908,11 +908,11 @@ def test_rpc_disconnect_mid_burst_reconnects_and_converges():
     # the same client identity rejoins: rejoining earlier would resume
     # the doomed record and the late LEAVE would evict the live client
     deadline = time.time() + 10
-    while time.time() < deadline and "c0" in server.service \
-            .endpoint("net")._orderer.sequencer._clients:
+    while time.time() < deadline and server.service \
+            .endpoint("net")._orderer.sequencer.is_connected("c0"):
         time.sleep(0.02)
-    assert "c0" not in server.service.endpoint("net") \
-        ._orderer.sequencer._clients
+    assert not server.service.endpoint("net") \
+        ._orderer.sequencer.is_connected("c0")
     # rebuild the transport; catch-up acks the ops that DID land before
     # the death, resubmit re-issues the rest
     factory2 = NetworkDocumentServiceFactory(port=server.port)

@@ -1008,6 +1008,47 @@ def test_wire_complete_missing_test_suite(tmp_path):
     assert any("no tests/test_wire*.py" in m for m in msgs), msgs
 
 
+def test_wire_complete_covers_wire_module_dataclasses(tmp_path):
+    """A dataclass defined in wire.py ITSELF (the columnar batch forms)
+    carries the same codec + registry + round-trip obligations as one in
+    messages.py."""
+    _write_wire_tree(tmp_path, COMPLETE_WIRE + """
+    import dataclasses
+
+    @dataclasses.dataclass(eq=False)
+    class ColumnBatch:
+        packed: bytes
+""", test_body="from x import PingMessage\n")
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-WIRE-COMPLETE"}
+    assert any("encode_column_batch" in m for m in msgs), msgs
+    assert any("decode_column_batch" in m for m in msgs), msgs
+    assert any("ColumnBatch is not registered" in m for m in msgs), msgs
+    assert any("ColumnBatch has no round-trip coverage" in m
+               for m in msgs), msgs
+
+
+def test_wire_complete_wire_dataclass_negative(tmp_path):
+    _write_wire_tree(tmp_path, """
+    import dataclasses
+
+    @dataclasses.dataclass(eq=False)
+    class ColumnBatch:
+        packed: bytes
+
+    def encode_ping_message(m): return {"seq": m.seq}
+    def decode_ping_message(d): return d["seq"]
+    def encode_column_batch(b): return {"packed": b.packed}
+    def decode_column_batch(d): return ColumnBatch(d["packed"])
+    MESSAGE_CODECS = {"PingMessage": (encode_ping_message,
+                                      decode_ping_message),
+                      "ColumnBatch": (encode_column_batch,
+                                      decode_column_batch)}
+""", test_body="from x import PingMessage, ColumnBatch\n")
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-WIRE-COMPLETE"] == []
+
+
 # -- baseline machinery ------------------------------------------------------
 
 

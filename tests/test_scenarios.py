@@ -358,18 +358,50 @@ def test_loadgen_writes_schema_stable_bench_json(tmp_path, capsys):
     assert out.read_text() == json.dumps(doc, indent=2, sort_keys=True) + "\n"
 
 
+def test_columnar_smoke_10k_clients_under_budget():
+    """ISSUE 11 tier-1 smoke: a 10⁴-client columnar steady-typing run
+    through the real 4-shard service, inside the wall budget (the 10⁶
+    matrix is slow-marked; this is the always-on canary for the
+    columnar wire path's scaling shape)."""
+    t0 = time.monotonic()
+    spec = build_scenario("steady-typing", seed=11, clients=10_000,
+                          docs=16, shards=4)
+    result = run_swarm(spec)
+    assert time.monotonic() - t0 < SMOKE_BUDGET_SEC
+    assert result.joins == 10_000
+    assert result.ops_stamped > 10_000
+    assert result.ingress["columnar_ops"] > 0
+    assert result.ingress["encode_bytes"] > 0
+    # ingress accounting is wall-derived and OUTSIDE replay identity
+    assert "ingress" not in result.identity()
+
+
 # -- the 10⁵ matrix (slow tier) -----------------------------------------------
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", sorted(SCENARIOS))
 def test_scale_matrix_100k_clients(name):
-    """The acceptance run: 10⁵ virtual clients through the real 4-shard
-    service on CPU, oracle-converged, within the slow budget."""
+    """The r10 acceptance run: 10⁵ virtual clients through the real
+    4-shard service on CPU, oracle-converged, within the slow budget."""
     spec = build_scenario(name, seed=10, clients=100_000, docs=128,
                           shards=4)
     result, oracle = run_swarm_with_oracle(spec)
     assert result.joins == 100_000
     assert result.sequenced_ops > 200_000
+    assert result.sampled_digests == oracle.sampled_digests
+    assert result.per_doc_head == oracle.per_doc_head
+
+
+@pytest.mark.slow
+def test_scale_matrix_1m_clients_columnar():
+    """The r11 acceptance run: 10⁶ virtual clients through the columnar
+    wire path on the real 4-shard service, oracle-converged."""
+    spec = build_scenario("steady-typing", seed=10, clients=1_000_000,
+                          docs=1024, shards=4)
+    spec = dataclasses.replace(spec, sample_every=64)
+    result, oracle = run_swarm_with_oracle(spec)
+    assert result.joins == 1_000_000
+    assert result.sequenced_ops > 2_000_000
     assert result.sampled_digests == oracle.sampled_digests
     assert result.per_doc_head == oracle.per_doc_head

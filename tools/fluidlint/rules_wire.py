@@ -4,6 +4,10 @@ Every dataclass in ``protocol/messages.py`` is a wire message: it must
 have an encode and a decode path in ``protocol/wire.py`` (the single
 definition point for framing and codecs, so a protocol bump can never ship
 a client/server pair that disagree) and a round-trip test exercising it.
+Dataclasses defined in ``protocol/wire.py`` ITSELF (the columnar batch
+forms, e.g. ``ColumnBatch``) are wire messages too and carry the same
+obligations — defining a batch layout next to the codecs does not exempt
+it from registration or round-trip coverage.
 
 The contract is purely structural so it stays checkable without importing
 the package:
@@ -88,6 +92,11 @@ class WireCompletenessRule(ProjectRule):
             return
         wire = project.parse(WIRE_PATH)
         classes = dataclass_names(messages)
+        if wire is not None:
+            # wire.py's own dataclasses (columnar batch forms) are wire
+            # messages with the same codec + round-trip obligations.
+            classes = classes + [c for c in dataclass_names(wire)
+                                 if c not in classes]
         if not classes:
             return
         if wire is None:

@@ -26,6 +26,7 @@ dumps, and the gate verdict.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 import time
@@ -51,9 +52,13 @@ GATES_OPS_PER_SEC = {
 
 
 def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
-            oracle: bool, replay_check: bool) -> dict:
+            oracle: bool, replay_check: bool, columnar: bool = True,
+            sample_every: int = 8, gate_override: float = None,
+            compare_boxed: bool = False) -> dict:
     spec = build_scenario(name, seed=seed, clients=clients, docs=docs,
                           shards=shards)
+    spec = dataclasses.replace(spec, columnar=columnar,
+                               sample_every=sample_every)
     t0 = time.time()
     result = run_swarm(spec)
     wall = time.time() - t0  # the gated number times the PRIMARY run only
@@ -66,12 +71,33 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
     if replay_check:
         replay_identical = \
             run_swarm(spec).identity() == result.identity()
+    boxed_compare = None
+    if compare_boxed:
+        # The r10 ingress comparator: the SAME scenario through the boxed
+        # per-op path (parity-pinned byte-identical), so the recorded
+        # ingress_us_per_op ratio is apples to apples.
+        t0 = time.time()
+        boxed = run_swarm(dataclasses.replace(spec, columnar=False))
+        boxed_wall = time.time() - t0
+        speedup = (boxed.ingress["ingress_us_per_op"]
+                   / result.ingress["ingress_us_per_op"]
+                   if result.ingress["ingress_us_per_op"] else None)
+        boxed_compare = {
+            "identity_match": boxed.identity() == result.identity(),
+            "ops_per_sec": round(boxed.sequenced_ops / boxed_wall, 1)
+            if boxed_wall > 0 else 0.0,
+            "ingress": boxed.ingress,
+            "ingress_speedup_vs_boxed":
+                round(speedup, 2) if speedup else None,
+        }
     ops_per_sec = result.sequenced_ops / wall if wall > 0 else 0.0
-    gate = GATES_OPS_PER_SEC.get(name)
+    gate = (gate_override if gate_override is not None
+            else GATES_OPS_PER_SEC.get(name))
     passed = (
         (gate is None or ops_per_sec >= gate)
         and oracle_match is not False
         and replay_identical is not False
+        and (boxed_compare is None or boxed_compare["identity_match"])
     )
     return {
         "clients": result.clients,
@@ -103,6 +129,10 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
         "replay_identical": replay_identical,
         "fault_counts": result.fault_counts,
         "counters": result.counters,
+        # ingress-stage accounting (wall-derived; outside replay identity)
+        "columnar": columnar,
+        "ingress": result.ingress,
+        "boxed_compare": boxed_compare,
         "passed": passed,
     }
 
@@ -124,6 +154,21 @@ def main(argv=None) -> int:
     parser.add_argument("--replay-check", action="store_true",
                         help="re-run each scenario with the same seed and "
                              "assert bit-identical metrics + counters")
+    parser.add_argument("--boxed", action="store_true",
+                        help="drive the per-op boxed ingress path instead "
+                             "of the columnar wire path (the r10 shape)")
+    parser.add_argument("--sample-every", type=int, default=8,
+                        help="sample every Nth document for elections + "
+                             "the digest oracle (sampled docs keep live "
+                             "broadcast subscribers and pay per-message "
+                             "materialization)")
+    parser.add_argument("--gate", type=float, default=None,
+                        help="override the per-scenario ops/sec floor "
+                             "(e.g. 100000 for the 10^6-client record)")
+    parser.add_argument("--compare-boxed", action="store_true",
+                        help="re-run each scenario through the boxed path "
+                             "and record the ingress_us_per_op ratio "
+                             "(plus a full identity parity verdict)")
     parser.add_argument("--out", default=None,
                         help="write the JSON report here (default stdout)")
     args = parser.parse_args(argv)
@@ -141,16 +186,23 @@ def main(argv=None) -> int:
         "clients": args.clients,
         "docs": args.docs,
         "shards": args.shards,
+        "columnar": not args.boxed,
+        "sample_every": args.sample_every,
         "scenarios": {},
     }
     for name in names:
         result = run_one(name, args.seed, args.clients, args.docs,
                          args.shards, oracle=not args.no_oracle,
-                         replay_check=args.replay_check)
+                         replay_check=args.replay_check,
+                         columnar=not args.boxed,
+                         sample_every=args.sample_every,
+                         gate_override=args.gate,
+                         compare_boxed=args.compare_boxed)
         report["scenarios"][name] = result
         print(
             f"{name}: {result['sequenced_ops']} msgs @ "
-            f"{result['ops_per_sec']:,.0f}/s | delivery p99 "
+            f"{result['ops_per_sec']:,.0f}/s | ingress "
+            f"{result['ingress']['ingress_us_per_op']}us/op | delivery p99 "
             f"{result['delivery_p99_ticks']} ticks | catchup p99 "
             f"{result['catchup_p99_ticks']} ticks | oracle="
             f"{result['oracle_match']} replay={result['replay_identical']} "
