@@ -117,6 +117,8 @@ class FileSummaryStorage(SummaryStorage):
             # (torn write) is dropped rather than left to KeyError readers.
             if rec["commit"] in self._commit_objects:
                 self._set_ref(rec["doc"], rec["ref"], rec["commit"])
+        #: refresh_doc memo: (commits, refs) file sizes already ingested
+        self._chain_sizes = self._chain_file_sizes()  # guarded-by: _lock
 
     def _persist_epoch(self) -> None:
         tmp_path = self._epoch_path + ".tmp"
@@ -143,6 +145,51 @@ class FileSummaryStorage(SummaryStorage):
         self._persist_epoch()
         return token
 
+    def _chain_file_sizes(self) -> tuple:
+        def size(path):
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return 0
+        return (size(self._commits_path), size(self._refs_path))
+
+    def refresh_doc(self, doc_id: str) -> None:
+        """Merge commit-chain records other PROCESSES appended to the
+        shared files (fluidproc adoption/migration): in the
+        out-of-process tier every shard host holds its own instance over
+        the SAME root, and a document's chain is appended by its single
+        owner — when ownership moves, the new owner's in-memory view is
+        stale for exactly the moved documents.  Append-only files + one
+        writer per document (the freeze/kill precedes the move) make
+        this a pure catch-up read: known records skip by digest, the
+        last ref record wins.
+
+        The scan ingests EVERY document's new records (not just
+        ``doc_id``) and memoizes by file size, so a mass failover pays
+        ONE file pass for its whole adoption wave instead of one per
+        document; own-instance uploads keep the memo current."""
+        with self._lock:
+            sizes = self._chain_file_sizes()
+            if sizes == getattr(self, "_chain_sizes", None):
+                return
+            replay_heads: dict = {}
+            for rec in _iter_jsonl(self._commits_path):
+                doc = rec["doc"]
+                parent = rec.get("parent",
+                                 replay_heads.get(doc, self.head(doc)))
+                commit = SummaryCommit(
+                    doc_id=doc, tree=rec["handle"], parent=parent,
+                    ref_seq=rec["refSeq"], message=rec.get("message", ""),
+                )
+                digest = commit.digest()
+                replay_heads[doc] = digest
+                if digest not in self._commit_objects:
+                    self._record_commit(commit)
+            for rec in _iter_jsonl(self._refs_path):
+                if rec["commit"] in self._commit_objects:
+                    self._set_ref(rec["doc"], rec["ref"], rec["commit"])
+            self._chain_sizes = sizes
+
     # -- persistence hooks -----------------------------------------------------
 
     def upload(self, doc_id: str, tree: SummaryTree, ref_seq: int,
@@ -158,6 +205,12 @@ class FileSummaryStorage(SummaryStorage):
                 "refSeq": commit.ref_seq, "parent": commit.parent,
                 "message": commit.message,
             })
+            # Deliberately NOT refreshing the scan memo here: the file
+            # size now also covers bytes OTHER processes appended since
+            # our last scan, and marking those as seen would make the
+            # next refresh skip records it never ingested (an adopted
+            # doc's summary chain would silently vanish).  An own append
+            # merely costs the next refresh one re-scan.
             return handle
 
     def create_ref(self, doc_id: str, name: str, commit_digest: str) -> None:

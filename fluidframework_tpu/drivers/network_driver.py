@@ -26,8 +26,8 @@ import threading
 import weakref
 from typing import Callable, Dict, List, Optional
 
-from ..protocol.messages import (NackError, RawOperation, SequencedMessage,
-                                 ShardFencedError)
+from ..protocol.messages import (DocRelocatedError, NackError, RawOperation,
+                                 SequencedMessage, ShardFencedError)
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
 from ..protocol.wire import (LEN as _LEN, WIRE_VERSION,
                              decode_sequenced_message,
@@ -302,13 +302,14 @@ class _RpcClient:
         only place the sink's own failure surfaces."""
         return self._last_sink_error
 
-    def request(self, method: str, params: dict):
+    def request(self, method: str, params: dict,
+                timeout: Optional[float] = None):
         if self._retry is None or self._closed:
             # A dead socket can never heal by resending — fail fast
             # rather than burn the budget against a closed fd.
-            return self._request_once(method, params)
+            return self._request_once(method, params, timeout=timeout)
         return self._retry.run(
-            lambda: self._request_once(method, params),
+            lambda: self._request_once(method, params, timeout=timeout),
             operation=f"rpc {method}",
             rng=self._retry_rng,
             # Only TRANSPORT-shaped failures resend the same bytes
@@ -327,7 +328,11 @@ class _RpcClient:
             counters=self.retry_counters,
         )
 
-    def _request_once(self, method: str, params: dict):
+    def _request_once(self, method: str, params: dict,
+                      timeout: Optional[float] = None):
+        """``timeout`` overrides the client default for THIS request —
+        supervision probes (the front door's heartbeat ping) must detect
+        a hung shard process in seconds, not the 30 s RPC default."""
         if self._closed:
             raise ConnectionLostError("connection lost")
         fault = (self._faults.fire("rpc.send", doc=params.get("doc"))
@@ -366,7 +371,8 @@ class _RpcClient:
                 self._pending.pop(rid, None)
             raise ConnectionLostError(f"send failed: {exc}")
         try:
-            frame = slot.get(timeout=self._timeout)
+            frame = slot.get(
+                timeout=timeout if timeout is not None else self._timeout)
         except queue.Empty:
             with self._pending_lock:
                 self._pending.pop(rid, None)
@@ -393,6 +399,16 @@ class _RpcClient:
                 raise ShardFencedError(
                     frame.get("doc", ""),
                     frame.get("error", "shard fenced"),
+                )
+            if frame.get("code") == "wrongShard":
+                # Out-of-process redirect: this server no longer owns the
+                # document (live migration, or a stale direct-to-shard
+                # route after failover).  Recovery is the fence path —
+                # re-resolve the owner through the front door and retry
+                # there; a blind in-place resend can never succeed.
+                raise DocRelocatedError(
+                    frame.get("doc", ""),
+                    frame.get("error", "document served by another shard"),
                 )
             if frame.get("code") == "connectionLost":
                 # The reader died and drained this waiter: transport

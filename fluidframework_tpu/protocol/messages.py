@@ -91,6 +91,26 @@ class ShardFencedError(ConnectionError):
         self.doc_id = doc_id
 
 
+class DocRelocatedError(ShardFencedError):
+    """The shard that received this request no longer owns the document —
+    it migrated to another shard (live rebalance) or the caller's routing
+    table is stale after a failover.  The wire form is the ``wrongShard``
+    error code (the out-of-process tier's redirect signal).
+
+    Subclasses :class:`ShardFencedError` deliberately: the recovery is
+    identical — re-resolve the owner (ask the front door / router) and
+    retry there — so every existing fence-handling path (driver no_retry,
+    DeltaManager self-heal, front-door re-route) takes it unchanged.
+    """
+
+    def __init__(self, doc_id: str, reason: str = "") -> None:
+        super().__init__(
+            doc_id,
+            reason or f"document {doc_id!r} is not served by this shard "
+                      f"(migrated or re-owned — re-resolve the owner)",
+        )
+
+
 class BatchAbortedError(ConnectionError):
     """A batched submit (``Sequencer.submit_many``) stopped partway.
 

@@ -189,6 +189,26 @@ class ColumnBatch:
     def doc_id(self, i: int) -> str:
         return self.doc_ids[int(self.doc_index[i])]
 
+    def take(self, rows: np.ndarray) -> "ColumnBatch":
+        """A new batch holding only ``rows`` (in the given order), sharing
+        the string tables by reference — the front door's per-shard split
+        of one client batch.  Row order is preserved, so per-document
+        stamp order is exactly the original batch's."""
+        return ColumnBatch(
+            doc_index=self.doc_index[rows],
+            client_index=self.client_index[rows],
+            client_seq=self.client_seq[rows],
+            ref_seq=self.ref_seq[rows],
+            kind=self.kind[rows],
+            key_index=self.key_index[rows],
+            value=self.value[rows],
+            char_index=self.char_index[rows],
+            doc_ids=self.doc_ids,
+            client_ids=self.client_ids,
+            v=self.v,
+            ds=self.ds,
+        )
+
 
 def column_batch_to_bytes(batch: ColumnBatch) -> bytes:
     """Struct-pack a :class:`ColumnBatch`: fixed-dtype column buffers

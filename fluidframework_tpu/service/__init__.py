@@ -13,6 +13,15 @@ deployment:
   routing, epoch-fenced failover) behind the same service surface.
 - :mod:`broadcaster` — serialize-once broadcast fan-out with laggard
   demotion (the per-doc delta/signal distribution tier).
+- :mod:`shardhost` — fluidproc: one shard as a standalone server
+  PROCESS (own durable log, shared summary store, migration/adoption
+  control plane, SIGTERM drain-and-seal).
+- :mod:`frontdoor` — fluidproc: the routing front door (shard-process
+  supervision, heartbeat death detection, SIGKILL-fenced failover,
+  live document migration).  Imported lazily — not re-exported here —
+  so the in-proc service surface keeps its import graph.
+- :mod:`procclient` — fluidproc: the swarm-facing service adapter over
+  the front door.
 """
 
 from .broadcaster import Broadcaster

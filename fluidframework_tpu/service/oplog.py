@@ -35,6 +35,15 @@ from ..protocol.wire import (ColumnSegment, decode_sequenced_message,
 from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 
 
+def shard_log_path(base_dir: str, shard_id: str) -> str:
+    """The canonical per-shard durable log location of the out-of-process
+    tier (fluidproc): every shard host writes its OWN log file under the
+    shared deployment directory, and failover/migration readers derive a
+    dead or source shard's log from nothing but ``(base_dir, shard_id)``.
+    """
+    return os.path.join(base_dir, "shards", shard_id, "oplog.ndjson")
+
+
 class OpLog:
     """Append-only sequenced-op store for many documents.
 
@@ -42,14 +51,25 @@ class OpLog:
     survives process restarts (the crash-resume tests reopen it).
     ``faults`` (a ``testing.faults.FaultInjector``) arms the
     ``oplog.append`` / ``oplog.flush`` fault sites.
+
+    ``read_only=True`` opens a file-backed log for READS only (no append
+    handle is held and every write raises): the fluidproc adoption path —
+    a surviving shard importing a SIGKILLed peer's documents from that
+    peer's log file — must never become a second writer of a log whose
+    owner could, in principle, still be mid-death.  The torn-tail repair
+    still runs (it is exactly what the dead owner's restart would do).
     """
 
     def __init__(self, path: Optional[str] = None,
-                 autoflush: bool = False, faults=None) -> None:
+                 autoflush: bool = False, faults=None,
+                 read_only: bool = False) -> None:
+        if read_only and path is None:
+            raise ValueError("read_only needs a file-backed log")
         self._docs: Dict[str, List[SequencedMessage]] = {}
         self._path = path
         self._autoflush = autoflush
         self._faults = faults
+        self._read_only = read_only
         #: >0 while inside batch(): per-append autoflush is deferred to
         #: ONE flush at outermost batch exit (group commit)
         self._batch_depth = 0
@@ -78,11 +98,18 @@ class OpLog:
                         log[-1] = msg
                     continue
                 log.append(msg)
-            self._file = open(path, "a", encoding="utf-8")
+            if not read_only:
+                self._file = open(path, "a", encoding="utf-8")
 
     # -- write side (the scriptorium lambda) -----------------------------------
 
+    def _check_writable(self) -> None:
+        if self._read_only:
+            raise OSError(f"op log {self._path!r} is read-only "
+                          "(adoption/backfill view of a peer shard's log)")
+
     def append(self, doc_id: str, msg: SequencedMessage) -> None:
+        self._check_writable()
         log = self._docs.setdefault(doc_id, [])
         if log and msg.seq <= entry_last_seq(log[-1]):
             return  # exactly-once: replays after crash-resume are idempotent
@@ -143,6 +170,7 @@ class OpLog:
         fires exactly as it would under per-op ingress — fault schedules
         line up byte-for-byte across the columnar and boxed modes.
         """
+        self._check_writable()
         n = len(segment)
         if n == 0:
             return
@@ -215,10 +243,13 @@ class OpLog:
     def _repair_open_tail(self) -> None:
         """Best-effort: clear a partial final line left by a failed write
         so later appends do not merge onto it.  The append handle is
-        O_APPEND — its next write lands at the repaired EOF."""
+        O_APPEND — its next write lands at the repaired EOF.  Tolerates a
+        concurrently-sealed handle (ValueError on a closed file): the
+        on-disk repair below is the part that matters."""
         try:
-            self._file.flush()
-        except OSError:
+            if self._file is not None:
+                self._file.flush()
+        except (OSError, ValueError):
             pass
         try:
             repair_jsonl_tail(self._path)

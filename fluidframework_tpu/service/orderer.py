@@ -609,6 +609,29 @@ class LocalOrderingService:
             if not val.done.wait(RECOVERY_JOIN_TIMEOUT):
                 self._recover_reap(doc_id, val)
 
+    def adopt_orderer(self, doc_id: str,
+                      orderer: DocumentOrderer) -> DocumentOrderer:
+        """Install an orderer built elsewhere (fluidproc migration: the
+        target shard restores the source's frozen checkpoint so quorum
+        state and dedup floors continue exactly).  Loses to an existing
+        orderer (``setdefault`` — a concurrent lazy recovery's result is
+        equivalent: both continue the same durable log); born fenced when
+        the shard itself is."""
+        with self.state_lock:
+            fenced = self._fenced
+            installed = self._orderers.setdefault(doc_id, orderer)
+        if fenced:
+            installed.fence()
+        return installed
+
+    def drop_orderer(self, doc_id: str) -> None:
+        """Forget a document's in-memory orderer (migration-abort thaw:
+        a frozen/fenced orderer is discarded so the next ``endpoint()``
+        lazily recovers a LIVE one from this shard's own durable log —
+        quorum and dedup floors rebuild from the replay)."""
+        with self.state_lock:
+            self._orderers.pop(doc_id, None)
+
     def submit_many(self, batches: Dict[str, List[RawOperation]]
                     ) -> Dict[str, SubmitOutcome]:
         """Batched ingress — see :func:`submit_batches` (the swarm-scale

@@ -35,7 +35,8 @@ import json
 import threading
 from typing import Dict, Optional, Set
 
-from ..protocol.messages import NackError, ShardFencedError
+from ..protocol.messages import (DocRelocatedError, NackError,
+                                 ShardFencedError)
 from ..protocol.summary import tree_from_obj, tree_to_obj
 from ..protocol.wire import (LEN as _LEN, MAX_FRAME, WIRE_VERSION,
                              decode_raw_operation,
@@ -254,6 +255,25 @@ class OrderingServer:
         #: faultline hook for the ``session.write`` stall site
         #: (testing/faults.py); None in production.
         self.faults = faults
+        #: extension point (fluidproc): method name -> fn(session, params),
+        #: consulted BEFORE the built-in table so a shard host can add its
+        #: control-plane RPC (freeze/export/import/adopt/stats) — or
+        #: override a built-in — without forking the dispatch loop.
+        self.extra_methods: Dict[str, callable] = {}
+        #: instance copy of OFFLOADED_METHODS so subclasses can offload
+        #: their own slow routes.
+        self.offloaded_methods = set(OFFLOADED_METHODS)
+        #: drain mode (SIGTERM): mutating/new work is refused with a
+        #: typed retryable ``shuttingDown`` nack while in-flight work
+        #: finishes and the durable log is sealed.  Methods listed in
+        #: ``drain_exempt`` still answer (supervision probes).
+        self.draining = False
+        self.drain_exempt = {"ping", "stats", "shard_info"}
+        #: in-flight EXECUTOR dispatches (offloaded methods only; inline
+        #: dispatches run on the event loop, which the drain sequence
+        #: shares, so they can never be mid-flight when it runs).
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0  # guarded-by: _inflight_lock
         #: admission control for the catchup RPC: device folds are the
         #: most expensive op the server runs — beyond this many in
         #: flight, new requests are SHED with an "overloaded" nack
@@ -361,6 +381,18 @@ class OrderingServer:
             return True
         if method == "ping":
             return "pong"
+        if self.draining and method not in self.drain_exempt:
+            # Typed retryable refusal: clients hold their encoded ops and
+            # retry after the restart (NackError semantics); nothing new
+            # may touch the log once the drain sequence armed the seal.
+            raise NackError(
+                "server is draining for shutdown; retry after restart",
+                retry_after=0.5, code="shuttingDown")
+        extra = self.extra_methods.get(method)
+        if extra is not None:
+            return extra(session, params)
+        if method == "stats":
+            return self._stats()
         # Generation check for EVERY doc/storage method in one place —
         # deltas, submits, and catchup included, not just the summary RPCs
         # (review r4: op-stream generation mixing must fail loudly too).
@@ -491,6 +523,55 @@ class OrderingServer:
             return tree_to_obj(node)
         raise ValueError(f"unknown method {method!r}")
 
+    def _stats(self) -> dict:
+        """The ``stats`` RPC: service-level counters every deployment
+        shape answers (the fluidproc shard host extends this with
+        per-shard identity and log heads)."""
+        service = self.service
+        docs = service.doc_ids()
+        return {
+            "docs": len(docs),
+            "ops": sum(service.oplog.head(d) for d in docs),
+            "epoch": service.storage.epoch,
+            "admission": self.admission.snapshot(),
+        }
+
+    def _track_dispatch(self, session: _ClientSession, method: str,
+                        params: dict):
+        """Executor-side dispatch wrapper: counts in-flight offloaded
+        work so the drain sequence can wait it out before sealing."""
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            return self._dispatch(session, method, params)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    async def drain_and_seal(self, seal=None, timeout: float = 30.0) -> None:
+        """SIGTERM drain: refuse new work (typed ``shuttingDown`` nacks),
+        stop accepting connections, wait out in-flight offloaded
+        dispatches, then run ``seal`` (the shard host flushes + closes
+        its durable log).  Inline dispatches — submits and their group
+        commits — run to completion on this same event loop before the
+        signal callback that starts this coroutine can execute, so a
+        SIGTERM landing mid-group-commit drains the in-flight batch by
+        construction; the seal's flush then makes its bytes durable."""
+        self.draining = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            with self._inflight_lock:
+                idle = self._inflight == 0
+            if idle:
+                break
+            await asyncio.sleep(0.02)
+        if seal is not None:
+            seal()
+
     def _catchup_rpc(self, session: _ClientSession, params: dict):
         """The catchup method body, run under an admission slot.
 
@@ -576,7 +657,7 @@ class OrderingServer:
                     try:
                         method = frame.get("method")
                         params = frame.get("params", {})
-                        if method in OFFLOADED_METHODS:
+                        if method in self.offloaded_methods:
                             # Device folds take seconds and storage
                             # mutations hold the commit-chain lock across
                             # disk writes; running either inline would
@@ -585,7 +666,7 @@ class OrderingServer:
                             # returns.
                             result = await asyncio.get_running_loop() \
                                 .run_in_executor(
-                                    None, self._dispatch, session,
+                                    None, self._track_dispatch, session,
                                     method, params,
                                 )
                         else:
@@ -599,6 +680,17 @@ class OrderingServer:
                                     "ok": False, "error": str(em),
                                     "code": "epochMismatch",
                                     "epoch": em.server_epoch}
+                    except DocRelocatedError as dr:
+                        # Out-of-process redirect: this shard no longer
+                        # owns the document (migrated away / stale
+                        # route).  Distinct code so callers re-resolve
+                        # the owner instead of treating it as a fence of
+                        # a live assignment.
+                        response = {"v": WIRE_VERSION,
+                                    "re": frame.get("id"),
+                                    "ok": False, "error": str(dr),
+                                    "code": "wrongShard",
+                                    "doc": dr.doc_id}
                     except ShardFencedError as sf:
                         # Mid-failover race: the request reached an
                         # orderer in the instant between its fence and
@@ -650,7 +742,11 @@ class OrderingServer:
             await self.start()
             started.set()
             async with self._server:
-                await self._server.serve_forever()
+                try:
+                    await self._server.serve_forever()
+                except asyncio.CancelledError:
+                    pass  # server.close() from another thread: normal
+                    # shutdown of an embedded server, not an error
 
         thread = threading.Thread(
             target=lambda: asyncio.run(_run()), daemon=True

@@ -59,6 +59,17 @@ from .orderer import (DocumentEndpoint, DocumentOrderer,
 FenceListener = Callable[[str, List[str], str], None]
 
 
+def fence_token(epoch: str, shard_id: str) -> str:
+    """Deterministic next storage epoch for fencing ``shard_id`` out of a
+    tier whose current epoch is ``epoch`` — ONE derivation shared by the
+    in-proc tier and the fluidproc front door, because byte-identical
+    fence epochs across tiers are part of the failover parity bar."""
+    return hashlib.sha256(
+        b"fence\x00" + epoch.encode("utf-8")
+        + b"\x00" + shard_id.encode("utf-8")
+    ).hexdigest()
+
+
 def rendezvous_score(doc_id: str, shard_id: str) -> int:
     """Deterministic 64-bit weight of (document, shard) — sha256-based so
     every process/run agrees without shared state, and uncorrelated
@@ -396,10 +407,7 @@ class ShardedOrderingService:
         """Deterministic next storage epoch for killing ``shard_id``:
         derived from the current epoch so replay harnesses produce the
         same fence token on every run (no wall clock, no PRNG)."""
-        return hashlib.sha256(
-            b"fence\x00" + self.storage.epoch.encode("utf-8")
-            + b"\x00" + shard_id.encode("utf-8")
-        ).hexdigest()
+        return fence_token(self.storage.epoch, shard_id)
 
     def kill_shard(self, shard_id: str) -> List[str]:
         """Fail one shard: fence its orderers, re-route its documents,

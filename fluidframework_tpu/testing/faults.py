@@ -68,11 +68,21 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "session.write": ("stall",),
     "shard.kill": ("kill",),
     "client.stall": ("stall",),
+    # fluidproc (out-of-process tier): the front door executes these
+    # against REAL shard processes at scheduled virtual ticks —
+    # ``proc.kill`` is SIGKILL (no drain, no seal; the per-shard log's
+    # torn tail and the adoption path are the recovery under test),
+    # ``proc.hang`` is SIGSTOP (the process is alive but silent; only
+    # heartbeat-based death detection can notice, and the front door
+    # SIGKILLs it before re-owning its documents — see SEMANTICS.md
+    # "Deployment & migration").
+    "proc.kill": ("kill",),
+    "proc.hang": ("hang",),
 }
 
 #: sites matched by occurrence count (the seam calls ``fire``); the rest
 #: are schedule-driven (the harness calls ``due`` with the virtual tick).
-SCHEDULED_SITES = ("shard.kill", "client.stall")
+SCHEDULED_SITES = ("shard.kill", "client.stall", "proc.kill", "proc.hang")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -162,12 +162,30 @@ class ScenarioSpec:
     #: fallback for pending/scripted/subscriber-bearing documents.
     #: ``False`` = the r10 boxed path — the byte-identical parity oracle.
     columnar: bool = True
+    #: out-of-process tier (ISSUE 12): drive the scenario against REAL
+    #: shard-host processes behind an in-process front door — every op
+    #: crosses the wire twice (swarm → front door → owning shard), logs
+    #: are per-shard files on disk, and scheduled ``proc.kill`` /
+    #: ``proc.hang`` points SIGKILL/SIGSTOP real processes.  Only
+    #: SCHEDULED fault sites are allowed in the plan (a seam site like
+    #: ``oplog.append`` lives inside a shard process this harness cannot
+    #: reach — such a plan fails loudly instead of reporting hollow
+    #: coverage).
+    out_of_proc: bool = False
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
             raise ValueError("need at least one client per document")
         if self.docs < 1 or self.shards < 1:
             raise ValueError(f"bad docs/shards on {self.name!r}")
+        if self.out_of_proc and self.plan is not None:
+            allowed = {"proc.kill", "proc.hang", "shard.kill"}
+            bad = [p.label() for p in self.plan.points
+                   if p.site not in allowed]
+            if bad:
+                raise ValueError(
+                    f"out-of-proc scenarios only execute scheduled "
+                    f"process faults {sorted(allowed)}; plan has {bad}")
 
     @property
     def ticks(self) -> int:
@@ -224,12 +242,18 @@ class SwarmResult:
     #: ingress-stage accounting (IngressMeter.snapshot()): wall-derived,
     #: NOT part of the replay-identity surface
     ingress: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: out-of-proc runs: per-shard stats pulled over the ``stats`` RPC
+    #: plus live-tap delivery accounting — carries pids and async frame
+    #: counts, so (like ``ingress``) excluded from replay identity
+    shard_stats: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def identity(self) -> dict:
         """The bit-identity surface: every field, canonically shaped —
-        except ``ingress``, which is wall-clock derived and excluded."""
+        except ``ingress`` and ``shard_stats``, which are wall-clock /
+        process derived and excluded."""
         out = dataclasses.asdict(self)
         out.pop("ingress", None)
+        out.pop("shard_stats", None)
         return out
 
 
@@ -402,20 +426,56 @@ class ClientSwarm:
         # -- the real service -------------------------------------------
         self.injector = (FaultInjector(spec.plan)
                          if spec.plan is not None else None)
-        if spec.dir is not None:
+        self._cluster = None
+        self._tmpdir = None
+        self._proc_taps: Dict[str, object] = {}
+        self._proc_frames: Dict[str, set] = {}
+        if spec.out_of_proc:
+            # The REAL process tier: shard-host processes with per-shard
+            # durable logs behind an in-process front door (the harness
+            # drives its fault-plan tick and reads its stats directly).
             import os as _os
+            import tempfile as _tempfile
 
-            _os.makedirs(spec.dir, exist_ok=True)
-            oplog = OpLog(_os.path.join(spec.dir, "swarm-ops.jsonl"),
-                          autoflush=True, faults=self.injector)
+            from ..drivers.network_driver import \
+                NetworkDocumentServiceFactory
+            from ..service.frontdoor import FrontDoor
+            from ..service.procclient import ProcServiceClient
+
+            base = spec.dir
+            if base is None:
+                self._tmpdir = _tempfile.mkdtemp(prefix="fluidproc-swarm-")
+                base = self._tmpdir
+            _os.makedirs(base, exist_ok=True)
+            self._cluster = FrontDoor(
+                _os.path.join(base, "proc"), n_shards=spec.shards,
+                spawn="proc", faults=self.injector,
+                request_timeout=5.0).start()
+            try:
+                self.service = ProcServiceClient(self._cluster)
+                self.factory = NetworkDocumentServiceFactory(
+                    port=self._cluster.port)
+            except BaseException:
+                # Construction failed AFTER the processes spawned: reap
+                # them, or every failed setup leaks a live shard fleet.
+                self._cluster.close()
+                raise
         else:
-            oplog = OpLog(faults=self.injector)
-        if spec.shards > 1:
-            self.service = ShardedOrderingService(
-                n_shards=spec.shards, oplog=oplog, faults=self.injector)
-        else:
-            self.service = LocalOrderingService(oplog=oplog)
-        self.factory = LocalDocumentServiceFactory(self.service)
+            if spec.dir is not None:
+                import os as _os
+
+                _os.makedirs(spec.dir, exist_ok=True)
+                oplog = OpLog(_os.path.join(spec.dir, "swarm-ops.jsonl"),
+                              autoflush=True, faults=self.injector)
+            else:
+                oplog = OpLog(faults=self.injector)
+            if spec.shards > 1:
+                self.service = ShardedOrderingService(
+                    n_shards=spec.shards, oplog=oplog,
+                    faults=self.injector)
+            else:
+                self.service = LocalOrderingService(oplog=oplog)
+            self.factory = LocalDocumentServiceFactory(self.service)
         self.loader = Loader(self.factory, clock=VirtualClock())
         self.broadcaster = Broadcaster()
         self._sink = _SwarmSink(self.counters)
@@ -468,9 +528,12 @@ class ClientSwarm:
             c.drain()
             c.close()
             if d in sampled:
-                self.broadcaster.attach(doc_id,
-                                        self.service.endpoint(doc_id),
-                                        self._sink)
+                if self.spec.out_of_proc:
+                    self._tap_proc_doc(doc_id)
+                else:
+                    self.broadcaster.attach(doc_id,
+                                            self.service.endpoint(doc_id),
+                                            self._sink)
         if isinstance(self.service, ShardedOrderingService):
             self.service.add_fence_listener(
                 lambda _sid, docs, epoch: [
@@ -481,10 +544,28 @@ class ClientSwarm:
             )
         self._sync_heads(range(self.spec.docs), tick=0)
 
+    def _tap_proc_doc(self, doc_id: str) -> None:
+        """Out-of-proc sampled doc: a LIVE broadcast tap through the
+        front-door relay (the real per-message fan-out consumer — the
+        shard serves these docs boxed, exactly the in-proc topology).
+        Delivery is async wall-time, so the unique-seq accounting lands
+        in ``shard_stats`` (outside replay identity)."""
+        conn = self.factory.resolve(doc_id).connection()
+        seen = self._proc_frames.setdefault(doc_id, set())
+        conn.subscribe(lambda msg, s=seen: s.add(msg.seq))
+        self._proc_taps[doc_id] = conn
+
     def _sync_heads(self, doc_indices, tick: int) -> None:
-        """Record stamp ticks for every new seq and refresh head_arr."""
-        for d in doc_indices:
-            head = self.service.oplog.head(self.doc_ids[d])
+        """Record stamp ticks for every new seq and refresh head_arr.
+        Out-of-proc services read heads in ONE bulk RPC (grouped by
+        owning shard) instead of one round-trip per document."""
+        doc_indices = list(doc_indices)
+        ids = [self.doc_ids[d] for d in doc_indices]
+        bulk = getattr(self.service, "heads", None)
+        heads = (bulk(ids) if bulk is not None
+                 else {i: self.service.oplog.head(i) for i in ids})
+        for d, doc_id in zip(doc_indices, ids):
+            head = heads[doc_id]
             ticks = self.stamp_ticks[d]
             if head > len(ticks):
                 ticks.extend([tick] * (head - len(ticks)))
@@ -764,19 +845,33 @@ class ClientSwarm:
                     # resubmit as a plain pending batch next tick.
                     defer_now[d] = [batch.materialize(int(i))
                                     for i in col_rows[doc_id].tolist()]
-                self.defers.append((t, d, outcome.consumed))
+                consumed = outcome.consumed
+                if consumed < 0:
+                    # Out-of-proc "shard died mid-batch": the exact
+                    # consumed count died with the process — the durable
+                    # head (read from the adopted owner) is the whole
+                    # truth, same as the JOIN-deferral readback.
+                    consumed = max(0, self.service.oplog.head(doc_id)
+                                   - int(self.head_arr[d]))
+                self.defers.append((t, d, consumed))
                 self.counters.bump("swarm.defers")
         self.pending = defer_now
         self._sync_heads(touched, t)
         return touched
 
     def _drive_faults(self, t: int) -> None:
-        if self.injector is None or not isinstance(
-                self.service, ShardedOrderingService):
+        """Scheduled fault execution: in-proc shard kills and (out of
+        proc) real process kills/hangs both ride the service's ``tick``
+        driver — the router diff is the mode-independent kill record."""
+        if self.injector is None:
             return
-        before = set(self.service.router.dead())
-        affected = self.service.tick(t)
-        newly = [s for s in self.service.router.dead() if s not in before]
+        router = getattr(self.service, "router", None)
+        tick = getattr(self.service, "tick", None)
+        if router is None or tick is None:
+            return
+        before = set(router.dead())
+        affected = tick(t)
+        newly = [s for s in router.dead() if s not in before]
         if newly:
             self.kills.append((t, newly[0], len(affected)))
             self.counters.bump("swarm.kills")
@@ -940,13 +1035,25 @@ class ClientSwarm:
 
     def _result(self, t: int,
                 phase_counters: Dict[str, Dict[str, int]]) -> SwarmResult:
-        per_doc_head = {doc: self.service.oplog.head(doc)
-                        for doc in self.doc_ids}
-        for doc in self.doc_ids:
-            # O(log entries), not O(messages): columnar segments verify
-            # by boundary (their seqs are an arange by construction).
-            if not self.service.oplog.is_contiguous(doc):
-                raise AssertionError(f"{doc} seq numbers not contiguous")
+        bulk = getattr(self.service, "heads", None)
+        per_doc_head = (bulk(self.doc_ids) if bulk is not None
+                        else {doc: self.service.oplog.head(doc)
+                              for doc in self.doc_ids})
+        # O(log entries), not O(messages): columnar segments verify by
+        # boundary (their seqs are an arange by construction).  Out-of-
+        # proc services answer in bulk RPCs grouped by owning shard.
+        bulk_contig = getattr(self.service, "contiguous", None)
+        if bulk_contig is not None:
+            broken = sorted(doc for doc, ok in
+                            bulk_contig(self.doc_ids).items() if not ok)
+            if broken:
+                raise AssertionError(
+                    f"seq numbers not contiguous: {broken}")
+        else:
+            for doc in self.doc_ids:
+                if not self.service.oplog.is_contiguous(doc):
+                    raise AssertionError(
+                        f"{doc} seq numbers not contiguous")
         digests = {}
         for d in self.sampled:
             ro = self.loader.resolve(self.doc_ids[d])
@@ -986,23 +1093,68 @@ class ClientSwarm:
             counters=counters,
             phase_counters=phase_counters,
             ingress=self.ingress.snapshot(),
+            shard_stats=self._shard_stats(per_doc_head),
         )
+
+    def _shard_stats(self, per_doc_head: Dict[str, int]) -> Dict[str, object]:
+        """Out-of-proc only: per-shard ``stats`` RPC pulls + the live-tap
+        delivery audit (unique seqs relayed to the swarm's sampled-doc
+        subscriptions — async wall-time, hence outside identity)."""
+        if self._cluster is None:
+            return {}
+        return {
+            "cluster": self.service.stats(),
+            "tap_unique_frames": {doc: len(seen) for doc, seen
+                                  in sorted(self._proc_frames.items())},
+            "tap_heads": {doc: per_doc_head[doc]
+                          for doc in sorted(self._proc_frames)},
+        }
+
+    def close(self) -> None:
+        """Tear the run down: out-of-proc clusters terminate their shard
+        processes (SIGTERM → drain-and-seal) and temp deployments are
+        removed; in-proc runs have nothing to release."""
+        if self._cluster is None:
+            return
+        try:
+            self.factory.close()
+        except OSError:
+            pass
+        self.service.close()
+        self._cluster.close()
+        self._cluster = None
+        if self._tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
 
 
 def run_swarm(spec: ScenarioSpec) -> SwarmResult:
-    """Drive one scenario end to end; pure function of ``spec``."""
-    return ClientSwarm(spec).run()
+    """Drive one scenario end to end; pure function of ``spec`` (modulo
+    the wall-derived ``ingress``/``shard_stats`` fields).  Out-of-proc
+    runs always release their shard processes, success or not."""
+    swarm = ClientSwarm(spec)
+    try:
+        return swarm.run()
+    finally:
+        swarm.close()
 
 
 def oracle_spec(spec: ScenarioSpec, result: SwarmResult) -> ScenarioSpec:
-    """The fault-free single-shard twin of a completed run: same seed and
-    phases, no faults, with the run's recorded op/JOIN deferrals replayed
-    as scripted splits so both runs stamp byte-identical logs."""
+    """The fault-free single-shard IN-PROCESS twin of a completed run:
+    same seed and phases, no faults, no processes, with the run's
+    recorded op/JOIN deferrals replayed as scripted splits so both runs
+    stamp byte-identical logs.  For an out-of-proc run this is the
+    strongest cross-validation in the repo: a process tier under real
+    SIGKILLs must land byte-identical per-document state to a single
+    in-memory orderer."""
     return dataclasses.replace(
         spec,
         shards=1,
         plan=None,
         dir=None,
+        out_of_proc=False,
         scripted_defers=tuple(result.defers),
         scripted_join_defers=tuple(result.join_defers),
     )

@@ -1,0 +1,653 @@
+"""fluidproc (ISSUE 12): out-of-process serving tier.
+
+Three layers of coverage:
+
+1. Engine/logic tests against THREAD-backend clusters (same RPC, same
+   per-shard on-disk logs, "kill" = abandon-without-another-stamp): the
+   routing proxy, epoch-fenced failover with adoption from the dead
+   shard's log, lazy adoption, the wrongShard redirect, live migration
+   (~1/N movers, byte-identical logs, retirement), and a crash point at
+   EVERY migration step.
+2. REAL-process tests (``ProcShard``): kill -9 mid-traffic converging
+   byte-identical to the fault-free single-service oracle, SIGSTOP hang
+   detection, SIGTERM drain-and-seal with restart-resumes-contiguous,
+   and the per-shard ``stats`` RPC.
+3. The fluidscale swarm driven out-of-proc: the 10³-client tier-1 smoke
+   (oracle-verified) and the ``slow``-marked 10⁵ scenario matrix.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.network_driver import (
+    NetworkDocumentServiceFactory, _RpcClient)
+from fluidframework_tpu.protocol.messages import (DocRelocatedError,
+                                                  MessageType, NackError,
+                                                  RawOperation)
+from fluidframework_tpu.protocol.wire import encode_raw_operation
+from fluidframework_tpu.service.frontdoor import (FrontDoor,
+                                                  MigrationAborted,
+                                                  ProcShard)
+from fluidframework_tpu.service.oplog import shard_log_path
+from fluidframework_tpu.service.orderer import LocalOrderingService
+from fluidframework_tpu.service.sharding import rendezvous_score
+from fluidframework_tpu.testing.faults import (FaultInjector, FaultPlan,
+                                               FaultPoint, SCHEDULED_SITES,
+                                               SITES)
+
+
+def _op(client, i, ref):
+    return RawOperation(client_id=client, client_seq=i + 1, ref_seq=ref,
+                        type=MessageType.OP, contents={"i": i})
+
+
+def _drive_tier(door, docs, n_ops, start=0, refs=None, progress=None):
+    """Submit ``n_ops`` ops per doc through the front door (one logical
+    writer per doc — the per-doc op stream is deterministic), riding
+    failovers via a bounded retry loop.  ``progress`` (a one-element
+    list) exposes the completed op index to a concurrent killer."""
+    refs = refs if refs is not None else {}
+    factory = NetworkDocumentServiceFactory(port=door.port)
+    rpc = factory._rpc
+    try:
+        if start == 0:
+            for d in docs:
+                rpc.request("create_document", {"doc": d})
+                rpc.request("connect", {"doc": d, "client": f"w-{d}"})
+                refs[d] = rpc.request("head", {"doc": d})
+        for i in range(start, start + n_ops):
+            for d in docs:
+                for _attempt in range(10):
+                    try:
+                        result = rpc.request("submit", {
+                            "doc": d,
+                            "op": encode_raw_operation(
+                                _op(f"w-{d}", i, refs[d]))})
+                        if result is None:
+                            # Deduped resend: the first attempt LANDED
+                            # before the kill and the response died with
+                            # the process — the op is durable; read the
+                            # head back (client_seq dedup is the whole
+                            # point of safe resends).
+                            refs[d] = rpc.request("head", {"doc": d})
+                        else:
+                            refs[d] = result["sequenceNumber"]
+                        break
+                    except (ConnectionError, OSError, NackError):
+                        time.sleep(0.05)
+                else:
+                    raise AssertionError(f"{d}: op {i} never landed")
+            if progress is not None:
+                progress[0] = i
+    finally:
+        factory.close()
+    return refs
+
+
+def _oracle_logs(docs, n_ops):
+    """The fault-free single-service oracle: identical per-doc op
+    streams through ONE in-proc orderer; returns {doc: wire dicts}."""
+    service = LocalOrderingService()
+    out = {}
+    for d in docs:
+        endpoint = service.create_document(d)
+        endpoint.connect(f"w-{d}")
+        ref = endpoint.head_seq
+        for i in range(n_ops):
+            ref = endpoint.submit(_op(f"w-{d}", i, ref)).seq
+        from fluidframework_tpu.protocol.wire import encode_sequenced_message
+
+        out[d] = [encode_sequenced_message(m) for m in endpoint.deltas()]
+    return out
+
+
+def _tier_logs(door, docs):
+    return {d: door._forward_doc("deltas", {"doc": d}) for d in docs}
+
+
+# -- faultline sites ----------------------------------------------------------
+
+
+def test_proc_fault_sites_registered_and_scheduled():
+    assert SITES["proc.kill"] == ("kill",)
+    assert SITES["proc.hang"] == ("hang",)
+    assert "proc.kill" in SCHEDULED_SITES and "proc.hang" in SCHEDULED_SITES
+    FaultPoint("proc.kill", "kill", at=7, doc="d").validate()
+    with pytest.raises(ValueError):
+        FaultPoint("proc.kill", "hang").validate()
+
+
+def test_proc_fault_points_fire_via_due_with_coverage_accounting():
+    plan = FaultPlan(points=(
+        FaultPoint("proc.kill", "kill", at=5, shard="s1"),
+        FaultPoint("proc.hang", "hang", at=3, doc="d0"),
+    ))
+    injector = FaultInjector(plan)
+    assert injector.due("proc.kill", 4) == []
+    hung = injector.due("proc.hang", 3)
+    assert [p.site for p in hung] == ["proc.hang"]
+    killed = injector.due("proc.kill", 9)
+    assert [p.shard for p in killed] == ["s1"]
+    assert injector.unfired() == []
+    # an unexecutable kill rolls its mark back for the coverage oracle
+    injector.mark_unfired(killed[0])
+    assert [p.site for p in injector.unfired()] == ["proc.kill"]
+    assert injector.snapshot() == {"proc.hang:hang": 1,
+                                   "proc.kill:kill": 0}
+
+
+# -- thread-backend cluster logic ---------------------------------------------
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=4,
+                     spawn="thread").start()
+    yield door
+    door.close()
+
+
+DOCS = [f"doc-{i}" for i in range(10)]
+
+
+def test_frontdoor_routes_proxies_and_reports_stats(cluster):
+    _drive_tier(cluster, DOCS[:4], 5)
+    heads = cluster.heads(DOCS[:4])
+    assert all(h == 6 for h in heads.values()), heads  # JOIN + 5 ops
+    client = _RpcClient("127.0.0.1", cluster.port)
+    try:
+        stats = client.request("stats", {})
+    finally:
+        client.close()
+    assert sorted(stats["shards"]) == cluster.router.shard_ids()
+    assert sum(s["ops"] for s in stats["shards"].values()
+               if "ops" in s) == 24
+    per_shard_docs = sum(s["docs"] for s in stats["shards"].values())
+    assert per_shard_docs == 4
+    assert stats["epoch"] == cluster.epoch
+
+
+def test_failover_converges_byte_identical_to_oracle(cluster):
+    refs = _drive_tier(cluster, DOCS, 4)
+    victim = cluster._route_probe(DOCS[0])[0]
+    old_epoch = cluster.epoch
+    affected = cluster.fail_shard(victim)
+    assert DOCS[0] in affected
+    # traffic continues across the whole doc set, same logical streams
+    _drive_tier(cluster, DOCS, 4, start=4, refs=refs)
+    assert cluster.epoch != old_epoch  # fence epoch bumped on survivors
+    for d in DOCS:
+        assert cluster._forward_doc("log_contiguous", {"doc": d}), d
+    assert _tier_logs(cluster, DOCS) == _oracle_logs(DOCS, 8)
+    # the dead shard's documents all re-owned off the corpse
+    for d in DOCS:
+        assert cluster._route_probe(d)[0] != victim
+
+
+def test_lazy_adoption_on_first_touch(cluster):
+    _drive_tier(cluster, DOCS, 3)
+    victim = cluster._route_probe(DOCS[0])[0]
+    victims_docs = [d for d in DOCS
+                    if cluster._route_probe(d)[0] == victim]
+    cluster.fail_shard(victim)
+    with cluster._route_lock:
+        orphaned = dict(cluster._orphans)
+    # no subscriptions in this harness → nothing adopted eagerly
+    assert sorted(orphaned) == sorted(victims_docs)
+    assert all(src == victim for src in orphaned.values())
+    # first touch imports the span from the dead shard's log
+    head = cluster.heads([victims_docs[0]])[victims_docs[0]]
+    assert head == 4  # JOIN + 3 ops, nothing lost
+    with cluster._route_lock:
+        assert victims_docs[0] not in cluster._orphans
+
+
+def test_wrong_shard_redirect_roundtrip(cluster):
+    _drive_tier(cluster, DOCS[:2], 2)
+    doc = DOCS[0]
+    sid = cluster._route_probe(doc)[0]
+    handle = cluster._shard(sid)
+    handle.request("retire_doc", {"doc": doc})
+    # direct-to-shard clients get the typed redirect...
+    direct = _RpcClient(handle.addr[0], handle.addr[1])
+    try:
+        with pytest.raises(DocRelocatedError):
+            direct.request("head", {"doc": doc})
+    finally:
+        direct.close()
+    # ...while the front door re-resolves: un-retire by re-adopting the
+    # doc (import path clears retirement), which _forward_doc triggers
+    # by re-routing after the wrongShard answer.
+    with cluster._route_lock:
+        cluster._orphans[doc] = sid
+    head = cluster.heads([doc])[doc]
+    assert head == 3
+
+
+def test_live_migration_moves_docs_byte_identically(cluster):
+    refs = _drive_tier(cluster, DOCS, 4)
+    before = {d: cluster._route_probe(d)[0] for d in DOCS}
+    result = cluster.add_shard("shard90")
+    after = {d: cluster._route_probe(d)[0] for d in DOCS}
+    movers = [d for d in DOCS if after[d] == "shard90"]
+    assert sorted(result["moved"]) == sorted(movers)
+    # rendezvous property: ONLY docs moving to the new shard moved
+    for d in DOCS:
+        if d not in movers:
+            assert after[d] == before[d], d
+    # traffic continues on every doc (migrated included), then compare
+    _drive_tier(cluster, DOCS, 4, start=4, refs=refs)
+    for d in DOCS:
+        assert cluster._forward_doc("log_contiguous", {"doc": d}), d
+    assert _tier_logs(cluster, DOCS) == _oracle_logs(DOCS, 8)
+    # the source copies are RETIRED: a stale direct route cannot fork
+    if movers:
+        src = before[movers[0]]
+        handle = cluster._shard(src)
+        direct = _RpcClient(handle.addr[0], handle.addr[1])
+        try:
+            with pytest.raises(DocRelocatedError):
+                direct.request("submit", {
+                    "doc": movers[0],
+                    "op": encode_raw_operation(
+                        _op(f"w-{movers[0]}", 99, 0))})
+        finally:
+            direct.close()
+
+
+def _movers_for(door, docs, new_sid):
+    future = door.router.alive() + [new_sid]
+    return [d for d in docs
+            if max(future, key=lambda s: (rendezvous_score(d, s), s))
+            == new_sid]
+
+
+@pytest.mark.parametrize("step,who", [
+    ("freeze", "src"), ("transfer", "src"), ("import", "src"),
+    ("flip", "src"), ("resume", "src"),
+    ("import", "dst"), ("flip", "dst"), ("resume", "dst"),
+])
+def test_migration_crash_points_converge(tmp_path, step, who):
+    """Kill a shard process at EVERY migration step, source and target:
+    source deaths degrade to failover + retry (the doc still ends up
+    migrated, logs never fork); a pre-import target death aborts the
+    expansion with the frozen doc THAWED — it never left; a post-import
+    target death converges through the failover/adoption path (the
+    target's log already holds the live span) whether the expansion
+    aborts or joins a corpse the next touch fails over."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=4,
+                     spawn="thread").start()
+    try:
+        refs = _drive_tier(door, DOCS, 3)
+        new_sid = "shard91"
+        movers = _movers_for(door, DOCS, new_sid)
+        assert movers, "need at least one migrating doc for a crash test"
+        target_doc = movers[0]
+        src_sid = door._route_probe(target_doc)[0]
+        fired = []
+
+        def hook(at_step, doc):
+            if at_step == step and doc == target_doc and not fired:
+                fired.append((at_step, doc))
+                victim = src_sid if who == "src" else new_sid
+                door._shards[victim].kill()
+
+        door.set_crash_hook(hook)
+        if who == "dst" and step == "import":
+            # pre-import target death: clean abort, nothing moved
+            with pytest.raises(MigrationAborted):
+                door.add_shard(new_sid)
+            assert new_sid not in door.router.shard_ids()
+        elif who == "dst":
+            # post-import target death: the span is durable in the
+            # target's log — the expansion may abort (re-orphaning the
+            # flipped docs) or complete with a corpse; either way the
+            # traffic below must converge via failover/adoption.
+            try:
+                door.add_shard(new_sid)
+            except MigrationAborted:
+                pass
+        else:
+            result = door.add_shard(new_sid)
+            assert target_doc in result["moved"]
+            assert door._route_probe(target_doc)[0] == new_sid
+        door.set_crash_hook(None)
+        assert fired, "crash hook never fired"
+        # the tier converges: same logical streams continue everywhere
+        _drive_tier(door, DOCS, 3, start=3, refs=refs)
+        for d in DOCS:
+            assert door._forward_doc("log_contiguous", {"doc": d}), d
+        assert _tier_logs(door, DOCS) == _oracle_logs(DOCS, 6)
+    finally:
+        door.close()
+
+
+def test_refresh_doc_after_own_upload_still_ingests_peer_records(tmp_path):
+    """Regression (caught by the 10⁵ drill re-record): the refresh scan
+    memo must only advance inside refresh_doc itself.  An instance's OWN
+    upload grows the shared file past records OTHER processes appended
+    since its last scan — snapshotting the size there marked those as
+    seen, and the adopted doc's summary chain silently vanished."""
+    from fluidframework_tpu.drivers.file_driver import FileSummaryStorage
+    from fluidframework_tpu.protocol.summary import SummaryTree
+
+    root = str(tmp_path / "summaries")
+    a = FileSummaryStorage(root)
+    b = FileSummaryStorage(root)
+    # A (another process's instance) appends doc X's chain...
+    ha = a.upload("doc-x", SummaryTree().add_blob("b", b"peer"), 5)
+    # ...then B uploads for ITS OWN doc before ever refreshing
+    b.upload("doc-y", SummaryTree().add_blob("b", b"own"), 3)
+    # B adopts doc X: refresh must still ingest A's record
+    b.refresh_doc("doc-x")
+    assert b.head("doc-x") is not None
+    assert b.read_commit(b.head("doc-x")).tree == ha
+    tree, ref_seq = b.latest("doc-x")
+    assert ref_seq == 5 and tree.digest() == ha
+
+
+def test_last_live_shard_is_unfailable_before_the_kill(tmp_path):
+    """Review pin: the last live shard is refused BEFORE the SIGKILL —
+    a missed heartbeat on a sole survivor must degrade to a stall, not
+    a self-inflicted total outage (in-proc kill_shard parity)."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=2,
+                     spawn="thread").start()
+    try:
+        refs = _drive_tier(door, DOCS[:4], 2)
+        first, second = door.router.alive()
+        door.fail_shard(first)
+        with pytest.raises(RuntimeError):
+            door.fail_shard(second)
+        # the survivor was NOT killed: traffic continues
+        assert door.router.alive() == [second]
+        assert door._shard(second).alive()
+        _drive_tier(door, DOCS[:4], 2, start=2, refs=refs)
+        assert _tier_logs(door, DOCS[:4]) == _oracle_logs(DOCS[:4], 4)
+    finally:
+        door.close()
+
+
+def test_adopting_nothing_durable_clears_the_orphan_without_looping(
+        tmp_path):
+    """Review pin: a created-but-empty document (no ops, no summary)
+    that died with its shard adopts as 'nothing durable' — the orphan
+    mark clears (no error loop) and the document simply no longer
+    exists, exactly the in-proc failover outcome."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=3,
+                     spawn="thread").start()
+    client = _RpcClient("127.0.0.1", door.port)
+    try:
+        client.request("create_document", {"doc": "empty-doc"})
+        victim = door._route_probe("empty-doc")[0]
+        # give the victim a SECOND doc with real history: its span must
+        # adopt fine while the empty doc resolves to nothing
+        full_doc = next(d for d in DOCS
+                        if door._route_probe(d)[0] == victim)
+        _drive_tier(door, [full_doc], 3)
+        door.fail_shard(victim)
+        heads = door.heads(["empty-doc", full_doc])
+        assert heads == {"empty-doc": 0, full_doc: 4}
+        with door._route_lock:
+            assert "empty-doc" not in door._orphans  # cleared, no loop
+            assert full_doc not in door._orphans
+        assert not client.request("has_document", {"doc": "empty-doc"})
+        client.request("create_document", {"doc": "empty-doc"})  # reusable
+    finally:
+        client.close()
+        door.close()
+
+
+def test_tick_executes_proc_kill_and_hang_points(tmp_path):
+    plan = FaultPlan(points=(
+        FaultPoint("proc.kill", "kill", doc=DOCS[0], at=5),
+        FaultPoint("proc.hang", "hang", doc=DOCS[1], at=2),
+    ))
+    injector = FaultInjector(plan)
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=4, spawn="thread",
+                     faults=injector, hang_detect_ticks=2).start()
+    try:
+        _drive_tier(door, DOCS[:4], 2)
+        hang_victim = door._route_probe(DOCS[1])[0]
+        assert door.tick(1) == []
+        door.tick(2)  # SIGSTOP fires; not detected yet
+        assert hang_victim not in door.router.dead()
+        kill_victim = door._route_probe(DOCS[0])[0]
+        affected = door.tick(5)  # kill executes AND the hang is detected
+        assert kill_victim in door.router.dead()
+        assert hang_victim in door.router.dead()
+        assert affected
+        assert injector.unfired() == []
+        assert injector.snapshot() == {"proc.hang:hang": 1,
+                                       "proc.kill:kill": 1}
+    finally:
+        door.close()
+
+
+# -- REAL processes -----------------------------------------------------------
+
+
+def test_sigkill_mid_traffic_converges_byte_identical(tmp_path):
+    """THE acceptance bar: kill -9 a real shard process mid-traffic; the
+    tier converges byte-identical (per-doc wire logs, contiguous seqs)
+    to the fault-free single-service oracle fed the same logical op
+    streams — the same bar the in-proc failover meets."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=4, spawn="proc",
+                     request_timeout=5.0).start()
+    try:
+        docs = [f"doc-{i}" for i in range(6)]
+        refs = _drive_tier(door, docs, 4)
+        victim_sid = door._route_probe(docs[0])[0]
+        victim = door._shard(victim_sid)
+        progress = [0]
+        errors = []
+
+        def assassinate():
+            # kill -9 once the writer loop below is provably mid-stream
+            try:
+                deadline = time.monotonic() + 30
+                while progress[0] < 10 and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                victim.proc.kill()
+            except Exception as exc:  # pragma: no cover - diagnostics
+                errors.append(exc)
+
+        killer = threading.Thread(target=assassinate, daemon=True)
+        killer.start()
+        _drive_tier(door, docs, 16, start=4, refs=refs,
+                    progress=progress)
+        killer.join(timeout=30)
+        assert not errors
+        assert victim.proc.poll() is not None, "victim survived kill -9"
+        assert victim_sid in door.router.dead(), \
+            "transport-error path never detected the kill"
+        for d in docs:
+            assert door._forward_doc("log_contiguous", {"doc": d}), d
+        assert _tier_logs(door, docs) == _oracle_logs(docs, 20)
+        stats = door.stats()
+        assert stats["fences"] == 1
+        assert victim_sid not in stats["alive"]
+    finally:
+        door.close()
+
+
+def test_sigstop_hang_is_detected_and_shot(tmp_path):
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=3, spawn="proc",
+                     request_timeout=4.0).start()
+    try:
+        docs = [f"doc-{i}" for i in range(4)]
+        refs = _drive_tier(door, docs, 3)
+        victim_sid = door._route_probe(docs[0])[0]
+        victim = door._shard(victim_sid)
+        victim.hang()  # SIGSTOP: alive but silent
+        assert victim.proc.poll() is None
+        failed = door.poll_shards()  # heartbeat sweep: ping times out
+        assert failed == [victim_sid]
+        # shoot-the-node: the stopped process was SIGKILLed BEFORE its
+        # documents were re-owned — it can never wake up and write
+        assert victim.proc.poll() is not None
+        _drive_tier(door, docs, 3, start=3, refs=refs)
+        assert _tier_logs(door, docs) == _oracle_logs(docs, 6)
+    finally:
+        door.close()
+
+
+def test_sigterm_drains_seals_and_restart_resumes(tmp_path):
+    """The graceful-shutdown satellite: SIGTERM racing a large group
+    commit drains the in-flight batch and seals the per-shard log —
+    the durable file holds NO duplicate seq lines and strictly
+    contiguous seqs, and a restart over the same directory resumes the
+    sequence exactly where the seal left it."""
+    base = str(tmp_path / "proc")
+    handle = ProcShard("s0", base)
+    handle.connect()
+    doc = "drain-doc"
+    handle.request("create_document", {"doc": doc})
+    handle.request("connect", {"doc": doc, "client": "w"})
+    head = handle.request("head", {"doc": doc})
+    ops = [encode_raw_operation(_op("w", i, head)) for i in range(2000)]
+    outcome = {}
+
+    def big_batch():
+        try:
+            outcome["result"] = handle.request(
+                "submit_mixed", {"batches": {doc: ops}})
+        except (ConnectionError, OSError) as exc:
+            outcome["error"] = exc
+
+    writer = threading.Thread(target=big_batch, daemon=True)
+    writer.start()
+    time.sleep(0.05)  # let the batch reach the server
+    handle.proc.terminate()  # SIGTERM mid-group-commit
+    handle.proc.wait(timeout=30)
+    writer.join(timeout=30)
+    # the sealed log: no duplicate lines, strictly contiguous seqs
+    path = shard_log_path(base, "s0")
+    seqs = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec["doc"] == doc:
+                seqs.append(rec["msg"]["sequenceNumber"])
+    assert len(seqs) == len(set(seqs)), "duplicate lines in sealed log"
+    assert seqs == list(range(1, len(seqs) + 1)), "seqs not contiguous"
+    sealed_head = len(seqs)
+    assert sealed_head >= 1  # the JOIN at minimum; usually the batch too
+    # restart over the same directory: the sequence resumes contiguously
+    handle2 = ProcShard("s0", base)
+    handle2.connect()
+    try:
+        assert handle2.request("heads", {"docs": [doc]})[doc] == sealed_head
+        result = handle2.request("submit", {
+            "doc": doc,
+            "op": encode_raw_operation(_op("w", 5000, sealed_head))})
+        assert result["sequenceNumber"] == sealed_head + 1
+        assert handle2.request("log_contiguous", {"doc": doc})
+    finally:
+        handle2.close()
+        handle2.terminate()
+    handle.close()
+
+
+def test_draining_server_refuses_with_typed_nack(tmp_path):
+    from fluidframework_tpu.service.shardhost import (ShardHost,
+                                                      ShardHostServer)
+
+    host = ShardHost("s0", str(tmp_path / "proc"))
+    server = ShardHostServer(host, port=0)
+    server.start_in_thread()
+    rpc = _RpcClient("127.0.0.1", server.port)
+    try:
+        rpc.request("create_document", {"doc": "d"})
+        server.draining = True
+        assert rpc.request("ping", {}) == "pong"  # probes stay answered
+        assert "shard" in rpc.request("stats", {})
+        with pytest.raises(NackError) as err:
+            rpc.request("submit", {
+                "doc": "d", "op": encode_raw_operation(_op("w", 0, 0))})
+        assert err.value.code == "shuttingDown"
+        assert err.value.retry_after > 0
+    finally:
+        rpc.close()
+        host.seal()
+
+
+def test_per_shard_stats_rpc_over_the_wire(tmp_path):
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=2,
+                     spawn="proc").start()
+    try:
+        _drive_tier(door, ["a-doc", "b-doc"], 3)
+        client = _RpcClient("127.0.0.1", door.port)
+        try:
+            stats = client.request("stats", {})
+        finally:
+            client.close()
+        shard_stats = stats["shards"]
+        assert set(shard_stats) == set(door.router.shard_ids())
+        pids = {s["pid"] for s in shard_stats.values()}
+        assert len(pids) == 2 and os.getpid() not in pids, \
+            "stats must come from the shard PROCESSES"
+        heads = {}
+        for s in shard_stats.values():
+            heads.update(s["heads"])
+        assert heads == {"a-doc": 4, "b-doc": 4}
+    finally:
+        door.close()
+
+
+# -- the swarm against the process tier ---------------------------------------
+
+
+def test_proc_swarm_smoke_oracle_verified(tmp_path):
+    """ISSUE 12 satellite: the 10³-client scenario smoke against the
+    REAL process tier — per-shard durable logs, batched ingress over the
+    wire both hops — byte-identical to the in-proc single-shard oracle."""
+    from fluidframework_tpu.testing.scenarios import (build_scenario,
+                                                      oracle_spec,
+                                                      run_swarm)
+
+    spec = build_scenario("steady-typing", seed=12, clients=1000, docs=16,
+                          shards=4)
+    spec = dataclasses.replace(spec, out_of_proc=True, sample_every=8,
+                               dir=str(tmp_path / "swarm"))
+    result = run_swarm(spec)
+    assert result.sequenced_ops > 1000
+    twin = run_swarm(oracle_spec(spec, result))
+    assert result.sampled_digests == twin.sampled_digests
+    assert result.per_doc_head == twin.per_doc_head
+    cluster = result.shard_stats["cluster"]
+    assert sorted(cluster["shards"]) == [f"shard{i:02d}" for i in range(4)]
+    assert sum(s.get("ops", 0) for s in cluster["shards"].values()) \
+        == result.sequenced_ops
+    # the live taps really relayed broadcast through the front door
+    assert any(n > 0
+               for n in result.shard_stats["tap_unique_frames"].values())
+
+
+@pytest.mark.slow
+def test_proc_swarm_failover_drill_100k():
+    """Nightly: the failover drill at 10⁵ clients against real shard
+    processes — a REAL SIGKILL mid-run at population scale, oracle- and
+    replay-verified."""
+    from fluidframework_tpu.testing.faults import FaultPlan, FaultPoint
+    from fluidframework_tpu.testing.scenarios import (build_scenario,
+                                                      oracle_spec,
+                                                      run_swarm)
+
+    spec = build_scenario("failover-drill", seed=12, clients=100_000,
+                          docs=128, shards=4)
+    total = sum(p.ticks for p in spec.phases)
+    plan = FaultPlan(seed=12, points=(
+        FaultPoint("proc.kill", "kill", doc="sw-0000", at=total // 2),))
+    spec = dataclasses.replace(spec, out_of_proc=True, plan=plan)
+    result = run_swarm(spec)
+    assert result.kills, "the process kill never executed"
+    twin = run_swarm(oracle_spec(spec, result))
+    assert result.sampled_digests == twin.sampled_digests
+    assert result.per_doc_head == twin.per_doc_head
+    replay = run_swarm(spec)
+    assert replay.identity() == result.identity()

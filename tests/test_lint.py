@@ -105,6 +105,25 @@ def test_fluidscale_modules_lint_clean_with_zero_suppressions():
     assert offenders == [], "new modules must stay suppression-free"
 
 
+def test_fluidproc_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 12 acceptance pin: the out-of-process tier — shard host,
+    front door (supervision, failover, live migration), and the proc
+    client adapter — passes ALL module rules (fluidlint + fluidrace +
+    fluidleak families) with zero findings AND zero baseline entries.
+    Deployment machinery gets no exemptions: bounded waits, no wall
+    clock on replay paths, every child process reaped or supervised."""
+    new_modules = [
+        "fluidframework_tpu/service/shardhost.py",
+        "fluidframework_tpu/service/frontdoor.py",
+        "fluidframework_tpu/service/procclient.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "new modules must stay suppression-free"
+
+
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5)

@@ -1604,6 +1604,48 @@ def test_escape_close_in_finally_is_clean():
     assert findings_for(src, SERVICE, "FL-LEAK-ESCAPE") == []
 
 
+def test_escape_popen_is_tracked_positive_and_negative():
+    """ISSUE 12 satellite: subprocess.Popen is a tracked resource — a
+    fire-and-forget child process (zombie + leaked pipes) fires; reaping
+    on all paths (try/finally wait or terminate) and the supervisor
+    hand-off shape (stored on self) stay clean."""
+    bad = """
+    import subprocess
+    def probe(cmd):
+        p = subprocess.Popen(cmd)
+        return p.stdout.read()
+    """
+    reaped = """
+    import subprocess
+    def probe(cmd):
+        p = subprocess.Popen(cmd)
+        try:
+            return p.stdout.read()
+        finally:
+            p.wait()
+    """
+    killed = """
+    import subprocess
+    def probe(cmd):
+        p = subprocess.Popen(cmd)
+        try:
+            return p.stdout.read()
+        finally:
+            p.kill()
+    """
+    handed_off = """
+    import subprocess
+    class Supervisor:
+        def spawn(self, cmd):
+            p = subprocess.Popen(cmd)
+            self._shards.append(p)
+    """
+    assert findings_for(bad, SERVICE, "FL-LEAK-ESCAPE")
+    assert findings_for(reaped, SERVICE, "FL-LEAK-ESCAPE") == []
+    assert findings_for(killed, SERVICE, "FL-LEAK-ESCAPE") == []
+    assert findings_for(handed_off, SERVICE, "FL-LEAK-ESCAPE") == []
+
+
 def test_escape_makefile_needs_close():
     bad = """
     class C:

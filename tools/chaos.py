@@ -97,6 +97,76 @@ def build_plan(name: str, seed: int) -> FaultPlan:
 
 PLANS = ("mixed", "append-storm", "kill-quake", "laggard-town")
 
+#: handled by the fluidproc runner, not run_plan: the kill-quake shape
+#: against REAL shard-host processes (SIGKILL, per-shard logs, adoption).
+PROC_PLANS = ("kill-quake-proc",)
+
+
+def run_proc_quake(seeds: int) -> dict:
+    """The kill-quake plan's process variant (ISSUE 12): a steady-typing
+    swarm against the REAL out-of-process tier with two scheduled
+    ``proc.kill`` points — each SIGKILLs the current owner process of a
+    pinned document at its tick — verified against the fault-free
+    single-shard in-proc oracle twin, plus full coverage accounting."""
+    import dataclasses
+
+    from fluidframework_tpu.testing.scenarios import (
+        build_scenario, oracle_spec, run_swarm)
+
+    a, b = _two_docs_on_distinct_shards_swarm()
+    survived = 0
+    ops = 0
+    fault_totals: dict = {}
+    failures: list = []
+    for seed in range(seeds):
+        spec = build_scenario("steady-typing", seed=seed, clients=1200,
+                              docs=8, shards=4)
+        total = spec.ticks
+        plan = FaultPlan(seed=seed, points=(
+            FaultPoint("proc.kill", "kill", doc=a, at=total // 3),
+            FaultPoint("proc.kill", "kill", doc=b, at=2 * total // 3),
+        ))
+        spec = dataclasses.replace(spec, plan=plan, out_of_proc=True,
+                                   sample_every=4)
+        chaos = run_swarm(spec)
+        oracle = run_swarm(oracle_spec(spec, chaos))
+        kills_executed = chaos.fault_counts.get("proc.kill:kill", 0)
+        ok = (chaos.sampled_digests == oracle.sampled_digests
+              and chaos.per_doc_head == oracle.per_doc_head
+              and kills_executed == 2)
+        if ok:
+            survived += 1
+        else:
+            failures.append({
+                "seed": seed,
+                "digest_match":
+                    chaos.sampled_digests == oracle.sampled_digests,
+                "head_match": chaos.per_doc_head == oracle.per_doc_head,
+                "kills_executed": kills_executed,
+            })
+        ops += chaos.sequenced_ops
+        for k, v in sorted(chaos.fault_counts.items()):
+            fault_totals[k] = fault_totals.get(k, 0) + v
+    return {
+        "scenarios": seeds,
+        "survived": survived,
+        "failures": failures,
+        "sequenced_ops": ops,
+        "fault_counts": fault_totals,
+    }
+
+
+def _two_docs_on_distinct_shards_swarm():
+    """Two swarm documents whose rendezvous owners differ under the
+    4-shard layout, so the double proc-kill really takes two processes."""
+    router = ShardRouter(SHARD_IDS)
+    docs = [f"sw-{i:04d}" for i in range(8)]
+    first = docs[0]
+    for other in docs[1:]:
+        if router.owner(other) != router.owner(first):
+            return first, other
+    return first, docs[-1]
+
 
 def load_plan_file(path: str, seed: int) -> FaultPlan:
     """A plan file is JSON: ``{"points": [{"site": ..., "kind": ...,
@@ -232,7 +302,8 @@ def tcp_smoke() -> dict:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         description="run named fault plans against the serving stack")
-    parser.add_argument("--plan", choices=PLANS + ("all",), default="all")
+    parser.add_argument("--plan", choices=PLANS + PROC_PLANS + ("all",),
+                        default="all")
     parser.add_argument("--plan-file", default=None,
                         help="run a custom JSON fault plan instead of "
                              "the named ones")
@@ -259,6 +330,14 @@ def main(argv=None) -> None:
     with tempfile.TemporaryDirectory(prefix="fluid-chaos-") as workdir:
         for name in plans:
             plan_t0 = time.time()
+            if name in PROC_PLANS:
+                result = run_proc_quake(args.seeds)
+                result["wall_sec"] = round(time.time() - plan_t0, 3)
+                report["plans"][name] = result
+                print(f"{name}: {result['survived']}/"
+                      f"{result['scenarios']} survived (process kills: "
+                      f"{result['fault_counts']})", file=sys.stderr)
+                continue
             result = run_plan(name, args.seeds, workdir,
                               plan_file=args.plan_file)
             result["wall_sec"] = round(time.time() - plan_t0, 3)

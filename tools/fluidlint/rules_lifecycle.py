@@ -63,14 +63,20 @@ RESOURCE_CTORS = {
     "concurrent.futures.ThreadPoolExecutor": "ThreadPoolExecutor",
     "concurrent.futures.ProcessPoolExecutor": "ProcessPoolExecutor",
     "threading.Thread": "threading.Thread",
+    # A child process is the heaviest leak in the table: an un-reaped
+    # Popen holds a zombie entry + pipes for the parent's lifetime (the
+    # fluidproc supervisor tracks every shard it spawns on self, which
+    # is the hand-off shape; a fire-and-forget Popen local is a bug).
+    "subprocess.Popen": "subprocess.Popen",
 }
 #: attribute-call constructors matched by method name (receiver-typed
 #: resolution is beyond the AST): ``sock.makefile(...)`` ownership.
 RESOURCE_CTOR_METHODS = {"makefile"}
 
-#: calls that release a locally-owned resource
+#: calls that release a locally-owned resource (``kill``/``wait`` are the
+#: Popen reap verbs)
 RESOURCE_CLOSERS = {"close", "shutdown", "release", "terminate", "stop",
-                    "join"}
+                    "join", "kill", "wait"}
 
 #: method names that release member state (the double-close rule's
 #: notion of a "release site")
