@@ -221,10 +221,14 @@ def run_catchup_cache_bench(n_docs: int, ops_per_doc: int) -> dict:
             "catchup_cache": None,
             "pack_cache": None,
             "delta_cache": None,
+            "device_cache": None,
             "catchup_stages_busy_sec": {},
             "catchup_d2h_bytes": None,
             "catchup_cold_d2h_bytes": None,
             "catchup_warm_d2h_bytes": None,
+            "catchup_h2d_bytes": None,
+            "catchup_cold_h2d_bytes": None,
+            "catchup_warm_h2d_bytes": None,
         }
     total_ops = n_docs * ops_per_doc
 
@@ -259,14 +263,20 @@ def run_catchup_cache_bench(n_docs: int, ops_per_doc: int) -> dict:
                        if svc._pack_cache is not None else None),
         "delta_cache": (svc.delta_cache.stats()
                         if svc.delta_cache is not None else None),
+        "device_cache": (svc.device_cache.stats()
+                         if svc.device_cache is not None else None),
         "catchup_stages_busy_sec": {
             k: round(v, 3) for k, v in sorted(svc.pipeline_stage.items())
-            if k != "d2h_bytes"
+            if k not in ("d2h_bytes", "h2d_bytes")
         },
         "catchup_d2h_bytes": int(svc.pipeline_stage.get("d2h_bytes", 0)),
-        # Warm tier-1 hits never reach the pipeline: warm d2h must be 0.
+        # Warm tier-1 hits never reach the pipeline: warm bytes must be 0
+        # each way.
         "catchup_cold_d2h_bytes": pair.cold_d2h_bytes,
         "catchup_warm_d2h_bytes": pair.warm_d2h_bytes,
+        "catchup_h2d_bytes": int(svc.pipeline_stage.get("h2d_bytes", 0)),
+        "catchup_cold_h2d_bytes": pair.cold_h2d_bytes,
+        "catchup_warm_h2d_bytes": pair.warm_h2d_bytes,
     }
     print(f"catchup cache: {pair.report()} | hit rate {hit_rate:.3f}",
           file=sys.stderr)
@@ -281,12 +291,15 @@ DELTA_GROW_EVERY = int(os.environ.get("BENCH_DELTA_GROW_EVERY", "8"))
 
 
 def run_delta_download_bench(n_docs: int, ops_per_doc: int) -> dict:
-    """Digest-gated delta download at full scale (ISSUE 6): fold a
-    tokened message-list corpus cold (tier 0 fills), grow every Nth
-    document's tail, then re-fold warm twice — once with delta download
-    ON (digest plane + changed rows only cross the d2h link) and once
-    with it OFF (the full-download reference) — asserting the two runs
-    are byte-identical and reporting the d2h byte and busy-second drop."""
+    """Warm grown-tail maintenance at full scale, BOTH link directions
+    (ISSUE 6 d2h + ISSUE 13 h2d): fold a tokened message-list corpus
+    cold (tiers 0/2/2.5 fill), grow every Nth document's tail, then
+    re-fold warm twice — once with the cache stack ON (digest plane +
+    changed rows only cross d2h; resident buffers + donated suffix
+    splices keep the upload to the new rows) and once with it OFF (the
+    full-transfer reference) — asserting the two runs are byte-identical
+    and reporting the byte and busy-second drop each way."""
+    from fluidframework_tpu.ops.device_cache import DevicePackCache
     from fluidframework_tpu.ops.pipeline import (
         PackCache,
         pipelined_mergetree_replay,
@@ -310,35 +323,39 @@ def run_delta_download_bench(n_docs: int, ops_per_doc: int) -> dict:
         for i in range(n_docs)
     ]
 
-    def one_pass(docs, delta_cache, pack_cache):
-        stage = {"d2h_bytes": 0}
+    def one_pass(docs, delta_cache, pack_cache, device_cache=None):
+        stage = {"d2h_bytes": 0, "h2d_bytes": 0}
         stats: dict = {}
         t0 = time.time()
         summaries = pipelined_mergetree_replay(
             docs, chunk_docs=CHUNK_DOCS, pack_threads=PACK_THREADS,
             extract_threads=EXTRACT_THREADS, stage=stage, stats=stats,
             delta_cache=delta_cache, pack_cache=pack_cache,
+            device_cache=device_cache,
         )
         return summaries, stage, stats, time.time() - t0
 
     # BOTH warm runs ride an identically-warmed pack cache, so the fold
     # configuration (suffix-extended packs — whose arena-tail offsets
     # legitimately force the wide export layout at full scale) is the
-    # same and ONLY the download policy differs; the reference would
+    # same and ONLY the transfer policy differs; the reference would
     # otherwise fresh-pack narrow and the byte comparison would measure
-    # the transfer encoding, not delta download.
-    delta, pack = DeltaExportCache(), PackCache()
+    # the transfer encoding, not the cache tiers.
+    delta, pack, dev = DeltaExportCache(), PackCache(), DevicePackCache()
     full_pack = PackCache()
-    _cold, stage_cold, _st, cold_wall = one_pass(docs_base, delta, pack)
+    _cold, stage_cold, _st, cold_wall = one_pass(docs_base, delta, pack,
+                                                 dev)
     one_pass(docs_base, None, full_pack)
     warm, stage_delta, stats_delta, delta_wall = one_pass(
-        docs_grown, delta, pack)
+        docs_grown, delta, pack, dev)
     full, stage_full, _st2, full_wall = one_pass(
         docs_grown, None, full_pack)
     assert [s.digest() for s in warm] == [s.digest() for s in full], (
         "delta-download summaries != full-download summaries"
     )
     reduction = stage_full["d2h_bytes"] / max(1, stage_delta["d2h_bytes"])
+    h2d_reduction = stage_full["h2d_bytes"] / max(
+        1, stage_delta["h2d_bytes"])
     out = {
         "delta_docs_total": n_docs,
         "delta_docs_grown": len(grown_idx),
@@ -346,25 +363,34 @@ def run_delta_download_bench(n_docs: int, ops_per_doc: int) -> dict:
         "delta_d2h_bytes_full": int(stage_full["d2h_bytes"]),
         "delta_d2h_bytes_delta": int(stage_delta["d2h_bytes"]),
         "delta_d2h_reduction": round(reduction, 2),
+        # The upload mirror (tier 2.5): full re-upload vs resident
+        # buffers + donated suffix splices on the same warm corpus.
+        "resident_h2d_bytes_full": int(stage_full["h2d_bytes"]),
+        "resident_h2d_bytes_delta": int(stage_delta["h2d_bytes"]),
+        "resident_h2d_reduction": round(h2d_reduction, 2),
         "delta_docs_served": stats_delta.get("delta_docs", 0),
         "delta_warm_wall_sec": round(delta_wall, 3),
         "delta_full_wall_sec": round(full_wall, 3),
         "delta_cold_wall_sec": round(cold_wall, 3),
         "delta_stages_busy_sec": {
             k: round(v, 3) for k, v in sorted(stage_delta.items())
-            if k != "d2h_bytes"
+            if k not in ("d2h_bytes", "h2d_bytes")
         },
         "delta_full_stages_busy_sec": {
             k: round(v, 3) for k, v in sorted(stage_full.items())
-            if k != "d2h_bytes"
+            if k not in ("d2h_bytes", "h2d_bytes")
         },
         "delta_cache_stats": delta.stats(),
+        "device_cache_stats": dev.stats(),
     }
     print(
         f"delta download: d2h {stage_full['d2h_bytes']/1e6:.1f} MB full "
         f"-> {stage_delta['d2h_bytes']/1e6:.2f} MB delta "
         f"({reduction:.1f}x less), {stats_delta.get('delta_docs', 0)}"
-        f"/{n_docs} docs served without download",
+        f"/{n_docs} docs served without download | resident upload: h2d "
+        f"{stage_full['h2d_bytes']/1e6:.1f} MB full -> "
+        f"{stage_delta['h2d_bytes']/1e6:.2f} MB "
+        f"({h2d_reduction:.1f}x less)",
         file=sys.stderr,
     )
     return out
@@ -397,7 +423,9 @@ def _emit_skip(reason: str, detail: dict | None = None,
                       # never reached that phase).
                       "cache_hit_rate": None,
                       "d2h_bytes": None,
-                      "delta_d2h_reduction": None})
+                      "h2d_bytes": None,
+                      "delta_d2h_reduction": None,
+                      "resident_h2d_reduction": None})
     line["skipped"] = reason
     line.update(detail or {})
     print(json.dumps(line), flush=True)
@@ -833,8 +861,9 @@ def _run_e2e_single_device_thread(docs):
     same code the catch-up service runs, not a private copy of it."""
     from fluidframework_tpu.ops.pipeline import pipelined_mergetree_replay
 
-    stage = {"pack": 0.0, "dispatch": 0.0, "device_wait": 0.0,
-             "download": 0.0, "extract": 0.0, "d2h_bytes": 0}
+    stage = {"pack": 0.0, "dispatch": 0.0, "upload": 0.0,
+             "device_wait": 0.0, "download": 0.0, "extract": 0.0,
+             "d2h_bytes": 0, "h2d_bytes": 0}
     packed_chunks: list = []
     stats: dict = {}
     wall0 = time.time()
@@ -859,8 +888,9 @@ def _run_e2e_legacy(docs):
     sets ``abort`` so the other stages unblock from their bounded queues
     and the first error re-raises in the caller instead of
     deadlocking."""
-    stage = {"pack": 0.0, "dispatch": 0.0, "device_wait": 0.0,
-             "download": 0.0, "extract": 0.0, "d2h_bytes": 0}
+    stage = {"pack": 0.0, "dispatch": 0.0, "upload": 0.0,
+             "device_wait": 0.0, "download": 0.0, "extract": 0.0,
+             "d2h_bytes": 0, "h2d_bytes": 0}
     folded: queue.Queue = queue.Queue(maxsize=3)
     downloaded: queue.Queue = queue.Queue(maxsize=3)
     errors = []
@@ -887,6 +917,13 @@ def _run_e2e_legacy(docs):
     def pack_one(lo):
         t0 = time.time()
         state, ops, meta = pack_mergetree_batch(docs[lo:lo + CHUNK_DOCS])
+        # Narrow on the pack thread (the product pipeline's split) so the
+        # dispatch leg can count the h2d bytes that really cross.
+        from fluidframework_tpu.ops.mergetree_kernel import (
+            narrow_ops_for_upload,
+        )
+
+        ops = narrow_ops_for_upload(ops, meta)
         return state, ops, meta, time.time() - t0
 
     def packer():
@@ -921,6 +958,8 @@ def _run_e2e_legacy(docs):
                         stage["pack"] += dt  # busy (overlapped) seconds
                         t0 = time.time()
                         S = state.tstart.shape[1]
+                        stage["h2d_bytes"] += int(sum(
+                            np.asarray(x).nbytes for x in ops))
                         ex = replay_export(None, ops, meta, S=S)
                         stage["dispatch"] += time.time() - t0
                         packed_chunks.append((None, ops, meta, S))
@@ -1129,9 +1168,11 @@ def _run_bench(probe: dict) -> dict:
     print(
         f"end-to-end {e2e_time:.2f}s = {e2e_ops_per_sec:,.0f} ops/s "
         f"(busy: pack {stage['pack']:.2f} | dispatch {stage['dispatch']:.2f}"
+        f" | upload {stage.get('upload', 0.0):.2f}"
         f" | device_wait {stage['device_wait']:.2f}"
         f" | download {stage['download']:.2f} | extract+summarize "
-        f"{stage['extract']:.2f} | d2h {stage['d2h_bytes']/1e6:.1f} MB)"
+        f"{stage['extract']:.2f} | h2d {stage['h2d_bytes']/1e6:.1f} MB"
+        f" | d2h {stage['d2h_bytes']/1e6:.1f} MB)"
         f" | oracle fallbacks {fallbacks}/{N_DOCS}",
         file=sys.stderr,
     )
@@ -1244,6 +1285,10 @@ def _run_bench(probe: dict) -> dict:
         "stages_busy_sec": {
             "pack": round(stage["pack"], 3),
             "fold_dispatch": round(stage["dispatch"], 3),
+            # Explicit resident-tier transfers only; without the tier
+            # the upload rides the dispatch jit (and h2d_bytes still
+            # counts the host arrays it pushes).
+            "upload": round(stage.get("upload", 0.0), 3),
             # "download" used to absorb the async fold wait (CPU d2h is
             # hundreds of GB/s yet "download" read as 12 s in r05c);
             # device_wait now carries the wait, download the copy alone.
@@ -1252,6 +1297,7 @@ def _run_bench(probe: dict) -> dict:
             "extract_summarize": round(stage["extract"], 3),
         },
         "d2h_bytes": int(stage["d2h_bytes"]),
+        "h2d_bytes": int(stage["h2d_bytes"]),
         "end_to_end_sec": round(e2e_time, 3),
         "oracle_fallback_docs": fallbacks,
         **catchup,

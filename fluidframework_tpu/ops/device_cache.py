@@ -1,0 +1,471 @@
+"""Device-resident pack buffers — tier 2.5 of the catch-up cache.
+
+Tier 2 (:class:`~fluidframework_tpu.ops.pipeline.PackCache`) killed the
+host *pack* work on warm catch-ups, and tier 0 made downloads delta-only
+— but the **upload** leg stayed untouched: even on an exact tier-2 hit,
+``_pipelined_fold`` re-uploads the full packed op/state planes to the
+device on every fold call.  On the recorded tunnel link
+(``BENCH_tpu_measured_r05.json``: h2d 15 MB/s) that re-upload IS the
+warm hot path.  This module keeps the packed chunk arrays resident in
+device memory across fold calls, keyed by the chunk's ordered
+``cache_token`` tuple — the same identity tier 2 already proves sound:
+
+- **exact** hit (every doc's op window unchanged): the dispatch leg
+  consumes the resident buffers directly — ZERO h2d bytes for ops,
+  state and ``doc_base``;
+- **suffix** hit (windows grew under the same pack-cache lineage): only
+  the new suffix rows cross the link as fine-bucketed ``[D, L]`` row
+  planes, and a jitted splice with ``donate_argnums`` writes them into
+  the resident op buffers IN PLACE — no 2× HBM spike, and the jit cache
+  stays bounded because ``L`` rides the fine bucket ladder;
+- anything else — bucket overflow (shape signature moved), a
+  narrow↔wide transfer-encoding flip (dtype signature moved), unknown
+  pack lineage, window mismatch — falls back to the full upload and
+  re-stores.  The resident tier can lose a win, never corrupt.
+
+Soundness of the suffix splice is *structural*, belt and braces:
+
+- the token contract (append-only op stream over a pinned base within
+  one storage generation) pins the shared prefix bytes;
+- the **pack lineage** (``meta["_pack_lineage"]``, stamped by tier 2)
+  additionally proves the host arrays in hand are the literal
+  suffix-extension of the arrays the resident buffers were built from —
+  a fresh repack (whose arena layout may legitimately differ) can never
+  masquerade as an extension;
+- the **encoding signature** (per-field dtype + shape of the narrowed
+  upload arrays) pins the transfer encoding: an ``i16``→wide flip or a
+  T/S/K bucket change is a signature mismatch, not a corrupted splice.
+
+Donation discipline: after the splice the PREVIOUS resident buffers are
+dead (XLA reused their memory) — the entry swaps in the splice outputs
+and the old references are never read again (the FL-TRACE-DONATE lint
+rule pins this discipline package-wide).  All device interaction
+(``device_put``, the splice dispatch) must happen on the caller's single
+device thread — the same contract the pipeline already holds for
+dispatch/fetch; the lock here guards only the entry map and counters.
+
+Byte-bounded LRU over insertion order (no wall-clock — replay-safe),
+epoch invalidation riding the existing fence/epoch sweeps (tokens carry
+the storage epoch as component 0).  Counters: ``served`` (exact hits —
+zero-upload dispatches), ``spliced`` (suffix splices), ``misses``,
+``bypass``, ``inserts``, ``evictions``, ``invalidations``, and
+``bytes_saved`` (h2d bytes the resident tier kept off the link).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.telemetry import CounterSet
+from .interning import next_bucket_fine
+from .mergetree_kernel import MTOps, MTState, _widen_ops, _widen_state
+# The shared tier-2/2.5 contracts live in the pipeline module (no
+# cycle: pipeline never imports this module): _np_nbytes is THE "what
+# the dispatch jit pushes over h2d" byte rule the reductions compare
+# against, _doc_window/match_windows THE window-identity rules.
+from .pipeline import _doc_window, _np_nbytes, match_windows
+
+
+def _dev_nbytes(*trees) -> int:
+    total = 0
+    for tree in trees:
+        if tree is None:
+            continue
+        leaves = tree if isinstance(tree, tuple) else (tree,)
+        total += int(sum(leaf.nbytes for leaf in leaves))
+    return total
+
+
+def _sig(state: Optional[MTState], ops: MTOps) -> tuple:
+    """The transfer-encoding signature: per-field dtype + shape of the
+    (already narrowed) upload arrays.  Any bucket growth, narrow↔wide
+    encoding flip, or cold↔warm change moves it — and a moved signature
+    means the resident buffers cannot be extended, only replaced."""
+    sig = tuple((f, str(getattr(ops, f).dtype), getattr(ops, f).shape)
+                for f in MTOps._fields)
+    if state is not None:
+        sig += tuple((f, str(getattr(state, f).dtype),
+                      getattr(state, f).shape) for f in MTState._fields)
+    return sig
+
+
+def _widened_sig(sig: tuple) -> tuple:
+    """The signature the same arrays would carry in the WIDE (int32)
+    transfer encoding — shapes unchanged, every non-bool dtype int32."""
+    return tuple((f, dt if dt == "bool" else "int32", shape)
+                 for f, dt, shape in sig)
+
+
+@jax.jit
+def _widen_resident_ops(ops: MTOps, doc_base: jnp.ndarray) -> MTOps:
+    """In-graph narrow→wide migration of resident op buffers (the
+    kernel's own ``_widen_ops`` inverse — exact by construction).  Zero
+    bytes cross the link: the whole point is that a chunk whose suffix
+    text landed at the shared arena tail (blowing the int16 offset
+    bound and flipping the upload encoding wide) can keep splicing
+    instead of re-uploading the full planes.  No donation here — an
+    int16 buffer cannot alias an int32 output; the narrow originals
+    free by refcount the moment the entry swaps."""
+    return _widen_ops(ops, doc_base)
+
+
+@jax.jit
+def _widen_resident_state(state: MTState,
+                          doc_base: jnp.ndarray) -> MTState:
+    """The warm-state twin of :func:`_widen_resident_ops`."""
+    return _widen_state(state, doc_base)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _splice_ops(ops: MTOps, rows: MTOps, start: jnp.ndarray,
+                count: jnp.ndarray) -> MTOps:
+    """Write each document's suffix rows into the resident op buffers
+    in place: ``out[d, start[d] + j] = rows[d, j]`` for ``j < count[d]``.
+
+    ``ops`` is DONATED — XLA reuses the resident buffers instead of
+    allocating a second copy (no 2× HBM spike), and the caller's old
+    references are dead after dispatch.  Expressed as a clipped
+    take-along-axis + masked select (no scatter), elementwise along the
+    doc axis, so the same executable serves the sharded mesh placement
+    with zero collectives."""
+    T = ops.kind.shape[1]
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)   # [1, T]
+    rel = t_idx - start[:, None]                             # [D, T]
+    L = rows.kind.shape[1]
+    take = jnp.clip(rel, 0, L - 1)
+    mask = (rel >= 0) & (rel < count[:, None])
+
+    def one(field, r):
+        if field.ndim == 2:
+            return jnp.where(mask, jnp.take_along_axis(r, take, axis=1),
+                             field)
+        return jnp.where(mask[:, :, None],
+                         jnp.take_along_axis(r, take[:, :, None], axis=1),
+                         field)
+
+    return MTOps(*(one(getattr(ops, f), getattr(rows, f))
+                   for f in MTOps._fields))
+
+
+class _ResidentEntry:
+    """One chunk's device-resident upload state + the host bookkeeping
+    needed to match and extend it."""
+
+    __slots__ = ("tokens", "n_ops", "first_seq", "last_seq", "t_rows",
+                 "sig", "gen", "state", "ops", "base", "nbytes")
+
+    def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows, sig,
+                 gen, state, ops, base):
+        self.tokens = tokens
+        self.n_ops = n_ops
+        self.first_seq = first_seq
+        self.last_seq = last_seq
+        self.t_rows = t_rows            # np [D]: used op rows per doc
+        self.sig = sig
+        self.gen = gen                  # tier-2 pack generation (or None)
+        self.state = state              # device MTState or None (cold)
+        self.ops = ops                  # device MTOps
+        self.base = base                # device [D] int32 doc_base
+        self.nbytes = _dev_nbytes(state, ops, base)
+
+
+def _lineage_gen(meta: dict) -> Optional[int]:
+    """The tier-2 pack generation of the host arrays in hand (None when
+    tier 2 did not produce them — exact reuse only)."""
+    lin = meta.get("_pack_lineage")
+    return lin[-1] if lin else None
+
+
+def _lineage_parent(meta: dict) -> Optional[int]:
+    """For a suffix-extended pack, the generation it extended."""
+    lin = meta.get("_pack_lineage")
+    if lin and lin[0] == "suffix":
+        return lin[1]
+    return None
+
+
+class DevicePackCache:
+    """Byte-bounded LRU of device-resident packed chunk buffers (see the
+    module docstring).  ``sharding`` (a ``jax.sharding.NamedSharding``)
+    places entries on a mesh — the sharded fold passes its doc-sharded
+    placement so mesh and single-device serve the identical tier."""
+
+    def __init__(self, max_bytes: int = 192 << 20, sharding=None) -> None:
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        # tokens -> _ResidentEntry (insertion order = LRU order)
+        self._entries: dict = {}  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._last_epoch = None  # guarded-by: _lock
+        self._sharding = sharding
+        self.counters = CounterSet(
+            "served", "spliced", "misses", "bypass", "inserts",
+            "evictions", "invalidations", "bytes_saved",
+        )  # guarded-by: _lock (CounterSet is not internally synchronized)
+
+    # -- placement -------------------------------------------------------------
+
+    def set_sharding(self, sharding) -> None:
+        """Pin the device placement (mesh path; idempotent — NamedSharding
+        compares by value).  CHANGING an established placement drops the
+        resident entries: buffers laid out for one placement must never
+        serve another."""
+        with self._lock:
+            if sharding is self._sharding or sharding == self._sharding:
+                return
+            self._sharding = sharding
+            dropped = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self.counters.bump("evictions", dropped)
+
+    @staticmethod
+    def _put(x, sharding):
+        # ``sharding`` is the caller's one-per-acquire snapshot (taken
+        # under the lock), so one entry can never end up split across
+        # placements by a racing set_sharding.
+        if sharding is not None:
+            return jax.device_put(jnp.asarray(x), sharding)
+        return jax.device_put(jnp.asarray(x))
+
+    @classmethod
+    def _put_tree(cls, tree, sharding):
+        if tree is None:
+            return None
+        return type(tree)(*(cls._put(leaf, sharding) for leaf in tree))
+
+    # -- introspection ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = self.counters.snapshot()
+            out["entries"] = len(self._entries)
+            out["bytes"] = self._bytes
+        return out
+
+    # -- the dispatch-side handshake -------------------------------------------
+
+    def acquire(self, state: Optional[MTState], ops: MTOps, meta: dict):
+        """Device-resident ``(state, ops, doc_base, h2d_bytes)`` for a
+        packed chunk about to dispatch: the resident buffers on an exact
+        hit (zero upload), a donated suffix splice on a lineage-proven
+        extension, else a full upload that (re)stores the entry.
+        Token-less / binary chunks bypass and return the host arrays
+        unchanged (``doc_base=None`` — the dispatcher derives it as
+        before); ``h2d_bytes`` is what this call actually put on the
+        link.  MUST be called from the single device-interaction thread
+        (the pipeline's dispatch leg / the mesh fold)."""
+        docs = meta["docs"]
+        tokens = tuple(d.cache_token for d in docs)
+        if any(t is None for t in tokens) \
+                or any(d.binary_ops is not None for d in docs):
+            with self._lock:
+                self.counters.bump("bypass")
+            return state, ops, None, _np_nbytes(state) + _np_nbytes(ops)
+        sig = _sig(state, ops)
+        full_bytes = _np_nbytes(state) + _np_nbytes(ops)
+        with self._lock:
+            entry = self._entries.get(tokens)
+            sharding = self._sharding
+        if entry is not None and entry.sig != sig \
+                and _widened_sig(entry.sig) == sig \
+                and self._match(entry, docs) is not None:
+            # The ONLY signature change is a narrow→wide transfer-
+            # encoding flip (full-scale suffix growth does this: the new
+            # text lands at the shared arena tail, blowing the int16
+            # offset bound).  Migrate the resident buffers to the wide
+            # encoding IN-GRAPH — donated, zero bytes over the link —
+            # so the window can still serve/splice.
+            old_nbytes = entry.nbytes
+            entry.ops = _widen_resident_ops(entry.ops, entry.base)
+            if entry.state is not None:
+                entry.state = _widen_resident_state(entry.state,
+                                                    entry.base)
+            entry.sig = sig
+            entry.nbytes = _dev_nbytes(entry.state, entry.ops, entry.base)
+            self._reaccount_widened(tokens, entry, old_nbytes)
+        if entry is not None and entry.sig == sig:
+            kind = self._match(entry, docs)
+            if kind == "exact":
+                with self._lock:
+                    self._touch(tokens)
+                    self.counters.bump("served")
+                    self.counters.bump("bytes_saved", full_bytes)
+                gen = _lineage_gen(meta)
+                if gen is not None:
+                    # Content is equal either way; tracking the freshest
+                    # tier-2 generation keeps future suffix lineage
+                    # checks matching.
+                    entry.gen = gen
+                return entry.state, entry.ops, entry.base, 0
+            if kind == "suffix" and entry.gen is not None \
+                    and _lineage_parent(meta) == entry.gen:
+                uploaded = self._splice(entry, docs, ops, meta, sharding)
+                if uploaded is not None:
+                    with self._lock:
+                        self._touch(tokens)
+                        self.counters.bump("spliced")
+                        self.counters.bump("bytes_saved",
+                                           max(0, full_bytes - uploaded))
+                    return entry.state, entry.ops, entry.base, uploaded
+        # Miss / signature moved / unprovable lineage: full upload.
+        with self._lock:
+            self.counters.bump("misses")
+        state_dev = self._put_tree(state, sharding)
+        ops_dev = self._put_tree(ops, sharding)
+        base_dev = self._put(np.asarray(meta["doc_base"], np.int32),
+                             sharding)
+        self._store(tokens, docs, sig, _lineage_gen(meta), state_dev,
+                    ops_dev, base_dev, ops)
+        base_bytes = len(docs) * 4
+        return state_dev, ops_dev, base_dev, full_bytes + base_bytes
+
+    # -- matching --------------------------------------------------------------
+
+    @staticmethod
+    def _match(entry: _ResidentEntry, docs) -> Optional[str]:
+        """The shared tier-2/2.5 window rule (``match_windows``) over
+        the resident entry's bookkeeping."""
+        return match_windows(entry.n_ops, entry.first_seq,
+                             entry.last_seq, docs)
+
+    # -- suffix splice ---------------------------------------------------------
+
+    def _splice(self, entry: _ResidentEntry, docs, ops: MTOps,
+                meta: dict, sharding) -> Optional[int]:
+        """Upload only the suffix rows and extend the resident op
+        buffers via the donated splice; returns uploaded bytes, or None
+        when the extension does not apply (caller full-uploads).  The
+        base state of a warm chunk is pinned by the token (it derives
+        from the base summary alone), so only the op planes move."""
+        kind_np = np.asarray(ops.kind)
+        t_new = np.count_nonzero(kind_np, axis=1).astype(np.int32)
+        t_old = entry.t_rows
+        if np.any(t_new < t_old):
+            return None
+        grow = int((t_new - t_old).max(initial=0))
+        T = kind_np.shape[1]
+        L = min(next_bucket_fine(max(grow, 1), floor=8), T)
+        if L >= T:
+            return None  # suffix ~ whole buffer: full upload is cheaper
+        idx = np.minimum(
+            t_old[:, None] + np.arange(L, dtype=np.int32)[None, :], T - 1)
+        rows_np = {}
+        for f in MTOps._fields:
+            v = np.asarray(getattr(ops, f))
+            take = idx if v.ndim == 2 else idx[:, :, None]
+            rows_np[f] = np.take_along_axis(v, take, axis=1)
+        uploaded = sum(v.nbytes for v in rows_np.values()) \
+            + 2 * t_new.nbytes
+        rows = MTOps(**{f: self._put(v, sharding)
+                        for f, v in rows_np.items()})
+        start = self._put(t_old, sharding)
+        count = self._put(t_new - t_old, sharding)
+        new_ops = _splice_ops(entry.ops, rows, start, count)
+        # The donated input buffers are DEAD past this point: the entry
+        # swaps in the splice outputs and the old references are never
+        # touched again.
+        entry.ops = new_ops
+        entry.t_rows = t_new
+        n_ops, first_seq, last_seq = [], [], []
+        for doc in docs:
+            n, first, last = _doc_window(doc)
+            n_ops.append(n)
+            first_seq.append(first)
+            last_seq.append(last)
+        entry.n_ops = n_ops
+        entry.first_seq = first_seq
+        entry.last_seq = last_seq
+        entry.gen = _lineage_gen(meta)
+        return int(uploaded)
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def _reaccount_widened(self, tokens, entry: _ResidentEntry,
+                           old_nbytes: int) -> None:
+        """Re-account a narrow→wide migrated entry (~2× the bytes) in
+        ONE identity-guarded critical section: the adjustment applies
+        only if the map still holds THE entry that was widened, and the
+        LRU sweep rebalances the budget (the migrated entry itself is
+        never evicted mid-serve — if it alone exceeds the budget it is
+        un-mapped, same policy as _store's never-admit rule, while this
+        call keeps serving its arrays)."""
+        with self._lock:
+            if self._entries.get(tokens) is not entry:
+                return
+            self._bytes += entry.nbytes - old_nbytes
+            while self._bytes > self.max_bytes \
+                    and len(self._entries) > 1:
+                oldest = next(iter(self._entries))
+                if oldest == tokens:
+                    self._touch(tokens)  # never evict the entry in hand
+                    continue
+                dropped = self._entries.pop(oldest)
+                self._bytes -= dropped.nbytes
+                self.counters.bump("evictions")
+            if self._bytes > self.max_bytes:
+                self._entries.pop(tokens)
+                self._bytes -= entry.nbytes
+                self.counters.bump("evictions")
+
+    def _touch(self, tokens) -> None:  # holds-lock: _lock
+        entry = self._entries.pop(tokens, None)
+        if entry is not None:
+            self._entries[tokens] = entry
+
+    def _store(self, tokens, docs, sig, gen, state_dev, ops_dev, base_dev,
+               host_ops: MTOps) -> None:
+        n_ops, first_seq, last_seq = [], [], []
+        for doc in docs:
+            n, first, last = _doc_window(doc)
+            n_ops.append(n)
+            first_seq.append(first)
+            last_seq.append(last)
+        t_rows = np.count_nonzero(
+            np.asarray(host_ops.kind), axis=1).astype(np.int32)
+        entry = _ResidentEntry(tokens, n_ops, first_seq, last_seq, t_rows,
+                               sig, gen, state_dev, ops_dev, base_dev)
+        with self._lock:
+            old = self._entries.pop(tokens, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            if entry.nbytes > self.max_bytes:
+                self.counters.bump("evictions")
+                return
+            self._entries[tokens] = entry
+            self._bytes += entry.nbytes
+            self.counters.bump("inserts")
+            while self._bytes > self.max_bytes and self._entries:
+                oldest = next(iter(self._entries))
+                dropped = self._entries.pop(oldest)
+                self._bytes -= dropped.nbytes
+                self.counters.bump("evictions")
+
+    # -- epoch invalidation ----------------------------------------------------
+
+    def invalidate_epoch(self, current_epoch: str) -> int:
+        """Drop entries holding any token pinned to a DIFFERENT storage
+        generation (token component 0 is the epoch — same contract as
+        tiers 0/1, riding the same server-side sweep).  O(1) while the
+        epoch is unchanged."""
+        with self._lock:
+            if current_epoch == self._last_epoch:
+                return 0
+            self._last_epoch = current_epoch
+            stale = [key for key in self._entries
+                     if any(tok[0] != current_epoch for tok in key)]
+            for key in stale:
+                dropped = self._entries.pop(key)
+                self._bytes -= dropped.nbytes
+                self.counters.bump("invalidations")
+        return len(stale)

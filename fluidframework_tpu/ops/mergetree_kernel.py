@@ -1197,7 +1197,8 @@ def _widen_ops(ops: MTOps, doc_base: jnp.ndarray) -> MTOps:
 
 def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
                   S: Optional[int] = None,
-                  digest: bool = False) -> jnp.ndarray:
+                  digest: bool = False,
+                  doc_base: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Dispatch the fold+export for a packed chunk (async); the result is
     the fused export buffer handle, int16 when the chunk qualifies (with
     obliterate/overlap row elision and int8 pair-packing per the pack-time
@@ -1205,7 +1206,11 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     built in-graph — no zero upload).  ``digest=True`` additionally emits
     the per-doc state digest plane as the last output leaf (split it off
     with ``split_export_digest`` — the delta-download gate fetches ONLY
-    that tiny plane eagerly)."""
+    that tiny plane eagerly).  ``doc_base`` (optional) supplies a
+    DEVICE-RESIDENT per-doc arena base (the tier-2.5 resident tier keeps
+    it on device so an exact warm hit uploads nothing); it must equal
+    ``meta["doc_base"]`` — passing the real bases is inert on layouts
+    that ignore them."""
     from .pallas_fold import pallas_fold_mode
 
     i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
@@ -1214,8 +1219,9 @@ def replay_export(state: Optional[MTState], ops: MTOps, meta: dict,
     # unchanged document digests identically across repacks that moved
     # its absolute arena offsets (_export_state reads doc_base only
     # under i16 — passing the real bases is inert for the buffer).
-    doc_base = jnp.asarray(meta["doc_base"]) if (i16 or digest) else \
-        jnp.zeros((ops.kind.shape[0],), jnp.int32)
+    if doc_base is None:
+        doc_base = jnp.asarray(meta["doc_base"]) if (i16 or digest) else \
+            jnp.zeros((ops.kind.shape[0],), jnp.int32)
     ops = narrow_ops_for_upload(ops, meta)  # h2d transfer encoding
     # The pallas fold ignores the chunk facts — normalize so mixed
     # workloads don't compile duplicate executables per cache key

@@ -25,6 +25,7 @@ Shape (round-5 pipeline, BASELINE.md):
 from __future__ import annotations
 
 import collections
+import itertools
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -80,15 +81,24 @@ def _copy_doc_pack(pack):
     return out
 
 
+#: monotone pack-generation ids — the lineage tokens tier 2 stamps into
+#: ``meta["_pack_lineage"]`` so the device-resident tier (tier 2.5,
+#: ops/device_cache.py) can PROVE a set of host arrays is the literal
+#: suffix-extension of what it holds resident.  itertools.count.__next__
+#: is atomic under CPython, so the stamp needs no extra locking.
+_PACK_GEN = itertools.count(1)
+
+
 class _PackEntry:
     """One cached packed window: the wide (pre-narrow) chunk arrays plus
     the per-doc window bookkeeping needed to match and extend it."""
 
     __slots__ = ("tokens", "n_ops", "first_seq", "last_seq", "t_rows",
-                 "state", "ops", "meta", "nbytes")
+                 "state", "ops", "meta", "nbytes", "gen")
 
     def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows,
-                 state, ops, meta):
+                 state, ops, meta, gen=0):
+        self.gen = gen
         self.tokens = tokens
         self.n_ops = n_ops
         self.first_seq = first_seq
@@ -109,6 +119,34 @@ def _doc_window(doc: MergeTreeDocInput):
     if n == 0:
         return 0, 0, 0
     return n, doc.ops[0].seq, doc.ops[-1].seq
+
+
+def match_windows(n_ops, first_seq, last_seq, chunk) -> Optional[str]:
+    """THE window-matching rule shared by tier 2 (:class:`PackCache`)
+    and tier 2.5 (``ops/device_cache.DevicePackCache``): "exact" when
+    every doc's op window is unchanged vs the cached per-doc
+    ``(n_ops, first_seq, last_seq)``, "suffix" when every window
+    extends its cached one (same first seq, the old tail still in
+    place, any new rows strictly past it — same-seq rows only ever
+    arrive inside one sequenced message, which the cached window
+    already held in full), else None.  One derivation point: the two
+    tiers deciding differently would let resident device buffers
+    disagree with the packed host arrays they mirror."""
+    exact = True
+    for d, doc in enumerate(chunk):
+        n, first, _last = _doc_window(doc)
+        cached_n = n_ops[d]
+        if n < cached_n:
+            return None
+        if cached_n:
+            if first != first_seq[d] \
+                    or doc.ops[cached_n - 1].seq != last_seq[d]:
+                return None
+            if n > cached_n and doc.ops[cached_n].seq <= last_seq[d]:
+                return None
+        if n != cached_n:
+            exact = False
+    return "exact" if exact else "suffix"
 
 
 class PackCache:
@@ -196,21 +234,28 @@ class PackCache:
                 with self._lock:
                     self._touch(tokens)
                     self.counters.bump("exact_hits")
-                return entry.state, entry.ops, dict(entry.meta,
-                                                    docs=list(chunk))
+                return entry.state, entry.ops, dict(
+                    entry.meta, docs=list(chunk),
+                    _pack_lineage=("exact", entry.gen))
             if kind == "suffix":
+                parent_gen = entry.gen
                 with self._extend_lock:
                     extended = self._extend(entry, chunk)
                 if extended is not None:
                     state, ops, meta = extended
-                    self._store(tokens, chunk, state, ops, meta)
+                    gen = self._store(tokens, chunk, state, ops, meta)
+                    # The lineage stamp: these arrays are the literal
+                    # extension of generation ``parent_gen`` — the
+                    # device-resident tier's suffix-splice license.
+                    meta["_pack_lineage"] = ("suffix", parent_gen, gen)
                     with self._lock:
                         self.counters.bump("suffix_hits")
                     return state, ops, meta
         with self._lock:
             self.counters.bump("misses")
         state, ops, meta = pack_mergetree_batch(chunk)
-        self._store(tokens, chunk, state, ops, meta)
+        gen = self._store(tokens, chunk, state, ops, meta)
+        meta["_pack_lineage"] = ("full", gen)
         return state, ops, meta
 
     # -- bookkeeping -----------------------------------------------------------
@@ -220,7 +265,10 @@ class PackCache:
         if entry is not None:
             self._entries[tokens] = entry
 
-    def _store(self, tokens, chunk, state, ops, meta) -> None:
+    def _store(self, tokens, chunk, state, ops, meta) -> int:
+        """Insert/replace the entry; returns its pack generation (fresh
+        even when the byte budget refuses the entry — the lineage stamp
+        must still be unique per produced array set)."""
         n_ops, first_seq, last_seq, t_rows = [], [], [], []
         for doc in chunk:
             n, first, last = _doc_window(doc)
@@ -236,15 +284,16 @@ class PackCache:
         # — so drop the doc inputs (and with them the per-op Python
         # message lists, the dominant retained memory the byte budget
         # would otherwise silently under-count).
+        gen = next(_PACK_GEN)
         entry = _PackEntry(tokens, n_ops, first_seq, last_seq, t_rows,
-                           state, ops, dict(meta, docs=None))
+                           state, ops, dict(meta, docs=None), gen=gen)
         with self._lock:
             old = self._entries.pop(tokens, None)
             if old is not None:
                 self._bytes -= old.nbytes
             if entry.nbytes > self.max_bytes:
                 self.counters.bump("evictions")
-                return
+                return gen
             self._entries[tokens] = entry
             self._bytes += entry.nbytes
             self.counters.bump("inserts")
@@ -253,30 +302,12 @@ class PackCache:
                 dropped = self._entries.pop(oldest)
                 self._bytes -= dropped.nbytes
                 self.counters.bump("evictions")
+        return gen
 
     @staticmethod
     def _match(entry: _PackEntry, chunk) -> Optional[str]:
-        """"exact" when every doc's window is unchanged, "suffix" when
-        every doc's window extends its cached one, else None."""
-        exact = True
-        for d, doc in enumerate(chunk):
-            n, first, last = _doc_window(doc)
-            cached_n = entry.n_ops[d]
-            if n < cached_n:
-                return None
-            if cached_n:
-                if first != entry.first_seq[d] \
-                        or doc.ops[cached_n - 1].seq != entry.last_seq[d]:
-                    return None
-                # The suffix must start STRICTLY past the cached window:
-                # same-seq rows only ever arrive inside one sequenced
-                # message, which the cached window already held in full.
-                if n > cached_n and doc.ops[cached_n].seq \
-                        <= entry.last_seq[d]:
-                    return None
-            if n != cached_n:
-                exact = False
-        return "exact" if exact else "suffix"
+        return match_windows(entry.n_ops, entry.first_seq,
+                             entry.last_seq, chunk)
 
     # -- suffix extension ------------------------------------------------------
 
@@ -414,6 +445,64 @@ class PackCache:
         meta["has_props"] = len(meta["prop_keys"]) > 0
 
 
+# -- tier-0 delta-download routing: ONE derivation point --------------------
+# The single-device pipeline below and the mesh fold
+# (parallel/shard.py replay_mergetree_sharded) both consume these — the
+# byte-identity-critical cache logic (serve gate, entry publication, the
+# changed-rows sub-meta) must never fork into hand-synced copies.
+
+
+def delta_route(docs, dig_np, delta_cache):
+    """The per-chunk tier-0 decision after the digest plane arrived:
+    ``("full", {}, None)`` — nothing servable, the cold/fallback/oracle
+    route; ``("served", served, None)`` — every document serves without
+    a download; ``("partial", served, changed)`` — only ``changed``
+    positions' rows must cross."""
+    served = (delta_cache.serve_many(docs, dig_np)
+              if delta_cache.any_candidate(docs) else {})
+    if not served:
+        return "full", served, None
+    if len(served) == len(docs):
+        return "served", served, None
+    return "partial", served, [d for d in range(len(docs))
+                               if d not in served]
+
+
+def delta_store_all(delta_cache, docs, dig_np, trees) -> None:
+    """(Re)publish every document's tier-0 entry — the cold-fill leg."""
+    delta_cache.put_many(
+        (doc, (int(dig_np[d, 0]), int(dig_np[d, 1])), trees[d])
+        for d, doc in enumerate(docs))
+
+
+def delta_sub_meta(meta, changed) -> dict:
+    """The per-doc meta rows of only the CHANGED positions (the gathered
+    rows' extraction view); chunk-global meta passes through."""
+    docs = meta["docs"]
+    return dict(
+        meta,
+        docs=[docs[d] for d in changed],
+        doc_packs=[meta["doc_packs"][d] for d in changed],
+        doc_base=np.asarray(meta["doc_base"])[
+            np.asarray(changed, np.intp)],
+    )
+
+
+def delta_merge_changed(delta_cache, meta, dig_np, served, changed, got):
+    """Served trees + freshly extracted changed trees → the chunk's
+    result list, publishing the changed documents' new tier-0 entries."""
+    docs = meta["docs"]
+    res: List = [None] * len(docs)
+    for d, tree in served.items():
+        res[d] = tree
+    for d, tree in zip(changed, got):
+        res[d] = tree
+    delta_cache.put_many(
+        (docs[d], (int(dig_np[d, 0]), int(dig_np[d, 1])), tree)
+        for d, tree in zip(changed, got))
+    return res
+
+
 def pipelined_mergetree_replay(
     docs: Sequence[MergeTreeDocInput],
     *,
@@ -427,29 +516,38 @@ def pipelined_mergetree_replay(
     packed_out: Optional[list] = None,
     pack_cache: Optional[PackCache] = None,
     delta_cache=None,
+    device_cache=None,
 ):
     """Canonical summaries for ``docs`` in the given order.
 
     ``stats`` accumulates ``device_docs``/``fallback_docs`` (plus
     ``delta_docs`` for documents served from the tier-0 delta cache
     without a download); ``stage`` (if given) accumulates busy seconds
-    under ``pack``/``dispatch``/``device_wait``/``download``/``extract``
-    and the integer byte counter ``d2h_bytes`` — the bench harness's
-    instrumentation hook; ``packed_out`` (if given) collects ``(ops,
-    meta, S)`` per chunk in schedule order so a caller can reuse the pack
-    work; ``pack_cache`` (if given) reuses packed windows across calls
-    for docs carrying a ``cache_token`` (see :class:`PackCache`);
+    under ``pack``/``dispatch``/``upload``/``device_wait``/``download``/
+    ``extract`` and the integer byte counters ``h2d_bytes``/``d2h_bytes``
+    — the bench harness's instrumentation hook; ``packed_out`` (if
+    given) collects ``(ops, meta, S)`` per chunk in schedule order so a
+    caller can reuse the pack work; ``pack_cache`` (if given) reuses
+    packed windows across calls for docs carrying a ``cache_token`` (see
+    :class:`PackCache`);
     ``delta_cache`` (a ``service.catchup_cache.DeltaExportCache``, tier 0
     of the catch-up cache) turns on digest-gated delta download: the fold
     emits a per-doc state digest, only the tiny digest plane round-trips
     eagerly, and only CHANGED documents' export rows are gathered and
     downloaded — unchanged documents serve their cached summaries
-    byte-identically.  Any miss/mismatch falls back to the full fetch."""
+    byte-identically.  Any miss/mismatch falls back to the full fetch.
+    ``device_cache`` (an ``ops.device_cache.DevicePackCache``, tier 2.5)
+    keeps packed chunk arrays device-resident across calls: an exact
+    tier-2 window hit dispatches with ZERO h2d pack bytes, a suffix hit
+    uploads only the new rows through a donated in-place splice, and any
+    mismatch falls back to the full upload — which without the tier is
+    also the only route (and is what ``h2d_bytes`` then counts)."""
 
     def fold(batch):
         return _pipelined_fold(
             batch, chunk_docs, pack_threads, extract_threads, fetch_depth,
             schedule, stats, stage, packed_out, pack_cache, delta_cache,
+            device_cache,
         )
 
     return partition_replay(
@@ -470,11 +568,29 @@ def _count_d2h(stage: Optional[dict], nbytes: int) -> None:
         stage["d2h_bytes"] = stage.get("d2h_bytes", 0) + int(nbytes)
 
 
+def _count_h2d(stage: Optional[dict], nbytes: int) -> None:
+    """The upload-side twin of :func:`_count_d2h`: bytes of pack data
+    this call pushed over the h2d link — the observable the
+    device-resident tier (ISSUE 13) exists to shrink."""
+    if stage is not None:
+        stage["h2d_bytes"] = stage.get("h2d_bytes", 0) + int(nbytes)
+
+
 def _nbytes(handle) -> int:
     """Byte size of a device/host buffer handle (or tuple of them) from
     shape metadata alone — never forces a transfer."""
     leaves = handle if isinstance(handle, tuple) else (handle,)
     return int(sum(leaf.nbytes for leaf in leaves))
+
+
+def _np_nbytes(tree) -> int:
+    """Bytes of the NUMPY leaves of a state/ops tree — exactly what the
+    dispatch jit will push over the h2d link (device-resident leaves
+    pass through and cost nothing)."""
+    if tree is None:
+        return 0
+    return int(sum(leaf.nbytes for leaf in tree
+                   if isinstance(leaf, np.ndarray)))
 
 
 def _block_until_ready(*handles) -> None:
@@ -493,7 +609,7 @@ def _block_until_ready(*handles) -> None:
 
 def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     fetch_depth, schedule, stats, stage, packed_out,
-                    pack_cache=None, delta_cache=None):
+                    pack_cache=None, delta_cache=None, device_cache=None):
     order = list(range(len(batch)))
     if schedule and any(d.binary_ops is not None for d in batch):
         # Fact-homogeneous scheduling: annotate-free docs first, so their
@@ -532,9 +648,7 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
         tier-0 entry — the cold-fill leg of the delta path."""
         res, st, dt = extract_one(meta, arr)
         t0 = perf_counter()
-        delta_cache.put_many(
-            (doc, (int(dig_np[d, 0]), int(dig_np[d, 1])), res[d])
-            for d, doc in enumerate(meta["docs"]))
+        delta_store_all(delta_cache, meta["docs"], dig_np, res)
         return res, st, dt + (perf_counter() - t0)
 
     def extract_served(docs, served):
@@ -548,24 +662,11 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
         (the cached tree came out of this same extraction under an equal
         digest + host anchor)."""
         t0 = perf_counter()
-        docs = meta["docs"]
-        sub_meta = dict(
-            meta,
-            docs=[docs[d] for d in changed],
-            doc_packs=[meta["doc_packs"][d] for d in changed],
-            doc_base=np.asarray(meta["doc_base"])[
-                np.asarray(changed, np.intp)],
-        )
         st: dict = {}
-        got = summaries_from_export(sub_meta, arr, stats=st)
-        res: List = [None] * len(docs)
-        for d, tree in served.items():
-            res[d] = tree
-        for d, tree in zip(changed, got):
-            res[d] = tree
-        delta_cache.put_many(
-            (docs[d], (int(dig_np[d, 0]), int(dig_np[d, 1])), tree)
-            for d, tree in zip(changed, got))
+        got = summaries_from_export(delta_sub_meta(meta, changed), arr,
+                                    stats=st)
+        res = delta_merge_changed(delta_cache, meta, dig_np, served,
+                                  changed, got)
         st["delta_docs"] = st.get("delta_docs", 0) + len(served)
         return res, st, perf_counter() - t0
 
@@ -612,10 +713,12 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     _count_d2h(stage, dig_np.nbytes)
                     # Host cache work stays OUTSIDE the download window
                     # (the stage times link traffic alone); one lock
-                    # acquisition serves the whole chunk.
-                    served = (delta_cache.serve_many(docs, dig_np)
-                              if cand else {})
-                    if not served:
+                    # acquisition serves the whole chunk
+                    # (delta_route, the shared tier-0 decision).
+                    route, served, changed = (
+                        delta_route(docs, dig_np, delta_cache)
+                        if cand else ("full", {}, None))
+                    if route == "full":
                         # Cold / all-changed / fallback route — and the
                         # golden oracle the delta path is tested against.
                         t0 = perf_counter()
@@ -624,13 +727,11 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                         _count_d2h(stage, _nbytes(arr))
                         ex_futs.append(ex_pool.submit(
                             extract_full_store, meta, arr, dig_np))
-                    elif len(served) == len(docs):
+                    elif route == "served":
                         delta_cache.note_bytes_saved(_nbytes(core))
                         ex_futs.append(ex_pool.submit(
                             extract_served, docs, served))
                     else:
-                        changed = [d for d in range(len(docs))
-                                   if d not in served]
                         # Exact rows on host-viewable buffers; fine-
                         # bucketed device gather (or whole-buffer fetch
                         # when padding would move it all) elsewhere —
@@ -659,10 +760,30 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     next_i += 1
                 if stage is not None:
                     stage["pack"] = stage.get("pack", 0.0) + dt
+                # --- upload leg (tier 2.5): resident buffers on a warm
+                # window, donated suffix splice on a grown one, full
+                # device_put otherwise.  All device interaction stays on
+                # THIS thread (the pipeline's single-device-thread
+                # contract); `upload` times the explicit transfers and
+                # h2d_bytes counts what really crossed — without the
+                # tier, the full host arrays upload inside the jit call
+                # below, so they are counted here either way.
+                base_dev = None
+                host_state, host_ops = state, ops
+                if device_cache is not None:
+                    t0 = perf_counter()
+                    state, ops, base_dev, up_bytes = \
+                        device_cache.acquire(state, ops, meta)
+                    _bump(stage, "upload", t0)
+                    _count_h2d(stage, up_bytes)
+                else:
+                    _count_h2d(stage,
+                               _np_nbytes(state) + _np_nbytes(ops))
                 t0 = perf_counter()
                 S = _chunk_S(meta)
                 ex = replay_export(state, ops, meta, S=S,
-                                   digest=want_digest)
+                                   digest=want_digest,
+                                   doc_base=base_dev)
                 core, dig = split_export_digest(ex, want_digest)
                 cand = want_digest and delta_cache.any_candidate(
                     meta["docs"])
@@ -679,8 +800,11 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                 if packed_out is not None:
                     # state included so a caller re-timing the fold can
                     # replay WARM chunks with the same executable the e2e
-                    # used (None for cold chunks).
-                    packed_out.append((state, ops, meta, S))
+                    # used (None for cold chunks).  Always the HOST
+                    # arrays: a resident-tier buffer may later be
+                    # donated away by a suffix splice — a collected
+                    # reference must never die under the caller.
+                    packed_out.append((host_state, host_ops, meta, S))
                 inflight.append((meta, core, dig, cand))
                 if len(inflight) > fetch_depth:
                     fetch_one(*inflight.popleft())

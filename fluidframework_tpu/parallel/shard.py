@@ -45,6 +45,7 @@ from ..ops.mergetree_kernel import (
     narrow_state_for_upload,
     oracle_fallback_summary,
     pack_mergetree_batch,
+    split_export_digest,
     summaries_from_export,
 )
 from ..protocol.summary import SummaryTree
@@ -119,7 +120,8 @@ def _shard_put(mesh: Mesh, tree):
 
 def sharded_export_step(mesh: Mesh, S: int, i16: bool, ob_rows: bool,
                         ov_rows: bool, i8: bool, sequential: bool,
-                        has_props: bool, warm: bool):
+                        has_props: bool, warm: bool,
+                        digest: bool = False):
     """Mesh-sharded fold+EXPORT: the SAME cached builders as the
     single-chip path (``_export_cold_fn`` / ``_export_warm_fn``) with
     the doc-sharded placement threaded through as ``out_sharding`` — one
@@ -128,21 +130,37 @@ def sharded_export_step(mesh: Mesh, S: int, i16: bool, ob_rows: bool,
     in-graph, folds with the chunk-fact specialization, and emits the
     fused transfer buffer doc-sharded (~10× less d2h than the 13 full
     int32 state planes it replaced), with the forced row-major fetch
-    layout where the backend supports layouts.  The fold and export are
-    per-doc elementwise along the doc axis: no collective is inserted;
-    each chip folds and encodes its shard."""
+    layout where the backend supports layouts.  ``digest`` appends the
+    per-doc state digest plane (the tier-0 delta-download gate), sharded
+    like the buffer.  The fold and export are per-doc elementwise along
+    the doc axis: no collective is inserted; each chip folds and encodes
+    its shard."""
     shard = NamedSharding(mesh, _doc_spec(mesh))
     if warm:
         return _export_warm_fn(i16, ob_rows, "", ov_rows, i8, sequential,
-                               has_props, out_sharding=shard)
+                               has_props, out_sharding=shard,
+                               digest=digest)
     return _export_cold_fn(S, i16, ob_rows, "", ov_rows, i8, sequential,
-                           has_props, out_sharding=shard)
+                           has_props, out_sharding=shard, digest=digest)
+
+
+def _pad_token(k: int) -> tuple:
+    """A deterministic cache token for mesh pad documents: the padded
+    chunk's token tuple must stay all-non-None for tier-2/2.5 keying,
+    and an empty pad doc's "stream" is trivially append-only under a
+    fixed token.  Component 0 is a sentinel epoch, so the tier-0/2.5
+    epoch sweeps treat pad entries as stale on any real epoch change."""
+    return ("\x00pad", f"\x00pad{k}", 0, "")
 
 
 def replay_mergetree_sharded(
     docs: Sequence[MergeTreeDocInput],
     mesh: Optional[Mesh] = None,
     stats: Optional[dict] = None,
+    stage: Optional[dict] = None,
+    pack_cache=None,
+    delta_cache=None,
+    device_cache=None,
 ) -> List[SummaryTree]:
     """Multi-chip catch-up replay: pack → narrow → shard over the mesh →
     fold+export in-graph → shared host extraction (the single-chip
@@ -152,55 +170,192 @@ def replay_mergetree_sharded(
     fused (elided/int16/int8) export buffer as single-chip — ~10× less
     d2h per chunk — and uploads the narrow encodings.
 
+    Round 13 pays the mesh-parity debt: the sharded fold serves the
+    identical cache stack as the single-device pipeline — ``pack_cache``
+    (tier 2 suffix reuse), ``delta_cache`` (tier 0 digest-gated delta
+    download; only the digest plane and changed documents' rows cross
+    d2h), ``device_cache`` (tier 2.5 resident upload buffers, placed
+    doc-sharded; exact hits upload nothing, suffix hits splice in place)
+    — and ``stage`` accumulates the same busy-second /
+    ``h2d_bytes``/``d2h_bytes`` schema
+    (``pack``/``upload``/``dispatch``/``device_wait``/``download``/
+    ``extract``) the single-device pipeline reports, so the first
+    multichip measurement records the full r06-style stage split.
+
     ``stats`` (optional dict) accumulates ``device_docs`` /
     ``fallback_docs`` exactly like ``replay_mergetree_batch`` — pre-pack
-    oracle routing plus post-fold overflow fallbacks — so the multichip
-    service path reports the same device-vs-oracle split as single-chip
-    (advisor, round 5)."""
+    oracle routing plus post-fold overflow fallbacks — plus
+    ``delta_docs`` for tier-0 serves, so the multichip service path
+    reports the same split as single-chip."""
     from ..ops.batching import partition_replay
+    from ..ops.mergetree_kernel import gather_export_rows
+    from ..ops.pipeline import (
+        _block_until_ready,
+        _bump,
+        _count_d2h,
+        _count_h2d,
+        _nbytes,
+        _np_nbytes,
+        delta_merge_changed,
+        delta_route,
+        delta_store_all,
+        delta_sub_meta,
+        perf_counter,
+    )
 
     if mesh is None:
         mesh = doc_mesh()
+    shard = NamedSharding(mesh, _doc_spec(mesh))
+    if device_cache is not None:
+        device_cache.set_sharding(shard)
+
+    def _bump_stats(st: dict) -> None:
+        if stats is not None:
+            for k, v in st.items():
+                stats[k] = stats.get(k, 0) + v
 
     def fold_batch_export(batch):
         n_real = len(batch)
+        pad_base = len(batch)
         padded = _pad_docs(
             batch, mesh.size,
             lambda: MergeTreeDocInput(doc_id="\x00pad", ops=[]),
         )
-        state, ops, meta = pack_mergetree_batch(padded)
-        S = state.tstart.shape[1]
-        i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
-        doc_base = jnp.asarray(meta["doc_base"]) if i16 else \
-            jnp.zeros((len(padded),), jnp.int32)
-        sequential = bool(meta.get("sequential"))
+        # Pad docs carry a deterministic token so the padded chunk's
+        # token tuple keys tiers 2/2.5 (any None would bypass both) —
+        # but only when every REAL doc is tokened; a mixed chunk
+        # bypasses anyway and must keep doing so.
+        if all(d.cache_token is not None for d in batch):
+            for k in range(pad_base, len(padded)):
+                padded[k].cache_token = _pad_token(k)
+        t0 = perf_counter()
+        if pack_cache is not None:
+            state, ops, meta = pack_cache.pack(padded)
+        else:
+            state, ops, meta = pack_mergetree_batch(padded)
         warm = any(d.base_records for d in padded)
+        state_n = narrow_state_for_upload(state, meta) if warm else None
+        ops_n = narrow_ops_for_upload(ops, meta)
+        _bump(stage, "pack", t0)
+        S = int(meta["_S"])
+        i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
+        sequential = bool(meta.get("sequential"))
+        want_digest = delta_cache is not None
+
+        # --- upload leg: resident tier or explicit sharded device_put;
+        # h2d_bytes counts what really crossed either way.
+        t0 = perf_counter()
+        base_dev = None
+        if device_cache is not None:
+            state_u, ops_u, base_dev, up_bytes = device_cache.acquire(
+                state_n, ops_n, meta)
+            if base_dev is None and (i16 or want_digest):
+                base_dev = jax.device_put(
+                    jnp.asarray(meta["doc_base"]), shard)
+                up_bytes += len(padded) * 4
+            if isinstance(ops_u.kind, np.ndarray):
+                # Bypass route (token-less chunk): shard-place like the
+                # plain path so the step still runs mesh-partitioned.
+                ops_u = _shard_put(mesh, ops_u)
+                state_u = _shard_put(mesh, state_u) if warm else None
+        else:
+            up_bytes = _np_nbytes(state_n) + _np_nbytes(ops_n)
+            ops_u = _shard_put(mesh, ops_n)
+            state_u = _shard_put(mesh, state_n) if warm else None
+        if base_dev is None:
+            base_np = meta["doc_base"] if (i16 or want_digest) else \
+                np.zeros((len(padded),), np.int32)
+            base_dev = jax.device_put(jnp.asarray(base_np), shard)
+        _bump(stage, "upload", t0)
+        _count_h2d(stage, up_bytes)
+
+        # --- dispatch + honest device wait.
+        t0 = perf_counter()
         the_step = sharded_export_step(
             mesh, S, i16, ob_rows, ov_rows, i8, sequential, has_props,
-            warm)
-        ops_n = _shard_put(mesh, narrow_ops_for_upload(ops, meta))
-        base_sh = jax.device_put(
-            doc_base, NamedSharding(mesh, _doc_spec(mesh)))
-        if warm:
-            state_n = _shard_put(mesh, narrow_state_for_upload(state, meta))
-            export = the_step(state_n, ops_n, base_sh)
-        else:
-            export = the_step(ops_n, base_sh)
-        # Trim pad docs BEFORE extraction (a tail batch of 1 real doc on
-        # a 256-chip mesh pads to 256): slice the fetched buffer and the
-        # per-doc meta rows; chunk-global meta (arena, interners) is
-        # untouched and tstart offsets are absolute, so the sliced view
-        # extracts identically.
-        ex_np = export_to_numpy(export)
-        ex_np = tuple(a[:n_real] for a in ex_np) \
-            if isinstance(ex_np, tuple) else ex_np[:n_real]
+            warm, digest=want_digest)
+        export = the_step(state_u, ops_u, base_dev) if warm \
+            else the_step(ops_u, base_dev)
+        core, dig = split_export_digest(export, want_digest)
+        _bump(stage, "dispatch", t0)
+        t0 = perf_counter()
+        _block_until_ready(core, dig)
+        _bump(stage, "device_wait", t0)
+
+        # Pad trimming: served/changed/extraction all operate on the
+        # REAL prefix (pads sit at the tail), so stats and the tier-0
+        # entries never see a pad; the sliced view extracts identically
+        # (chunk-global meta untouched, tstart offsets absolute).
         meta_real = dict(
             meta,
             docs=meta["docs"][:n_real],
             doc_packs=meta["doc_packs"][:n_real],
             doc_base=meta["doc_base"][:n_real],
         )
-        return summaries_from_export(meta_real, ex_np, stats=stats)
+        real_docs = meta_real["docs"]
+
+        def trim(ex_np):
+            return tuple(a[:n_real] for a in ex_np) \
+                if isinstance(ex_np, tuple) else ex_np[:n_real]
+
+        def extract(meta_x, arr, extra=()):
+            t1 = perf_counter()
+            st: dict = {}
+            res = summaries_from_export(meta_x, arr, stats=st)
+            for fn in extra:
+                fn(res)
+            _bump(stage, "extract", t1)
+            _bump_stats(st)
+            return res
+
+        def fetch_full():
+            # d2h_bytes counts the PADDED buffer — that is what crosses
+            # the link; pads trim host-side after the transfer.
+            t1 = perf_counter()
+            raw = export_to_numpy(core)
+            _bump(stage, "download", t1)
+            _count_d2h(stage, _nbytes(raw))
+            return trim(raw)
+
+        if dig is None:
+            return extract(meta_real, fetch_full())
+        t0 = perf_counter()
+        dig_full = np.asarray(dig)  # the full padded plane crosses
+        _bump(stage, "download", t0)
+        _count_d2h(stage, dig_full.nbytes)
+        dig_np = dig_full[:n_real]
+        # The shared tier-0 decision + entry publication
+        # (ops/pipeline.py delta_* helpers — one derivation point with
+        # the single-device pipeline); pads never enter the handshake.
+        route, served, changed = delta_route(real_docs, dig_np,
+                                             delta_cache)
+        if route == "full":
+            # Cold / all-changed / fallback route — and the golden
+            # oracle the delta path is tested against.
+            def store(res):
+                delta_store_all(delta_cache, real_docs, dig_np, res)
+
+            return extract(meta_real, fetch_full(), extra=(store,))
+        if route == "served":
+            delta_cache.note_bytes_saved(_nbytes(core))
+            _bump_stats({"delta_docs": len(real_docs)})
+            return [served[d] for d in range(len(real_docs))]
+        t0 = perf_counter()
+        sub, fetched = gather_export_rows(
+            core, np.asarray(changed, np.int32))
+        _bump(stage, "download", t0)
+        _count_d2h(stage, fetched)
+        delta_cache.note_bytes_saved(max(0, _nbytes(core) - fetched))
+        t0 = perf_counter()
+        st: dict = {}
+        got = summaries_from_export(delta_sub_meta(meta_real, changed),
+                                    sub, stats=st)
+        res = delta_merge_changed(delta_cache, meta_real, dig_np, served,
+                                  changed, got)
+        st["delta_docs"] = st.get("delta_docs", 0) + len(served)
+        _bump(stage, "extract", t0)
+        _bump_stats(st)
+        return res
 
     return partition_replay(
         docs, known_oracle_fallback, oracle_fallback_summary,
@@ -238,13 +393,19 @@ def map_sharded_replay_step(mesh: Mesh, num_keys: int, num_docs: int):
     )
 
 
-def replay_map_sharded(docs, mesh: Optional[Mesh] = None) -> List[SummaryTree]:
+def replay_map_sharded(docs, mesh: Optional[Mesh] = None,
+                       stats: Optional[dict] = None) -> List[SummaryTree]:
     """Multi-chip SharedMap catch-up replay; byte-compatible with
-    ``replay_map_batch`` and the CPU oracle."""
+    ``replay_map_batch`` and the CPU oracle.  ``stats`` accumulates
+    ``device_docs`` exactly like the batch entry point (the LWW
+    reduction has no fallback cases), so the mesh service path reports
+    the same split as single-chip."""
     from ..ops.map_kernel import pack_map_batch, summaries_from_lww
 
     if not docs:
         return []
+    if stats is not None:
+        stats["device_docs"] = stats.get("device_docs", 0) + len(docs)
     if mesh is None:
         mesh = doc_mesh()
     # Bucket floor = mesh size so the flat op axis splits evenly over
@@ -303,11 +464,14 @@ def matrix_sharded_replay_step(mesh: Mesh):
 
 def replay_matrix_sharded(
     docs, mesh: Optional[Mesh] = None, step=None,
+    stats: Optional[dict] = None,
 ) -> List[SummaryTree]:
     """Multi-chip SharedMatrix catch-up replay (see replay_mergetree_sharded).
 
     Matrices pack as TWO axis rows each, so the doc list pads to half the
-    mesh size to keep the [2D] axis evenly sharded."""
+    mesh size to keep the [2D] axis evenly sharded.  ``stats``
+    accumulates ``device_docs``/``fallback_docs`` like the batch entry
+    point (pre-pack routing + per-axis overflow fallbacks)."""
     from ..ops.batching import partition_replay
     from ..ops.matrix_kernel import (
         MatrixDocInput,
@@ -341,12 +505,14 @@ def replay_matrix_sharded(
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
         resolved_np = np.asarray(resolved)
         return [
-            summary_from_matrix_state(meta, state_np, resolved_np, d)
+            summary_from_matrix_state(meta, state_np, resolved_np, d,
+                                      stats=stats)
             for d in range(n_real)
         ]
 
     return partition_replay(
-        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch
+        docs, known_matrix_fallback, oracle_matrix_fallback, fold_batch,
+        stats=stats,
     )
 
 
@@ -387,8 +553,12 @@ def tree_sharded_replay_step(mesh: Mesh):
 
 def replay_tree_sharded(
     docs, mesh: Optional[Mesh] = None, step=None,
+    stats: Optional[dict] = None,
 ) -> List[SummaryTree]:
-    """Multi-chip SharedTree catch-up replay (see replay_mergetree_sharded)."""
+    """Multi-chip SharedTree catch-up replay (see replay_mergetree_sharded).
+    ``stats`` accumulates ``device_docs``/``fallback_docs`` like the
+    batch entry point (pack-time revive/multi-id-move detection + fold
+    overflow)."""
     from ..ops.batching import partition_replay
     from ..ops.tree_kernel import (
         TreeDocInput,
@@ -414,11 +584,11 @@ def replay_tree_sharded(
         state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
         state_np["overflow"] = np.asarray(overflow)
         return [
-            tree_summary_from_state(meta, state_np, d)
+            tree_summary_from_state(meta, state_np, d, stats=stats)
             for d in range(n_real)
         ]
 
     # Tree fallbacks (revive edits, multi-id moves) are detected at pack
     # time inside summary_from_state; no pre-pack predicate exists.
     return partition_replay(docs, lambda _d: False,
-                            tree_oracle_fallback, fold_batch)
+                            tree_oracle_fallback, fold_batch, stats=stats)

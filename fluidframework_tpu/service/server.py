@@ -604,6 +604,12 @@ class OrderingServer:
             # Tier 0 (delta download) is epoch-keyed the same way.
             catchup.delta_cache.invalidate_epoch(
                 service.storage.epoch)
+        if catchup.device_cache is not None:
+            # Tier 2.5 (device-resident pack buffers): epoch-keyed
+            # tokens, same sweep — a recreated store frees the HBM its
+            # dead generation held.
+            catchup.device_cache.invalidate_epoch(
+                service.storage.epoch)
         doc_ids = params.get("docs")
         prefix = f"{session.tenant}/" if self.tenants is not None else ""
         if doc_ids is not None:
@@ -638,6 +644,12 @@ class OrderingServer:
             "deltaCache": (catchup.delta_cache.stats()
                            if catchup.delta_cache is not None
                            else None),
+            # Tier-2.5 resident-upload health: chunks dispatched with
+            # zero h2d pack bytes (served), donated suffix splices
+            # (spliced), and the upload bytes the tier kept off the link.
+            "deviceCache": (catchup.device_cache.stats()
+                            if catchup.device_cache is not None
+                            else None),
         }
 
     async def _handle(self, reader: asyncio.StreamReader,

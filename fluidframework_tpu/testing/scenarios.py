@@ -172,6 +172,13 @@ class ScenarioSpec:
     #: reach — such a plan fails loudly instead of reporting hollow
     #: coverage).
     out_of_proc: bool = False
+    #: run a REAL CatchupService cold+warm fold pass over the sampled
+    #: documents after the run (ISSUE 13): the swarm's op logs hit the
+    #: device fold twice with tier 1 off, so the warm pass exercises the
+    #: pack / delta / device-resident tiers and their counters land in
+    #: ``SwarmResult.fold_tier`` (outside replay identity, like
+    #: ``ingress``).  In-proc runs only.
+    fold_probe: bool = False
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
@@ -246,14 +253,20 @@ class SwarmResult:
     #: plus live-tap delivery accounting — carries pids and async frame
     #: counts, so (like ``ingress``) excluded from replay identity
     shard_stats: Dict[str, object] = dataclasses.field(default_factory=dict)
+    #: ``spec.fold_probe`` runs: catch-up fold-tier counters over the
+    #: sampled docs (device-resident / delta / pack cache stats + the
+    #: h2d/d2h byte split) — busy seconds are wall-derived, so (like
+    #: ``ingress``) excluded from replay identity
+    fold_tier: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def identity(self) -> dict:
         """The bit-identity surface: every field, canonically shaped —
-        except ``ingress`` and ``shard_stats``, which are wall-clock /
-        process derived and excluded."""
+        except ``ingress``/``shard_stats``/``fold_tier``, which are
+        wall-clock / process derived and excluded."""
         out = dataclasses.asdict(self)
         out.pop("ingress", None)
         out.pop("shard_stats", None)
+        out.pop("fold_tier", None)
         return out
 
 
@@ -1094,7 +1107,41 @@ class ClientSwarm:
             phase_counters=phase_counters,
             ingress=self.ingress.snapshot(),
             shard_stats=self._shard_stats(per_doc_head),
+            fold_tier=(self._fold_probe()
+                       if self.spec.fold_probe else {}),
         )
+
+    def _fold_probe(self) -> Dict[str, object]:
+        """ISSUE 13: close the loop between the swarm engine and the
+        device fold — catch the SAMPLED documents up twice through a
+        real CatchupService (tier 1 off, so the warm pass re-folds
+        through the pack / device-resident / delta tiers instead of
+        serving a memoized tree) and report the fold-tier counters.  The
+        cold pass fills the tiers from the swarm's real op logs; the
+        warm pass must serve resident (``device_cache["served"]``) and
+        delta-download (``delta_cache["served"]``) hits with the h2d
+        upload collapsed to zero pack bytes.  Wall-derived, hence
+        outside replay identity."""
+        if self._cluster is not None:
+            return {"skipped": "out-of-proc"}
+        from ..service.catchup import CatchupService
+
+        svc = CatchupService(self.service, mesh=None, cache=None)
+        ids = [self.doc_ids[d] for d in self.sampled]
+        svc.catch_up(ids, upload=False)  # cold: the tiers fill
+        svc.catch_up(ids, upload=False)  # warm: resident + delta serve
+        stage = svc.pipeline_stage
+        return {
+            "docs": len(ids),
+            "device_cache": (svc.device_cache.stats()
+                             if svc.device_cache is not None else None),
+            "delta_cache": (svc.delta_cache.stats()
+                            if svc.delta_cache is not None else None),
+            "pack_cache": (svc._pack_cache.stats()
+                           if svc._pack_cache is not None else None),
+            "h2d_bytes": int(stage.get("h2d_bytes", 0)),
+            "d2h_bytes": int(stage.get("d2h_bytes", 0)),
+        }
 
     def _shard_stats(self, per_doc_head: Dict[str, int]) -> Dict[str, object]:
         """Out-of-proc only: per-shard ``stats`` RPC pulls + the live-tap

@@ -132,11 +132,14 @@ class ColdWarmResult:
     cold_s: float
     warm_s: float  # best warm run
     warm_runs: int
-    #: d2h bytes the cold / per-warm run moved (from a pipeline ``stage``
-    #: dict's ``d2h_bytes`` counter) — None when no stage was attached,
-    #: so JSON consumers see the fields null-stable, not absent.
+    #: d2h / h2d bytes the cold / per-warm run moved (from a pipeline
+    #: ``stage`` dict's ``d2h_bytes``/``h2d_bytes`` counters) — None
+    #: when no stage was attached, so JSON consumers see the fields
+    #: null-stable, not absent.
     cold_d2h_bytes: Optional[int] = None
     warm_d2h_bytes: Optional[int] = None  # LAST warm run (deterministic)
+    cold_h2d_bytes: Optional[int] = None
+    warm_h2d_bytes: Optional[int] = None  # LAST warm run (deterministic)
 
     @property
     def speedup(self) -> float:
@@ -151,7 +154,9 @@ class ColdWarmResult:
         )
         if self.cold_d2h_bytes is not None:
             out += (f" | d2h cold {self.cold_d2h_bytes} B, warm "
-                    f"{self.warm_d2h_bytes} B")
+                    f"{self.warm_d2h_bytes} B | h2d cold "
+                    f"{self.cold_h2d_bytes} B, warm "
+                    f"{self.warm_h2d_bytes} B")
         return out
 
 
@@ -164,30 +169,35 @@ def benchmark_cold_warm(
     """Cold/warm mode: time ``fn`` once cold, then ``warm_runs`` more
     times taking the best — no setup hook on purpose (the state carried
     between runs IS the measurement).  ``stage`` (a pipeline stage dict
-    whose ``d2h_bytes`` counter ``fn`` advances) additionally attributes
-    the cold run's and the last warm run's d2h bytes — the delta-download
-    observable, deterministic where the timings are not."""
+    whose ``d2h_bytes``/``h2d_bytes`` counters ``fn`` advances)
+    additionally attributes the cold run's and the last warm run's link
+    bytes EACH WAY — the delta-download and resident-upload observables,
+    deterministic where the timings are not."""
 
-    def _bytes() -> int:
-        return int(stage.get("d2h_bytes", 0)) if stage is not None else 0
+    def _bytes(key: str) -> int:
+        return int(stage.get(key, 0)) if stage is not None else 0
 
-    b0 = _bytes()
+    b0, u0 = _bytes("d2h_bytes"), _bytes("h2d_bytes")
     t0 = time.perf_counter()
     fn()
     cold = time.perf_counter() - t0
-    cold_bytes = _bytes() - b0
+    cold_bytes = _bytes("d2h_bytes") - b0
+    cold_up = _bytes("h2d_bytes") - u0
     warm = float("inf")
-    warm_bytes = 0
+    warm_bytes = warm_up = 0
     for _ in range(max(1, warm_runs)):
-        b0 = _bytes()
+        b0, u0 = _bytes("d2h_bytes"), _bytes("h2d_bytes")
         t0 = time.perf_counter()
         fn()
         warm = min(warm, time.perf_counter() - t0)
-        warm_bytes = _bytes() - b0
+        warm_bytes = _bytes("d2h_bytes") - b0
+        warm_up = _bytes("h2d_bytes") - u0
     return ColdWarmResult(
         name=name, cold_s=cold, warm_s=warm, warm_runs=max(1, warm_runs),
         cold_d2h_bytes=cold_bytes if stage is not None else None,
         warm_d2h_bytes=warm_bytes if stage is not None else None,
+        cold_h2d_bytes=cold_up if stage is not None else None,
+        warm_h2d_bytes=warm_up if stage is not None else None,
     )
 
 

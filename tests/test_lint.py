@@ -124,10 +124,26 @@ def test_fluidproc_modules_lint_clean_with_zero_suppressions():
     assert offenders == [], "new modules must stay suppression-free"
 
 
+def test_device_cache_module_lints_clean_with_zero_suppressions():
+    """ISSUE 13 acceptance pin: the device-resident pack-buffer tier
+    passes ALL module rules (fluidlint + fluidrace + fluidleak families)
+    with zero findings AND zero baseline entries — the module that
+    donates device buffers must itself satisfy the donated-read
+    discipline (FL-TRACE-DONATE) it motivated."""
+    new_modules = [
+        "fluidframework_tpu/ops/device_cache.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "new modules must stay suppression-free"
+
+
 def test_every_rule_registered_and_described():
     rules = all_rules()
-    # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5)
-    assert len(rules) >= 21, sorted(rules)
+    # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5) + donate (PR 13)
+    assert len(rules) >= 22, sorted(rules)
     for name, rule in rules.items():
         assert rule.description, f"{name} has no description"
         assert rule.severity in ("error", "warning"), name

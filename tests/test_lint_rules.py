@@ -144,6 +144,33 @@ MODULE_RULE_FIXTURES = {
         """,
         OPS,
     ),
+    "FL-TRACE-DONATE": (
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def extend(buf, rows):
+            return buf + rows
+
+        def caller(buf, rows):
+            out = extend(buf, rows)
+            return out, buf.sum()
+        """,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def extend(buf, rows):
+            return buf + rows
+
+        def caller(buf, rows):
+            buf = extend(buf, rows)
+            return buf, buf.sum()
+        """,
+        OPS,
+    ),
     "FL-RACE-GUARD": (
         """
         import threading
@@ -505,6 +532,62 @@ def test_scan_argument_is_traced():
         return lax.scan(step, 0, xs)
     """
     assert findings_for(src, OPS, "FL-TRACE-HOSTSYNC")
+
+
+def test_donate_assigned_jit_form_and_position():
+    # f = jax.jit(g, donate_argnums=(1,)) donates position 1 ONLY: a
+    # later read of the position-0 arg is fine, the donated one fires.
+    src = """
+    import jax
+    def g(a, b):
+        return a + b
+    f = jax.jit(g, donate_argnums=(1,))
+    def caller(a, b):
+        out = f(a, b)
+        keep = a.sum()
+        return out, keep, b.sum()
+    """
+    msgs = [x.message for x in findings_for(src, OPS, "FL-TRACE-DONATE")]
+    assert len(msgs) == 1 and "'b' was donated" in msgs[0], msgs
+
+
+def test_donate_rebind_before_read_clears():
+    # A Store between the donating call and the read re-points the name
+    # at a live value — no finding.
+    src = """
+    import functools
+    import jax
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def extend(buf, rows):
+        return buf + rows
+    def caller(buf, rows, fresh):
+        out = extend(buf, rows)
+        buf = fresh
+        return out, buf.sum()
+    """
+    assert findings_for(src, OPS, "FL-TRACE-DONATE") == []
+
+
+def test_donate_attribute_receiver_not_flagged():
+    # Attribute receivers (entry.ops) are the documented limit: the
+    # owner swaps the reference (the device-cache idiom) and the rule
+    # stays silent rather than guessing aliasing.
+    src = """
+    import functools
+    import jax
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def extend(buf, rows):
+        return buf + rows
+    def caller(entry, rows):
+        entry.ops = extend(entry.ops, rows)
+        return entry.ops.sum()
+    """
+    assert findings_for(src, OPS, "FL-TRACE-DONATE") == []
+
+
+def test_donate_outside_kernel_scope_is_exempt():
+    bad, _good, _path = MODULE_RULE_FIXTURES["FL-TRACE-DONATE"]
+    assert findings_for(bad, LOADER, "FL-TRACE-DONATE") == []
 
 
 # -- fluidrace: the concurrency family ---------------------------------------

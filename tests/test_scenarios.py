@@ -405,3 +405,27 @@ def test_scale_matrix_1m_clients_columnar():
     assert result.sequenced_ops > 2_000_000
     assert result.sampled_digests == oracle.sampled_digests
     assert result.per_doc_head == oracle.per_doc_head
+
+
+def test_fold_probe_reports_resident_tier_counters():
+    """ISSUE 13 satellite: ``fold_probe`` catches the sampled docs up
+    cold+warm through a REAL CatchupService after the run — the warm
+    pass must serve resident (tier 2.5) and delta (tier 0) hits — and
+    the counters land in ``fold_tier``, OUTSIDE replay identity (a
+    probe-off run's identity is bit-equal)."""
+    spec = build_scenario("catchup-herd", seed=5, clients=96, docs=8,
+                          shards=2)
+    probed = dataclasses.replace(spec, fold_probe=True)
+    result = run_swarm(probed)
+    ft = result.fold_tier
+    assert ft["docs"] == len(result.sampled_digests) >= 1
+    assert ft["device_cache"]["inserts"] >= 1
+    assert ft["device_cache"]["served"] >= 1, ft["device_cache"]
+    assert ft["delta_cache"]["served"] >= 1, ft["delta_cache"]
+    assert ft["pack_cache"]["exact_hits"] >= 1
+    assert ft["h2d_bytes"] > 0 and ft["d2h_bytes"] > 0
+    assert "fold_tier" not in result.identity()
+    off = run_swarm(spec)
+    assert off.fold_tier == {}
+    assert off.identity() == result.identity(), (
+        "the fold probe perturbed replay identity")

@@ -293,7 +293,7 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
     t0 = time.time()
     if self_chunked:
         # The product pipeline carries the honest stage split
-        # (device_wait vs download) + the d2h byte counter.
+        # (device_wait vs download) + the d2h/h2d byte counters.
         summaries = list(device_batch_fn(docs, stats=stats, stage=stage))
     else:
         summaries = []
@@ -318,9 +318,10 @@ def run_config(name, docs, n_ops, oracle_fn, device_batch_fn,
         # Null-stable on non-pipeline configs (no stage instrumentation).
         "stages_busy_sec": ({
             k: round(v, 3) for k, v in sorted(stage.items())
-            if k != "d2h_bytes"
+            if k not in ("d2h_bytes", "h2d_bytes")
         } if stage else None),
         "d2h_bytes": (int(stage.get("d2h_bytes", 0)) if stage else None),
+        "h2d_bytes": (int(stage.get("h2d_bytes", 0)) if stage else None),
     }
     print(
         f"{name:12s} docs={len(docs):5d} ops={total_ops:7d} "

@@ -415,3 +415,133 @@ def _direct_body(fn: ast.FunctionDef) -> Iterator[ast.AST]:
                                   ast.Lambda)):
                 continue
             stack.append(child)
+
+
+# -- donated-buffer discipline ------------------------------------------------
+
+
+def _donated_positions(jit_call: ast.Call) -> List[int]:
+    for kw in jit_call.keywords:
+        if kw.arg == "donate_argnums":
+            return _const_ints(kw.value)
+    return []
+
+
+def _donating_callables(m: ModuleContext) -> dict:
+    """{local name: donated positional indices} for every callable built
+    with ``donate_argnums`` — ``f = jax.jit(g, donate_argnums=...)``
+    assignments and ``@functools.partial(jax.jit, donate_argnums=...)``
+    decorated defs."""
+    out: dict = {}
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _entrypoint_of(m.imports, call) == "jax.jit":
+                pos = _donated_positions(call)
+                if pos:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            out[target.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and \
+                        _entrypoint_of(m.imports, dec) == "jax.jit":
+                    pos = _donated_positions(dec)
+                    if pos:
+                        out[node.name] = pos
+    return out
+
+
+def _target_names(stmt: ast.AST) -> Set[str]:
+    """Names a statement (re)binds — the rebind that makes a donated
+    reference safe again."""
+    names: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+    return names
+
+
+@register
+class DonatedBufferReadRule(Rule):
+    name = "FL-TRACE-DONATE"
+    severity = "error"
+    scope = KERNEL_SCOPE
+    description = (
+        "a buffer passed at a donate_argnums position is DEAD after "
+        "dispatch (XLA reused its memory) — reading the old reference "
+        "later raises at best and aliases garbage at worst; rebind the "
+        "result over the donated name"
+    )
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        donors = _donating_callables(m)
+        if not donors:
+            return
+        for fn in ast.walk(m.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(m, fn, donors)
+
+    def _check_fn(self, m: ModuleContext, fn: ast.FunctionDef,
+                  donors: dict) -> Iterator[Finding]:
+        # Per donated-Name call: any Load of that name textually after
+        # the call — before a rebinding Store — reads a dead buffer.
+        # Known limits (documented in the README): plain Names only
+        # (attribute receivers like ``self.ops`` need the caller to swap
+        # the reference, which this rule cannot see), and lineno order
+        # approximates control flow (a loop re-reading a name bound
+        # before the donating call on iteration 2 is not modeled).
+        donated: List[tuple] = []  # (name, callee, call node)
+        for stmt in _direct_body(fn):
+            if not isinstance(stmt, ast.Call) or \
+                    not isinstance(stmt.func, ast.Name):
+                continue
+            callee = stmt.func.id
+            if callee not in donors:
+                continue
+            for i in donors[callee]:
+                if i < len(stmt.args) and isinstance(stmt.args[i],
+                                                     ast.Name):
+                    donated.append((stmt.args[i].id, callee, stmt))
+        for name, callee, call in donated:
+            # The safe idiom: the donating call's own statement rebinds
+            # the name (``x = f(x)``) — the old reference is gone.
+            rebound = False
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)) \
+                        and any(n is call for n in ast.walk(stmt)) \
+                        and name in _target_names(stmt):
+                    rebound = True
+                    break
+            if rebound:
+                continue
+            end = getattr(call, "end_lineno", call.lineno)
+            stores = sorted(
+                n.lineno for n in _direct_body(fn)
+                if isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Store) and n.lineno > end
+            )
+            first_store = stores[0] if stores else None
+            for node in _direct_body(fn):
+                if isinstance(node, ast.Name) and node.id == name \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.lineno > end \
+                        and (first_store is None
+                             or node.lineno < first_store) \
+                        and not any(n is node for n in ast.walk(call)):
+                    yield m.finding(
+                        self, node,
+                        f"'{name}' was donated to {callee}() in "
+                        f"{fn.name}() and is dead after dispatch; "
+                        "rebind the call's result over the donated "
+                        "name (x = f(x)) before any further read",
+                    )
+                    break

@@ -84,7 +84,16 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
                 for p in spec.plan.points if p.site == "shard.kill")))
     spec = dataclasses.replace(spec, columnar=columnar,
                                sample_every=sample_every,
-                               out_of_proc=out_of_proc)
+                               out_of_proc=out_of_proc,
+                               # catchup-herd is the fold-tier scenario:
+                               # after the swarm run its sampled docs
+                               # catch up cold+warm through the REAL
+                               # CatchupService so the report carries the
+                               # resident-tier counters (ISSUE 13) —
+                               # served / spliced / evictions /
+                               # bytes_saved next to delta + pack stats.
+                               fold_probe=(name == "catchup-herd"
+                                           and not out_of_proc))
     t0 = time.time()
     result = run_swarm(spec)
     wall = time.time() - t0  # the gated number times the PRIMARY run only
@@ -164,6 +173,10 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
         # delivery audit (empty dict for in-proc runs)
         "out_of_proc": out_of_proc,
         "shard_stats": result.shard_stats,
+        # catchup-herd: resident / delta / pack fold-tier counters from
+        # the post-run cold+warm CatchupService pass over sampled docs
+        # (empty dict on other scenarios)
+        "fold_tier": result.fold_tier,
         "passed": passed,
     }
 
