@@ -7,15 +7,31 @@ previously hand-rolled per kernel; review-found)."""
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, TypeVar
+from typing import Callable, List, Optional, Sequence, TypeVar, Union
 
 Doc = TypeVar("Doc")
 Result = TypeVar("Result")
 
 
+def count_fallback(stats: Optional[dict], reason: Union[bool, str]) -> None:
+    """Bump the shared fallback counters: the total ``fallback_docs``
+    plus — when the predicate names WHY (a reason string instead of a
+    bare True) — a per-reason ``fallback_<reason>`` counter, so a bench
+    can report revive vs multi-id-move vs MAX_DEPTH instead of one
+    opaque number.  THE one counting point for pre-pack routing and the
+    extractors' post-fold fallbacks alike (the split must sum to the
+    total by construction, not by discipline)."""
+    if stats is None:
+        return
+    stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
+    if isinstance(reason, str) and reason:
+        key = f"fallback_{reason}"
+        stats[key] = stats.get(key, 0) + 1
+
+
 def partition_replay(
     docs: Sequence[Doc],
-    known_fallback: Callable[[Doc], bool],
+    known_fallback: Callable[[Doc], Union[bool, str, None]],
     fallback_fn: Callable[[Doc], Result],
     batch_fn: Callable[[List[Doc]], List[Result]],
     stats: Optional[dict] = None,
@@ -24,18 +40,20 @@ def partition_replay(
     oracle), fold the rest as one device batch, and return results in the
     original order.  Filtering first keeps fallback docs from inflating the
     shared power-of-two pack buckets and wasting their shard of the fold.
-    ``stats`` (optional dict) accumulates a ``fallback_docs`` counter for
-    the pre-pack routing (post-fold fallbacks are the extractors' to
-    count)."""
+    ``known_fallback`` may return a plain truthy value or a REASON string;
+    ``stats`` (optional dict) then accumulates ``fallback_docs`` plus a
+    per-reason ``fallback_<reason>`` counter for the pre-pack routing
+    (post-fold fallbacks are the extractors' to count, through the same
+    :func:`count_fallback`)."""
     if not docs:
         return []
     out: List[Optional[Result]] = [None] * len(docs)
     device_idx: List[int] = []
     for i, doc in enumerate(docs):
-        if known_fallback(doc):
+        reason = known_fallback(doc)
+        if reason:
             out[i] = fallback_fn(doc)
-            if stats is not None:
-                stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
+            count_fallback(stats, reason)
         else:
             device_idx.append(i)
     if device_idx:

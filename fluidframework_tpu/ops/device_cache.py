@@ -3,8 +3,8 @@
 Tier 2 (:class:`~fluidframework_tpu.ops.pipeline.PackCache`) killed the
 host *pack* work on warm catch-ups, and tier 0 made downloads delta-only
 — but the **upload** leg stayed untouched: even on an exact tier-2 hit,
-``_pipelined_fold`` re-uploads the full packed op/state planes to the
-device on every fold call.  On the recorded tunnel link
+the pipeline re-uploads the full packed planes to the device on every
+fold call.  On the recorded tunnel link
 (``BENCH_tpu_measured_r05.json``: h2d 15 MB/s) that re-upload IS the
 warm hot path.  This module keeps the packed chunk arrays resident in
 device memory across fold calls, keyed by the chunk's ordered
@@ -12,16 +12,24 @@ device memory across fold calls, keyed by the chunk's ordered
 
 - **exact** hit (every doc's op window unchanged): the dispatch leg
   consumes the resident buffers directly — ZERO h2d bytes for ops,
-  state and ``doc_base``;
+  state and the per-doc aux planes;
 - **suffix** hit (windows grew under the same pack-cache lineage): only
   the new suffix rows cross the link as fine-bucketed ``[D, L]`` row
   planes, and a jitted splice with ``donate_argnums`` writes them into
-  the resident op buffers IN PLACE — no 2× HBM spike, and the jit cache
+  the resident buffers IN PLACE — no 2× HBM spike, and the jit cache
   stays bounded because ``L`` rides the fine bucket ladder;
 - anything else — bucket overflow (shape signature moved), a
   narrow↔wide transfer-encoding flip (dtype signature moved), unknown
   pack lineage, window mismatch — falls back to the full upload and
   re-stores.  The resident tier can lose a win, never corrupt.
+
+The class is FAMILY-GENERIC since round 14: window matching, the LRU,
+epoch invalidation, and the store/serve handshake are shared, while the
+family-shaped pieces — the transfer-encoding signature, the donated
+splice (merge-tree splices one op-row axis; the tree family splices edit
+rows AND the node/container state rows its suffix inserts materialized
+— see ops/tree_pipeline.py), and encoding migration — live on a small
+*device-ops* object (:class:`MergeTreeDeviceOps` is the default).
 
 Soundness of the suffix splice is *structural*, belt and braces:
 
@@ -34,7 +42,7 @@ Soundness of the suffix splice is *structural*, belt and braces:
   masquerade as an extension;
 - the **encoding signature** (per-field dtype + shape of the narrowed
   upload arrays) pins the transfer encoding: an ``i16``→wide flip or a
-  T/S/K bucket change is a signature mismatch, not a corrupted splice.
+  bucket change is a signature mismatch, not a corrupted splice.
 
 Donation discipline: after the splice the PREVIOUS resident buffers are
 dead (XLA reused their memory) — the entry swaps in the splice outputs
@@ -77,29 +85,135 @@ def _dev_nbytes(*trees) -> int:
     for tree in trees:
         if tree is None:
             continue
-        leaves = tree if isinstance(tree, tuple) else (tree,)
-        total += int(sum(leaf.nbytes for leaf in leaves))
+        total += int(sum(leaf.nbytes for leaf in jax.tree.leaves(tree)))
     return total
 
 
-def _sig(state: Optional[MTState], ops: MTOps) -> tuple:
-    """The transfer-encoding signature: per-field dtype + shape of the
-    (already narrowed) upload arrays.  Any bucket growth, narrow↔wide
-    encoding flip, or cold↔warm change moves it — and a moved signature
-    means the resident buffers cannot be extended, only replaced."""
+def tuple_sig(state, ops) -> tuple:
+    """The transfer-encoding signature over namedtuple plane trees:
+    per-field dtype + shape of the (already narrowed) upload arrays.
+    Any bucket growth, narrow↔wide encoding flip, or cold↔warm change
+    moves it — and a moved signature means the resident buffers cannot
+    be extended, only replaced (unless the family's ``migrate`` can
+    convert them in-graph)."""
     sig = tuple((f, str(getattr(ops, f).dtype), getattr(ops, f).shape)
-                for f in MTOps._fields)
+                for f in type(ops)._fields)
     if state is not None:
         sig += tuple((f, str(getattr(state, f).dtype),
-                      getattr(state, f).shape) for f in MTState._fields)
+                      getattr(state, f).shape)
+                     for f in type(state)._fields)
     return sig
 
 
-def _widened_sig(sig: tuple) -> tuple:
-    """The signature the same arrays would carry in the WIDE (int32)
-    transfer encoding — shapes unchanged, every non-bool dtype int32."""
-    return tuple((f, dt if dt == "bool" else "int32", shape)
-                 for f, dt, shape in sig)
+def splice_row_planes(tuple_type, resident, rows, start, count):
+    """Donated in-place row splice over a namedtuple of ``[D, L, ...]``
+    planes: ``out[d, start[d] + j] = rows[d, j]`` for ``j < count[d]``
+    — THE shared splice primitive (merge-tree op rows, tree edit rows,
+    tree node/container state rows all ride it).  ``resident`` is
+    DONATED; expressed as a clipped take-along-axis + masked select (no
+    scatter), elementwise along the doc axis, so the same executable
+    serves the sharded mesh placement with zero collectives."""
+    return _splice_jit(tuple_type)(resident, rows, start, count)
+
+
+def _splice_ops(ops: MTOps, rows: MTOps, start, count) -> MTOps:
+    """The merge-tree instance of :func:`splice_row_planes` (the name
+    the splice-parity tests pin).  ``ops`` is DONATED."""
+    return splice_row_planes(MTOps, ops, rows, start, count)
+
+
+@functools.lru_cache(maxsize=16)
+def _splice_jit(tuple_type):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _splice(resident, rows, start, count):
+        lead = getattr(resident, tuple_type._fields[0])
+        T = lead.shape[1]
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)  # [1, T]
+        rel = t_idx - start[:, None]                            # [D, T]
+        L = getattr(rows, tuple_type._fields[0]).shape[1]
+        take = jnp.clip(rel, 0, L - 1)
+        mask = (rel >= 0) & (rel < count[:, None])
+
+        def one(field, r):
+            if field.ndim == 2:
+                return jnp.where(
+                    mask, jnp.take_along_axis(r, take, axis=1), field)
+            return jnp.where(
+                mask[:, :, None],
+                jnp.take_along_axis(r, take[:, :, None], axis=1),
+                field)
+
+        return tuple_type(*(one(getattr(resident, f), getattr(rows, f))
+                            for f in tuple_type._fields))
+
+    return _splice
+
+
+def gather_suffix_rows(tuple_type, host_tree, t_old: np.ndarray,
+                       t_new: np.ndarray, floor: int = 8):
+    """Host-side suffix-row gather for the splice upload: each doc's
+    rows ``[t_old[d], t_new[d])`` taken from the combined host planes
+    into fine-bucketed ``[D, L, ...]`` arrays (pad rows clone the last
+    valid index — masked out by the splice).  Returns ``(rows_np, L)``
+    or ``(None, L)`` when ``L`` reaches the full plane width (the full
+    upload is then cheaper than a splice)."""
+    lead = np.asarray(getattr(host_tree, tuple_type._fields[0]))
+    T = lead.shape[1]
+    grow = int((t_new - t_old).max(initial=0))
+    L = min(next_bucket_fine(max(grow, 1), floor=floor), T)
+    if L >= T:
+        return None, L
+    idx = np.minimum(
+        t_old[:, None] + np.arange(L, dtype=np.int32)[None, :], T - 1)
+    rows_np = {}
+    for f in tuple_type._fields:
+        v = np.asarray(getattr(host_tree, f))
+        take = idx if v.ndim == 2 else idx[:, :, None]
+        rows_np[f] = np.take_along_axis(v, take, axis=1)
+    return rows_np, L
+
+
+class _ResidentEntry:
+    """One chunk's device-resident upload state + the host bookkeeping
+    needed to match and extend it."""
+
+    __slots__ = ("tokens", "n_ops", "first_seq", "last_seq", "t_rows",
+                 "sig", "gen", "state", "ops", "base", "aux", "nbytes")
+
+    def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows, sig,
+                 gen, state, ops, base, aux=None):
+        self.tokens = tokens
+        self.n_ops = n_ops
+        self.first_seq = first_seq
+        self.last_seq = last_seq
+        self.t_rows = t_rows            # np [D]: used op rows per doc
+        self.sig = sig
+        self.gen = gen                  # tier-2 pack generation (or None)
+        self.state = state              # device state tree or None (cold)
+        self.ops = ops                  # device ops tree
+        self.base = base                # device per-doc aux tree
+        self.aux = aux                  # family host bookkeeping (counts)
+        self.nbytes = _dev_nbytes(state, ops, base)
+
+
+def _lineage_gen(meta: dict) -> Optional[int]:
+    """The tier-2 pack generation of the host arrays in hand (None when
+    tier 2 did not produce them — exact reuse only)."""
+    lin = meta.get("_pack_lineage")
+    return lin[-1] if lin else None
+
+
+def _lineage_parent(meta: dict) -> Optional[int]:
+    """For a suffix-extended pack, the generation it extended."""
+    lin = meta.get("_pack_lineage")
+    if lin and lin[0] == "suffix":
+        return lin[1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The merge-tree device-ops instance
+# ---------------------------------------------------------------------------
 
 
 @jax.jit
@@ -122,82 +236,103 @@ def _widen_resident_state(state: MTState,
     return _widen_state(state, doc_base)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _splice_ops(ops: MTOps, rows: MTOps, start: jnp.ndarray,
-                count: jnp.ndarray) -> MTOps:
-    """Write each document's suffix rows into the resident op buffers
-    in place: ``out[d, start[d] + j] = rows[d, j]`` for ``j < count[d]``.
+class MergeTreeDeviceOps:
+    """The merge-tree family's tier-2.5 hooks: int16/int8 narrow
+    encodings (with the in-graph narrow→wide migration), a single
+    op-row splice axis, and the per-doc arena base as the aux plane."""
 
-    ``ops`` is DONATED — XLA reuses the resident buffers instead of
-    allocating a second copy (no 2× HBM spike), and the caller's old
-    references are dead after dispatch.  Expressed as a clipped
-    take-along-axis + masked select (no scatter), elementwise along the
-    doc axis, so the same executable serves the sharded mesh placement
-    with zero collectives."""
-    T = ops.kind.shape[1]
-    t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)   # [1, T]
-    rel = t_idx - start[:, None]                             # [D, T]
-    L = rows.kind.shape[1]
-    take = jnp.clip(rel, 0, L - 1)
-    mask = (rel >= 0) & (rel < count[:, None])
+    @staticmethod
+    def bypass(docs) -> bool:
+        return any(d.binary_ops is not None for d in docs)
 
-    def one(field, r):
-        if field.ndim == 2:
-            return jnp.where(mask, jnp.take_along_axis(r, take, axis=1),
-                             field)
-        return jnp.where(mask[:, :, None],
-                         jnp.take_along_axis(r, take[:, :, None], axis=1),
-                         field)
+    @staticmethod
+    def sig(state, ops) -> tuple:
+        return tuple_sig(state, ops)
 
-    return MTOps(*(one(getattr(ops, f), getattr(rows, f))
-                   for f in MTOps._fields))
+    @staticmethod
+    def aux(meta):
+        return np.asarray(meta["doc_base"], np.int32)
 
+    @staticmethod
+    def t_rows(host_ops) -> np.ndarray:
+        return np.count_nonzero(
+            np.asarray(host_ops.kind), axis=1).astype(np.int32)
 
-class _ResidentEntry:
-    """One chunk's device-resident upload state + the host bookkeeping
-    needed to match and extend it."""
+    @staticmethod
+    def entry_aux(meta):
+        return None
 
-    __slots__ = ("tokens", "n_ops", "first_seq", "last_seq", "t_rows",
-                 "sig", "gen", "state", "ops", "base", "nbytes")
+    @staticmethod
+    def _widened_sig(sig: tuple) -> tuple:
+        """The signature the same arrays would carry in the WIDE (int32)
+        transfer encoding — shapes unchanged, every non-bool dtype
+        int32."""
+        return tuple((f, dt if dt == "bool" else "int32", shape)
+                     for f, dt, shape in sig)
 
-    def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows, sig,
-                 gen, state, ops, base):
-        self.tokens = tokens
-        self.n_ops = n_ops
-        self.first_seq = first_seq
-        self.last_seq = last_seq
-        self.t_rows = t_rows            # np [D]: used op rows per doc
-        self.sig = sig
-        self.gen = gen                  # tier-2 pack generation (or None)
-        self.state = state              # device MTState or None (cold)
-        self.ops = ops                  # device MTOps
-        self.base = base                # device [D] int32 doc_base
-        self.nbytes = _dev_nbytes(state, ops, base)
+    def migrate(self, cache: "DevicePackCache", tokens,
+                entry: _ResidentEntry, sig: tuple, docs) -> None:
+        if not (entry.sig != sig
+                and self._widened_sig(entry.sig) == sig
+                and cache.match(entry, docs) is not None):
+            return
+        # The ONLY signature change is a narrow→wide transfer-
+        # encoding flip (full-scale suffix growth does this: the new
+        # text lands at the shared arena tail, blowing the int16
+        # offset bound).  Migrate the resident buffers to the wide
+        # encoding IN-GRAPH — donated, zero bytes over the link —
+        # so the window can still serve/splice.
+        old_nbytes = entry.nbytes
+        entry.ops = _widen_resident_ops(entry.ops, entry.base)
+        if entry.state is not None:
+            entry.state = _widen_resident_state(entry.state,
+                                                entry.base)
+        entry.sig = sig
+        entry.nbytes = _dev_nbytes(entry.state, entry.ops, entry.base)
+        cache.reaccount_migrated(tokens, entry, old_nbytes)
 
-
-def _lineage_gen(meta: dict) -> Optional[int]:
-    """The tier-2 pack generation of the host arrays in hand (None when
-    tier 2 did not produce them — exact reuse only)."""
-    lin = meta.get("_pack_lineage")
-    return lin[-1] if lin else None
-
-
-def _lineage_parent(meta: dict) -> Optional[int]:
-    """For a suffix-extended pack, the generation it extended."""
-    lin = meta.get("_pack_lineage")
-    if lin and lin[0] == "suffix":
-        return lin[1]
-    return None
+    def splice(self, cache: "DevicePackCache", entry: _ResidentEntry,
+               docs, state, ops: MTOps, meta: dict,
+               sharding) -> Optional[int]:
+        """Upload only the suffix rows and extend the resident op
+        buffers via the donated splice; returns uploaded bytes, or None
+        when the extension does not apply (caller full-uploads).  The
+        base state of a warm chunk is pinned by the token (it derives
+        from the base summary alone), so only the op planes move."""
+        t_new = self.t_rows(ops)
+        t_old = entry.t_rows
+        if np.any(t_new < t_old):
+            return None
+        rows_np, _L = gather_suffix_rows(MTOps, ops, t_old, t_new)
+        if rows_np is None:
+            return None  # suffix ~ whole buffer: full upload is cheaper
+        uploaded = sum(v.nbytes for v in rows_np.values()) \
+            + 2 * t_new.nbytes
+        rows = MTOps(**{f: cache.put(v, sharding)
+                        for f, v in rows_np.items()})
+        start = cache.put(t_old, sharding)
+        count = cache.put(t_new - t_old, sharding)
+        new_ops = splice_row_planes(MTOps, entry.ops, rows, start, count)
+        # The donated input buffers are DEAD past this point: the entry
+        # swaps in the splice outputs and the old references are never
+        # touched again.
+        entry.ops = new_ops
+        entry.t_rows = t_new
+        return int(uploaded)
 
 
 class DevicePackCache:
     """Byte-bounded LRU of device-resident packed chunk buffers (see the
     module docstring).  ``sharding`` (a ``jax.sharding.NamedSharding``)
     places entries on a mesh — the sharded fold passes its doc-sharded
-    placement so mesh and single-device serve the identical tier."""
+    placement so mesh and single-device serve the identical tier.
+    ``device_ops`` selects the family (default: merge-tree)."""
 
-    def __init__(self, max_bytes: int = 192 << 20, sharding=None) -> None:
+    def __init__(self, max_bytes: int = 192 << 20, sharding=None,
+                 device_ops=None) -> None:
         self.max_bytes = int(max_bytes)
+        self._fam = device_ops if device_ops is not None \
+            else MergeTreeDeviceOps()
         self._lock = threading.Lock()
         # tokens -> _ResidentEntry (insertion order = LRU order)
         self._entries: dict = {}  # guarded-by: _lock
@@ -226,7 +361,7 @@ class DevicePackCache:
             self.counters.bump("evictions", dropped)
 
     @staticmethod
-    def _put(x, sharding):
+    def put(x, sharding):
         # ``sharding`` is the caller's one-per-acquire snapshot (taken
         # under the lock), so one entry can never end up split across
         # placements by a racing set_sharding.
@@ -235,10 +370,10 @@ class DevicePackCache:
         return jax.device_put(jnp.asarray(x))
 
     @classmethod
-    def _put_tree(cls, tree, sharding):
+    def put_tree(cls, tree, sharding):
         if tree is None:
             return None
-        return type(tree)(*(cls._put(leaf, sharding) for leaf in tree))
+        return jax.tree.map(lambda leaf: cls.put(leaf, sharding), tree)
 
     # -- introspection ---------------------------------------------------------
 
@@ -255,47 +390,31 @@ class DevicePackCache:
 
     # -- the dispatch-side handshake -------------------------------------------
 
-    def acquire(self, state: Optional[MTState], ops: MTOps, meta: dict):
-        """Device-resident ``(state, ops, doc_base, h2d_bytes)`` for a
-        packed chunk about to dispatch: the resident buffers on an exact
-        hit (zero upload), a donated suffix splice on a lineage-proven
+    def acquire(self, state, ops, meta: dict):
+        """Device-resident ``(state, ops, aux, h2d_bytes)`` for a packed
+        chunk about to dispatch: the resident buffers on an exact hit
+        (zero upload), a donated suffix splice on a lineage-proven
         extension, else a full upload that (re)stores the entry.
-        Token-less / binary chunks bypass and return the host arrays
-        unchanged (``doc_base=None`` — the dispatcher derives it as
+        Token-less / family-bypass chunks bypass and return the host
+        arrays unchanged (``aux=None`` — the dispatcher derives it as
         before); ``h2d_bytes`` is what this call actually put on the
         link.  MUST be called from the single device-interaction thread
         (the pipeline's dispatch leg / the mesh fold)."""
         docs = meta["docs"]
         tokens = tuple(d.cache_token for d in docs)
-        if any(t is None for t in tokens) \
-                or any(d.binary_ops is not None for d in docs):
+        if any(t is None for t in tokens) or self._fam.bypass(docs):
             with self._lock:
                 self.counters.bump("bypass")
             return state, ops, None, _np_nbytes(state) + _np_nbytes(ops)
-        sig = _sig(state, ops)
+        sig = self._fam.sig(state, ops)
         full_bytes = _np_nbytes(state) + _np_nbytes(ops)
         with self._lock:
             entry = self._entries.get(tokens)
             sharding = self._sharding
-        if entry is not None and entry.sig != sig \
-                and _widened_sig(entry.sig) == sig \
-                and self._match(entry, docs) is not None:
-            # The ONLY signature change is a narrow→wide transfer-
-            # encoding flip (full-scale suffix growth does this: the new
-            # text lands at the shared arena tail, blowing the int16
-            # offset bound).  Migrate the resident buffers to the wide
-            # encoding IN-GRAPH — donated, zero bytes over the link —
-            # so the window can still serve/splice.
-            old_nbytes = entry.nbytes
-            entry.ops = _widen_resident_ops(entry.ops, entry.base)
-            if entry.state is not None:
-                entry.state = _widen_resident_state(entry.state,
-                                                    entry.base)
-            entry.sig = sig
-            entry.nbytes = _dev_nbytes(entry.state, entry.ops, entry.base)
-            self._reaccount_widened(tokens, entry, old_nbytes)
+        if entry is not None and entry.sig != sig:
+            self._fam.migrate(self, tokens, entry, sig, docs)
         if entry is not None and entry.sig == sig:
-            kind = self._match(entry, docs)
+            kind = self.match(entry, docs)
             if kind == "exact":
                 with self._lock:
                     self._touch(tokens)
@@ -310,8 +429,10 @@ class DevicePackCache:
                 return entry.state, entry.ops, entry.base, 0
             if kind == "suffix" and entry.gen is not None \
                     and _lineage_parent(meta) == entry.gen:
-                uploaded = self._splice(entry, docs, ops, meta, sharding)
+                uploaded = self._fam.splice(self, entry, docs, state,
+                                            ops, meta, sharding)
                 if uploaded is not None:
+                    self._refresh_windows(entry, docs, meta)
                     with self._lock:
                         self._touch(tokens)
                         self.counters.bump("spliced")
@@ -321,62 +442,29 @@ class DevicePackCache:
         # Miss / signature moved / unprovable lineage: full upload.
         with self._lock:
             self.counters.bump("misses")
-        state_dev = self._put_tree(state, sharding)
-        ops_dev = self._put_tree(ops, sharding)
-        base_dev = self._put(np.asarray(meta["doc_base"], np.int32),
-                             sharding)
+        state_dev = self.put_tree(state, sharding)
+        ops_dev = self.put_tree(ops, sharding)
+        aux_host = self._fam.aux(meta)
+        base_dev = self.put_tree(aux_host, sharding)
         self._store(tokens, docs, sig, _lineage_gen(meta), state_dev,
-                    ops_dev, base_dev, ops)
-        base_bytes = len(docs) * 4
+                    ops_dev, base_dev, ops, meta)
+        base_bytes = _np_nbytes(tuple(jax.tree.leaves(aux_host)))
         return state_dev, ops_dev, base_dev, full_bytes + base_bytes
 
     # -- matching --------------------------------------------------------------
 
     @staticmethod
-    def _match(entry: _ResidentEntry, docs) -> Optional[str]:
+    def match(entry: _ResidentEntry, docs) -> Optional[str]:
         """The shared tier-2/2.5 window rule (``match_windows``) over
         the resident entry's bookkeeping."""
         return match_windows(entry.n_ops, entry.first_seq,
                              entry.last_seq, docs)
 
-    # -- suffix splice ---------------------------------------------------------
-
-    def _splice(self, entry: _ResidentEntry, docs, ops: MTOps,
-                meta: dict, sharding) -> Optional[int]:
-        """Upload only the suffix rows and extend the resident op
-        buffers via the donated splice; returns uploaded bytes, or None
-        when the extension does not apply (caller full-uploads).  The
-        base state of a warm chunk is pinned by the token (it derives
-        from the base summary alone), so only the op planes move."""
-        kind_np = np.asarray(ops.kind)
-        t_new = np.count_nonzero(kind_np, axis=1).astype(np.int32)
-        t_old = entry.t_rows
-        if np.any(t_new < t_old):
-            return None
-        grow = int((t_new - t_old).max(initial=0))
-        T = kind_np.shape[1]
-        L = min(next_bucket_fine(max(grow, 1), floor=8), T)
-        if L >= T:
-            return None  # suffix ~ whole buffer: full upload is cheaper
-        idx = np.minimum(
-            t_old[:, None] + np.arange(L, dtype=np.int32)[None, :], T - 1)
-        rows_np = {}
-        for f in MTOps._fields:
-            v = np.asarray(getattr(ops, f))
-            take = idx if v.ndim == 2 else idx[:, :, None]
-            rows_np[f] = np.take_along_axis(v, take, axis=1)
-        uploaded = sum(v.nbytes for v in rows_np.values()) \
-            + 2 * t_new.nbytes
-        rows = MTOps(**{f: self._put(v, sharding)
-                        for f, v in rows_np.items()})
-        start = self._put(t_old, sharding)
-        count = self._put(t_new - t_old, sharding)
-        new_ops = _splice_ops(entry.ops, rows, start, count)
-        # The donated input buffers are DEAD past this point: the entry
-        # swaps in the splice outputs and the old references are never
-        # touched again.
-        entry.ops = new_ops
-        entry.t_rows = t_new
+    def _refresh_windows(self, entry: _ResidentEntry, docs,
+                         meta: dict) -> None:
+        """After a successful splice: advance the entry's window
+        bookkeeping, lineage generation, and family aux counts to the
+        combined (extended) chunk."""
         n_ops, first_seq, last_seq = [], [], []
         for doc in docs:
             n, first, last = _doc_window(doc)
@@ -387,15 +475,15 @@ class DevicePackCache:
         entry.first_seq = first_seq
         entry.last_seq = last_seq
         entry.gen = _lineage_gen(meta)
-        return int(uploaded)
+        entry.aux = self._fam.entry_aux(meta)
 
     # -- bookkeeping -----------------------------------------------------------
 
-    def _reaccount_widened(self, tokens, entry: _ResidentEntry,
+    def reaccount_migrated(self, tokens, entry: _ResidentEntry,
                            old_nbytes: int) -> None:
-        """Re-account a narrow→wide migrated entry (~2× the bytes) in
+        """Re-account an encoding-migrated entry (~2× the bytes) in
         ONE identity-guarded critical section: the adjustment applies
-        only if the map still holds THE entry that was widened, and the
+        only if the map still holds THE entry that was migrated, and the
         LRU sweep rebalances the budget (the migrated entry itself is
         never evicted mid-serve — if it alone exceeds the budget it is
         un-mapped, same policy as _store's never-admit rule, while this
@@ -424,17 +512,17 @@ class DevicePackCache:
             self._entries[tokens] = entry
 
     def _store(self, tokens, docs, sig, gen, state_dev, ops_dev, base_dev,
-               host_ops: MTOps) -> None:
+               host_ops, meta: dict) -> None:
         n_ops, first_seq, last_seq = [], [], []
         for doc in docs:
             n, first, last = _doc_window(doc)
             n_ops.append(n)
             first_seq.append(first)
             last_seq.append(last)
-        t_rows = np.count_nonzero(
-            np.asarray(host_ops.kind), axis=1).astype(np.int32)
+        t_rows = self._fam.t_rows(host_ops)
         entry = _ResidentEntry(tokens, n_ops, first_seq, last_seq, t_rows,
-                               sig, gen, state_dev, ops_dev, base_dev)
+                               sig, gen, state_dev, ops_dev, base_dev,
+                               aux=self._fam.entry_aux(meta))
         with self._lock:
             old = self._entries.pop(tokens, None)
             if old is not None:

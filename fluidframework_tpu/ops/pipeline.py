@@ -1,6 +1,17 @@
-"""Chunked, pipelined bulk merge-tree replay — the PRODUCT's version of
+"""Chunked, pipelined bulk catch-up replay — the PRODUCT's version of
 the bench harness's e2e loop (SURVEY §3.2: catch-up is the north-star
 path, and the service must not be slower than the benchmark of itself).
+
+Round 14: the pipeline is KERNEL-FAMILY-GENERIC.  Everything below —
+chunking, the thread-pool pack/extract legs, the single-device-thread
+dispatch/fetch contract, the tier-2 :class:`PackCache`, the tier-2.5
+device-resident handshake, the tier-0 digest-gated delta download, and
+the ``pack/upload/dispatch/device_wait/download/extract`` +
+``h2d_bytes``/``d2h_bytes`` stage schema — runs through a
+:class:`~fluidframework_tpu.ops.family.KernelFamily` descriptor.
+``pipelined_mergetree_replay`` is the merge-tree instance;
+``ops/tree_pipeline.py`` registers the SharedTree rebaser as the second
+(the PAPER §0 pair), and a third family (matrix) can ride for free.
 
 Shape (round-5 pipeline, BASELINE.md):
 
@@ -26,15 +37,15 @@ from __future__ import annotations
 
 import collections
 import itertools
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from time import perf_counter
-from typing import Callable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .batching import partition_replay
+from .family import KernelFamily
 from .interning import Interner, next_bucket_fine
 from .mergetree_kernel import (
     I8_LIMIT,
@@ -45,6 +56,7 @@ from .mergetree_kernel import (
     MTOps,
     MergeTreeDocInput,
     NOT_REMOVED,
+    _export_flags,
     export_to_numpy,
     fill_sequence_op_rows,
     gather_export_rows,
@@ -85,7 +97,9 @@ def _copy_doc_pack(pack):
 #: ``meta["_pack_lineage"]`` so the device-resident tier (tier 2.5,
 #: ops/device_cache.py) can PROVE a set of host arrays is the literal
 #: suffix-extension of what it holds resident.  itertools.count.__next__
-#: is atomic under CPython, so the stamp needs no extra locking.
+#: is atomic under CPython, so the stamp needs no extra locking.  ONE
+#: counter across every family: a generation id never collides between
+#: the merge-tree and tree caches.
 _PACK_GEN = itertools.count(1)
 
 
@@ -97,7 +111,7 @@ class _PackEntry:
                  "state", "ops", "meta", "nbytes", "gen")
 
     def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows,
-                 state, ops, meta, gen=0):
+                 state, ops, meta, nbytes, gen=0):
         self.gen = gen
         self.tokens = tokens
         self.n_ops = n_ops
@@ -107,14 +121,10 @@ class _PackEntry:
         self.state = state
         self.ops = ops
         self.meta = meta
-        self.nbytes = (
-            sum(np.asarray(x).nbytes for x in ops)
-            + sum(np.asarray(x).nbytes for x in state)
-            + len(meta["arena"]) * 4
-        )
+        self.nbytes = nbytes
 
 
-def _doc_window(doc: MergeTreeDocInput):
+def _doc_window(doc):
     n = len(doc.ops)
     if n == 0:
         return 0, 0, 0
@@ -123,8 +133,9 @@ def _doc_window(doc: MergeTreeDocInput):
 
 def match_windows(n_ops, first_seq, last_seq, chunk) -> Optional[str]:
     """THE window-matching rule shared by tier 2 (:class:`PackCache`)
-    and tier 2.5 (``ops/device_cache.DevicePackCache``): "exact" when
-    every doc's op window is unchanged vs the cached per-doc
+    and tier 2.5 (``ops/device_cache.DevicePackCache``), for EVERY
+    family (both carry ascending-seq message lists): "exact" when every
+    doc's op window is unchanged vs the cached per-doc
     ``(n_ops, first_seq, last_seq)``, "suffix" when every window
     extends its cached one (same first seq, the old tail still in
     place, any new rows strictly past it — same-seq rows only ever
@@ -150,14 +161,20 @@ def match_windows(n_ops, first_seq, last_seq, chunk) -> Optional[str]:
 
 
 class PackCache:
-    """Suffix-aware cache of ``pack_mergetree_batch`` chunk outputs —
-    tier 2 of the catch-up cache, attacking the pack leg of the host
-    floor (BENCH_cpu_fullscale_r05c: pack is the largest busy stage).
+    """Suffix-aware cache of packed chunk outputs — tier 2 of the
+    catch-up cache, attacking the pack leg of the host floor
+    (BENCH_cpu_fullscale_r05c: pack is the largest busy stage).
+    Family-generic since round 14: the default instance serves
+    ``pack_mergetree_batch`` windows; construct with the tree family
+    (``ops/tree_pipeline.tree_pack_cache``) to cache SharedTree packs —
+    window matching, lineage stamping, LRU, and locking are THIS class,
+    while packing/extension go through the family hooks.
 
     Chunks are keyed by the ordered tuple of per-doc ``cache_token``s
     (doc + base summary + storage generation identity, supplied by the
-    catch-up service); any doc without a token — or any binary-stream
-    doc, whose C++ pack is already the fast path — bypasses the cache.
+    catch-up service); any doc without a token — or any doc the family
+    marks ``bypass`` (e.g. binary-stream docs, whose C++ pack is already
+    the fast path) — bypasses the cache.
 
     Three outcomes per chunk:
 
@@ -166,16 +183,11 @@ class PackCache:
       re-pointed so extraction reads fresh ``final_seq``/``final_msn``).
     - **suffix**: every doc's window extends the cached one (same first
       seq, tail grew — the append-only op log guarantees the shared
-      prefix is byte-identical under an equal token) → the op arrays are
-      memcpy'd and ONLY the new suffix ops are packed, provided the
-      chunk's T/S/K buckets hold; chunk facts (i16/i8 eligibility,
-      sequential, ob/ov/props rows) are re-derived from the combined
-      arrays.  The i16 text bound is re-checked against the ACTUAL
-      rebased span ends (suffix text appends at the arena tail, so the
-      fresh pack's contiguous-span shortcut does not apply); any
-      violation just falls back to the wide transfer encodings — never
-      corrupts.
-    - **miss**: a full ``pack_mergetree_batch`` whose result is cached.
+      prefix is byte-identical under an equal token) → the family's
+      ``extend`` packs ONLY the new suffix rows onto copies of the
+      cached arrays, provided the chunk's shape buckets hold; any
+      violation just falls back to a full repack — never corrupts.
+    - **miss**: a full family pack whose result is cached.
 
     Extraction-side summaries are byte-identical in all three cases
     (pinned by tests): intern ids may differ from a fresh pack's, but
@@ -187,12 +199,14 @@ class PackCache:
     full packs and exact hits run lock-free.
     """
 
-    def __init__(self, max_bytes: int = 192 << 20) -> None:
+    def __init__(self, max_bytes: int = 192 << 20,
+                 family: Optional[KernelFamily] = None) -> None:
         from ..utils.telemetry import CounterSet
 
+        self.family = family if family is not None else MERGETREE_FAMILY
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
-        # Serializes suffix extension: _extend appends to the cached
+        # Serializes suffix extension: extend appends to the cached
         # entry's SHARED arena and value interner (append-only, so
         # readers are safe, but two concurrent extends of the same entry
         # would interleave writes).  Extends are the rare path — one
@@ -217,15 +231,16 @@ class PackCache:
 
     # -- public entry point ----------------------------------------------------
 
-    def pack(self, chunk: List[MergeTreeDocInput]):
+    def pack(self, chunk):
         """(state, ops, meta) for ``chunk`` — cached, suffix-extended, or
         freshly packed."""
+        family = self.family
         tokens = tuple(d.cache_token for d in chunk)
         if any(t is None for t in tokens) \
-                or any(d.binary_ops is not None for d in chunk):
+                or any(family.bypass(d) for d in chunk):
             with self._lock:
                 self.counters.bump("bypass")
-            return pack_mergetree_batch(chunk)
+            return family.pack(chunk)
         with self._lock:
             entry = self._entries.get(tokens)
         if entry is not None:
@@ -237,10 +252,10 @@ class PackCache:
                 return entry.state, entry.ops, dict(
                     entry.meta, docs=list(chunk),
                     _pack_lineage=("exact", entry.gen))
-            if kind == "suffix":
+            if kind == "suffix" and family.extend is not None:
                 parent_gen = entry.gen
                 with self._extend_lock:
-                    extended = self._extend(entry, chunk)
+                    extended = family.extend(entry, chunk)
                 if extended is not None:
                     state, ops, meta = extended
                     gen = self._store(tokens, chunk, state, ops, meta)
@@ -253,7 +268,7 @@ class PackCache:
                     return state, ops, meta
         with self._lock:
             self.counters.bump("misses")
-        state, ops, meta = pack_mergetree_batch(chunk)
+        state, ops, meta = family.pack(chunk)
         gen = self._store(tokens, chunk, state, ops, meta)
         meta["_pack_lineage"] = ("full", gen)
         return state, ops, meta
@@ -269,16 +284,13 @@ class PackCache:
         """Insert/replace the entry; returns its pack generation (fresh
         even when the byte budget refuses the entry — the lineage stamp
         must still be unique per produced array set)."""
-        n_ops, first_seq, last_seq, t_rows = [], [], [], []
+        n_ops, first_seq, last_seq = [], [], []
         for doc in chunk:
             n, first, last = _doc_window(doc)
             n_ops.append(n)
             first_seq.append(first)
             last_seq.append(last)
-            t_rows.append(sum(
-                1 for m in doc.ops
-                if not m.contents["kind"].startswith("interval")
-            ))
+        t_rows = list(self.family.entry_rows(chunk, meta))
         # The stored meta never serves extraction directly — both the
         # exact-hit and suffix paths re-point ``docs`` at the fresh chunk
         # — so drop the doc inputs (and with them the per-op Python
@@ -286,7 +298,9 @@ class PackCache:
         # would otherwise silently under-count).
         gen = next(_PACK_GEN)
         entry = _PackEntry(tokens, n_ops, first_seq, last_seq, t_rows,
-                           state, ops, dict(meta, docs=None), gen=gen)
+                           state, ops, dict(meta, docs=None),
+                           self.family.entry_nbytes(state, ops, meta),
+                           gen=gen)
         with self._lock:
             old = self._entries.pop(tokens, None)
             if old is not None:
@@ -309,147 +323,152 @@ class PackCache:
         return match_windows(entry.n_ops, entry.first_seq,
                              entry.last_seq, chunk)
 
-    # -- suffix extension ------------------------------------------------------
 
-    def _extend(self, entry: _PackEntry, chunk):
-        """Pack only each doc's suffix ops on top of the cached arrays;
-        None = shape/bucket constraints do not hold (caller full-packs)."""
-        meta = entry.meta
-        T = entry.ops.kind.shape[1]
-        S = int(meta["_S"])
-        K = int(meta["props_K"])
-        key_ids = {k: i for i, k in enumerate(meta["prop_keys"])}
-        # Pre-scan (no shared state touched): per-doc text-op counts and
-        # the suffix's new property keys, so every bucket check happens
-        # before any mutation.
-        new_t_counts, suffixes = [], []
-        new_keys = []
-        for d, doc in enumerate(chunk):
-            suffix = doc.ops[entry.n_ops[d]:]
-            suffixes.append(suffix)
-            t_count = entry.t_rows[d]
-            for msg in suffix:
-                contents = msg.contents
-                if contents["kind"].startswith("interval"):
-                    continue
-                t_count += 1
-                for key in (contents.get("props") or {}):
-                    if key not in key_ids and key not in new_keys:
-                        new_keys.append(key)
-            new_t_counts.append(t_count)
-        if len(key_ids) + len(new_keys) > K:
-            return None  # props bucket would grow: repack
-        if next_bucket_fine(max(max(new_t_counts), 1), floor=16) != T:
-            return None  # op-row bucket would grow
-        base_counts = [int(n) for n in np.asarray(entry.state.n)]
-        s_need = max(bc + 2 * tc
-                     for bc, tc in zip(base_counts, new_t_counts))
-        if next_bucket_fine(max(s_need, 1), floor=32) != S:
-            return None  # slot bucket would grow
-        for key in new_keys:
-            key_ids[key] = len(key_ids)
+# ---------------------------------------------------------------------------
+# Merge-tree tier-2 suffix extension (the family's ``extend`` hook)
+# ---------------------------------------------------------------------------
 
-        # Commit: copy the op arrays (the cached entry must stay intact),
-        # share the append-only arena/value interner and the untouched
-        # base state, and fill only the suffix rows.
-        op = {f: np.copy(getattr(entry.ops, f)) for f in MTOps._fields}
-        arena = meta["arena"]
-        values: Interner = meta["values"]
-        doc_packs = [_copy_doc_pack(p) for p in meta["doc_packs"]]
-        try:
-            self._fill_suffixes(chunk, suffixes, entry, op, arena, values,
-                                doc_packs, key_ids)
-        except ValueError:
-            # An op shape this fill doesn't know (drift vs
-            # pack_mergetree_batch's row fill) must degrade to a full
-            # pack — which raises the same error if the op is genuinely
-            # malformed — never crash only-when-warm.  The arena/interner
-            # appends already made are unreferenced and harmless.
-            return None
-        new_meta = dict(
-            meta,
-            docs=list(chunk),
-            doc_packs=doc_packs,
-            prop_keys=sorted(key_ids, key=key_ids.__getitem__),
-        )
-        self._refresh_facts(entry.state, op, new_meta, chunk)
-        return entry.state, MTOps(**op), new_meta
 
-    @staticmethod
-    def _fill_suffixes(chunk, suffixes, entry, op, arena, values,
-                       doc_packs, key_ids) -> None:
-        # THE shared row fill (mergetree_kernel.fill_sequence_op_rows) —
-        # byte-drift between fresh and suffix-cached packs is impossible
-        # by construction.
-        for d, doc in enumerate(chunk):
-            pack = doc_packs[d]
-            if known_oracle_fallback(doc):
-                pack.needs_fallback = True
-            fill_sequence_op_rows(op, d, entry.t_rows[d] - 1, suffixes[d],
-                                  pack, arena, key_ids.__getitem__, values)
+def _extend_mergetree(entry: _PackEntry, chunk):
+    """Pack only each doc's suffix ops on top of the cached arrays;
+    None = shape/bucket constraints do not hold (caller full-packs)."""
+    meta = entry.meta
+    T = entry.ops.kind.shape[1]
+    S = int(meta["_S"])
+    K = int(meta["props_K"])
+    key_ids = {k: i for i, k in enumerate(meta["prop_keys"])}
+    # Pre-scan (no shared state touched): per-doc text-op counts and
+    # the suffix's new property keys, so every bucket check happens
+    # before any mutation.
+    new_t_counts, suffixes = [], []
+    new_keys = []
+    for d, doc in enumerate(chunk):
+        suffix = doc.ops[entry.n_ops[d]:]
+        suffixes.append(suffix)
+        t_count = entry.t_rows[d]
+        for msg in suffix:
+            contents = msg.contents
+            if contents["kind"].startswith("interval"):
+                continue
+            t_count += 1
+            for key in (contents.get("props") or {}):
+                if key not in key_ids and key not in new_keys:
+                    new_keys.append(key)
+        new_t_counts.append(t_count)
+    if len(key_ids) + len(new_keys) > K:
+        return None  # props bucket would grow: repack
+    if next_bucket_fine(max(max(new_t_counts), 1), floor=16) != T:
+        return None  # op-row bucket would grow
+    base_counts = [int(n) for n in np.asarray(entry.state.n)]
+    s_need = max(bc + 2 * tc
+                 for bc, tc in zip(base_counts, new_t_counts))
+    if next_bucket_fine(max(s_need, 1), floor=32) != S:
+        return None  # slot bucket would grow
+    for key in new_keys:
+        key_ids[key] = len(key_ids)
 
-    @staticmethod
-    def _refresh_facts(state, op, meta, chunk) -> None:
-        """Re-derive the chunk facts over the COMBINED arrays — same
-        predicates as ``pack_mergetree_batch``, except the i16 text bound
-        checks the actual per-doc rebased span ends (suffix text is not
-        contiguous with the doc's original arena span)."""
-        doc_base = np.asarray(meta["doc_base"], np.int32)
-        S = int(meta["_S"])
-        is_ins = op["kind"] == K_INSERT
-        op_end = np.where(
-            is_ins, op["tstart"] + op["tlen"] - doc_base[:, None], 0
-        )
-        live = np.arange(state.tstart.shape[1],
-                         dtype=np.int32)[None, :] < np.asarray(
-                             state.n)[:, None]
-        st_end = np.where(
-            live,
-            np.asarray(state.tstart) + np.asarray(state.tlen)
-            - doc_base[:, None],
-            0,
-        )
-        max_off = max(int(op_end.max(initial=0)),
-                      int(st_end.max(initial=0)))
-        max_seq = max(
-            int(op["seq"].max(initial=0)),
-            max((d.final_seq for d in chunk), default=0),
-            max((d.base_seq for d in chunk), default=0),
-        )
-        max_clients = max(
-            (len(p.clients) for p in meta["doc_packs"]), default=0
-        )
-        n_values = len(meta["values"])
-        meta["i16_ok"] = (
-            max_seq < I16_LIMIT and max_off < I16_LIMIT and S < I16_LIMIT
-            and n_values < I16_LIMIT and max_clients < I16_LIMIT
-        )
-        real_ops = op["kind"] != K_NOOP
-        max_tlen = max(int(op["tlen"].max(initial=0)),
-                       int(np.asarray(state.tlen).max(initial=0)))
-        meta["i8_ok"] = (
-            meta["i16_ok"] and max_seq < I8_LIMIT and max_tlen < I8_LIMIT
-            and n_values < I8_LIMIT and max_clients < I8_LIMIT
-        )
-        sequential = not bool(
-            (real_ops & (op["ref_seq"] != op["seq"] - 1)).any()
-        )
-        meta["sequential"] = sequential
-        meta["ob_rows"] = bool(
-            (np.asarray(state.ob1_seq) != NOT_REMOVED).any()
-            or (op["kind"] == K_OBLITERATE).any()
-        )
-        meta["ov_rows"] = bool(
-            (np.asarray(state.rem2_client) >= 0).any()
-        ) or not sequential
-        meta["has_props"] = len(meta["prop_keys"]) > 0
+    # Commit: copy the op arrays (the cached entry must stay intact),
+    # share the append-only arena/value interner and the untouched
+    # base state, and fill only the suffix rows.
+    op = {f: np.copy(getattr(entry.ops, f)) for f in MTOps._fields}
+    arena = meta["arena"]
+    values: Interner = meta["values"]
+    doc_packs = [_copy_doc_pack(p) for p in meta["doc_packs"]]
+    try:
+        _fill_mergetree_suffixes(chunk, suffixes, entry, op, arena,
+                                 values, doc_packs, key_ids)
+    except ValueError:
+        # An op shape this fill doesn't know (drift vs
+        # pack_mergetree_batch's row fill) must degrade to a full
+        # pack — which raises the same error if the op is genuinely
+        # malformed — never crash only-when-warm.  The arena/interner
+        # appends already made are unreferenced and harmless.
+        return None
+    new_meta = dict(
+        meta,
+        docs=list(chunk),
+        doc_packs=doc_packs,
+        prop_keys=sorted(key_ids, key=key_ids.__getitem__),
+    )
+    _refresh_mergetree_facts(entry.state, op, new_meta, chunk)
+    return entry.state, MTOps(**op), new_meta
+
+
+def _fill_mergetree_suffixes(chunk, suffixes, entry, op, arena, values,
+                             doc_packs, key_ids) -> None:
+    # THE shared row fill (mergetree_kernel.fill_sequence_op_rows) —
+    # byte-drift between fresh and suffix-cached packs is impossible
+    # by construction.
+    for d, doc in enumerate(chunk):
+        pack = doc_packs[d]
+        if known_oracle_fallback(doc):
+            pack.needs_fallback = True
+        fill_sequence_op_rows(op, d, entry.t_rows[d] - 1, suffixes[d],
+                              pack, arena, key_ids.__getitem__, values)
+
+
+def _refresh_mergetree_facts(state, op, meta, chunk) -> None:
+    """Re-derive the chunk facts over the COMBINED arrays — same
+    predicates as ``pack_mergetree_batch``, except the i16 text bound
+    checks the actual per-doc rebased span ends (suffix text is not
+    contiguous with the doc's original arena span)."""
+    doc_base = np.asarray(meta["doc_base"], np.int32)
+    S = int(meta["_S"])
+    is_ins = op["kind"] == K_INSERT
+    op_end = np.where(
+        is_ins, op["tstart"] + op["tlen"] - doc_base[:, None], 0
+    )
+    live = np.arange(state.tstart.shape[1],
+                     dtype=np.int32)[None, :] < np.asarray(
+                         state.n)[:, None]
+    st_end = np.where(
+        live,
+        np.asarray(state.tstart) + np.asarray(state.tlen)
+        - doc_base[:, None],
+        0,
+    )
+    max_off = max(int(op_end.max(initial=0)),
+                  int(st_end.max(initial=0)))
+    max_seq = max(
+        int(op["seq"].max(initial=0)),
+        max((d.final_seq for d in chunk), default=0),
+        max((d.base_seq for d in chunk), default=0),
+    )
+    max_clients = max(
+        (len(p.clients) for p in meta["doc_packs"]), default=0
+    )
+    n_values = len(meta["values"])
+    meta["i16_ok"] = (
+        max_seq < I16_LIMIT and max_off < I16_LIMIT and S < I16_LIMIT
+        and n_values < I16_LIMIT and max_clients < I16_LIMIT
+    )
+    real_ops = op["kind"] != K_NOOP
+    max_tlen = max(int(op["tlen"].max(initial=0)),
+                   int(np.asarray(state.tlen).max(initial=0)))
+    meta["i8_ok"] = (
+        meta["i16_ok"] and max_seq < I8_LIMIT and max_tlen < I8_LIMIT
+        and n_values < I8_LIMIT and max_clients < I8_LIMIT
+    )
+    sequential = not bool(
+        (real_ops & (op["ref_seq"] != op["seq"] - 1)).any()
+    )
+    meta["sequential"] = sequential
+    meta["ob_rows"] = bool(
+        (np.asarray(state.ob1_seq) != NOT_REMOVED).any()
+        or (op["kind"] == K_OBLITERATE).any()
+    )
+    meta["ov_rows"] = bool(
+        (np.asarray(state.rem2_client) >= 0).any()
+    ) or not sequential
+    meta["has_props"] = len(meta["prop_keys"]) > 0
 
 
 # -- tier-0 delta-download routing: ONE derivation point --------------------
 # The single-device pipeline below and the mesh fold
-# (parallel/shard.py replay_mergetree_sharded) both consume these — the
+# (parallel/shard.py replay_family_sharded) both consume these — the
 # byte-identity-critical cache logic (serve gate, entry publication, the
-# changed-rows sub-meta) must never fork into hand-synced copies.
+# changed-rows sub-meta) must never fork into hand-synced copies, for
+# ANY family.
 
 
 def delta_route(docs, dig_np, delta_cache):
@@ -475,17 +494,23 @@ def delta_store_all(delta_cache, docs, dig_np, trees) -> None:
         for d, doc in enumerate(docs))
 
 
-def delta_sub_meta(meta, changed) -> dict:
+def delta_sub_meta(meta, changed,
+                   per_doc: Sequence[str] = ("doc_base",)) -> dict:
     """The per-doc meta rows of only the CHANGED positions (the gathered
-    rows' extraction view); chunk-global meta passes through."""
+    rows' extraction view); chunk-global meta passes through.
+    ``per_doc`` names the family's per-doc ndarray meta entries that
+    must slice alongside ``docs``/``doc_packs``."""
     docs = meta["docs"]
-    return dict(
+    rows = np.asarray(changed, np.intp)
+    out = dict(
         meta,
         docs=[docs[d] for d in changed],
         doc_packs=[meta["doc_packs"][d] for d in changed],
-        doc_base=np.asarray(meta["doc_base"])[
-            np.asarray(changed, np.intp)],
     )
+    for key in per_doc:
+        if key in meta:
+            out[key] = np.asarray(meta[key])[rows]
+    return out
 
 
 def delta_merge_changed(delta_cache, meta, dig_np, served, changed, got):
@@ -503,6 +528,74 @@ def delta_merge_changed(delta_cache, meta, dig_np, served, changed, got):
     return res
 
 
+# ---------------------------------------------------------------------------
+# The family-generic pipelined fold
+# ---------------------------------------------------------------------------
+
+
+def pipelined_family_replay(
+    family: KernelFamily,
+    docs,
+    *,
+    chunk_docs: int = 1024,
+    pack_threads: int = 4,
+    extract_threads: int = 3,
+    fetch_depth: int = 2,
+    schedule: bool = True,
+    stats: Optional[dict] = None,
+    stage: Optional[dict] = None,
+    packed_out: Optional[list] = None,
+    pack_cache: Optional[PackCache] = None,
+    delta_cache=None,
+    device_cache=None,
+):
+    """Canonical summaries for ``docs`` in the given order, through the
+    generic four-tier pipeline for any registered kernel family.
+
+    ``stats`` accumulates ``device_docs``/``fallback_docs`` (plus the
+    per-reason ``fallback_<reason>`` split and ``delta_docs`` for
+    documents served from the tier-0 delta cache without a download);
+    ``stage`` (if given) accumulates busy seconds under
+    ``pack``/``dispatch``/``upload``/``device_wait``/``download``/
+    ``extract`` and the integer byte counters ``h2d_bytes``/``d2h_bytes``
+    — the bench harness's instrumentation hook; ``packed_out`` (if
+    given) collects ``(state, ops, meta, tag)`` per chunk in schedule
+    order so a caller can reuse the pack work; ``pack_cache`` (if given,
+    built over THIS family) reuses packed windows across calls for docs
+    carrying a ``cache_token`` (see :class:`PackCache`);
+    ``delta_cache`` (a ``service.catchup_cache.DeltaExportCache``, tier 0
+    of the catch-up cache) turns on digest-gated delta download: the fold
+    emits a per-doc state digest, only the tiny digest plane round-trips
+    eagerly, and only CHANGED documents' export rows are gathered and
+    downloaded — unchanged documents serve their cached summaries
+    byte-identically.  Any miss/mismatch falls back to the full fetch.
+    ``device_cache`` (an ``ops.device_cache.DevicePackCache`` built over
+    this family's device ops, tier 2.5) keeps packed chunk arrays
+    device-resident across calls: an exact tier-2 window hit dispatches
+    with ZERO h2d pack bytes, a suffix hit uploads only the new rows
+    through a donated in-place splice, and any mismatch falls back to
+    the full upload — which without the tier is also the only route (and
+    is what ``h2d_bytes`` then counts)."""
+
+    # Seed HERE, not in the fold: a batch that routes entirely to
+    # fallback never reaches _pipelined_fold, and the schema contract
+    # (same keys single-device and mesh, every configuration) must hold
+    # for it too.
+    seed_stage(stage)
+
+    def fold(batch):
+        return _pipelined_fold(
+            family, batch, chunk_docs, pack_threads, extract_threads,
+            fetch_depth, schedule, stats, stage, packed_out, pack_cache,
+            delta_cache, device_cache,
+        )
+
+    return partition_replay(
+        docs, family.known_fallback, family.fallback_summary, fold,
+        stats=stats,
+    )
+
+
 def pipelined_mergetree_replay(
     docs: Sequence[MergeTreeDocInput],
     *,
@@ -518,41 +611,15 @@ def pipelined_mergetree_replay(
     delta_cache=None,
     device_cache=None,
 ):
-    """Canonical summaries for ``docs`` in the given order.
-
-    ``stats`` accumulates ``device_docs``/``fallback_docs`` (plus
-    ``delta_docs`` for documents served from the tier-0 delta cache
-    without a download); ``stage`` (if given) accumulates busy seconds
-    under ``pack``/``dispatch``/``upload``/``device_wait``/``download``/
-    ``extract`` and the integer byte counters ``h2d_bytes``/``d2h_bytes``
-    — the bench harness's instrumentation hook; ``packed_out`` (if
-    given) collects ``(ops, meta, S)`` per chunk in schedule order so a
-    caller can reuse the pack work; ``pack_cache`` (if given) reuses
-    packed windows across calls for docs carrying a ``cache_token`` (see
-    :class:`PackCache`);
-    ``delta_cache`` (a ``service.catchup_cache.DeltaExportCache``, tier 0
-    of the catch-up cache) turns on digest-gated delta download: the fold
-    emits a per-doc state digest, only the tiny digest plane round-trips
-    eagerly, and only CHANGED documents' export rows are gathered and
-    downloaded — unchanged documents serve their cached summaries
-    byte-identically.  Any miss/mismatch falls back to the full fetch.
-    ``device_cache`` (an ``ops.device_cache.DevicePackCache``, tier 2.5)
-    keeps packed chunk arrays device-resident across calls: an exact
-    tier-2 window hit dispatches with ZERO h2d pack bytes, a suffix hit
-    uploads only the new rows through a donated in-place splice, and any
-    mismatch falls back to the full upload — which without the tier is
-    also the only route (and is what ``h2d_bytes`` then counts)."""
-
-    def fold(batch):
-        return _pipelined_fold(
-            batch, chunk_docs, pack_threads, extract_threads, fetch_depth,
-            schedule, stats, stage, packed_out, pack_cache, delta_cache,
-            device_cache,
-        )
-
-    return partition_replay(
-        docs, known_oracle_fallback, oracle_fallback_summary, fold,
-        stats=stats,
+    """The merge-tree instance of :func:`pipelined_family_replay` — the
+    original round-5 entry point, signature unchanged."""
+    return pipelined_family_replay(
+        MERGETREE_FAMILY, docs,
+        chunk_docs=chunk_docs, pack_threads=pack_threads,
+        extract_threads=extract_threads, fetch_depth=fetch_depth,
+        schedule=schedule, stats=stats, stage=stage,
+        packed_out=packed_out, pack_cache=pack_cache,
+        delta_cache=delta_cache, device_cache=device_cache,
     )
 
 
@@ -607,21 +674,29 @@ def _block_until_ready(*handles) -> None:
                 wait()
 
 
-def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
-                    fetch_depth, schedule, stats, stage, packed_out,
-                    pack_cache=None, delta_cache=None, device_cache=None):
-    order = list(range(len(batch)))
-    if schedule and any(d.binary_ops is not None for d in batch):
-        # Fact-homogeneous scheduling: annotate-free docs first, so their
-        # chunks compile with the props plane traced away (~20% fold win
-        # on the pure-text majority).  Stable sort; order restored below.
-        # Binary docs carry the fact in their header (O(1)); message-list
-        # docs would need an O(ops) serial pre-scan on this thread, so a
-        # batch with no binary docs keeps its order (the pack pre-scan
-        # derives the facts in the parallel pool regardless).
-        order.sort(key=lambda i: batch[i].binary_prop_keys is not None
-                   if batch[i].binary_ops is not None
-                   else _has_props(batch[i]))
+#: THE stage schema, identical for every family, single-device and mesh
+#: (the byte counters ride as ints next to the busy seconds).
+STAGE_KEYS = ("pack", "upload", "dispatch", "device_wait", "download",
+              "extract")
+
+
+def seed_stage(stage: Optional[dict]) -> None:
+    """Pre-seed the full stage schema so every fold — with or without
+    cache tiers, single-device or mesh — reports the SAME keys (a leg
+    that never ran reads 0, instead of being absent)."""
+    if stage is None:
+        return
+    for key in STAGE_KEYS:
+        stage.setdefault(key, 0.0)
+    stage.setdefault("h2d_bytes", 0)
+    stage.setdefault("d2h_bytes", 0)
+
+
+def _pipelined_fold(family, batch, chunk_docs, pack_threads,
+                    extract_threads, fetch_depth, schedule, stats, stage,
+                    packed_out, pack_cache=None, delta_cache=None,
+                    device_cache=None):
+    order = family.order(batch, schedule)
     sched = [batch[i] for i in order]
     starts = list(range(0, len(sched), chunk_docs))
 
@@ -631,16 +706,14 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
         if pack_cache is not None:
             state, ops, meta = pack_cache.pack(chunk)
         else:
-            state, ops, meta = pack_mergetree_batch(chunk)
-        warm = any(d.base_records for d in chunk)
-        state = narrow_state_for_upload(state, meta) if warm else None
-        ops = narrow_ops_for_upload(ops, meta)
+            state, ops, meta = family.pack(chunk)
+        state, ops = family.narrow(chunk, state, ops, meta)
         return state, ops, meta, perf_counter() - t0
 
     def extract_one(meta, arr):
         t0 = perf_counter()
         st: dict = {}
-        res = summaries_from_export(meta, arr, stats=st)
+        res = family.extract(meta, arr, st)
         return res, st, perf_counter() - t0
 
     def extract_full_store(meta, arr, dig_np):
@@ -663,8 +736,8 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
         digest + host anchor)."""
         t0 = perf_counter()
         st: dict = {}
-        got = summaries_from_export(delta_sub_meta(meta, changed), arr,
-                                    stats=st)
+        got = family.extract(
+            delta_sub_meta(meta, changed, family.per_doc_meta), arr, st)
         res = delta_merge_changed(delta_cache, meta, dig_np, served,
                                   changed, got)
         st["delta_docs"] = st.get("delta_docs", 0) + len(served)
@@ -702,7 +775,7 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                 docs = meta["docs"]
                 if dig is None:
                     t0 = perf_counter()
-                    arr = export_to_numpy(core)  # the d2h link RPC(s)
+                    arr = family.fetch(core)  # the d2h link RPC(s)
                     _bump(stage, "download", t0)
                     _count_d2h(stage, _nbytes(arr))
                     ex_futs.append(ex_pool.submit(extract_one, meta, arr))
@@ -722,7 +795,7 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                         # Cold / all-changed / fallback route — and the
                         # golden oracle the delta path is tested against.
                         t0 = perf_counter()
-                        arr = export_to_numpy(core)
+                        arr = family.fetch(core)
                         _bump(stage, "download", t0)
                         _count_d2h(stage, _nbytes(arr))
                         ex_futs.append(ex_pool.submit(
@@ -735,10 +808,10 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                         # Exact rows on host-viewable buffers; fine-
                         # bucketed device gather (or whole-buffer fetch
                         # when padding would move it all) elsewhere —
-                        # gather_export_rows owns that choice and
+                        # the family's gather owns that choice and
                         # reports the bytes that really crossed.
                         t0 = perf_counter()
-                        sub, fetched = gather_export_rows(
+                        sub, fetched = family.gather_rows(
                             core, np.asarray(changed, np.int32))
                         _bump(stage, "download", t0)
                         _count_d2h(stage, fetched)
@@ -780,11 +853,9 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     _count_h2d(stage,
                                _np_nbytes(state) + _np_nbytes(ops))
                 t0 = perf_counter()
-                S = _chunk_S(meta)
-                ex = replay_export(state, ops, meta, S=S,
-                                   digest=want_digest,
-                                   doc_base=base_dev)
-                core, dig = split_export_digest(ex, want_digest)
+                ex = family.dispatch(state, ops, meta, want_digest,
+                                     base_dev)
+                core, dig = family.split_digest(ex, want_digest)
                 cand = want_digest and delta_cache.any_candidate(
                     meta["docs"])
                 if dig is not None:
@@ -804,7 +875,8 @@ def _pipelined_fold(batch, chunk_docs, pack_threads, extract_threads,
                     # arrays: a resident-tier buffer may later be
                     # donated away by a suffix splice — a collected
                     # reference must never die under the caller.
-                    packed_out.append((host_state, host_ops, meta, S))
+                    packed_out.append((host_state, host_ops, meta,
+                                       family.chunk_tag(meta)))
                 inflight.append((meta, core, dig, cand))
                 if len(inflight) > fetch_depth:
                     fetch_one(*inflight.popleft())
@@ -844,3 +916,108 @@ def _start_host_copy(ex) -> None:
         copy = getattr(leaf, "copy_to_host_async", None)
         if copy is not None:
             copy()
+
+
+# ---------------------------------------------------------------------------
+# The merge-tree family instance
+# ---------------------------------------------------------------------------
+
+
+def _mt_order(batch, schedule: bool):
+    order = list(range(len(batch)))
+    if schedule and any(d.binary_ops is not None for d in batch):
+        # Fact-homogeneous scheduling: annotate-free docs first, so their
+        # chunks compile with the props plane traced away (~20% fold win
+        # on the pure-text majority).  Stable sort; order restored by the
+        # caller.  Binary docs carry the fact in their header (O(1));
+        # message-list docs would need an O(ops) serial pre-scan on this
+        # thread, so a batch with no binary docs keeps its order (the
+        # pack pre-scan derives the facts in the parallel pool
+        # regardless).
+        order.sort(key=lambda i: batch[i].binary_prop_keys is not None
+                   if batch[i].binary_ops is not None
+                   else _has_props(batch[i]))
+    return order
+
+
+def _mt_narrow(chunk, state, ops, meta):
+    warm = any(d.base_records for d in chunk)
+    return (narrow_state_for_upload(state, meta) if warm else None,
+            narrow_ops_for_upload(ops, meta))
+
+
+def _mt_aux(meta, digest: bool):
+    """The per-doc arena base the dispatch consumes next to state/ops —
+    real bases when the narrow layout or the digest reads them, zeros
+    otherwise (inert, but the jitted signature always takes the arg)."""
+    if bool(meta.get("i16_ok")) or digest:
+        return np.asarray(meta["doc_base"], np.int32)
+    return np.zeros((len(meta["docs"]),), np.int32)
+
+
+def _mt_dispatch(state, ops, meta, digest: bool, aux_dev):
+    return replay_export(state, ops, meta, S=int(meta["_S"]),
+                         digest=digest, doc_base=aux_dev)
+
+
+def _mt_dispatch_sharded(mesh, state, ops, meta, digest: bool, aux_dev):
+    from ..parallel.shard import sharded_export_step
+
+    i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
+    sequential = bool(meta.get("sequential"))
+    warm = state is not None
+    step = sharded_export_step(mesh, int(meta["_S"]), i16, ob_rows,
+                               ov_rows, i8, sequential, has_props, warm,
+                               digest=digest)
+    return step(state, ops, aux_dev) if warm else step(ops, aux_dev)
+
+
+def _mt_entry_rows(chunk, meta):
+    return [
+        sum(1 for m in doc.ops
+            if not m.contents["kind"].startswith("interval"))
+        for doc in chunk
+    ]
+
+
+def _mt_entry_nbytes(state, ops, meta) -> int:
+    return (
+        sum(np.asarray(x).nbytes for x in ops)
+        + sum(np.asarray(x).nbytes for x in state)
+        + len(meta["arena"]) * 4
+    )
+
+
+def _mt_pad_token(k: int) -> tuple:
+    """A deterministic cache token for mesh pad documents: the padded
+    chunk's token tuple must stay all-non-None for tier-2/2.5 keying,
+    and an empty pad doc's "stream" is trivially append-only under a
+    fixed token.  Component 0 is a sentinel epoch, so the tier-0/2.5
+    epoch sweeps treat pad entries as stale on any real epoch change."""
+    return ("\x00pad", f"\x00pad{k}", 0, "")
+
+
+MERGETREE_FAMILY = KernelFamily(
+    name="mergetree",
+    known_fallback=known_oracle_fallback,
+    fallback_summary=oracle_fallback_summary,
+    pack=pack_mergetree_batch,
+    bypass=lambda d: d.binary_ops is not None,
+    entry_rows=_mt_entry_rows,
+    entry_nbytes=_mt_entry_nbytes,
+    extend=_extend_mergetree,
+    order=_mt_order,
+    narrow=_mt_narrow,
+    aux=_mt_aux,
+    dispatch=_mt_dispatch,
+    split_digest=split_export_digest,
+    chunk_tag=_chunk_S,
+    fetch=export_to_numpy,
+    gather_rows=gather_export_rows,
+    extract=lambda meta, arr, st: summaries_from_export(meta, arr,
+                                                        stats=st),
+    per_doc_meta=("doc_base",),
+    make_pad=lambda: MergeTreeDocInput(doc_id="\x00pad", ops=[]),
+    pad_token=_mt_pad_token,
+    dispatch_sharded=_mt_dispatch_sharded,
+)

@@ -244,21 +244,46 @@ class TreeDocInput:
     #: and the pack restores a warm base's keys, so this is extraction
     #: work only.
     attribution: bool = False
+    #: catch-up cache identity (tiers 0/2/2.5, same contract as
+    #: ``MergeTreeDocInput.cache_token``): ``(storage epoch, channel id,
+    #: base ref_seq, base summary digest)`` — within one storage
+    #: generation the edit stream extends append-only under this anchor.
+    #: None bypasses every cache tier.
+    cache_token: Optional[tuple] = None
 
 
 class _DocPack:
     """Per-document host bookkeeping: node/container interning plus the
-    static attributes the device never needs (ids, types)."""
+    static attributes the device never needs (ids, types), and the purge
+    bookkeeping (``removal_time``/``boundary``) the suffix extension
+    resumes from."""
 
     def __init__(self) -> None:
         self.node_ids = Interner()     # node id str -> node idx
         self.node_types: List[str] = []
         self.containers = Interner()   # (node idx, field) -> container idx
-        self.needs_fallback = False
+        self.fallback_reason: Optional[str] = None
         self.header_seq = 0            # channel fold position for the header
         self.base_min_seq = 0
+        #: host-exact removal times (first remover wins; base tombstones
+        #: count) — they decide, per edit, whether the oracle had already
+        #: popped a referenced node when the edit applied.
+        self.removal_time: Dict[str, int] = {}
+        #: purge boundary while applying the NEXT message = max min_seq
+        #: over all prior messages (+ the base minSeq).
+        self.boundary = 0
         self.node_ids.intern("")       # root is node 0
         self.node_types.append("")
+
+    @property
+    def needs_fallback(self) -> bool:
+        return self.fallback_reason is not None
+
+    def mark_fallback(self, reason: str) -> None:
+        """First reason wins (it names the edit that disqualified the
+        doc); later shapes would have routed through the oracle anyway."""
+        if self.fallback_reason is None:
+            self.fallback_reason = reason
 
     def node(self, node_id: str) -> int:
         idx = self.node_ids.intern(node_id)
@@ -305,219 +330,259 @@ def _count_nodes_and_edits(doc: TreeDocInput) -> Tuple[int, int]:
     return nodes, edits
 
 
-def pack_tree_batch(docs: Sequence[TreeDocInput]):
-    """Pack documents into uniform-shape arrays + host metadata."""
+def _materialize_spec(pack: _DocPack, values: Interner, node_rows: Dict,
+                      chains: Dict, spec: dict, container: int) -> int:
+    """Intern one NodeSpec subtree into host rows: the node row (value /
+    seqs / tombstone), its nested containers, and their ordered chains.
+    THE one materialization shared by the fresh pack and the tier-2
+    suffix extension."""
+    idx = pack.node(spec["id"])
+    pack.node_types[idx] = spec["type"]
+    node_rows[idx] = {
+        "container": container,
+        "value": (
+            values.intern(spec["value"])
+            if "value" in spec and spec["value"] is not None
+            else NO_VALUE
+        ),
+        "value_seq": 0,
+        "insert_seq": 0,
+        "removed_seq": (
+            spec["removedSeq"] if "removedSeq" in spec
+            else int(NOT_REMOVED)
+        ),
+    }
+    for f, children in spec.get("fields", {}).items():
+        c = pack.container(idx, f)
+        for ch in children:
+            chains.setdefault(c, []).append(
+                _materialize_spec(pack, values, node_rows, chains, ch, c))
+    return idx
+
+
+def _note_removals(removal_time: Dict[str, int], spec: dict) -> None:
+    if spec.get("removedSeq") is not None:
+        removal_time[spec["id"]] = spec["removedSeq"]
+    for chs in spec.get("fields", {}).values():
+        for ch in chs:
+            _note_removals(removal_time, ch)
+
+
+def fill_tree_doc_messages(pack: _DocPack, values: Interner,
+                           node_rows: Dict, chains: Dict,
+                           edit_rows: List[dict],
+                           msgs: Sequence[SequencedMessage]) -> None:
+    """THE per-message edit-row fill shared by ``pack_tree_batch`` and
+    the pack cache's suffix extension (ops/tree_pipeline.py) — byte
+    drift between fresh and suffix-extended packs is impossible by
+    construction.  Resumes from (and advances) ``pack.removal_time`` /
+    ``pack.boundary`` / ``pack.header_seq`` / ``pack.base_min_seq``, so
+    filling a suffix continues exactly where the cached window stopped."""
+    for msg in msgs:
+        for edit in msg.contents["edits"]:
+            if edit["kind"] == "remove":
+                for nid in edit["ids"]:
+                    # First remover wins; a FUTURE removal can never
+                    # satisfy ``rt <= boundary`` below (its seq exceeds
+                    # every prior min_seq), so pre-noting the whole span
+                    # is equivalent to noting incrementally.
+                    pack.removal_time.setdefault(nid, msg.seq)
+
+    def popped(node_id: str) -> bool:
+        rt = pack.removal_time.get(node_id)
+        return rt is not None and rt <= pack.boundary
+
+    for msg in msgs:
+        pack.header_seq = max(pack.header_seq, msg.seq)
+        pack.base_min_seq = max(pack.base_min_seq, msg.min_seq)
+        rows_before = len(edit_rows)
+        for edit in msg.contents["edits"]:
+            kind = edit["kind"]
+            if kind == "insert":
+                if popped(edit["parent"]):
+                    # The oracle skips this insert entirely (parent
+                    # popped); follow-on references to its content
+                    # would need an existence simulation — fallback.
+                    pack.mark_fallback("purged_parent_insert")
+                parent_idx = pack.node(edit["parent"])
+                c = pack.container(parent_idx, edit["field"])
+                block: List[int] = []
+                for spec in edit["content"]:
+                    idx = _materialize_spec(pack, values, node_rows,
+                                            chains, spec, c)
+                    node_rows[idx]["insert_seq"] = msg.seq
+                    node_rows[idx]["value_seq"] = max(msg.seq, 0)
+                    block.append(idx)
+                # Nested nodes' seqs:
+                def stamp(spec):
+                    i = pack.node(spec["id"])
+                    node_rows[i]["insert_seq"] = msg.seq
+                    if node_rows[i]["value"] != NO_VALUE:
+                        node_rows[i]["value_seq"] = msg.seq
+                    for chs in spec.get("fields", {}).values():
+                        for ch in chs:
+                            stamp(ch)
+                for spec in edit["content"]:
+                    stamp(spec)
+                anchor = edit["anchor"]
+                edit_rows.append({
+                    "kind": K_INSERT, "seq": msg.seq, "container": c,
+                    "anchor": (
+                        pack.node(anchor) if anchor is not None else NIL
+                    ),
+                    "first": block[0], "tail": block[-1],
+                    "block": block,
+                })
+            elif kind == "remove":
+                for nid in edit["ids"]:
+                    edit_rows.append({
+                        "kind": K_REMOVE, "seq": msg.seq,
+                        "first": pack.node(nid),
+                    })
+            elif kind == "set":
+                edit_rows.append({
+                    "kind": K_SET, "seq": msg.seq,
+                    "first": pack.node(edit["id"]),
+                    "value": (
+                        values.intern(edit["value"])
+                        if edit["value"] is not None else NO_VALUE
+                    ),
+                })
+            elif kind == "move":
+                if len(edit["ids"]) != 1:
+                    pack.mark_fallback("multi_id_move")  # block-cycle rules
+                    continue
+                parent_idx = pack.node(edit["parent"])
+                c = pack.container(parent_idx, edit["field"])
+                anchor = edit["anchor"]
+                tgt = pack.node(edit["ids"][0])
+                edit_rows.append({
+                    "kind": K_MOVE, "seq": msg.seq, "container": c,
+                    "anchor": (
+                        pack.node(anchor) if anchor is not None else NIL
+                    ),
+                    "first": tgt, "tail": tgt,
+                })
+            elif kind == "revive":
+                pack.mark_fallback("revive")  # purge-timing interaction
+            else:
+                raise ValueError(f"unknown edit kind {kind!r}")
+        for row in edit_rows[rows_before:]:
+            row["purge_msn"] = pack.boundary
+        pack.boundary = max(pack.boundary, msg.min_seq)
+
+
+def load_tree_base(pack: _DocPack, values: Interner, node_rows: Dict,
+                   chains: Dict, doc: TreeDocInput) -> None:
+    """Materialize a warm base summary into host rows (header seqs,
+    tombstone times, attribution-key restore) — the pre-message half of
+    the per-doc pack."""
     import json
 
-    values = Interner()
-    doc_packs = [_DocPack() for _ in docs]
+    if doc.base_summary is None:
+        return
+    base_obj = obj = json.loads(doc.base_summary.blob_bytes("header"))
+    pack.header_seq = obj.get("seq", 0)
+    pack.base_min_seq = obj.get("minSeq", 0)
+    pack.boundary = pack.base_min_seq
+    if obj.get("limbo"):
+        # Detached-but-rescuable subtrees in the base need a
+        # container-less representation — oracle fallback.
+        pack.mark_fallback("base_limbo")
+    for f, children in obj.get("fields", {}).items():
+        c = pack.container(0, f)
+        for ch in children:
+            idx = _materialize_spec(pack, values, node_rows, chains, ch, c)
+            chains.setdefault(c, []).append(idx)
+            node_rows[idx]["insert_seq"] = ch["insertSeq"]
+    # insert/value seqs for nested nodes come from the summary obj.
+    def fix_seqs(o):
+        idx = pack.node(o["id"])
+        node_rows[idx]["insert_seq"] = o["insertSeq"]
+        node_rows[idx]["value_seq"] = o.get("valueSeq", 0)
+        for chs in o.get("fields", {}).values():
+            for ch in chs:
+                fix_seqs(ch)
+    for chs in obj.get("fields", {}).values():
+        for ch in chs:
+            fix_seqs(ch)
+    if "attribution" in doc.base_summary.children:
+        # Warm base carrying pre-clamp keys: restore them via the
+        # ONE shared helper (SharedTree.load uses it too), so
+        # re-summarizing regenerates identical keys.
+        from ..dds.tree import restore_attribution_seqs
 
-    sizes = [_count_nodes_and_edits(d) for d in docs]
-    # +2·edits slack: anchors/parents naming already-purged ids intern fresh
-    # (inert) rows — the oracle's "missing → field start / drop" fallback
-    # falls out of their NIL containers.
-    N = next_bucket(
-        max((n + 2 * e for n, e in sizes), default=1), floor=16
-    )
-    T = next_bucket(max((e for _, e in sizes), default=1), floor=16)
-    D = len(docs)
-    # Containers ≤ nodes·fields; sized after a packing dry run is overkill —
-    # intern first, then allocate.  Two passes keep the arrays exact.
+        def get_seqs(nid):
+            if nid not in pack.node_ids:
+                return None
+            row = node_rows.get(pack.node(nid))
+            return None if row is None else (
+                row["insert_seq"], row["value_seq"])
 
-    packed_docs = []
-    for d, doc in enumerate(docs):
-        pack = doc_packs[d]
-        node_rows: Dict[int, dict] = {}
-        chains: Dict[int, List[int]] = {}  # container -> ordered node idxs
-        edit_rows: List[dict] = []
+        def put_seqs(nid, ins, val):
+            row = node_rows[pack.node(nid)]
+            row["insert_seq"], row["value_seq"] = ins, val
 
-        def materialize(spec: dict, container: int) -> int:
-            idx = pack.node(spec["id"])
-            pack.node_types[idx] = spec["type"]
-            node_rows[idx] = {
-                "container": container,
-                "value": (
-                    values.intern(spec["value"])
-                    if "value" in spec and spec["value"] is not None
-                    else NO_VALUE
-                ),
-                "value_seq": 0,
-                "insert_seq": 0,
-                "removed_seq": (
-                    spec["removedSeq"] if "removedSeq" in spec
-                    else int(NOT_REMOVED)
-                ),
-            }
-            for f, children in spec.get("fields", {}).items():
-                c = pack.container(idx, f)
-                for ch in children:
-                    chains.setdefault(c, []).append(materialize(ch, c))
-            return idx
+        restore_attribution_seqs(
+            json.loads(
+                doc.base_summary.blob_bytes("attribution")),
+            get_seqs, put_seqs,
+        )
+    for chs in base_obj.get("fields", {}).values():
+        for ch in chs:
+            _note_removals(pack.removal_time, ch)
 
-        base_obj = None
-        if doc.base_summary is not None:
-            base_obj = obj = json.loads(doc.base_summary.blob_bytes("header"))
-            pack.header_seq = obj.get("seq", 0)
-            pack.base_min_seq = obj.get("minSeq", 0)
-            if obj.get("limbo"):
-                # Detached-but-rescuable subtrees in the base need a
-                # container-less representation — oracle fallback.
-                pack.needs_fallback = True
-            for f, children in obj.get("fields", {}).items():
-                c = pack.container(0, f)
-                for ch in children:
-                    idx = materialize(ch, c)
-                    chains.setdefault(c, []).append(idx)
-                    node_rows[idx]["insert_seq"] = ch["insertSeq"]
-            # insert/value seqs for nested nodes come from the summary obj.
-            def fix_seqs(o):
-                idx = pack.node(o["id"])
-                node_rows[idx]["insert_seq"] = o["insertSeq"]
-                node_rows[idx]["value_seq"] = o.get("valueSeq", 0)
-                for chs in o.get("fields", {}).values():
-                    for ch in chs:
-                        fix_seqs(ch)
-            for chs in obj.get("fields", {}).values():
-                for ch in chs:
-                    fix_seqs(ch)
-            if "attribution" in doc.base_summary.children:
-                # Warm base carrying pre-clamp keys: restore them via the
-                # ONE shared helper (SharedTree.load uses it too), so
-                # re-summarizing regenerates identical keys.
-                from ..dds.tree import restore_attribution_seqs
 
-                def get_seqs(nid):
-                    if nid not in pack.node_ids:
-                        return None
-                    row = node_rows.get(pack.node(nid))
-                    return None if row is None else (
-                        row["insert_seq"], row["value_seq"])
+def scatter_tree_doc_rows(st: dict, ed: dict, d: int, node_rows: Dict,
+                          chains: Dict, edit_rows: List[dict],
+                          containers: List[tuple], t_base: int = 0,
+                          cont_start: int = 0) -> None:
+    """Write one document's host rows into the batch arrays (dicts of
+    numpy planes).  THE one scatter shared by the fresh pack (``t_base``
+    / ``cont_start`` 0) and the suffix extension (which scatters ONLY
+    the new rows into copied planes: edit rows land at ``t_base``+,
+    container rows from ``cont_start``)."""
+    for c in range(cont_start, len(containers)):
+        st["container_parent"][d, c] = containers[c][0]
+    for idx, row in node_rows.items():
+        st["node_container"][d, idx] = row["container"]
+        st["value"][d, idx] = row["value"]
+        st["value_seq"][d, idx] = row["value_seq"]
+        st["insert_seq"][d, idx] = row["insert_seq"]
+        st["removed_seq"][d, idx] = row["removed_seq"]
+    # Pre-link chains: base-summary sibling lists fully; insert-block
+    # interiors (head/prev of the block come alive at splice time).
+    for e in edit_rows:
+        if e["kind"] == K_INSERT:
+            block = e["block"]
+            for a, b in zip(block, block[1:]):
+                st["next"][d, a] = b
+                st["prev"][d, b] = a
+    for c, members in chains.items():
+        # Base lists (live at t=0) need head set; nested insert-block
+        # chains were added under their materialized parent and are
+        # reachable only through it, so setting head is safe for both —
+        # an unreachable container's head is never read before its
+        # parent links in.
+        st["head"][d, c] = members[0]
+        for a, b in zip(members, members[1:]):
+            st["next"][d, a] = b
+            st["prev"][d, b] = a
+    for t, e in enumerate(edit_rows):
+        ed["kind"][d, t_base + t] = e["kind"]
+        ed["seq"][d, t_base + t] = e["seq"]
+        ed["container"][d, t_base + t] = e.get("container", 0)
+        ed["anchor"][d, t_base + t] = e.get("anchor", NIL)
+        ed["first"][d, t_base + t] = e["first"]
+        ed["tail"][d, t_base + t] = e.get("tail", e["first"])
+        ed["value"][d, t_base + t] = e.get("value", NO_VALUE)
+        ed["purge_msn"][d, t_base + t] = e.get("purge_msn", -1)
 
-                def put_seqs(nid, ins, val):
-                    row = node_rows[pack.node(nid)]
-                    row["insert_seq"], row["value_seq"] = ins, val
 
-                restore_attribution_seqs(
-                    json.loads(
-                        doc.base_summary.blob_bytes("attribution")),
-                    get_seqs, put_seqs,
-                )
-
-        # Host-exact removal times (first remover wins; base tombstones
-        # count) — they decide, per edit, whether the oracle had already
-        # popped a referenced node when the edit applied.
-        removal_time: Dict[str, int] = {}
-
-        def note_removals(spec):
-            if spec.get("removedSeq") is not None:
-                removal_time[spec["id"]] = spec["removedSeq"]
-            for chs in spec.get("fields", {}).values():
-                for ch in chs:
-                    note_removals(ch)
-
-        if base_obj is not None:
-            for chs in base_obj.get("fields", {}).values():
-                for ch in chs:
-                    note_removals(ch)
-        for msg in doc.ops:
-            for edit in msg.contents["edits"]:
-                if edit["kind"] == "remove":
-                    for nid in edit["ids"]:
-                        removal_time.setdefault(nid, msg.seq)
-
-        # purge boundary while applying a message = max min_seq over all
-        # PRIOR messages (+ the base minSeq) — the oracle advances the
-        # window AFTER applying each message.
-        boundary = pack.base_min_seq
-
-        def popped(node_id: str) -> bool:
-            rt = removal_time.get(node_id)
-            return rt is not None and rt <= boundary
-
-        for msg in doc.ops:
-            pack.header_seq = max(pack.header_seq, msg.seq)
-            pack.base_min_seq = max(pack.base_min_seq, msg.min_seq)
-            rows_before = len(edit_rows)
-            for edit in msg.contents["edits"]:
-                kind = edit["kind"]
-                if kind == "insert":
-                    if popped(edit["parent"]):
-                        # The oracle skips this insert entirely (parent
-                        # popped); follow-on references to its content
-                        # would need an existence simulation — fallback.
-                        pack.needs_fallback = True
-                    parent_idx = pack.node(edit["parent"])
-                    c = pack.container(parent_idx, edit["field"])
-                    block: List[int] = []
-                    for spec in edit["content"]:
-                        idx = materialize(spec, c)
-                        node_rows[idx]["insert_seq"] = msg.seq
-                        node_rows[idx]["value_seq"] = max(msg.seq, 0)
-                        block.append(idx)
-                    # Nested nodes' seqs:
-                    def stamp(spec):
-                        i = pack.node(spec["id"])
-                        node_rows[i]["insert_seq"] = msg.seq
-                        if node_rows[i]["value"] != NO_VALUE:
-                            node_rows[i]["value_seq"] = msg.seq
-                        for chs in spec.get("fields", {}).values():
-                            for ch in chs:
-                                stamp(ch)
-                    for spec in edit["content"]:
-                        stamp(spec)
-                    anchor = edit["anchor"]
-                    edit_rows.append({
-                        "kind": K_INSERT, "seq": msg.seq, "container": c,
-                        "anchor": (
-                            pack.node(anchor) if anchor is not None else NIL
-                        ),
-                        "first": block[0], "tail": block[-1],
-                        "block": block,
-                    })
-                elif kind == "remove":
-                    for nid in edit["ids"]:
-                        edit_rows.append({
-                            "kind": K_REMOVE, "seq": msg.seq,
-                            "first": pack.node(nid),
-                        })
-                elif kind == "set":
-                    edit_rows.append({
-                        "kind": K_SET, "seq": msg.seq,
-                        "first": pack.node(edit["id"]),
-                        "value": (
-                            values.intern(edit["value"])
-                            if edit["value"] is not None else NO_VALUE
-                        ),
-                    })
-                elif kind == "move":
-                    if len(edit["ids"]) != 1:
-                        pack.needs_fallback = True  # block-cycle semantics
-                        continue
-                    parent_idx = pack.node(edit["parent"])
-                    c = pack.container(parent_idx, edit["field"])
-                    anchor = edit["anchor"]
-                    tgt = pack.node(edit["ids"][0])
-                    edit_rows.append({
-                        "kind": K_MOVE, "seq": msg.seq, "container": c,
-                        "anchor": (
-                            pack.node(anchor) if anchor is not None else NIL
-                        ),
-                        "first": tgt, "tail": tgt,
-                    })
-                elif kind == "revive":
-                    pack.needs_fallback = True  # purge-timing interaction
-                else:
-                    raise ValueError(f"unknown edit kind {kind!r}")
-            for row in edit_rows[rows_before:]:
-                row["purge_msn"] = boundary
-            boundary = max(boundary, msg.min_seq)
-
-        packed_docs.append((node_rows, chains, edit_rows))
-
-    C = next_bucket(
-        max((len(p.containers) for p in doc_packs), default=1), floor=8
-    )
-
+def empty_tree_arrays(D: int, N: int, C: int, T: int):
+    """Fresh default-filled batch planes — also what the suffix
+    extension's unwritten new rows must equal (inert interned rows keep
+    these defaults)."""
     st = {
         "head": np.full((D, C), NIL, np.int32),
         "next": np.full((D, N), NIL, np.int32),
@@ -540,54 +605,70 @@ def pack_tree_batch(docs: Sequence[TreeDocInput]):
         "value": np.full((D, T), NO_VALUE, np.int32),
         "purge_msn": np.full((D, T), -1, np.int32),
     }
+    return st, ed
 
-    for d, (node_rows, chains, edit_rows) in enumerate(packed_docs):
+
+def tree_buckets(docs: Sequence[TreeDocInput]):
+    """(N, T) sizing buckets from the estimate predicate.  +2·edits
+    slack on N: anchors/parents naming already-purged ids intern fresh
+    (inert) rows — the oracle's "missing → field start / drop" fallback
+    falls out of their NIL containers.  ONE derivation point: the
+    suffix extension re-evaluates this same predicate over the combined
+    windows to decide whether the cached buckets still hold."""
+    sizes = [_count_nodes_and_edits(d) for d in docs]
+    N = next_bucket(
+        max((n + 2 * e for n, e in sizes), default=1), floor=16
+    )
+    T = next_bucket(max((e for _, e in sizes), default=1), floor=16)
+    return N, T
+
+
+def pack_tree_batch(docs: Sequence[TreeDocInput]):
+    """Pack documents into uniform-shape arrays + host metadata."""
+    values = Interner()
+    doc_packs = [_DocPack() for _ in docs]
+    N, T = tree_buckets(docs)
+    D = len(docs)
+    # Containers ≤ nodes·fields; sized after a packing dry run is overkill —
+    # intern first, then allocate.  Two passes keep the arrays exact.
+
+    packed_docs = []
+    for d, doc in enumerate(docs):
         pack = doc_packs[d]
-        for (pidx, _f), c in zip(pack.containers.values,
-                                 range(len(pack.containers))):
-            st["container_parent"][d, c] = pidx
-        for idx, row in node_rows.items():
-            st["node_container"][d, idx] = row["container"]
-            st["value"][d, idx] = row["value"]
-            st["value_seq"][d, idx] = row["value_seq"]
-            st["insert_seq"][d, idx] = row["insert_seq"]
-            st["removed_seq"][d, idx] = row["removed_seq"]
-        # Pre-link chains: base-summary sibling lists fully; insert-block
-        # interiors (head/prev of the block come alive at splice time).
-        base_containers = set()
-        if docs[d].base_summary is not None:
-            # chains collected during base materialization are live lists;
-            # chains from insert blocks must only pre-link interiors.
-            pass
-        for e in edit_rows:
-            if e["kind"] == K_INSERT:
-                block = e.pop("block")
-                for a, b in zip(block, block[1:]):
-                    st["next"][d, a] = b
-                    st["prev"][d, b] = a
-        for c, members in chains.items():
-            # Distinguish base lists (live at t=0) from insert-block nested
-            # chains (live at splice): base lists need head set; nested
-            # chains were added under their materialized parent and are
-            # reachable only through it, so setting head is safe for both —
-            # an unreachable container's head is never read before its
-            # parent links in.
-            st["head"][d, c] = members[0]
-            for a, b in zip(members, members[1:]):
-                st["next"][d, a] = b
-                st["prev"][d, b] = a
-        for t, e in enumerate(edit_rows):
-            ed["kind"][d, t] = e["kind"]
-            ed["seq"][d, t] = e["seq"]
-            ed["container"][d, t] = e.get("container", 0)
-            ed["anchor"][d, t] = e.get("anchor", NIL)
-            ed["first"][d, t] = e["first"]
-            ed["tail"][d, t] = e.get("tail", e["first"])
-            ed["value"][d, t] = e.get("value", NO_VALUE)
-            ed["purge_msn"][d, t] = e.get("purge_msn", -1)
+        node_rows: Dict[int, dict] = {}
+        chains: Dict[int, List[int]] = {}  # container -> ordered node idxs
+        edit_rows: List[dict] = []
+        load_tree_base(pack, values, node_rows, chains, doc)
+        fill_tree_doc_messages(pack, values, node_rows, chains, edit_rows,
+                               doc.ops)
+        packed_docs.append((node_rows, chains, edit_rows))
 
-    meta = {"doc_packs": doc_packs, "values": values, "docs": docs}
+    C = next_bucket(
+        max((len(p.containers) for p in doc_packs), default=1), floor=8
+    )
+    st, ed = empty_tree_arrays(D, N, C, T)
+    for d, (node_rows, chains, edit_rows) in enumerate(packed_docs):
+        scatter_tree_doc_rows(st, ed, d, node_rows, chains, edit_rows,
+                              doc_packs[d].containers.values)
+
+    meta = {
+        "doc_packs": doc_packs, "values": values, "docs": docs,
+        # Per-doc used-row counts: the digest mask (only written rows may
+        # hash) and the suffix extension/splice windows read these.
+        "n_nodes": np.asarray([len(p.node_ids) for p in doc_packs],
+                              np.int32),
+        "n_cont": np.asarray([len(p.containers) for p in doc_packs],
+                             np.int32),
+        "t_rows": np.asarray([len(rows) for _n, _c, rows in packed_docs],
+                             np.int32),
+    }
     return TreeState(**st), TreeEdits(**ed), meta
+
+
+class _ChainCycleError(Exception):
+    """A sibling chain longer than the doc's interned rows: a cycle in
+    the final linked list, reachable only through out-of-contract input
+    (duplicate node ids) — extraction bails to the oracle."""
 
 
 def oracle_fallback_summary(doc: TreeDocInput) -> SummaryTree:
@@ -609,20 +690,60 @@ def oracle_fallback_summary(doc: TreeDocInput) -> SummaryTree:
     return replica.summarize()
 
 
+#: distinct-from-None sentinel: the memoized verdict itself can be None
+_VERDICT_UNSET = object()
+
+
+def known_tree_fallback(doc: TreeDocInput):
+    # Memoized per doc object (same discipline as known_oracle_fallback):
+    # benches and warm catch-up passes re-route the same doc objects, and
+    # the base-header JSON parse + full op scan must not repeat per pass.
+    cached = getattr(doc, "_fallback_verdict", _VERDICT_UNSET)
+    if cached is not _VERDICT_UNSET:
+        return cached
+    verdict = _known_tree_fallback_uncached(doc)
+    doc._fallback_verdict = verdict
+    return verdict
+
+
+def _known_tree_fallback_uncached(doc: TreeDocInput):
+    """Pre-pack oracle routing: the reason string when the document's
+    SHAPE disqualifies the device fold before packing — revive edits,
+    multi-id moves, a base summary carrying limbo roots — else None.
+    Mirrors the pack-time ``mark_fallback`` calls (MAX_DEPTH overflow
+    and purged-parent inserts need the fold/purge simulation and stay
+    post-pack); routing these out FIRST keeps them from inflating the
+    shared N/T buckets, exactly like ``known_oracle_fallback`` does for
+    merge-tree docs."""
+    if doc.base_summary is not None:
+        import json
+
+        if json.loads(doc.base_summary.blob_bytes("header")).get("limbo"):
+            return "base_limbo"
+    for msg in doc.ops:
+        for edit in msg.contents["edits"]:
+            kind = edit["kind"]
+            if kind == "revive":
+                return "revive"
+            if kind == "move" and len(edit["ids"]) != 1:
+                return "multi_id_move"
+    return None
+
+
 def summary_from_state(meta, state_np: dict, d: int,
                        stats: Optional[dict] = None) -> SummaryTree:
     """Final device state → the oracle's canonical summary bytes.
     ``stats`` counts this doc as device/fallback WHERE the routing
-    decision is made, so the counters can never drift from the actual
-    serving path."""
+    decision is made — per REASON (revive / multi-id move / MAX_DEPTH
+    overflow / …) through the shared ``count_fallback`` — so the
+    counters can never drift from the actual serving path."""
+    from .batching import count_fallback
+
     doc: TreeDocInput = meta["docs"][d]
     pack: _DocPack = meta["doc_packs"][d]
     if pack.needs_fallback or bool(state_np["overflow"][d]):
-        if stats is not None:
-            stats["fallback_docs"] = stats.get("fallback_docs", 0) + 1
+        count_fallback(stats, pack.fallback_reason or "max_depth")
         return oracle_fallback_summary(doc)
-    if stats is not None:
-        stats["device_docs"] = stats.get("device_docs", 0) + 1
     values: Interner = meta["values"]
     msn = max(doc.final_msn, pack.base_min_seq)
 
@@ -646,12 +767,20 @@ def summary_from_state(meta, state_np: dict, d: int,
         rs = int(removed[idx])
         return not (rs != int(NOT_REMOVED) and rs <= msn)
 
+    n_used = len(pack.node_ids)
+
     def chain(c: int) -> List[int]:
         out = []
         cur = int(head[c])
         while cur != NIL:
             # Only nodes currently linked in this container (a node moved
             # away leaves no stale link — splice repairs both sides).
+            if len(out) >= n_used:
+                # More links than interned rows proves a CYCLE — possible
+                # only on out-of-contract streams (e.g. duplicate node
+                # ids).  The walk must terminate regardless; the doc
+                # routes to the oracle below.
+                raise _ChainCycleError()
             out.append(cur)
             cur = int(nxt[cur])
         return out
@@ -683,26 +812,36 @@ def summary_from_state(meta, state_np: dict, d: int,
                 out[fname] = kids
         return out
 
-    root_obj = {
-        "fields": fields_obj(0),
-        "minSeq": msn,
-        "seq": pack.header_seq,
-    }
-    # Limbo: kept nodes still linked in a chain whose owning node is NOT
-    # kept (their enclosing tombstone expired).  The oracle detaches them
-    # at purge time; here they surface at extraction — same set, because
-    # rescued nodes were re-linked under kept owners by their moves.
-    # Unlinked rows (e.g. content of oracle-skipped inserts, which are a
-    # pack-time fallback anyway) are reachable from no chain.
-    limbo_idxs = []
-    for c in range(len(pack.containers)):
-        owner = int(state_np["container_parent"][d][c])
-        if owner == NIL or keep(owner):
-            continue
-        limbo_idxs.extend(i for i in chain(c) if keep(i))
-    if limbo_idxs:
-        limbo_idxs.sort(key=lambda i: pack.node_ids.values[i])
-        root_obj["limbo"] = [node_obj(i) for i in limbo_idxs]
+    try:
+        root_obj = {
+            "fields": fields_obj(0),
+            "minSeq": msn,
+            "seq": pack.header_seq,
+        }
+        # Limbo: kept nodes still linked in a chain whose owning node is
+        # NOT kept (their enclosing tombstone expired).  The oracle
+        # detaches them at purge time; here they surface at extraction —
+        # same set, because rescued nodes were re-linked under kept
+        # owners by their moves.  Unlinked rows (e.g. content of
+        # oracle-skipped inserts, which are a pack-time fallback anyway)
+        # are reachable from no chain.
+        limbo_idxs = []
+        for c in range(len(pack.containers)):
+            owner = int(state_np["container_parent"][d][c])
+            if owner == NIL or keep(owner):
+                continue
+            limbo_idxs.extend(i for i in chain(c) if keep(i))
+        if limbo_idxs:
+            limbo_idxs.sort(key=lambda i: pack.node_ids.values[i])
+            root_obj["limbo"] = [node_obj(i) for i in limbo_idxs]
+    except (_ChainCycleError, RecursionError):
+        # A next-link or container-nesting cycle (out-of-contract input
+        # such as duplicate node ids): extraction must never hang or
+        # blow the stack — lose the device win, serve the oracle bytes.
+        count_fallback(stats, "chain_cycle")
+        return oracle_fallback_summary(doc)
+    if stats is not None:
+        stats["device_docs"] = stats.get("device_docs", 0) + 1
     tree = SummaryTree()
     tree.add_blob("header", canonical_json(root_obj))
     if doc.attribution:

@@ -37,16 +37,7 @@ from ..ops.mergetree_kernel import (
     MTState,
     MergeTreeDocInput,
     _export_cold_fn,
-    _export_flags,
     _export_warm_fn,
-    export_to_numpy,
-    known_oracle_fallback,
-    narrow_ops_for_upload,
-    narrow_state_for_upload,
-    oracle_fallback_summary,
-    pack_mergetree_batch,
-    split_export_digest,
-    summaries_from_export,
 )
 from ..protocol.summary import SummaryTree
 
@@ -144,17 +135,9 @@ def sharded_export_step(mesh: Mesh, S: int, i16: bool, ob_rows: bool,
                            has_props, out_sharding=shard, digest=digest)
 
 
-def _pad_token(k: int) -> tuple:
-    """A deterministic cache token for mesh pad documents: the padded
-    chunk's token tuple must stay all-non-None for tier-2/2.5 keying,
-    and an empty pad doc's "stream" is trivially append-only under a
-    fixed token.  Component 0 is a sentinel epoch, so the tier-0/2.5
-    epoch sweeps treat pad entries as stale on any real epoch change."""
-    return ("\x00pad", f"\x00pad{k}", 0, "")
-
-
-def replay_mergetree_sharded(
-    docs: Sequence[MergeTreeDocInput],
+def replay_family_sharded(
+    family,
+    docs: Sequence,
     mesh: Optional[Mesh] = None,
     stats: Optional[dict] = None,
     stage: Optional[dict] = None,
@@ -162,33 +145,27 @@ def replay_mergetree_sharded(
     delta_cache=None,
     device_cache=None,
 ) -> List[SummaryTree]:
-    """Multi-chip catch-up replay: pack → narrow → shard over the mesh →
-    fold+export in-graph → shared host extraction (the single-chip
-    ``summaries_from_export``, verbatim).  Byte-compatible with the
-    single-chip path and the CPU oracle.  Until round 5 this path
-    downloaded all 13 full int32 state planes; it now fetches the same
-    fused (elided/int16/int8) export buffer as single-chip — ~10× less
-    d2h per chunk — and uploads the narrow encodings.
-
-    Round 13 pays the mesh-parity debt: the sharded fold serves the
-    identical cache stack as the single-device pipeline — ``pack_cache``
+    """THE generic mesh-sharded catch-up fold (round 14): pack → narrow
+    → shard over the mesh → family fold+export in-graph → shared host
+    extraction, serving the IDENTICAL four-tier cache stack and
+    stage-counter schema as the single-device pipeline — ``pack_cache``
     (tier 2 suffix reuse), ``delta_cache`` (tier 0 digest-gated delta
     download; only the digest plane and changed documents' rows cross
     d2h), ``device_cache`` (tier 2.5 resident upload buffers, placed
     doc-sharded; exact hits upload nothing, suffix hits splice in place)
-    — and ``stage`` accumulates the same busy-second /
-    ``h2d_bytes``/``d2h_bytes`` schema
-    (``pack``/``upload``/``dispatch``/``device_wait``/``download``/
-    ``extract``) the single-device pipeline reports, so the first
-    multichip measurement records the full r06-style stage split.
+    — with ``stage`` accumulating the
+    ``pack``/``upload``/``dispatch``/``device_wait``/``download``/
+    ``extract`` busy split plus ``h2d_bytes``/``d2h_bytes``.
 
-    ``stats`` (optional dict) accumulates ``device_docs`` /
-    ``fallback_docs`` exactly like ``replay_mergetree_batch`` — pre-pack
-    oracle routing plus post-fold overflow fallbacks — plus
-    ``delta_docs`` for tier-0 serves, so the multichip service path
-    reports the same split as single-chip."""
+    Every family-shaped decision rides the
+    :class:`~fluidframework_tpu.ops.family.KernelFamily` hooks (the same
+    descriptor the single-device pipeline consumes, plus
+    ``dispatch_sharded``/``make_pad``/``pad_token``), so the merge-tree
+    and tree mesh paths cannot drift from each other or from their
+    single-device twins.  ``stats`` accumulates ``device_docs`` /
+    ``fallback_docs`` (+ the per-reason split) exactly like the batch
+    entry points, plus ``delta_docs`` for tier-0 serves."""
     from ..ops.batching import partition_replay
-    from ..ops.mergetree_kernel import gather_export_rows
     from ..ops.pipeline import (
         _block_until_ready,
         _bump,
@@ -201,8 +178,10 @@ def replay_mergetree_sharded(
         delta_store_all,
         delta_sub_meta,
         perf_counter,
+        seed_stage,
     )
 
+    seed_stage(stage)
     if mesh is None:
         mesh = doc_mesh()
     shard = NamedSharding(mesh, _doc_spec(mesh))
@@ -217,66 +196,54 @@ def replay_mergetree_sharded(
     def fold_batch_export(batch):
         n_real = len(batch)
         pad_base = len(batch)
-        padded = _pad_docs(
-            batch, mesh.size,
-            lambda: MergeTreeDocInput(doc_id="\x00pad", ops=[]),
-        )
+        padded = _pad_docs(batch, mesh.size, family.make_pad)
         # Pad docs carry a deterministic token so the padded chunk's
         # token tuple keys tiers 2/2.5 (any None would bypass both) —
         # but only when every REAL doc is tokened; a mixed chunk
         # bypasses anyway and must keep doing so.
-        if all(d.cache_token is not None for d in batch):
+        if family.pad_token is not None \
+                and all(d.cache_token is not None for d in batch):
             for k in range(pad_base, len(padded)):
-                padded[k].cache_token = _pad_token(k)
+                padded[k].cache_token = family.pad_token(k)
         t0 = perf_counter()
         if pack_cache is not None:
             state, ops, meta = pack_cache.pack(padded)
         else:
-            state, ops, meta = pack_mergetree_batch(padded)
-        warm = any(d.base_records for d in padded)
-        state_n = narrow_state_for_upload(state, meta) if warm else None
-        ops_n = narrow_ops_for_upload(ops, meta)
+            state, ops, meta = family.pack(padded)
+        state_n, ops_n = family.narrow(padded, state, ops, meta)
         _bump(stage, "pack", t0)
-        S = int(meta["_S"])
-        i16, ob_rows, ov_rows, i8, has_props = _export_flags(meta)
-        sequential = bool(meta.get("sequential"))
         want_digest = delta_cache is not None
 
         # --- upload leg: resident tier or explicit sharded device_put;
         # h2d_bytes counts what really crossed either way.
         t0 = perf_counter()
-        base_dev = None
+        aux_dev = None
         if device_cache is not None:
-            state_u, ops_u, base_dev, up_bytes = device_cache.acquire(
+            state_u, ops_u, aux_dev, up_bytes = device_cache.acquire(
                 state_n, ops_n, meta)
-            if base_dev is None and (i16 or want_digest):
-                base_dev = jax.device_put(
-                    jnp.asarray(meta["doc_base"]), shard)
-                up_bytes += len(padded) * 4
-            if isinstance(ops_u.kind, np.ndarray):
+            if isinstance(jax.tree.leaves(ops_u)[0], np.ndarray):
                 # Bypass route (token-less chunk): shard-place like the
                 # plain path so the step still runs mesh-partitioned.
                 ops_u = _shard_put(mesh, ops_u)
-                state_u = _shard_put(mesh, state_u) if warm else None
+                state_u = _shard_put(mesh, state_u) \
+                    if state_u is not None else None
         else:
             up_bytes = _np_nbytes(state_n) + _np_nbytes(ops_n)
             ops_u = _shard_put(mesh, ops_n)
-            state_u = _shard_put(mesh, state_n) if warm else None
-        if base_dev is None:
-            base_np = meta["doc_base"] if (i16 or want_digest) else \
-                np.zeros((len(padded),), np.int32)
-            base_dev = jax.device_put(jnp.asarray(base_np), shard)
+            state_u = _shard_put(mesh, state_n) \
+                if state_n is not None else None
+        if aux_dev is None:
+            aux_host = family.aux(meta, want_digest)
+            up_bytes += _np_nbytes(tuple(jax.tree.leaves(aux_host)))
+            aux_dev = _shard_put(mesh, aux_host)
         _bump(stage, "upload", t0)
         _count_h2d(stage, up_bytes)
 
         # --- dispatch + honest device wait.
         t0 = perf_counter()
-        the_step = sharded_export_step(
-            mesh, S, i16, ob_rows, ov_rows, i8, sequential, has_props,
-            warm, digest=want_digest)
-        export = the_step(state_u, ops_u, base_dev) if warm \
-            else the_step(ops_u, base_dev)
-        core, dig = split_export_digest(export, want_digest)
+        export = family.dispatch_sharded(mesh, state_u, ops_u, meta,
+                                         want_digest, aux_dev)
+        core, dig = family.split_digest(export, want_digest)
         _bump(stage, "dispatch", t0)
         t0 = perf_counter()
         _block_until_ready(core, dig)
@@ -285,13 +252,15 @@ def replay_mergetree_sharded(
         # Pad trimming: served/changed/extraction all operate on the
         # REAL prefix (pads sit at the tail), so stats and the tier-0
         # entries never see a pad; the sliced view extracts identically
-        # (chunk-global meta untouched, tstart offsets absolute).
+        # (chunk-global meta untouched, per-doc offsets absolute).
         meta_real = dict(
             meta,
             docs=meta["docs"][:n_real],
             doc_packs=meta["doc_packs"][:n_real],
-            doc_base=meta["doc_base"][:n_real],
         )
+        for key in family.per_doc_meta:
+            if key in meta:
+                meta_real[key] = np.asarray(meta[key])[:n_real]
         real_docs = meta_real["docs"]
 
         def trim(ex_np):
@@ -301,7 +270,7 @@ def replay_mergetree_sharded(
         def extract(meta_x, arr, extra=()):
             t1 = perf_counter()
             st: dict = {}
-            res = summaries_from_export(meta_x, arr, stats=st)
+            res = family.extract(meta_x, arr, st)
             for fn in extra:
                 fn(res)
             _bump(stage, "extract", t1)
@@ -312,7 +281,7 @@ def replay_mergetree_sharded(
             # d2h_bytes counts the PADDED buffer — that is what crosses
             # the link; pads trim host-side after the transfer.
             t1 = perf_counter()
-            raw = export_to_numpy(core)
+            raw = family.fetch(core)
             _bump(stage, "download", t1)
             _count_d2h(stage, _nbytes(raw))
             return trim(raw)
@@ -341,15 +310,16 @@ def replay_mergetree_sharded(
             _bump_stats({"delta_docs": len(real_docs)})
             return [served[d] for d in range(len(real_docs))]
         t0 = perf_counter()
-        sub, fetched = gather_export_rows(
+        sub, fetched = family.gather_rows(
             core, np.asarray(changed, np.int32))
         _bump(stage, "download", t0)
         _count_d2h(stage, fetched)
         delta_cache.note_bytes_saved(max(0, _nbytes(core) - fetched))
         t0 = perf_counter()
         st: dict = {}
-        got = summaries_from_export(delta_sub_meta(meta_real, changed),
-                                    sub, stats=st)
+        got = family.extract(
+            delta_sub_meta(meta_real, changed, family.per_doc_meta),
+            sub, st)
         res = delta_merge_changed(delta_cache, meta_real, dig_np, served,
                                   changed, got)
         st["delta_docs"] = st.get("delta_docs", 0) + len(served)
@@ -358,8 +328,32 @@ def replay_mergetree_sharded(
         return res
 
     return partition_replay(
-        docs, known_oracle_fallback, oracle_fallback_summary,
+        docs, family.known_fallback, family.fallback_summary,
         fold_batch_export, stats=stats,
+    )
+
+
+def replay_mergetree_sharded(
+    docs: Sequence[MergeTreeDocInput],
+    mesh: Optional[Mesh] = None,
+    stats: Optional[dict] = None,
+    stage: Optional[dict] = None,
+    pack_cache=None,
+    delta_cache=None,
+    device_cache=None,
+) -> List[SummaryTree]:
+    """Multi-chip merge-tree catch-up replay — the merge-tree instance
+    of :func:`replay_family_sharded` (round 13 paid the mesh-parity
+    debt; round 14 made the body family-generic).  Byte-compatible with
+    the single-chip path and the CPU oracle; fetches the same fused
+    (elided/int16/int8) export buffer as single-chip and uploads the
+    narrow encodings."""
+    from ..ops.pipeline import MERGETREE_FAMILY
+
+    return replay_family_sharded(
+        MERGETREE_FAMILY, docs, mesh=mesh, stats=stats, stage=stage,
+        pack_cache=pack_cache, delta_cache=delta_cache,
+        device_cache=device_cache,
     )
 
 
@@ -551,44 +545,58 @@ def tree_sharded_replay_step(mesh: Mesh):
     )
 
 
+@functools.lru_cache(maxsize=16)
+def tree_sharded_export_step(mesh: Mesh, digest: bool):
+    """Jitted, mesh-sharded tree fold+EXPORT (cached per mesh/digest):
+    the vmapped edit-fold partitioned along the doc axis, the final
+    forest planes emitted doc-sharded (each chip encodes its shard;
+    the host trims pads after the transfer), and — under ``digest`` —
+    the per-doc ``[D, 2]`` digest plane appended LAST, sharded like the
+    planes.  The tree family's ``dispatch_sharded`` hook; the fold is
+    per-doc elementwise, so no collective is inserted."""
+    from ..ops.tree_kernel import TreeEdits, TreeState
+    from ..ops.tree_kernel import replay_vmapped as tree_replay_vmapped
+    from ..ops.tree_pipeline import tree_doc_digests
+
+    shard = NamedSharding(mesh, _doc_spec(mesh))
+
+    def _step(state: TreeState, edits: TreeEdits, n_nodes, n_cont):
+        final = tree_replay_vmapped(state, edits)
+        out = tuple(final)
+        if digest:
+            out = out + (tree_doc_digests(final, n_nodes, n_cont),)
+        return out
+
+    n_out = len(TreeState._fields) + (1 if digest else 0)
+    return jax.jit(
+        _step,
+        in_shardings=(
+            TreeState(*([shard] * len(TreeState._fields))),
+            TreeEdits(*([shard] * len(TreeEdits._fields))),
+            shard, shard,
+        ),
+        out_shardings=(shard,) * n_out,
+    )
+
+
 def replay_tree_sharded(
-    docs, mesh: Optional[Mesh] = None, step=None,
+    docs, mesh: Optional[Mesh] = None,
     stats: Optional[dict] = None,
+    stage: Optional[dict] = None,
+    pack_cache=None,
+    delta_cache=None,
+    device_cache=None,
 ) -> List[SummaryTree]:
-    """Multi-chip SharedTree catch-up replay (see replay_mergetree_sharded).
-    ``stats`` accumulates ``device_docs``/``fallback_docs`` like the
-    batch entry point (pack-time revive/multi-id-move detection + fold
-    overflow)."""
-    from ..ops.batching import partition_replay
-    from ..ops.tree_kernel import (
-        TreeDocInput,
-        oracle_fallback_summary as tree_oracle_fallback,
-        pack_tree_batch,
-        summary_from_state as tree_summary_from_state,
+    """Multi-chip SharedTree catch-up replay — the SECOND instance of
+    :func:`replay_family_sharded` (ISSUE 14): the tree route serves the
+    identical four-tier stack and stage schema as the merge-tree mesh
+    fold.  ``stats`` accumulates ``device_docs``/``fallback_docs`` (with
+    the per-reason split: revive / multi-id move / MAX_DEPTH overflow /
+    purged-parent inserts / limbo bases) like the batch entry point."""
+    from ..ops.tree_pipeline import TREE_FAMILY
+
+    return replay_family_sharded(
+        TREE_FAMILY, docs, mesh=mesh, stats=stats, stage=stage,
+        pack_cache=pack_cache, delta_cache=delta_cache,
+        device_cache=device_cache,
     )
-
-    if mesh is None:
-        mesh = doc_mesh()
-    the_step = step if step is not None else (
-        tree_sharded_replay_step(mesh) if docs else None
-    )
-
-    def fold_batch(batch):
-        n_real = len(batch)
-        padded = _pad_docs(
-            batch, mesh.size, lambda: TreeDocInput(doc_id="\x00pad", ops=[])
-        )
-        state, edits, meta = pack_tree_batch(padded)
-        final, overflow = the_step(_shard_put(mesh, state),
-                                   _shard_put(mesh, edits))
-        state_np = {k: np.asarray(v) for k, v in final._asdict().items()}
-        state_np["overflow"] = np.asarray(overflow)
-        return [
-            tree_summary_from_state(meta, state_np, d, stats=stats)
-            for d in range(n_real)
-        ]
-
-    # Tree fallbacks (revive edits, multi-id moves) are detected at pack
-    # time inside summary_from_state; no pre-pack predicate exists.
-    return partition_replay(docs, lambda _d: False,
-                            tree_oracle_fallback, fold_batch, stats=stats)

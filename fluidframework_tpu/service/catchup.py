@@ -213,6 +213,32 @@ class CatchupService:
         self.device_cache = _gated(device_cache, "Catchup.DeviceResident",
                                     "Catchup.DeviceCacheBytes", 192 << 20,
                                     DevicePackCache)
+        # The SECOND kernel family (ISSUE 14): tree channels ride the
+        # same four-tier pipeline.  Tier 0/1 are family-agnostic and
+        # SHARED (entries key by channel-scoped token / doc);
+        # tiers 2/2.5 hold family-typed arrays, so the tree route gets
+        # its own instances behind the SAME gates — an operator turning
+        # a tier off turns it off for every family.
+        from ..ops.tree_pipeline import tree_device_cache, tree_pack_cache
+
+        # Each family gets its OWN budget of the configured size (the
+        # bytes keys bound a tier per family, not summed across them —
+        # an operator tuning Catchup.DeviceCacheBytes down bounds the
+        # tree planes exactly like the merge-tree ones).
+        self.tree_pack_cache = (
+            tree_pack_cache(
+                self.mc.config.get_int("Catchup.PackCacheBytes",
+                                       192 << 20))
+            if isinstance(self._pack_cache, PackCache) else None)
+        self.tree_device_cache = (
+            tree_device_cache(
+                self.mc.config.get_int("Catchup.DeviceCacheBytes",
+                                       192 << 20))
+            if isinstance(self.device_cache, DevicePackCache) else None)
+        #: kernel channels that fell back to the oracle path (ISSUE 14
+        #: satellite: hostChannels alone could not distinguish a
+        #: non-kernel channel from a kernel channel that fell back).
+        self.fallback_channels = 0  # guarded-by: _serial
         raw_timeout = self.mc.config.raw("Catchup.JoinTimeout")
         try:
             # Explicit None check: a configured 0 means "never wait on a
@@ -241,6 +267,23 @@ class CatchupService:
         self.device_docs = 0  # guarded-by: _serial
         self.cpu_docs = 0  # guarded-by: _serial
         self.host_channels = 0  # guarded-by: _serial (host-side channel folds)
+
+    def invalidate_epoch(self, epoch: str) -> None:
+        """ONE epoch sweep over every epoch-keyed cache tier this
+        service holds — tier 1 (results), tier 0 (delta export), and
+        BOTH families' tier-2.5 resident buffers (the server's per-RPC
+        sweep calls this so a new family can never be forgotten).  The
+        tier-2 pack caches need no sweep: their tokens carry the epoch
+        as component 0, so dead-generation windows simply never match
+        and age out of the LRU."""
+        if self.cache is not None:
+            self.cache.invalidate_epoch(epoch)
+        if self.delta_cache is not None:
+            self.delta_cache.invalidate_epoch(epoch)
+        if self.device_cache is not None:
+            self.device_cache.invalidate_epoch(epoch)
+        if self.tree_device_cache is not None:
+            self.tree_device_cache.invalidate_epoch(epoch)
 
     def _resolve_mesh(self):  # holds-lock: _serial
         """Lazy mesh detection: touch ``jax.devices()`` only on the first
@@ -291,7 +334,8 @@ class CatchupService:
             if complete:
                 # Pure cache serve: no fold ran, all deltas are zero.
                 if stats is not None:
-                    stats.update(deviceDocs=0, cpuDocs=0, hostChannels=0)
+                    stats.update(deviceDocs=0, cpuDocs=0, hostChannels=0,
+                                 fallbackChannels=0)
                 # stats() is the LOCKED snapshot — reading the counter
                 # dict directly would race concurrent leaders bumping it
                 # under the cache lock (fluidrace cannot see cross-object
@@ -313,6 +357,7 @@ class CatchupService:
             )
             device_before, cpu_before = self.device_docs, self.cpu_docs
             host_before = self.host_channels
+            fb_before = self.fallback_channels
             with tracer, PerformanceEvent.timed_exec(
                     self.mc.logger, "bulkCatchup") as perf:
                 results = self._catch_up(doc_ids, upload, prefetched)
@@ -320,6 +365,10 @@ class CatchupService:
                     deviceDocs=self.device_docs - device_before,
                     cpuDocs=self.cpu_docs - cpu_before,
                     hostChannels=self.host_channels - host_before,
+                    # Kernel channels that fell back to the oracle this
+                    # call — distinguishable from hostChannels (channel
+                    # types with no kernel at all) since round 14.
+                    fallbackChannels=self.fallback_channels - fb_before,
                 )
                 perf["extra"].update(docs=len(results), **deltas)
             if stats is not None:
@@ -589,6 +638,19 @@ class CatchupService:
                     enumerate(work.plan):
                 cid = f"{work.doc_id}/{ds_id}/{channel_id}"
                 ops = flatten_channel_ops(work.decoded, ds_id, channel_id)
+
+                def channel_token(tree=channel_tree, cid=cid):
+                    # THE append-only cache identity (tiers 0/2/2.5)
+                    # every kernel family packs under: the channel's op
+                    # stream extends append-only under a fixed (epoch,
+                    # base summary, ref_seq) anchor.  ONE derivation
+                    # point — two hand-synced copies could silently give
+                    # one family a weaker key — called lazily: only the
+                    # pipelined families consume it, and the digest is a
+                    # full Merkle walk the other channels must not pay.
+                    return (epoch, cid, work.ref_seq,
+                            tree.digest() if tree is not None else "")
+
                 if type_name not in KERNEL_TYPES:
                     self.host_channels += 1
                     host_trees[wi, pi] = self._host_channel_fold(
@@ -601,14 +663,7 @@ class CatchupService:
                         doc_id=cid, ops=ops, final_seq=final_seq,
                         final_msn=final_msn,
                         attribution=work.attribution,
-                        # Pack-cache identity (tier 2): the channel's op
-                        # stream extends append-only under a fixed
-                        # (epoch, base summary, ref_seq) anchor.
-                        cache_token=(
-                            epoch, cid, work.ref_seq,
-                            channel_tree.digest()
-                            if channel_tree is not None else "",
-                        ),
+                        cache_token=channel_token(),
                         **self._string_base_kwargs(channel_tree),
                     ))
                 elif type_name == MAP_TYPE:
@@ -631,6 +686,7 @@ class CatchupService:
                         doc_id=cid, ops=ops, base_summary=channel_tree,
                         final_seq=final_seq, final_msn=final_msn,
                         attribution=work.attribution,
+                        cache_token=channel_token(),
                     ))
         mesh = self._resolve_mesh()
         if mesh is not None:
@@ -666,22 +722,32 @@ class CatchupService:
                 MATRIX_TYPE: functools.partial(
                     replay_matrix_sharded, mesh=mesh,
                     stats=self.pipeline_stats),
+                # The second kernel family (ISSUE 14): the tree route
+                # serves the IDENTICAL four-tier stack and stage schema
+                # as the string route — tier 0 shared, tiers 2/2.5 its
+                # own family-typed instances.
                 TREE_TYPE: functools.partial(
                     replay_tree_sharded, mesh=mesh,
-                    stats=self.pipeline_stats),
+                    stats=self.pipeline_stats,
+                    stage=self.pipeline_stage,
+                    pack_cache=self.tree_pack_cache,
+                    delta_cache=self.delta_cache,
+                    device_cache=self.tree_device_cache),
             }
         else:
             import functools
 
             from ..ops.pipeline import pipelined_mergetree_replay
+            from ..ops.tree_pipeline import pipelined_tree_replay
 
-            # String channels (the north-star volume) ride the chunked,
-            # fact-scheduled, single-device-thread pipeline — the same
-            # code path bench.py measures; the other kernels' batches are
-            # small enough to fold in one dispatch each.  Stage busy
-            # seconds + doc counts accumulate on this instance (the
-            # warm-vs-cold gate reads them), and packed windows reuse
-            # through the tier-2 pack cache.
+            # String + tree channels (the two PAPER §0 kernel families)
+            # ride the chunked, single-device-thread family pipeline —
+            # the same code path bench.py measures; the remaining
+            # kernels' batches are small enough to fold in one dispatch
+            # each (matrix is the named third family candidate).  Stage
+            # busy seconds + doc counts accumulate on this instance (the
+            # warm-vs-cold gates read them), and packed windows reuse
+            # through the per-family tier-2 pack caches.
             replay = {
                 STRING_TYPE: functools.partial(
                     pipelined_mergetree_replay,
@@ -696,14 +762,26 @@ class CatchupService:
                 MATRIX_TYPE: functools.partial(
                     replay_matrix_batch, stats=self.pipeline_stats),
                 TREE_TYPE: functools.partial(
-                    replay_tree_batch, stats=self.pipeline_stats),
+                    pipelined_tree_replay,
+                    stats=self.pipeline_stats,
+                    stage=self.pipeline_stage,
+                    pack_cache=self.tree_pack_cache,
+                    delta_cache=self.delta_cache,
+                    device_cache=self.tree_device_cache,
+                ),
             }
+        fb_before = self.pipeline_stats.get("fallback_docs", 0)
         results = {
             STRING_TYPE: replay[STRING_TYPE](string_in),
             MAP_TYPE: replay[MAP_TYPE](map_in) if map_in else [],
             MATRIX_TYPE: replay[MATRIX_TYPE](matrix_in) if matrix_in else [],
             TREE_TYPE: replay[TREE_TYPE](tree_in) if tree_in else [],
         }
+        # Kernel channels that fell back to their oracle (pre-pack
+        # routing + post-fold overflow alike bump fallback_docs at the
+        # one shared counting point) — the hostChannels disambiguator.
+        self.fallback_channels += (
+            self.pipeline_stats.get("fallback_docs", 0) - fb_before)
 
         out: List[SummaryTree] = []
         for wi, work in enumerate(works):

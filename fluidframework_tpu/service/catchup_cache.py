@@ -347,8 +347,11 @@ class DeltaExportCache:
     @staticmethod
     def _eligible(doc) -> bool:
         # Binary-stream docs carry their ops opaquely (len(doc.ops) == 0
-        # would alias every window): bypass, like tier 2 does.
-        return doc.cache_token is not None and doc.binary_ops is None
+        # would alias every window): bypass, like tier 2 does.  Families
+        # without a binary form (tree) simply lack the attribute — the
+        # tier is family-generic (round 14), so probe, don't assume.
+        return doc.cache_token is not None \
+            and getattr(doc, "binary_ops", None) is None
 
     # -- introspection ---------------------------------------------------------
 

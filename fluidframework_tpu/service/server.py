@@ -592,24 +592,13 @@ class OrderingServer:
             # attribute (fluidrace FL-RACE-GUARD — the instance is
             # immutable-once-set, the attribute slot is not).
             catchup = self._catchup
-        if catchup.cache is not None:
-            # Epoch-keyed invalidation (EpochTracker parity for the
-            # SERVER's own fold cache): entries are keyed by the
-            # storage generation so a recreated store can never be
-            # served a stale fold — dropping dead-generation entries
-            # here just frees the budget immediately.
-            catchup.cache.invalidate_epoch(
-                service.storage.epoch)
-        if catchup.delta_cache is not None:
-            # Tier 0 (delta download) is epoch-keyed the same way.
-            catchup.delta_cache.invalidate_epoch(
-                service.storage.epoch)
-        if catchup.device_cache is not None:
-            # Tier 2.5 (device-resident pack buffers): epoch-keyed
-            # tokens, same sweep — a recreated store frees the HBM its
-            # dead generation held.
-            catchup.device_cache.invalidate_epoch(
-                service.storage.epoch)
+        # Epoch-keyed invalidation (EpochTracker parity for the SERVER's
+        # own fold caches): entries are keyed by the storage generation
+        # so a recreated store can never be served a stale fold —
+        # dropping dead-generation entries here just frees the budget
+        # (and the HBM tier 2.5 held) immediately.  ONE sweep covers
+        # every tier of every kernel family (round 14).
+        catchup.invalidate_epoch(service.storage.epoch)
         doc_ids = params.get("docs")
         prefix = f"{session.tenant}/" if self.tenants is not None else ""
         if doc_ids is not None:
@@ -634,6 +623,12 @@ class OrderingServer:
             ),
             "deviceDocs": stats.get("deviceDocs", 0),
             "cpuDocs": stats.get("cpuDocs", 0),
+            # Per-channel split inside device-routed documents:
+            # non-kernel channels folded host-side vs kernel channels
+            # that FELL BACK to their oracle (ISSUE 14 satellite — the
+            # two were indistinguishable before).
+            "hostChannels": stats.get("hostChannels", 0),
+            "fallbackChannels": stats.get("fallbackChannels", 0),
             # Cumulative fold-cache health (hits/misses/evictions/
             # waits + bytes) — operators watching a herd of loading
             # clients see the single-flight amortization here.

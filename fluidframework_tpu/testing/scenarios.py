@@ -179,6 +179,16 @@ class ScenarioSpec:
     #: ``SwarmResult.fold_tier`` (outside replay identity, like
     #: ``ingress``).  In-proc runs only.
     fold_probe: bool = False
+    #: SharedTree collaboration (ISSUE 14): every document carries a
+    #: tree channel and the swarm's generated ops are id-addressed tree
+    #: changesets (leaf insert / value set / remove under root fields)
+    #: instead of map/counter/string traffic.  Tree changesets are
+    #: outside the closed columnar wire vocabulary, so ingress takes the
+    #: boxed envelope path by design (the per-doc fallback route the
+    #: columnar contract documents); with ``fold_probe`` the sampled
+    #: documents then catch up through the REAL CatchupService TREE
+    #: route — the second-kernel-family serving shape.
+    tree_ops: bool = False
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
@@ -310,6 +320,23 @@ def _laggard_window(seed, clients, docs, shards) -> ScenarioSpec:
     )
 
 
+def _tree_collab(seed, clients, docs, shards) -> ScenarioSpec:
+    """SharedTree collab swarm: boxed tree changesets + a catch-up herd.
+
+    Every client edits a shared tree channel (leaf inserts / LWW value
+    sets / removes under root fields, all id-addressed); a cohort goes
+    dark mid-run and returns as one herd.  The sampled documents then
+    catch up cold+warm through the REAL CatchupService tree route
+    (``fold_probe``), so the report carries the second kernel family's
+    resident / delta / pack tier counters (ISSUE 14)."""
+    return ScenarioSpec(
+        name="tree-collab", seed=seed, clients=clients, docs=docs,
+        shards=shards, tree_ops=True,
+        phases=(Phase("ramp", 16), Phase("steady", 48),
+                Phase("herd", 32, frac=0.25), Phase("steady", 32)),
+    )
+
+
 def _failover_drill(seed, clients, docs, shards) -> ScenarioSpec:
     """Mid-run shard kill between summary elections, under live traffic.
 
@@ -334,6 +361,7 @@ SCENARIOS: Dict[str, Callable[..., ScenarioSpec]] = {
     "steady-typing": _steady_typing,
     "catchup-herd": _catchup_herd,
     "laggard-window": _laggard_window,
+    "tree-collab": _tree_collab,
     "failover-drill": _failover_drill,
 }
 
@@ -405,6 +433,11 @@ class ClientSwarm:
         self.cursor = np.zeros(n, dtype=np.int64)
         self.client_seq = np.zeros(n, dtype=np.int64)
         self.op_count = np.zeros(n, dtype=np.int64)
+        #: tree-collab: nodes each client has inserted so far — target
+        #: ids for its sets/removes derive from this count, so every
+        #: referenced id was inserted by the same client earlier in its
+        #: own (sequencer-ordered) stream.
+        self.tree_created = np.zeros(n, dtype=np.int64)
         self.next_fire = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
         self.catchup_start = np.zeros(n, dtype=np.int64)
         self.lag_start = np.full(n, -1, dtype=np.int64)
@@ -523,6 +556,8 @@ class ClientSwarm:
         ds.create_channel("sequence-tpu", "text")
         ds.create_channel("map-tpu", "kv")
         ds.create_channel("counter-tpu", "count")
+        if self.spec.tree_ops:
+            ds.create_channel("tree-tpu", "tree")
 
     def setup(self) -> None:
         """Create every document through the real Loader (attach summary
@@ -688,10 +723,34 @@ class ClientSwarm:
         value = np.where(kind_code == COL_KIND_INCREMENT, delta, val)
         return firing, kind_code, key_i, value, ch_i
 
+    def _tree_edit(self, i: int, k: int, key_i: int, value: int,
+                   ch_i: int) -> dict:
+        """One client's tree changeset for this fire: the columnar plan's
+        (kind, key, value, char) columns mapped onto id-addressed edits.
+        Inserts mint ``{client}-n{count}`` leaf ids (globally unique by
+        construction); sets/removes target the client's OWN earlier
+        inserts — removing an already-removed id is the first-remover-
+        wins no-op, setting a purged id the oracle's silent drop, both
+        byte-exact on the device fold."""
+        created = int(self.tree_created[i])
+        cid = self.client_ids[i]
+        if k == COL_KIND_INSERT or created == 0:
+            nid = f"{cid}-n{created}"
+            self.tree_created[i] = created + 1
+            return {"kind": "insert", "parent": "",
+                    "field": f"f{ch_i % 2}", "anchor": None,
+                    "content": [{"id": nid, "type": "n", "value": value}]}
+        target = f"{cid}-n{key_i % created}"
+        if k == COL_KIND_SET:
+            return {"kind": "set", "id": target, "value": value}
+        return {"kind": "remove", "ids": [target]}
+
     def _generate_ops(self, t: int) -> Dict[int, List[RawOperation]]:
-        """Boxed ingress (``columnar=False`` — the parity oracle): the
-        same columnar plan, materialized per op into dict + RawOperation
-        envelopes before submission."""
+        """Boxed ingress (``columnar=False`` — the parity oracle — and
+        ALL ``tree_ops`` traffic, whose changesets live outside the
+        closed columnar vocabulary): the same columnar plan,
+        materialized per op into dict + RawOperation envelopes before
+        submission."""
         out: Dict[int, List[RawOperation]] = {}
         fired = self._fire(t)
         if fired is None:
@@ -700,9 +759,14 @@ class ClientSwarm:
         docs = self.doc_of[firing]
         seqs = self.client_seq[firing]
         refs = self.cursor[firing]
+        tree_mode = self.spec.tree_ops
         for j, i in enumerate(firing.tolist()):
             k = int(kind_code[j])
-            if k == COL_KIND_SET:
+            if tree_mode:
+                contents = {"edits": [self._tree_edit(
+                    i, k, int(key_i[j]), int(value[j]), int(ch_i[j]))]}
+                channel = "tree"
+            elif k == COL_KIND_SET:
                 contents = {"kind": "set", "key": key_string(int(key_i[j])),
                             "value": int(value[j])}
                 channel = "kv"
@@ -754,7 +818,9 @@ class ClientSwarm:
         ingress meter covers the WHOLE swarm→sequencer leg — op
         planning/boxing, wire encode/decode, and batch stamping — which
         is the r10 per-op cost the columnar path exists to kill."""
-        if not self.spec.columnar:
+        if not self.spec.columnar or self.spec.tree_ops:
+            # tree-collab always boxes: changesets are outside the
+            # closed columnar vocabulary — the documented fallback.
             with self.ingress.timed():
                 ops = self._generate_ops(t)
             return self._submit(t, ops)
@@ -1129,7 +1195,8 @@ class ClientSwarm:
         svc = CatchupService(self.service, mesh=None, cache=None)
         ids = [self.doc_ids[d] for d in self.sampled]
         svc.catch_up(ids, upload=False)  # cold: the tiers fill
-        svc.catch_up(ids, upload=False)  # warm: resident + delta serve
+        stats: dict = {}
+        svc.catch_up(ids, upload=False, stats=stats)  # warm: tiers serve
         stage = svc.pipeline_stage
         return {
             "docs": len(ids),
@@ -1139,6 +1206,16 @@ class ClientSwarm:
                             if svc.delta_cache is not None else None),
             "pack_cache": (svc._pack_cache.stats()
                            if svc._pack_cache is not None else None),
+            # The second kernel family's tiers (ISSUE 14) — live on
+            # tree-collab runs, zero-traffic otherwise.
+            "tree_device_cache": (
+                svc.tree_device_cache.stats()
+                if svc.tree_device_cache is not None else None),
+            "tree_pack_cache": (
+                svc.tree_pack_cache.stats()
+                if svc.tree_pack_cache is not None else None),
+            "host_channels": stats.get("hostChannels", 0),
+            "fallback_channels": stats.get("fallbackChannels", 0),
             "h2d_bytes": int(stage.get("h2d_bytes", 0)),
             "d2h_bytes": int(stage.get("d2h_bytes", 0)),
         }

@@ -140,6 +140,32 @@ def test_device_cache_module_lints_clean_with_zero_suppressions():
     assert offenders == [], "new modules must stay suppression-free"
 
 
+def test_kernel_family_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 14 acceptance pin: every module the family-generic pipeline
+    refactor touched or created — the descriptor, both family bindings,
+    the generic tiers, the mesh twin, the reason-counting router, and
+    the second-family bench harness — passes ALL module rules (fluidlint
+    + fluidrace + fluidleak families) with zero findings AND zero
+    baseline entries.  The load-bearing generalization layer gets no
+    exemptions."""
+    new_modules = [
+        "fluidframework_tpu/ops/family.py",
+        "fluidframework_tpu/ops/pipeline.py",
+        "fluidframework_tpu/ops/tree_pipeline.py",
+        "fluidframework_tpu/ops/tree_kernel.py",
+        "fluidframework_tpu/ops/batching.py",
+        "fluidframework_tpu/ops/device_cache.py",
+        "fluidframework_tpu/parallel/shard.py",
+        "fluidframework_tpu/service/catchup.py",
+        "tools/bench_kernels.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "new modules must stay suppression-free"
+
+
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5) + donate (PR 13)

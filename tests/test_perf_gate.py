@@ -320,6 +320,42 @@ def test_catchup_warm_hit_skips_pack_stage_entirely():
     )
 
 
+def test_tree_catchup_warm_hit_skips_pack_stage_entirely():
+    """The SECOND kernel family's warm-vs-cold gate (ISSUE 14): a warm
+    tree catch-up through the real CatchupService must be a pure tier-1
+    serve — every doc a cache hit (rate 1.0), the pack-stage counter and
+    both byte counters untouched, bytes identical to the cold fold.
+    Mirrors test_catchup_warm_hit_skips_pack_stage_entirely; mesh=None
+    pins the single-device pipelined tree path."""
+    from fluidframework_tpu.service import LocalOrderingService
+    from fluidframework_tpu.service.catchup import CatchupService
+    from tools.bench_kernels import build_tree_catchup_corpus
+
+    n_docs, edits = 16, 24
+    service = LocalOrderingService()
+    doc_ids = build_tree_catchup_corpus(service, n_docs, edits)
+    svc = CatchupService(service, mesh=None)
+
+    cold = svc.catch_up(doc_ids, upload=False)
+    assert svc.pipeline_stage.get("pack", 0) > 0, (
+        "cold tree catch-up never reached the pack stage — gate miswired"
+    )
+    stage_after_cold = dict(svc.pipeline_stage)
+    counters = svc.cache.counters
+
+    hits_before = counters.get("hits")
+    warm = svc.catch_up(doc_ids, upload=False)
+    assert warm == cold, "warm tree catch-up changed bytes"
+    assert svc.pipeline_stage == stage_after_cold, (
+        f"warm tree hit touched pipeline stages: {svc.pipeline_stage} "
+        f"vs {stage_after_cold}"
+    )
+    hit_rate = (counters.get("hits") - hits_before) / n_docs
+    assert hit_rate == 1.0, (
+        f"warm tree pass was not a full tier-1 hit (rate {hit_rate})"
+    )
+
+
 def test_narrow_upload_shrinks_op_stream(packed_chunk, monkeypatch):
     """The narrow transfer encoding must keep cutting ≥40% off the
     qualifying op-stream upload (the h2d leg of the link budget)."""

@@ -249,7 +249,9 @@ def test_limbo_rescue_survives_purge_summary_and_device():
         stats=stats,
     )
     assert warm.digest() == final.digest()
-    assert stats == {"fallback_docs": 1}
+    # Per-reason fallback accounting (ISSUE 14 satellite): the opaque
+    # total survives, joined by WHY the doc left the device path.
+    assert stats == {"fallback_docs": 1, "fallback_base_limbo": 1}
 
 
 def test_deep_tree_fuzz_device_parity():

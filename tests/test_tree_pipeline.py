@@ -1,0 +1,346 @@
+"""Family-generic pipeline, second instance (ISSUE 14): SharedTree
+through the four-tier catch-up stack.
+
+The acceptance matrix: ``tree pipelined-on == pipelined-off ==
+replay_tree_batch == dds/tree.py oracle`` on golden shapes AND 3-seed
+fuzz logs, across warm summary re-entry, grown-tail suffix hits, forced
+repacks, every fallback shape (per-reason counted), and the mesh twin.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.tree_kernel import (
+    TreeDocInput,
+    oracle_fallback_summary,
+    replay_tree_batch,
+)
+from fluidframework_tpu.ops.tree_pipeline import (
+    pipelined_tree_replay,
+    tree_device_cache,
+    tree_pack_cache,
+)
+from fluidframework_tpu.service.catchup_cache import DeltaExportCache
+from tests.test_tree_kernel import run_fuzz_doc
+from tools.bench_kernels import synth_tree_messages, tree_doc, tree_shape
+
+
+def _caches():
+    return dict(pack_cache=tree_pack_cache(),
+                device_cache=tree_device_cache(),
+                delta_cache=DeltaExportCache())
+
+
+def _digests(summaries):
+    return [s.digest() for s in summaries]
+
+
+def _fold(docs, caches, **kw):
+    stage: dict = {}
+    stats: dict = {}
+    out = _digests(pipelined_tree_replay(docs, chunk_docs=8, stage=stage,
+                                         stats=stats, **caches, **kw))
+    return out, stage, stats
+
+
+def _fuzz_docs(seed, n=6, steps=40, cut=0):
+    docs = []
+    for k in range(n):
+        _f, _t, log, fs, fm = run_fuzz_doc(seed * 100 + k, steps=steps,
+                                           with_moves=(k % 2 == 0))
+        window = log[:len(log) - cut] if cut else log
+        docs.append(TreeDocInput(
+            f"d{seed}-{k}", ops=window, final_seq=window[-1].seq,
+            final_msn=(fm if not cut else 0),
+            cache_token=("ep", f"d{seed}-{k}", 0, "")))
+    return docs
+
+
+def test_golden_parity_every_shape():
+    """The bench generator's five shapes (deep-move chains, wide
+    containers, revive, multi-id move, MAX_DEPTH overflow): caches-on ==
+    caches-off == replay_tree_batch == dds oracle, with the per-reason
+    fallback split live."""
+    docs = [tree_doc(i, synth_tree_messages(i, 40), 40) for i in range(32)]
+    assert {tree_shape(i) for i in range(32)} == {
+        "deep-move", "wide-container", "revive", "multi_id_move",
+        "max_depth"}
+    oracle = [oracle_fallback_summary(d).digest() for d in docs]
+    on, _stage, stats = _fold(docs, _caches())
+    off, _stage2, _stats2 = _fold(docs, {})
+    assert on == oracle
+    assert off == oracle
+    assert _digests(replay_tree_batch(list(docs))) == oracle
+    assert stats["fallback_docs"] == (
+        stats.get("fallback_revive", 0)
+        + stats.get("fallback_multi_id_move", 0)
+        + stats.get("fallback_max_depth", 0)
+        + stats.get("fallback_purged_parent_insert", 0)
+        + stats.get("fallback_base_limbo", 0))
+    assert stats.get("fallback_revive", 0) >= 1
+    assert stats.get("fallback_multi_id_move", 0) >= 1
+    assert stats.get("fallback_max_depth", 0) >= 1
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fuzz_parity_pipelined_on_off_batch_oracle(seed):
+    docs = _fuzz_docs(seed)
+    oracle = [oracle_fallback_summary(d).digest() for d in docs]
+    on, _st, _s = _fold(docs, _caches())
+    off, _st2, _s2 = _fold(docs, {})
+    assert on == oracle
+    assert off == oracle
+    assert _digests(replay_tree_batch(list(docs))) == oracle
+
+
+def test_warm_summary_reentry_through_the_tiers():
+    """A warm base summary re-enters the kernel as packed base state;
+    the warm window then serves exact from tiers 2/2.5/0 with zero
+    upload and only the digest plane downloaded."""
+    from fluidframework_tpu.dds.tree import SharedTree
+
+    docs = []
+    for k in range(4):
+        _f, _t, log, fs, fm = run_fuzz_doc(7000 + k, steps=36,
+                                           with_moves=(k % 2 == 0))
+        mid = len(log) // 2
+        base = SharedTree("t")
+        for m in log[:mid]:
+            base.process(m, local=False)
+        docs.append(TreeDocInput(
+            f"w{k}", ops=log[mid:], base_summary=base.summarize(),
+            final_seq=fs, final_msn=fm,
+            cache_token=("ep", f"w{k}", 0, "")))
+    oracle = [oracle_fallback_summary(d).digest() for d in docs]
+    caches = _caches()
+    cold, _stage, _stats = _fold(docs, caches)
+    warm, stage, stats = _fold(docs, caches)
+    assert cold == oracle and warm == oracle
+    # Warm-base docs without fallback shapes serve exact: zero h2d, the
+    # digest plane only on d2h.
+    n_device = stats.get("delta_docs", 0)
+    assert n_device >= 1
+    assert stage.get("h2d_bytes", 0) == 0
+    assert caches["pack_cache"].stats()["exact_hits"] >= 1
+    assert caches["device_cache"].stats()["served"] >= 1
+
+
+def test_grown_tail_suffix_hits_and_splice():
+    """A grown tail extends the cached window: tier 2 packs ONLY the
+    suffix (suffix_hits), tier 2.5 splices in place (spliced) with the
+    h2d bytes collapsing to the new rows, and the bytes stay oracle-
+    identical."""
+    base = _fuzz_docs(31, cut=3)
+    full = _fuzz_docs(31, cut=0)
+    oracle = [oracle_fallback_summary(d).digest() for d in full]
+    caches = _caches()
+    _fold(base, caches)
+    grown, stage, _stats = _fold(full, caches)
+    assert grown == oracle
+    assert caches["pack_cache"].stats()["suffix_hits"] >= 1
+    assert caches["device_cache"].stats()["spliced"] >= 1
+    _off, stage_off, _s = _fold(full, {})
+    assert stage["h2d_bytes"] < stage_off["h2d_bytes"], (
+        "suffix splice did not shrink the upload")
+
+
+def test_second_splice_advances_the_watermark():
+    """Two consecutive grown-tail splices: the resident entry's edit-row
+    watermark must advance with each splice (review-found: a stale
+    watermark makes every later splice re-upload all rows since the
+    last full store), so the second splice gathers only the SECOND
+    round's rows — and the bytes stay oracle-identical."""
+    from fluidframework_tpu.ops.tree_pipeline import TreeDeviceOps
+
+    base = _fuzz_docs(31, cut=4)
+    mid = _fuzz_docs(31, cut=2)
+    full = _fuzz_docs(31, cut=0)
+    caches = _caches()
+    _fold(base, caches)
+    _fold(mid, caches)
+    grown, _stage, _stats = _fold(full, caches)
+    assert grown == [oracle_fallback_summary(d).digest() for d in full]
+    dev = caches["device_cache"]
+    assert dev.stats()["spliced"] == 2
+    (entry,) = dev._entries.values()
+    np.testing.assert_array_equal(
+        np.asarray(entry.t_rows), TreeDeviceOps.t_rows(entry.ops))
+
+
+def test_forced_repack_on_bucket_growth_still_byte_identical():
+    """A tail that blows the edit-row bucket must REPACK (no suffix
+    hit), never corrupt — the tier loses the win, keeps the bytes."""
+    msgs = synth_tree_messages(3, 120)  # wide-container shape
+    base = [tree_doc(3, msgs, 30)]      # bucket 32
+    full = [tree_doc(3, msgs, 120)]     # bucket 128: forced repack
+    caches = _caches()
+    _fold(base, caches)
+    grown, _stage, _stats = _fold(full, caches)
+    assert grown == [oracle_fallback_summary(full[0]).digest()]
+    assert caches["pack_cache"].stats()["suffix_hits"] == 0
+    assert caches["pack_cache"].stats()["misses"] >= 2
+    assert caches["device_cache"].stats()["spliced"] == 0
+
+
+def test_duplicate_id_suffix_forces_repack_never_corrupts():
+    """A grown tail whose suffix re-inserts an ALREADY-INTERNED node id
+    (nothing validates client-minted ids) rewrites a row BELOW the
+    cached watermark — which the device splice could never mirror.  The
+    extension must bail to a full repack, bytes staying identical to
+    the caches-off fold and the oracle."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    def msg(seq, edits):
+        return SequencedMessage(
+            seq=seq, client_id="c0", client_seq=seq, ref_seq=seq - 1,
+            min_seq=0, type=MessageType.OP, contents={"edits": edits})
+
+    def spec(nid, value):
+        return {"id": nid, "type": "n", "value": value}
+
+    def ins(nid, value, field="a"):
+        return {"kind": "insert", "parent": "", "field": field,
+                "anchor": None, "content": [spec(nid, value)]}
+
+    log = [msg(1, [ins("n1", 1)]), msg(2, [ins("n2", 2)]),
+           msg(3, [ins("n1", 99)]),           # the duplicate-id suffix
+           msg(4, [ins("n3", 3, field="b")])]
+
+    def doc(n):
+        window = log[:n]
+        return TreeDocInput(
+            "dupdoc", ops=window, final_seq=window[-1].seq,
+            cache_token=("ep", "dupdoc", 0, ""))
+
+    caches = _caches()
+    _fold([doc(2)], caches)
+    grown, _stage, _stats = _fold([doc(4)], caches)
+    assert grown == [oracle_fallback_summary(doc(4)).digest()]
+    off, _st, _s = _fold([doc(4)], {})
+    assert off == grown
+    assert caches["pack_cache"].stats()["suffix_hits"] == 0, (
+        "duplicate-id suffix must force a full repack")
+    assert caches["device_cache"].stats()["spliced"] == 0
+
+
+def test_partial_delta_gather_serves_unchanged_docs():
+    """One chunk, SOME docs grown: the tier-0 route goes partial — the
+    unchanged docs serve cached summaries, only the changed docs' forest
+    rows cross — and the merged result is byte-identical."""
+    streams = [synth_tree_messages(100 + i, 40) for i in range(8)]
+    # keep non-fallback shapes so every doc stays on the device path
+    streams = [s for i, s in enumerate(streams)
+               if tree_shape(100 + i) in ("deep-move", "wide-container")]
+    base = [tree_doc(i, s, len(s) - 2) for i, s in enumerate(streams)]
+    grown = [tree_doc(i, s, len(s) if i % 2 else len(s) - 2)
+             for i, s in enumerate(streams)]
+    oracle = [oracle_fallback_summary(d).digest() for d in grown]
+    caches = _caches()
+    _fold(base, caches)
+    got, _stage, stats = _fold(grown, caches)
+    assert got == oracle
+    delta = caches["delta_cache"].stats()
+    assert delta["served"] >= 1, delta
+    # a grown doc drifts its HOST ANCHOR (window length moved), which is
+    # a tier-0 miss — `changed` is reserved for digest mismatches under
+    # a matching anchor (pinned in tests/test_delta_download.py)
+    assert delta["misses"] >= 1, delta
+    assert stats.get("delta_docs", 0) >= 1
+
+
+def test_mesh_tree_stack_parity_and_stage_schema():
+    """The forced 8-device CPU mesh (conftest) serves the IDENTICAL
+    four-tier stack: byte parity with the single-device pipeline, warm
+    serves from the resident tier, and the same stage-key schema."""
+    from fluidframework_tpu.parallel.shard import (
+        doc_mesh,
+        replay_tree_sharded,
+    )
+
+    docs = _fuzz_docs(21, n=5)
+    oracle = [oracle_fallback_summary(d).digest() for d in docs]
+    pack, dev, delta = tree_pack_cache(), tree_device_cache(), \
+        DeltaExportCache()
+    stage: dict = {}
+    stats: dict = {}
+    mesh = doc_mesh()
+    cold = _digests(replay_tree_sharded(
+        docs, mesh=mesh, stage=stage, stats=stats, pack_cache=pack,
+        delta_cache=delta, device_cache=dev))
+    assert cold == oracle
+    single_stage: dict = {}
+    single = _digests(pipelined_tree_replay(docs, chunk_docs=8,
+                                            stage=single_stage))
+    assert single == oracle
+    assert set(stage) == set(single_stage), (
+        f"mesh stage schema {sorted(stage)} != "
+        f"single-device {sorted(single_stage)}")
+    warm_stage: dict = {}
+    warm = _digests(replay_tree_sharded(
+        docs, mesh=mesh, stage=warm_stage, pack_cache=pack,
+        delta_cache=delta, device_cache=dev))
+    assert warm == oracle
+    assert dev.stats()["served"] >= 1
+    assert pack.stats()["exact_hits"] >= 1
+    assert warm_stage.get("h2d_bytes", 0) == 0
+    # the digest plane is the only d2h traffic on a fully-served chunk
+    assert warm_stage.get("d2h_bytes", 0) <= 8 * (len(docs) + mesh.size)
+
+
+def test_tree_digest_is_padding_invariant():
+    """An unchanged document's digest survives a NEIGHBOUR's growth
+    (bucket padding moves, its own rows do not)."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.tree_kernel import pack_tree_batch
+    from fluidframework_tpu.ops.tree_pipeline import (
+        _tree_export_fn,
+    )
+
+    small = [tree_doc(5, synth_tree_messages(5, 24), 24),
+             tree_doc(7, synth_tree_messages(7, 12), 12)]
+    big = [small[0],
+           tree_doc(9, synth_tree_messages(9, 100), 100)]
+
+    def digest_of(docs, d):
+        state, edits, meta = pack_tree_batch(docs)
+        out = _tree_export_fn(True)(
+            state, edits, jnp.asarray(meta["n_nodes"]),
+            jnp.asarray(meta["n_cont"]))
+        return tuple(np.asarray(out[-1])[d])
+
+    assert digest_of(small, 0) == digest_of(big, 0)
+    assert digest_of(small, 0) != digest_of(small, 1)
+
+
+def test_tree_collab_swarm_converges_and_probes_the_tree_tiers():
+    """The fluidscale tree-collab family: boxed tree changesets through
+    the real sharded service, oracle-twin convergence, and the
+    fold_probe catching sampled docs up through the REAL CatchupService
+    tree route (the second family's serving-tier counters live)."""
+    from fluidframework_tpu.testing.scenarios import (
+        build_scenario,
+        run_swarm,
+        run_swarm_with_oracle,
+    )
+
+    spec = build_scenario("tree-collab", seed=4, clients=300, docs=4,
+                          shards=2)
+    spec = dataclasses.replace(spec, fold_probe=True, sample_every=2)
+    result, oracle = run_swarm_with_oracle(spec)
+    assert result.sampled_digests == oracle.sampled_digests
+    assert result.per_doc_head == oracle.per_doc_head
+    assert result.ops_stamped > 0
+    tier = result.fold_tier
+    assert tier["tree_pack_cache"]["exact_hits"] >= 1, tier
+    assert tier["tree_device_cache"]["served"] >= 1, tier
+    assert tier["fallback_channels"] == 0
+    # replay identity survives the new per-client tree bookkeeping
+    again = run_swarm(dataclasses.replace(spec, fold_probe=False))
+    probe_free = dataclasses.replace(spec, fold_probe=False)
+    assert run_swarm(probe_free).identity() == again.identity()

@@ -51,6 +51,10 @@ GATES_OPS_PER_SEC = {
     "steady-typing": 3000.0,
     "catchup-herd": 3000.0,
     "laggard-window": 3000.0,
+    # tree changesets ride the boxed envelope path by design (outside
+    # the closed columnar vocabulary), so the floor sits at the boxed
+    # rate, not the columnar one.
+    "tree-collab": 1000.0,
     "failover-drill": 2000.0,
 }
 
@@ -62,6 +66,7 @@ GATES_OPS_PER_SEC_PROC = {
     "steady-typing": 300.0,
     "catchup-herd": 300.0,
     "laggard-window": 300.0,
+    "tree-collab": 100.0,
     "failover-drill": 200.0,
 }
 
@@ -85,15 +90,19 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
     spec = dataclasses.replace(spec, columnar=columnar,
                                sample_every=sample_every,
                                out_of_proc=out_of_proc,
-                               # catchup-herd is the fold-tier scenario:
-                               # after the swarm run its sampled docs
-                               # catch up cold+warm through the REAL
-                               # CatchupService so the report carries the
-                               # resident-tier counters (ISSUE 13) —
-                               # served / spliced / evictions /
-                               # bytes_saved next to delta + pack stats.
-                               fold_probe=(name == "catchup-herd"
-                                           and not out_of_proc))
+                               # catchup-herd and tree-collab are the
+                               # fold-tier scenarios: after the swarm run
+                               # their sampled docs catch up cold+warm
+                               # through the REAL CatchupService so the
+                               # report carries the resident-tier
+                               # counters (ISSUE 13) — served / spliced /
+                               # evictions / bytes_saved next to delta +
+                               # pack stats — and, for tree-collab, the
+                               # SECOND kernel family's tree-tier
+                               # counters (ISSUE 14).
+                               fold_probe=(
+                                   name in ("catchup-herd", "tree-collab")
+                                   and not out_of_proc))
     t0 = time.time()
     result = run_swarm(spec)
     wall = time.time() - t0  # the gated number times the PRIMARY run only
