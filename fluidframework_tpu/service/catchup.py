@@ -307,18 +307,48 @@ class CatchupService:
 
     # -- public API ------------------------------------------------------------
 
+    def catch_up_cached(
+        self,
+        doc_ids: Optional[Sequence[str]] = None,
+        upload: bool = True,
+        join_timeout: Optional[float] = None,
+    ) -> Tuple[Dict[str, Tuple[str, int]], bool]:
+        """The tier-0/1 WARM pass alone: ``(results, complete)`` where
+        ``complete`` means every requested document was served without
+        any device work — from the result cache, a single-flight join
+        on another caller's in-flight fold, or the no-new-ops fast path
+        — and the caller can skip the fold lane entirely.  This is the
+        server's admission priority lane (ISSUE 15): warm readers must
+        never queue behind cold folds, and a herd joining one in-flight
+        fold costs the leader's ONE admission slot.  ``join_timeout``
+        bounds the single-flight wait (defaults to the service's
+        ``Catchup.JoinTimeout``); the server passes a SHORT bound so a
+        wedged leader turns joiners into fold-lane requests — where
+        admission sheds with pacing — instead of parking them on
+        executor threads.  ``({}, False)`` when the result cache is
+        disabled."""
+        if self.cache is None:
+            return {}, False
+        return self._serve_cached(doc_ids, upload,
+                                  join_timeout=join_timeout)
+
     def catch_up(
         self,
         doc_ids: Optional[Sequence[str]] = None,
         upload: bool = True,
         stats: Optional[dict] = None,
+        prefetched: Optional[Dict[str, Tuple[str, int]]] = None,
     ) -> Dict[str, Tuple[str, int]]:
         """Fold each document's tail; returns {doc_id: (handle, seq)}.
         Documents with no new ops keep their current summary handle.
         ``stats`` (optional dict) receives this call's own
         ``deviceDocs``/``cpuDocs``/``hostChannels`` deltas, computed under
         the serialization lock so concurrent callers' documents never leak
-        into each other's numbers.
+        into each other's numbers.  ``prefetched`` carries results a
+        caller's OWN :meth:`catch_up_cached` pass already served (the
+        server's warm lane): the internal cached pass is skipped so those
+        documents' metadata scans — and their cache hit counts — never
+        run twice.
 
         With the ``Catchup.ProfileDir`` config gate set (or
         ``FLUID_TPU_CATCHUP_PROFILEDIR``), each bulk fold is wrapped in a
@@ -328,8 +358,13 @@ class CatchupService:
 
         from ..utils.telemetry import PerformanceEvent
 
-        prefetched: Dict[str, Tuple[str, int]] = {}
-        if self.cache is not None:
+        # None = no warm pass ran yet (run ours); a dict — even an empty
+        # one — means the CALLER's warm pass already scanned, and
+        # re-scanning here would duplicate the metadata/tail reads and
+        # double-count cache hits.
+        skip_warm = prefetched is not None
+        prefetched = dict(prefetched or {})
+        if self.cache is not None and not skip_warm:
             served, complete = self._serve_cached(doc_ids, upload)
             if complete:
                 # Pure cache serve: no fold ran, all deltas are zero.
@@ -375,15 +410,22 @@ class CatchupService:
                 stats.update(deltas)
             return results
 
-    def _cache_key(self, doc_id: str, base_handle: str, ref_seq: int,
-                   tail: Sequence[SequencedMessage]) -> tuple:
+    def _cache_key_at(self, doc_id: str, base_handle: str, ref_seq: int,
+                      head_seq: int) -> tuple:
         """Seq-anchored identity of one fold's full input: the store
         generation pins the namespace, the base summary HANDLE (the
         commit's tree digest — never re-hashed here) pins the summary
         bytes, and (ref_seq, head seq) pins the tail bytes — the op log
         is append-only, so the range IS the content."""
         return (self.service.storage.epoch, doc_id, base_handle,
-                ref_seq, tail[-1].seq)
+                ref_seq, head_seq)
+
+    def _cache_key(self, doc_id: str, base_handle: str, ref_seq: int,
+                   tail: Sequence[SequencedMessage]) -> tuple:
+        """:meth:`_cache_key_at` over a materialized tail (seqs are
+        contiguous, so the last message's seq IS the durable head)."""
+        return self._cache_key_at(doc_id, base_handle, ref_seq,
+                                  tail[-1].seq)
 
     def _finish_result(self, doc_id: str, fold, seq: int,
                        upload: bool) -> Tuple[str, int]:
@@ -397,7 +439,8 @@ class CatchupService:
                 doc_id, fold.tree, seq, handle=fold.handle), seq
         return fold.handle, seq
 
-    def _serve_cached(self, doc_ids, upload: bool):
+    def _serve_cached(self, doc_ids, upload: bool,
+                      join_timeout: Optional[float] = None):
         """As much of the request as tier 1 can serve: ``(results,
         complete)`` where ``complete`` means every document was served
         and the caller can skip the fold path entirely.  Runs WITHOUT
@@ -405,7 +448,14 @@ class CatchupService:
         that fold (single-flight) instead of queueing behind the device.
         Stops at the first miss — the fold pass re-reads the remaining
         docs under the lock anyway, so scanning past the miss would be
-        pure duplicated work."""
+        pure duplicated work.  Deliberately O(1) per document on the
+        storage side: the cache key needs only the durable HEAD seq
+        (appends are contiguous, so the head IS the last tail seq), so
+        a request that ends up SHED never materialized a single op —
+        the pre-admission warm probe must not cost what admission
+        exists to bound."""
+        if join_timeout is None:
+            join_timeout = self.join_timeout
         results: Dict[str, Tuple[str, int]] = {}
         for doc_id in (doc_ids if doc_ids is not None
                        else self.service.doc_ids()):
@@ -413,13 +463,18 @@ class CatchupService:
                 self.service.storage.latest_with_handle(doc_id)
             if summary is None:
                 continue
-            tail = self.service.oplog.get(doc_id, from_seq=ref_seq)
-            if not tail:
+            head = self.service.oplog.head(doc_id)
+            if head <= ref_seq:
                 results[doc_id] = (handle, ref_seq)
                 continue
             fold = self.cache.join(
-                self._cache_key(doc_id, handle, ref_seq, tail),
-                timeout=self.join_timeout,
+                self._cache_key_at(doc_id, handle, ref_seq, head),
+                timeout=join_timeout,
+                # Only a wait that exhausted the service's full
+                # crashed-leader bound may reap the flight; a caller's
+                # deliberately shorter wait (the warm priority lane)
+                # just stops waiting.
+                reap_on_timeout=join_timeout >= self.join_timeout,
             )
             if fold is None:
                 # Nothing cached/in flight — or the bounded wait expired
@@ -429,7 +484,7 @@ class CatchupService:
                 # fold path re-claims the key: begin() leads.
                 return results, False  # at least one real fold needed
             results[doc_id] = self._finish_result(
-                doc_id, fold, tail[-1].seq, upload)
+                doc_id, fold, head, upload)
         return results, True
 
     def _catch_up(  # holds-lock: _serial

@@ -212,20 +212,24 @@ class CatchupResultCache:
             flight.done.set()
 
     def join(self, key: tuple,
-             timeout: Optional[float] = DEFAULT_JOIN_TIMEOUT
-             ) -> Optional[CachedFold]:
+             timeout: Optional[float] = DEFAULT_JOIN_TIMEOUT,
+             reap_on_timeout: bool = True) -> Optional[CachedFold]:
         """Wait-or-read: the cached (tree, handle); else, when a leader
         is in flight, block until it publishes and return its result
         (None if it abandoned or ``timeout`` elapsed); else None
         immediately.
 
-        A timeout presumes the leader crashed without reaching its
-        finally-abandon: the flight is removed — only if it is still
-        THE flight this caller waited on, so a fresh leader's flight is
-        never popped — and its event set, waking every other waiter
-        stuck on the dead leader (they retry or fold themselves).  A
-        merely-slow leader losing its flight is benign: ``finish`` on a
-        popped flight still publishes to the LRU."""
+        With ``reap_on_timeout`` (the default), a timeout presumes the
+        leader crashed without reaching its finally-abandon: the flight
+        is removed — only if it is still THE flight this caller waited
+        on, so a fresh leader's flight is never popped — and its event
+        set, waking every other waiter stuck on the dead leader (they
+        retry or fold themselves).  A merely-slow leader losing its
+        flight is benign: ``finish`` on a popped flight still publishes
+        to the LRU.  Callers waiting a DELIBERATELY short bound (the
+        server's warm priority lane giving up and taking the admission
+        fold lane instead) pass ``reap_on_timeout=False`` — an impatient
+        reader must not tear down a live leader's flight."""
         with self._lock:
             found = self._get_locked(key)
             if found is not None:
@@ -236,7 +240,8 @@ class CatchupResultCache:
                 return None  # probe only: begin() counts the miss
             self.counters.bump("waits")
         if not flight.done.wait(timeout):
-            self._reap_flight(key, flight)
+            if reap_on_timeout:
+                self._reap_flight(key, flight)
             return None
         return flight.result
 

@@ -53,6 +53,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -286,13 +287,33 @@ class ThreadShard(ShardHandle):
 
 
 class _FrontSession:
-    """One client connection's server-side state on the front door."""
+    """One client connection's server-side state on the front door.
 
-    def __init__(self, sock: socket.socket) -> None:
+    Broadcast relay frames do NOT write to the socket inline: they
+    queue under a per-client byte budget and a lazily-started writer
+    thread drains them (ISSUE 15).  Before this, ``_relay_event`` ran a
+    blocking ``sendall`` per subscribed session on the shard-RPC
+    dispatcher thread — ONE stalled reader blocked every other client's
+    events and its kernel-plus-process buffering grew unboundedly.  Now
+    a stalled reader saturates ITS OWN queue (bounded by
+    ``relay_budget``) and the front door demotes it — the existing
+    broadcaster demotion contract, applied at the relay hop."""
+
+    def __init__(self, sock: socket.socket,
+                 relay_budget: int = 4 << 20) -> None:
         self.sock = sock
         self._write_lock = threading.Lock()
         self.subscribed: Set[str] = set()
         self.closed = False
+        self.relay_budget = int(relay_budget)
+        #: a Condition so the writer thread sleeps until a frame arrives
+        #: (or close()) instead of idle-polling for the session lifetime
+        self._relay_lock = threading.Condition()
+        self._relay_q: "deque[bytes]" = deque()  # guarded-by: _relay_lock
+        self._relay_bytes = 0  # guarded-by: _relay_lock
+        #: lazily started on the first relayed frame — sessions that
+        #: never subscribe (the 10⁴-connection shape) cost no thread.
+        self._relay_thread: Optional[threading.Thread] = None  # guarded-by: _relay_lock
 
     def write(self, obj: dict) -> None:
         self.write_bytes(frame_bytes(obj))
@@ -306,10 +327,79 @@ class _FrontSession:
         except OSError:
             self.closed = True
 
+    # -- bounded broadcast relay (per-client flow control) ---------------------
+
+    def relay(self, data: bytes) -> bool:
+        """Bounded enqueue of one broadcast frame: False = the budget
+        is exhausted (a stalled or slow reader) and the caller demotes
+        this session — the broadcaster's sink contract at this hop.
+        A frame larger than the whole budget is still accepted into an
+        EMPTY queue (charged in flight): otherwise one oversized event
+        would demote every subscriber — idle fast readers included — on
+        every occurrence, forever.  Memory stays bounded by
+        ``max(relay_budget, one frame)``."""
+        if self.closed:
+            return True  # tearing down: drop silently, like the server sink
+        with self._relay_lock:
+            if self._relay_bytes > 0 \
+                    and self._relay_bytes + len(data) > self.relay_budget:
+                return False
+            self._enqueue_locked(data)
+        return True
+
+    def relay_priority(self, data: bytes) -> None:
+        """Budget-exempt, queue-jumping enqueue for CONTROL frames
+        (demoted / fence): bounded by construction — at most one per
+        (doc, event) — and they must reach a saturated client
+        PROMPTLY, not behind its whole data backlog (the demotion
+        notice IS the recovery trigger the driver's re-subscribe
+        rides; receivers dedup any stale data frames that drain after
+        it by seq watermark)."""
+        if self.closed:
+            return
+        with self._relay_lock:
+            self._enqueue_locked(data, front=True)
+
+    def _enqueue_locked(self, data: bytes, front: bool = False) -> None:
+        if front:
+            self._relay_q.appendleft(data)
+        else:
+            self._relay_q.append(data)
+        self._relay_bytes += len(data)
+        self._relay_lock.notify()
+        if self._relay_thread is None:
+            self._relay_thread = threading.Thread(target=self._relay_loop,
+                                                  daemon=True)
+            self._relay_thread.start()
+
+    def relay_pending(self) -> int:
+        with self._relay_lock:
+            return self._relay_bytes
+
+    def _relay_loop(self) -> None:
+        while True:
+            with self._relay_lock:
+                while not self._relay_q and not self.closed:
+                    # bounded wait: re-checks closed even if a racing
+                    # close() slipped between the notify and this wait
+                    self._relay_lock.wait(timeout=0.5)
+                if not self._relay_q and self.closed:
+                    return
+                data = self._relay_q.popleft()
+            # Send OUTSIDE the queue lock (the socket may block on a
+            # slow reader for arbitrarily long); the frame stays
+            # budget-charged (``_relay_bytes``) until the kernel
+            # accepted it, so in-flight bytes count against the budget.
+            self.write_bytes(data)
+            with self._relay_lock:
+                self._relay_bytes -= len(data)
+
     def close(self) -> None:
         if self.closed:
             return
         self.closed = True
+        with self._relay_lock:
+            self._relay_lock.notify_all()  # wake the writer to exit
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -336,7 +426,8 @@ class FrontDoor:
                  heartbeat_interval: Optional[float] = None,
                  hang_detect_ticks: int = 2, mc=None,
                  shard_fault_plan_path: Optional[str] = None,
-                 request_timeout: float = 30.0) -> None:
+                 request_timeout: float = 30.0,
+                 relay_budget: int = 4 << 20) -> None:
         if spawn not in ("proc", "thread"):
             raise ValueError(f"unknown spawn backend {spawn!r}")
         ids = (list(shard_ids) if shard_ids is not None
@@ -358,9 +449,15 @@ class FrontDoor:
         #: only discovered when a request against it expires — harnesses
         #: drop this so hang windows cost seconds, not the 30 s default.
         self.request_timeout = float(request_timeout)
+        #: per-client broadcast-relay byte budget (ISSUE 15): queued +
+        #: in-flight relay bytes above this demote the session for the
+        #: saturating document — bounded memory per laggard, no relay
+        #: stall for anyone else.
+        self.relay_budget = int(relay_budget)
         self.counters = LockedCounterSet(
             "fd.requests", "fd.failovers", "fd.adoptions", "fd.migrations",
             "fd.retries", "fd.events", "fd.hangs", "fd.heartbeat_failures",
+            "fd.relay_demotions",
         )
         #: routing state — every map below is dict-operations-only under
         #: the route lock; RPC never happens while it is held.
@@ -593,7 +690,7 @@ class FrontDoor:
                 continue  # periodic shutdown check
             except OSError:
                 return  # listener closed (shutdown)
-            session = _FrontSession(conn)
+            session = _FrontSession(conn, relay_budget=self.relay_budget)
             with self._route_lock:
                 self._sessions.append(session)
             thread = threading.Thread(target=self._serve_client,
@@ -739,7 +836,8 @@ class FrontDoor:
                 doc_ids = sorted(self._docs)
         groups = self._group_by_owner(doc_ids)
         merged = {"docs": {}, "skipped": [], "deviceDocs": 0, "cpuDocs": 0,
-                  "cache": None, "deltaCache": None}
+                  "cache": None, "deltaCache": None, "lane": None,
+                  "lanes": {}, "degraded": []}
         for sid, docs in sorted(groups.items()):
             part = self._shard(sid).request(
                 "catchup", dict(params, docs=docs))
@@ -747,7 +845,17 @@ class FrontDoor:
             merged["skipped"].extend(part.get("skipped", ()))
             merged["deviceDocs"] += part.get("deviceDocs", 0)
             merged["cpuDocs"] += part.get("cpuDocs", 0)
+            merged["degraded"].extend(part.get("degraded", ()))
+            merged["lanes"][sid] = part.get("lane")
         merged["skipped"] = sorted(merged["skipped"])
+        merged["degraded"] = sorted(merged["degraded"])
+        # One summary lane for single-shard callers; the per-shard split
+        # stays in "lanes".  Worst lane wins: any degraded answer makes
+        # the merged answer degraded (a stale doc is in there somewhere).
+        lanes = set(merged["lanes"].values())
+        merged["lane"] = ("degraded" if "degraded" in lanes
+                          else "fold" if "fold" in lanes
+                          else "warm" if lanes else None)
         return merged
 
     def _submit_mixed(self, params: dict) -> Dict[str, dict]:
@@ -830,7 +938,31 @@ class FrontDoor:
         self.counters.bump("fd.events")
         data = frame_bytes(frame)  # ONE encode for every client session
         for session in sessions:
-            session.write_bytes(data)
+            if not session.relay(data):
+                self._demote_relay(session, doc_id)
+
+    def _demote_relay(self, session: _FrontSession, doc_id: str) -> None:
+        """Per-client relay flow control tripped (ISSUE 15): remove the
+        laggard session from this document's fan-out and tell it once —
+        the client driver re-subscribes and gap-repairs from durable
+        deltas, the exact broadcaster demotion contract (SEMANTICS.md
+        "Delivery and backpressure") applied at the front-door hop.
+        The session's OTHER documents are untouched (it may be current
+        on them), and no other session ever waits on the laggard."""
+        with self._route_lock:
+            subs = self._subs.get(doc_id)
+            if subs is None or session not in subs:
+                return  # already demoted by a racing relay fan-out
+            subs.remove(session)
+            # Under the lock: _drop_session iterates session.subscribed
+            # while holding it, and this is the one cross-thread writer
+            # (every other touch happens on the session's own serve
+            # thread).
+            session.subscribed.discard(doc_id)
+        self.counters.bump("fd.relay_demotions")
+        session.relay_priority(frame_bytes(
+            {"v": WIRE_VERSION, "event": "demoted", "doc": doc_id,
+             "head": 0}))
 
     def _relay_demoted(self, frame: dict) -> None:
         """The shard's broadcaster demoted the FRONT DOOR (we lagged):
@@ -839,13 +971,15 @@ class FrontDoor:
         durable deltas, the exact single-server recovery path.  Handler
         registrations stay (``_tap_registered``): they belong to the
         connection, and re-adding them on re-subscribe would
-        double-deliver every later event."""
+        double-deliver every later event.  Rides the priority relay
+        path: a demotion notice must reach even a budget-saturated
+        client."""
         doc_id = frame.get("doc", "")
         with self._route_lock:
             sessions = list(self._subs.get(doc_id, ()))
         data = frame_bytes(frame)
         for session in sessions:
-            session.write_bytes(data)
+            session.relay_priority(data)
 
     def _retap(self, doc_id: str, head: int) -> None:
         """Failover/migration re-wiring: move the upstream tap to the
@@ -859,7 +993,9 @@ class FrontDoor:
                  "epoch": self.epoch, "head": head}
         data = frame_bytes(frame)
         for session in sessions:
-            session.write_bytes(data)
+            # Control frame: budget-exempt — a fenced client must learn
+            # the new epoch even when its relay queue is saturated.
+            session.relay_priority(data)
 
     # -- supervision: death detection + failover -------------------------------
 
@@ -1335,6 +1471,7 @@ class FrontDoor:
             handles = sorted(self._shards.items())
             migrations = list(self.migrations)
             fences = self.fences
+            sessions = list(self._sessions)
         shards = {}
         for sid, handle in handles:
             if sid in self.router.dead() or not handle.alive():
@@ -1348,6 +1485,14 @@ class FrontDoor:
                     "stats", {}, timeout=min(self.request_timeout, 5.0))
             except (RpcError, OSError, ConnectionError) as exc:
                 shards[sid] = {"error": str(exc)}
+        # Supervisor-view rollup (ISSUE 15 satellite): each shard host
+        # snapshots its catchup admission counters locally, but an
+        # operator watching a storm needs the TIER's overload picture in
+        # one place — sum every live shard's admission counters here.
+        admission: Dict[str, int] = {}
+        for per_shard in shards.values():
+            for key, value in (per_shard.get("admission") or {}).items():
+                admission[key] = admission.get(key, 0) + int(value)
         return {
             "shards": shards,
             "alive": self.router.alive(),
@@ -1357,6 +1502,16 @@ class FrontDoor:
             "fences": fences,
             "migrations": [list(m) for m in migrations],
             "counters": self.counters.snapshot(),
+            "admission": admission,
+            # per-client relay flow control health: live client
+            # sessions, bytes currently queued across them, the
+            # per-session budget (demotions are in counters).
+            "relay": {
+                "sessions": len(sessions),
+                "pending_bytes": sum(s.relay_pending()
+                                     for s in sessions),
+                "budget_per_session": self.relay_budget,
+            },
         }
 
 

@@ -85,7 +85,8 @@ class RetryPolicy:
         ShardFencedError retryable by re-resolving before the next
         attempt.  ``counters`` (when given) receives ``retry.attempts``,
         ``retry.retries``, ``retry.fence_resolves``,
-        ``retry.exhausted`` bumps — the bench/oracle surface.
+        ``retry.nack_holds``, ``retry.exhausted`` bumps — the
+        bench/oracle surface.
         """
         do_sleep = sleep if sleep is not None else time.sleep
         dice = rng if rng is not None else random.Random(0)
@@ -112,6 +113,12 @@ class RetryPolicy:
                 delay = 0.0  # re-resolve IS the recovery; no backoff
             except NackError as exc:
                 last = exc
+                # The server's own pacing (retry_after) is never
+                # undercut — with the round-15 adaptive admission it is
+                # load-derived, not a constant, so the hold is the
+                # overload signal worth counting.
+                if counters is not None:
+                    counters.bump("retry.nack_holds")
                 delay = max(self.delay_for(attempt, dice),
                             float(exc.retry_after))
             except retry_on as exc:

@@ -33,7 +33,8 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
-from typing import Dict, Optional, Set
+import time
+from typing import Dict, Optional, Set, Tuple
 
 from ..protocol.messages import (DocRelocatedError, NackError,
                                  ShardFencedError)
@@ -57,6 +58,114 @@ class EpochMismatch(Exception):
             f"state is from a dead generation)"
         )
         self.server_epoch = server_epoch
+
+
+class AdmissionController:
+    """Adaptive admission for the catch-up fold lane (ISSUE 15).
+
+    The round-9 controller was a fixed-size semaphore whose shed nack
+    carried a hardcoded ``retry_after=0.5`` — pacing that had never been
+    hit by the storm it exists for.  This controller derives both the
+    shed decision and the pacing from MEASURED load, entirely off an
+    injectable clock, so a deterministic harness (VirtualClock) replays
+    every admission decision bit-identically:
+
+    - a fold holds a **lease** from admit until release; ``release`` may
+      carry a ``hold`` — extra clock time the slot stays occupied after
+      the synchronous call returns.  Production releases with hold 0
+      (the slot frees when the fold thread finishes); the swarm storm
+      harness models fold DURATION in virtual time this way, which is
+      what lets a single-threaded deterministic driver produce real
+      overlapping-fold admission pressure.
+    - ``retry_after`` = measured fold cost (EMA over released leases) ×
+      backlog-per-slot, clamped to ``[retry_floor, retry_cap]``: a
+      deeper queue paces retries further out, a fast fold tier calls
+      the herd back sooner.
+    - sustained overload — ``degrade_after`` consecutive overflow
+      verdicts with no slot freed between them — flips the verdict from
+      ``shed`` to ``degrade``: the server answers with the stored
+      summary at an older ref_seq (see ``_degraded_serve``) instead of
+      pure refusal.
+    """
+
+    def __init__(self, max_inflight: int, clock=None,
+                 retry_floor: float = 0.05, retry_cap: float = 5.0,
+                 degrade_after: int = 2,
+                 cost_init: float = 0.25) -> None:
+        #: injected clock (seconds); time.monotonic in production,
+        #: a VirtualClock in deterministic harnesses.
+        self._clock = clock if clock is not None else time.monotonic
+        self.max_inflight = max(1, int(max_inflight))
+        self.retry_floor = float(retry_floor)
+        self.retry_cap = float(retry_cap)
+        self.degrade_after = max(0, int(degrade_after))
+        self._lock = threading.Lock()
+        #: token -> [admitted_at, expires]; expires None = still in
+        #: flight (never expires), a float = released-with-hold lease
+        #: that keeps occupying its slot until that clock time.
+        self._leases: Dict[int, list] = {}  # guarded-by: _lock
+        self._next_token = 0  # guarded-by: _lock
+        self._cost_ema = float(cost_init)  # guarded-by: _lock
+        #: consecutive overflow verdicts since the last admit — the
+        #: sustained-overload signal and the queue-depth estimate (each
+        #: consecutive shed implies another caller waiting out there).
+        self._shed_streak = 0  # guarded-by: _lock
+
+    def _purge_locked(self, now: float) -> None:
+        expired = [token for token, lease in self._leases.items()
+                   if lease[1] is not None and lease[1] <= now]
+        for token in expired:
+            self._leases.pop(token)
+
+    def admit(self) -> Tuple[str, object]:
+        """One admission decision: ``("admit", token)`` — the caller
+        runs its fold and MUST ``release(token)`` (try/finally) — or
+        ``("shed" | "degrade", retry_after)`` under overload."""
+        now = self._clock()
+        with self._lock:
+            self._purge_locked(now)
+            if len(self._leases) >= self.max_inflight:
+                self._shed_streak += 1
+                backlog = len(self._leases) + self._shed_streak
+                retry_after = min(self.retry_cap, max(
+                    self.retry_floor,
+                    self._cost_ema * backlog / self.max_inflight))
+                verdict = ("degrade"
+                           if self._shed_streak > self.degrade_after
+                           else "shed")
+                return verdict, retry_after
+            token = self._next_token
+            self._next_token += 1
+            self._leases[token] = [now, None]
+            self._shed_streak = 0
+            return "admit", token
+
+    def release(self, token: int, hold: float = 0.0) -> None:
+        """Fold done: record its measured cost (clock delta + ``hold``)
+        in the EMA the pacing derives from; with ``hold`` > 0 the lease
+        keeps its slot until ``now + hold`` (purged lazily by later
+        admits), else the slot frees immediately."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(token)
+            if lease is None:
+                return
+            cost = max(0.0, now - lease[0]) + max(0.0, hold)
+            if cost > 0.0:
+                self._cost_ema = 0.5 * self._cost_ema + 0.5 * cost
+            if hold > 0.0:
+                lease[1] = now + hold
+            else:
+                self._leases.pop(token)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": len(self._leases),
+                "max_inflight": self.max_inflight,
+                "cost_ema": round(self._cost_ema, 6),
+                "shed_streak": self._shed_streak,
+            }
 
 
 #: Methods offloaded to executor threads.  Shared-state discipline: lazy
@@ -229,7 +338,7 @@ class OrderingServer:
                  tenants: Optional[Dict[str, str]] = None,
                  broadcast_high_water: int = 8 << 20,
                  catchup_max_inflight: int = 4,
-                 faults=None) -> None:
+                 faults=None, clock=None, mc=None) -> None:
         #: any object with the LocalOrderingService surface — including
         #: ShardedOrderingService (the front door dispatches by its
         #: router transparently: every access goes through endpoint()).
@@ -274,19 +383,68 @@ class OrderingServer:
         #: shares, so they can never be mid-flight when it runs).
         self._inflight_lock = threading.Lock()
         self._inflight = 0  # guarded-by: _inflight_lock
+        from ..utils.telemetry import LockedCounterSet, MonitoringContext
+
+        #: logger + feature gates (Catchup.* / Server.* keys below); the
+        #: lazy CatchupService inherits it so its own cache gates read
+        #: the same config.
+        self.mc = mc if mc is not None else MonitoringContext()
+        cfg = self.mc.config
+
+        def _cfg_float(key: str, default: float) -> float:
+            raw = cfg.raw(key)
+            try:
+                return default if raw is None else float(raw)
+            except (TypeError, ValueError):
+                return default
+
+        #: injected clock for every admission/pacing decision —
+        #: time.monotonic in production, a VirtualClock (whose reads and
+        #: ``sleep`` advance virtual time) in deterministic harnesses.
+        self.clock = clock if clock is not None else time.monotonic
         #: admission control for the catchup RPC: device folds are the
         #: most expensive op the server runs — beyond this many in
         #: flight, new requests are SHED with an "overloaded" nack
-        #: (clients catch up from the durable op log instead) rather
-        #: than piling onto the executor until every connection stalls.
-        self.catchup_max_inflight = int(catchup_max_inflight)
-        self._catchup_slots = threading.BoundedSemaphore(
-            self.catchup_max_inflight)
-        from ..utils.telemetry import LockedCounterSet
-
-        #: ``catchup.admitted`` / ``catchup.shed`` — the overload surface
-        self.admission = LockedCounterSet("catchup.admitted",
-                                          "catchup.shed")
+        #: whose retry_after is derived from measured fold cost and
+        #: queue depth (clients catch up from the durable op log
+        #: instead), or — under SUSTAINED overload — served DEGRADED
+        #: from the stored summary at an older ref_seq.
+        self.catchup_max_inflight = cfg.get_int(
+            "Catchup.MaxInflight", int(catchup_max_inflight))
+        self.admission_control = AdmissionController(
+            self.catchup_max_inflight, clock=self.clock,
+            retry_floor=_cfg_float("Catchup.ShedRetryFloor", 0.05),
+            retry_cap=_cfg_float("Catchup.ShedRetryCap", 5.0),
+            degrade_after=cfg.get_int("Catchup.DegradeAfter", 2))
+        #: Catchup.DegradedServe gate (default ON): under sustained
+        #: overload serve the tier-1 stored summary at an older ref_seq
+        #: — the client replays the durable tail via normal gap repair —
+        #: instead of pure shedding.
+        self.degraded_serve = str(
+            cfg.raw("Catchup.DegradedServe") or "on"
+        ).strip().lower() not in ("off", "false", "0")
+        #: retry_after on the ``shuttingDown`` drain nack
+        #: (Server.DrainRetryAfter gate; was a hardcoded 0.5).
+        self.drain_retry_after = _cfg_float("Server.DrainRetryAfter", 0.5)
+        #: bound on the warm lane's single-flight join
+        #: (Catchup.WarmJoinTimeout): a wedged leader must turn joiners
+        #: into FOLD-LANE requests — where admission sheds with pacing —
+        #: after seconds, not park them on executor threads for the full
+        #: crashed-leader JoinTimeout (60 s).
+        self.warm_join_timeout = _cfg_float("Catchup.WarmJoinTimeout",
+                                            5.0)
+        #: modeled fold duration: extra clock seconds an admission lease
+        #: stays occupied AFTER the synchronous fold returns.  0 in
+        #: production; the deterministic storm harness sets it so
+        #: sequentially-driven folds overlap in virtual time.
+        self.catchup_hold_seconds = 0.0
+        #: the overload surface: ``catchup.requests`` counts fold-lane
+        #: entries and balances exactly — requests = admitted + shed +
+        #: degraded; ``catchup.warm`` counts priority-lane serves that
+        #: never entered the fold lane at all.
+        self.admission = LockedCounterSet(
+            "catchup.requests", "catchup.admitted", "catchup.shed",
+            "catchup.degraded", "catchup.degraded_docs", "catchup.warm")
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
         # lazy CatchupService (the "catchup" method); executor threads
@@ -387,7 +545,7 @@ class OrderingServer:
             # may touch the log once the drain sequence armed the seal.
             raise NackError(
                 "server is draining for shutdown; retry after restart",
-                retry_after=0.5, code="shuttingDown")
+                retry_after=self.drain_retry_after, code="shuttingDown")
         extra = self.extra_methods.get(method)
         if extra is not None:
             return extra(session, params)
@@ -454,25 +612,7 @@ class OrderingServer:
             )
             return True
         if method == "catchup":
-            # Admission control: the fold is the most expensive op this
-            # server runs.  Beyond catchup_max_inflight concurrent folds,
-            # SHED with a typed "overloaded" nack (retry_after carries
-            # the pacing hint) — the caller falls back to catch-up from
-            # the durable op log, which always works, instead of this
-            # queue collapsing under a herd.
-            admitted = False
-            try:
-                admitted = self._catchup_slots.acquire(blocking=False)
-                if not admitted:
-                    self.admission.bump("catchup.shed")
-                    raise NackError(
-                        "catch-up tier overloaded; backfill from deltas "
-                        "or retry", retry_after=0.5, code="overloaded")
-                self.admission.bump("catchup.admitted")
-                return self._catchup_rpc(session, params)
-            finally:
-                if admitted:
-                    self._catchup_slots.release()
+            return self._catchup_entry(session, params)
         if method == "latest_summary":
             epoch = service.storage.epoch
             tree, ref_seq = service.storage.latest(
@@ -534,6 +674,9 @@ class OrderingServer:
             "ops": sum(service.oplog.head(d) for d in docs),
             "epoch": service.storage.epoch,
             "admission": self.admission.snapshot(),
+            # live controller state (inflight leases, measured fold-cost
+            # EMA, shed streak) next to the monotonic counters
+            "admissionControl": self.admission_control.snapshot(),
         }
 
     def _track_dispatch(self, session: _ClientSession, method: str,
@@ -572,42 +715,185 @@ class OrderingServer:
         if seal is not None:
             seal()
 
-    def _catchup_rpc(self, session: _ClientSession, params: dict):
-        """The catchup method body, run under an admission slot.
-
-        The north-star maintenance op in the deployed server shape:
-        fold the named documents' op tails (or every document of the
-        caller's namespace) into fresh summaries centrally, routing
-        kernel-backed channels through the device (service.catchup).
-        (_handle runs this method on an executor thread — the fold
-        can take seconds and must not stall the event loop.)"""
-        service = self.service
+    def _ensure_catchup(self):
         from .catchup import CatchupService
 
         with self._catchup_init:
             if self._catchup is None:
-                self._catchup = CatchupService(service)
+                self._catchup = CatchupService(self.service, mc=self.mc)
             # Hand the instance out of the critical section as a
             # local: every later use reads the local, not the guarded
             # attribute (fluidrace FL-RACE-GUARD — the instance is
             # immutable-once-set, the attribute slot is not).
-            catchup = self._catchup
+            return self._catchup
+
+    def _catchup_docs(self, session: _ClientSession, params: dict):
+        """(resolved doc ids, tenant prefix) for one catchup request."""
+        doc_ids = params.get("docs")
+        prefix = f"{session.tenant}/" if self.tenants is not None else ""
+        if doc_ids is not None:
+            doc_ids = [f"{prefix}{d}" for d in doc_ids]
+        else:
+            doc_ids = [d for d in self.service.doc_ids()
+                       if d.startswith(prefix)]
+        return doc_ids, prefix
+
+    def _catchup_entry(self, session: _ClientSession, params: dict):
+        """The ``catchup`` method: admission-orchestrated (ISSUE 15).
+
+        Lanes, in order:
+
+        1. **warm** — requests fully servable from tiers 0/1 (including
+           a single-flight ``join`` on another caller's in-flight fold)
+           never touch the device and BYPASS the fold admission
+           entirely: a herd of warm readers must not queue behind cold
+           folds, and N concurrent catch-ups of one document cost ONE
+           admission slot (the leader's).
+        2. **fold** — an :class:`AdmissionController` lease per real
+           fold; the shed nack's retry_after is load-derived.
+        3. **degraded** — under sustained overload, the stored summary
+           at an older ref_seq instead of pure shed (the client replays
+           the durable tail via normal gap repair); falls back to shed
+           when nothing is servable.
+
+        Counter balance (asserted by the storm harness):
+        ``catchup.requests == admitted + shed + degraded``, with
+        ``catchup.warm`` counting lane-1 serves outside that balance.
+        """
+        catchup = self._ensure_catchup()
         # Epoch-keyed invalidation (EpochTracker parity for the SERVER's
         # own fold caches): entries are keyed by the storage generation
         # so a recreated store can never be served a stale fold —
         # dropping dead-generation entries here just frees the budget
         # (and the HBM tier 2.5 held) immediately.  ONE sweep covers
         # every tier of every kernel family (round 14).
-        catchup.invalidate_epoch(service.storage.epoch)
-        doc_ids = params.get("docs")
-        prefix = f"{session.tenant}/" if self.tenants is not None else ""
-        if doc_ids is not None:
-            doc_ids = [f"{prefix}{d}" for d in doc_ids]
+        catchup.invalidate_epoch(self.service.storage.epoch)
+        doc_ids, prefix = self._catchup_docs(session, params)
+        served, complete = catchup.catch_up_cached(
+            doc_ids, join_timeout=self.warm_join_timeout)
+        if complete:
+            self.admission.bump("catchup.warm")
+            return self._catchup_response(
+                session, catchup, prefix, doc_ids, served,
+                self._zero_fold_stats(), lane="warm")
+        self.admission.bump("catchup.requests")
+        verdict, grant = self.admission_control.admit()
+        if verdict != "admit":
+            if verdict == "degrade" and self.degraded_serve:
+                degraded = self._degraded_serve(session, catchup, prefix,
+                                                doc_ids, served)
+                if degraded is not None:
+                    self.admission.bump("catchup.degraded")
+                    return degraded
+            self.admission.bump("catchup.shed")
+            raise NackError(
+                "catch-up tier overloaded; backfill from deltas "
+                "or retry", retry_after=float(grant), code="overloaded")
+        self.admission.bump("catchup.admitted")
+        try:
+            # The warm pre-pass's partial serves ride along so the fold
+            # never re-scans (or re-counts hits for) those documents.
+            return self._catchup_rpc(session, params, catchup=catchup,
+                                     doc_ids=doc_ids, prefix=prefix,
+                                     prefetched=served)
+        finally:
+            self.admission_control.release(
+                grant, hold=self.catchup_hold_seconds)
+
+    @staticmethod
+    def _zero_fold_stats() -> dict:
+        return dict(deviceDocs=0, cpuDocs=0, hostChannels=0,
+                    fallbackChannels=0)
+
+    def _hold_fold(self, seconds: float) -> None:
+        """``catchup.slow`` actuator: an injected fold delay, advanced
+        on the injected clock (virtual under a VirtualClock — the
+        admission controller then measures the slow fold's cost
+        deterministically; wall sleep in production)."""
+        sleep = getattr(self.clock, "sleep", None)
+        if sleep is not None:
+            sleep(float(seconds))
         else:
-            doc_ids = [d for d in service.doc_ids()
-                       if d.startswith(prefix)]
+            time.sleep(float(seconds))
+
+    def _degraded_serve(self, session: _ClientSession, catchup,
+                        prefix: str, doc_ids, warm_served=None):
+        """Degraded-mode serving (ISSUE 15): under SUSTAINED overload,
+        answer with each document's newest STORED summary at its
+        (older) ref_seq instead of pure-shedding the request.  The
+        client loads that summary and replays the durable op tail
+        through normal DeltaManager gap repair — freshness is weakened
+        (the served ref_seq may trail the head), convergence is not
+        (the tail is durable and contiguous; see SEMANTICS.md "Overload
+        & degradation").  ``warm_served`` seeds the answer with the
+        warm pre-pass's partial results: a document the cache already
+        served FRESH must not be re-answered stale (nor re-read).
+        Returns None when nothing is servable (no stored summaries at
+        all): the caller sheds instead."""
+        storage = self.service.storage
+        results: Dict[str, tuple] = dict(warm_served or {})
+        degraded = []
+        for doc_id in doc_ids:
+            if doc_id in results:
+                continue  # warm pre-pass already served it fresh
+            summary, ref_seq, handle = storage.latest_with_handle(doc_id)
+            if summary is None:
+                continue
+            results[doc_id] = (handle, ref_seq)
+            if self.service.oplog.head(doc_id) > ref_seq:
+                degraded.append(doc_id)
+        if not results:
+            return None
+        self.admission.bump("catchup.degraded_docs", len(degraded))
+        self.mc.logger.send({
+            "eventName": "catchupDegraded", "docs": len(results),
+            "stale": len(degraded)})
+        return self._catchup_response(
+            session, catchup, prefix, doc_ids, results,
+            self._zero_fold_stats(), lane="degraded",
+            degraded=degraded)
+
+    def _catchup_rpc(self, session: _ClientSession, params: dict,
+                     catchup=None, doc_ids=None, prefix=None,
+                     prefetched=None):
+        """The catchup FOLD body, run under an admission lease.
+
+        The north-star maintenance op in the deployed server shape:
+        fold the named documents' op tails (or every document of the
+        caller's namespace) into fresh summaries centrally, routing
+        kernel-backed channels through the device (service.catchup).
+        (_handle runs this method on an executor thread — the fold
+        can take seconds and must not stall the event loop.)  The
+        ``catchup.fail`` / ``catchup.slow`` faultline seams fire here:
+        an injected failure takes the real recovery paths (the
+        single-flight finally-abandon, the caller's retry policy, the
+        admission release), an injected delay registers in the measured
+        fold cost the shed pacing derives from."""
+        if catchup is None:  # direct callers (tests, legacy paths)
+            catchup = self._ensure_catchup()
+            catchup.invalidate_epoch(self.service.storage.epoch)
+        if doc_ids is None:
+            doc_ids, prefix = self._catchup_docs(session, params)
+        if self.faults is not None:
+            point = self.faults.fire("catchup.fail")
+            if point is not None:
+                from ..testing.faults import FaultError
+
+                raise FaultError("catchup.fail", point.kind)
+            point = self.faults.fire("catchup.slow")
+            if point is not None:
+                self._hold_fold(point.arg)
         stats: dict = {}
-        results = catchup.catch_up(doc_ids, stats=stats)
+        results = catchup.catch_up(doc_ids, stats=stats,
+                                   prefetched=prefetched)
+        return self._catchup_response(session, catchup, prefix, doc_ids,
+                                      results, stats, lane="fold")
+
+    def _catchup_response(self, session: _ClientSession, catchup,
+                          prefix: str, doc_ids, results: dict,
+                          stats: dict, lane: str, degraded=()):
+        """ONE response shape for every catchup lane."""
+        service = self.service
         out = {}
         for doc_id, (handle, seq) in results.items():
             self._grant_tree(service.storage.read(handle),
@@ -621,6 +907,12 @@ class OrderingServer:
             "skipped": sorted(
                 d[len(prefix):] for d in doc_ids if d not in results
             ),
+            # Which lane answered ("warm" | "fold" | "degraded") and —
+            # for degraded serves — which documents were answered at a
+            # ref_seq older than the durable head (the client's cue
+            # that a tail replay is coming via gap repair).
+            "lane": lane,
+            "degraded": sorted(d[len(prefix):] for d in degraded),
             "deviceDocs": stats.get("deviceDocs", 0),
             "cpuDocs": stats.get("cpuDocs", 0),
             # Per-channel split inside device-routed documents:

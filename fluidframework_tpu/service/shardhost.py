@@ -414,7 +414,10 @@ def main(argv=None) -> None:
             )))
 
     host = ShardHost(args.shard_id, args.dir, faults=faults)
-    server = ShardHostServer(host, tcp_host=args.host, port=args.port)
+    # The injector arms the server-side seams too (catchup.fail /
+    # catchup.slow / session.write), not just the durable tier's.
+    server = ShardHostServer(host, tcp_host=args.host, port=args.port,
+                             faults=faults)
 
     async def _run():
         await server.start()

@@ -15,6 +15,8 @@ function of ``(seed, plan)`` and replays bit-identically:
   reorder), duplicate delivery, disconnect (``rpc.send`` / ``rpc.recv``);
 - ``_ClientSession.write_frame``    — stall → broadcaster demotion
   (``session.write``);
+- ``OrderingServer`` catchup fold   — fail, injected fold delay on the
+  server's injected clock (``catchup.fail`` / ``catchup.slow``);
 - ``ShardedOrderingService``        — shard kill at scheduled virtual
   ticks (``shard.kill``, driven by :meth:`FaultInjector.due`).
 
@@ -78,6 +80,16 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # "Deployment & migration").
     "proc.kill": ("kill",),
     "proc.hang": ("hang",),
+    # Catch-up fold tier (round 15, the storm subsystem): fired by the
+    # server's fold lane AFTER admission — ``catchup.fail`` raises out
+    # of the fold (the single-flight finally-abandon, the admission
+    # release, and the caller's retry policy are the recovery under
+    # test), ``catchup.slow`` injects a fold delay of ``arg`` seconds
+    # on the server's injected clock (virtual under a VirtualClock), so
+    # the measured fold cost — and the load-derived shed pacing it
+    # feeds — slows deterministically.
+    "catchup.fail": ("fail",),
+    "catchup.slow": ("delay",),
 }
 
 #: sites matched by occurrence count (the seam calls ``fire``); the rest

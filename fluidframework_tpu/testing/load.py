@@ -568,14 +568,17 @@ def run_chaos_load(spec: ChaosLoadSpec) -> ChaosLoadResult:
     plan = spec.plan if spec.plan is not None \
         else FaultPlan.generate(spec.seed, doc_ids, spec.steps)
     wire_sites = [p.site for p in plan.points
-                  if p.site.startswith("rpc.") or p.site == "session.write"]
+                  if p.site.startswith("rpc.") or p.site == "session.write"
+                  or p.site.startswith("catchup.")]
     if wire_sites:
         raise ValueError(
             f"plan points at {sorted(set(wire_sites))} need the TCP "
-            "stack, which this in-process harness does not drive — they "
-            "would silently never fire and fail the coverage oracle; "
-            "exercise them via tools/chaos.py's tcp_smoke or the "
-            "directed wire tests (tests/test_faultline.py)")
+            "stack or the server catchup fold lane, which this "
+            "in-process harness does not drive — they would silently "
+            "never fire and fail the coverage oracle; exercise wire "
+            "sites via tools/chaos.py's tcp_smoke or the directed wire "
+            "tests (tests/test_faultline.py), and catchup.* via the "
+            "catchup-storm swarm scenario (testing/scenarios.py)")
     file_sites = ("storage.store", "storage.read", "oplog.flush")
     needs_dir = any(
         p.site in file_sites or (p.site == "oplog.append"

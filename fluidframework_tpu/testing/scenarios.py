@@ -49,7 +49,7 @@ from ..service import LocalOrderingService
 from ..service.broadcaster import Broadcaster
 from ..service.oplog import OpLog
 from ..service.sharding import ShardedOrderingService
-from ..protocol.messages import MessageType, RawOperation
+from ..protocol.messages import MessageType, NackError, RawOperation
 from ..protocol.wire import (COL_KIND_INCREMENT, COL_KIND_INSERT,
                              COL_KIND_SET, CHAR_STRINGS, ColumnBatch,
                              column_batch_from_bytes, column_batch_to_bytes,
@@ -189,6 +189,46 @@ class ScenarioSpec:
     #: documents then catch up through the REAL CatchupService TREE
     #: route — the second-kernel-family serving shape.
     tree_ops: bool = False
+    #: catch-up STORM (ISSUE 15): every herd/laggard re-entry cohort
+    #: elects real catch-up callers per document whose joins are
+    #: converted into REAL ``CatchupService.catch_up`` calls through an
+    #: adaptive-admission fold lane (``service/server.py``) — warm
+    #: bypass, load-derived shed pacing, degraded serving, and the
+    #: ``catchup.slow``/``catchup.fail`` seams all fire; the rest of the
+    #: cohort models consumption columnar so cost stays bounded.
+    #: In-proc runs are replay bit-identical (admission runs off a
+    #: VirtualClock); out-of-proc runs issue the real ``catchup`` RPC
+    #: through the front door (verdict detail lands in
+    #: ``SwarmResult.storm``, outside replay identity).
+    storm: bool = False
+    #: real catch-up callers elected per document per storm wave — the
+    #: "sampled real folds" bound; the cohort remainder stays columnar
+    storm_clients_per_doc: int = 4
+    #: admission slots of the storm fold lane (Catchup.MaxInflight)
+    storm_max_inflight: int = 4
+    #: consecutive overflow verdicts before degraded-mode serving takes
+    #: over from shedding (Catchup.DegradeAfter) — high enough that the
+    #: herd really cycles through shed → paced retry before the tier
+    #: falls back to stale serves
+    storm_degrade_after: int = 4
+    #: reconnect jitter: a cohort's first attempts hash-spread over this
+    #: many ticks (an instantaneous 10⁴ spike would lock the tier into
+    #: pure degraded mode on tick one — real herds arrive over seconds,
+    #: and the spread is what lets folds, sheds, paced retries, and warm
+    #: hits all interplay)
+    storm_spread_ticks: int = 8
+    #: modeled fold duration: virtual ticks an admission lease stays
+    #: occupied after its (synchronous) fold returns — what makes a
+    #: single-threaded deterministic driver produce real overlapping-
+    #: fold admission pressure
+    storm_fold_ticks: int = 2
+    #: seconds one virtual tick maps to on the storm's injected clock
+    #: (converts the server's load-derived retry_after into ticks)
+    storm_tick_seconds: float = 0.05
+    #: oracle-twin knob (set by :func:`oracle_spec`): unlimited
+    #: admission and zero modeled hold — the never-shed twin every
+    #: shed/degraded client must converge byte-identically to
+    storm_never_shed: bool = False
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
@@ -268,15 +308,27 @@ class SwarmResult:
     #: h2d/d2h byte split) — busy seconds are wall-derived, so (like
     #: ``ingress``) excluded from replay identity
     fold_tier: Dict[str, object] = dataclasses.field(default_factory=dict)
+    #: ``spec.storm`` runs: the catch-up storm report (per-lane counts,
+    #: p50/p99 storm latency in virtual ticks, admission + tier-cache
+    #: snapshots, per-phase tier stats).  The DETERMINISTIC essentials
+    #: (requests/warm/folds/shed/degraded/retries) are mirrored into
+    #: ``counters`` as ``swarm.storm_*`` for in-proc runs — those ARE
+    #: replay identity; this dict additionally carries wall-derived
+    #: stage seconds and (out of proc) remote verdicts, so the dict
+    #: itself is excluded like ``ingress``.
+    storm: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def identity(self) -> dict:
         """The bit-identity surface: every field, canonically shaped —
-        except ``ingress``/``shard_stats``/``fold_tier``, which are
-        wall-clock / process derived and excluded."""
+        except ``ingress``/``shard_stats``/``fold_tier``/``storm``,
+        which carry wall-clock / process derived detail and are
+        excluded (the storm's deterministic counters ride ``counters``
+        instead)."""
         out = dataclasses.asdict(self)
         out.pop("ingress", None)
         out.pop("shard_stats", None)
         out.pop("fold_tier", None)
+        out.pop("storm", None)
         return out
 
 
@@ -337,6 +389,34 @@ def _tree_collab(seed, clients, docs, shards) -> ScenarioSpec:
     )
 
 
+def _catchup_storm(seed, clients, docs, shards) -> ScenarioSpec:
+    """A dark cohort returns as a catch-up STORM through the real fold tier.
+
+    30% of the steady population goes dark, then re-enters together —
+    and the re-entry herd is converted into real
+    ``CatchupService.catch_up`` calls (``storm_clients_per_doc`` real
+    callers elected per document; the cohort remainder models
+    consumption columnar) against the server's adaptive-admission fold
+    lane: warm-cache bypass, load-derived shed pacing honored under
+    VirtualClock, degraded serving under sustained overload, and the
+    ``catchup.slow``/``catchup.fail`` fault seams, all deterministic
+    and replay bit-identical (ISSUE 15).  A mid-run election freshens
+    the stored summaries degraded serving answers from."""
+    phases = (Phase("ramp", 16), Phase("steady", 40), Phase("election"),
+              Phase("herd", 32, frac=0.3), Phase("steady", 40))
+    plan = FaultPlan(seed=seed, points=(
+        # The 2nd admitted fold is slow (0.2 s on the injected clock =
+        # 4 ticks): the measured-cost EMA, and with it the shed pacing,
+        # must adapt.  The 5th admitted fold dies: single-flight
+        # finally-abandon + admission release + caller retry.
+        FaultPoint("catchup.slow", "delay", at=2, arg=0.2),
+        FaultPoint("catchup.fail", "fail", at=5),
+    ))
+    return ScenarioSpec(
+        name="catchup-storm", seed=seed, clients=clients, docs=docs,
+        shards=shards, storm=True, plan=plan, phases=phases)
+
+
 def _failover_drill(seed, clients, docs, shards) -> ScenarioSpec:
     """Mid-run shard kill between summary elections, under live traffic.
 
@@ -362,6 +442,7 @@ SCENARIOS: Dict[str, Callable[..., ScenarioSpec]] = {
     "catchup-herd": _catchup_herd,
     "laggard-window": _laggard_window,
     "tree-collab": _tree_collab,
+    "catchup-storm": _catchup_storm,
     "failover-drill": _failover_drill,
 }
 
@@ -408,6 +489,294 @@ class _SwarmSink:
         self._counters.bump("swarm.sink_fences")
 
 
+class _StormSession:
+    """Session shim for driving ``OrderingServer._dispatch`` in-proc
+    (the storm server is never started — no sockets, no tenants)."""
+
+    tenant = None
+
+
+class _CatchupStorm:
+    """Deterministic catch-up storm driver (ISSUE 15): the loop-closer
+    between the swarm engine and the fold tier.
+
+    **In-proc**: builds a REAL :class:`~..service.server.OrderingServer`
+    (never started — no sockets) over the swarm's sharded service and
+    drives its catchup entry per storming client, sequentially, off a
+    dedicated VirtualClock.  Fold-slot occupancy is modeled in virtual
+    time (``catchup_hold_seconds`` = ``storm_fold_ticks`` ×
+    ``storm_tick_seconds``), so sequentially-driven folds OVERLAP on
+    the admission controller's clock and every shed / degrade / warm /
+    retry decision is a pure function of ``(seed, spec)`` — the whole
+    storm replays bit-identically, counters included.  Shed clients
+    honor the server's load-derived ``retry_after`` (converted to
+    ticks) before retrying.
+
+    **Out-of-proc**: issues the real ``catchup`` RPC through the front
+    door to the owning shard process.  Remote admission runs on wall
+    clock, so per-verdict detail lands only in the (identity-excluded)
+    ``SwarmResult.storm`` report.
+    """
+
+    #: defensive bound — the acceptance criterion is ZERO unbounded
+    #: queueing, so a client that cannot get served in this many
+    #: attempts is a bug, not pacing.
+    MAX_ATTEMPTS = 64
+
+    def __init__(self, swarm: "ClientSwarm") -> None:
+        self.swarm = swarm
+        spec = swarm.spec
+        #: tick -> storm client indices due (first attempt or retry)
+        self.due: Dict[int, List[int]] = {}
+        self.start_tick: Dict[int, int] = {}
+        self.attempts: Dict[int, int] = {}
+        self.latencies: List[int] = []
+        self.remote: Dict[str, int] = {}
+        self.phase_tiers: Dict[str, object] = {}
+        self._session = _StormSession()
+        self.clock = None
+        self.server = None
+        if not spec.out_of_proc:
+            from ..service.server import OrderingServer
+            from ..utils.telemetry import ConfigProvider, MonitoringContext
+
+            self.clock = VirtualClock(tick=0.0001)
+            max_inflight = (1 << 30 if spec.storm_never_shed
+                            else spec.storm_max_inflight)
+            self.server = OrderingServer(
+                swarm.service, catchup_max_inflight=max_inflight,
+                faults=swarm.injector, clock=self.clock,
+                mc=MonitoringContext(config=ConfigProvider({
+                    "Catchup.DegradeAfter": spec.storm_degrade_after,
+                })))
+            if not spec.storm_never_shed:
+                self.server.catchup_hold_seconds = (
+                    spec.storm_fold_ticks * spec.storm_tick_seconds)
+
+    # -- scheduling ------------------------------------------------------------
+
+    def enlist(self, t: int, cohort: np.ndarray) -> None:
+        """A re-entry cohort formed at tick ``t-1``: elect the first
+        ``storm_clients_per_doc`` members of each document's cohort
+        (client-index order — deterministic) as REAL catch-up callers
+        due at ``t``; the rest stay columnar-modeled."""
+        if cohort.size == 0:
+            return
+        k = max(0, int(self.swarm.spec.storm_clients_per_doc))
+        if k == 0:
+            return
+        docs = self.swarm.doc_of[cohort]
+        order = np.argsort(docs, kind="stable")
+        members = cohort[order]
+        docs = docs[order]
+        cuts = np.flatnonzero(np.diff(docs)) + 1
+        starts = np.concatenate([[0], cuts])
+        ends = np.concatenate([cuts, [members.size]])
+        chosen: List[int] = []
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            chosen.extend(int(i) for i in members[s:min(e, s + k)])
+        spread = max(1, int(self.swarm.spec.storm_spread_ticks))
+        jitter = _hash_clients(self.swarm.spec.seed, 41,
+                               np.asarray(chosen, dtype=np.int64))
+        for i, j in zip(chosen, (jitter % np.uint64(spread)).tolist()):
+            due_t = t + int(j)
+            self.due.setdefault(due_t, []).append(i)
+            self.start_tick[i] = due_t
+            self.attempts[i] = 0
+        self.swarm.counters.bump("swarm.storm_requests", len(chosen))
+
+    def pending(self) -> bool:
+        return bool(self.due)
+
+    # -- the per-tick step -----------------------------------------------------
+
+    def step(self, t: int) -> None:
+        if self.clock is not None:
+            # One swarm tick of storm time: previously-held fold leases
+            # age toward expiry on the admission controller's clock.
+            self.clock.sleep(self.swarm.spec.storm_tick_seconds)
+        # Everything due AT OR BEFORE t: the run loop skips storm steps
+        # across the phase→quiescence boundary (those ticks advance ``t``
+        # without a step), and an entry stranded at a skipped tick would
+        # otherwise never pop — ``pending()`` stays true and the drain
+        # loop spins forever.
+        wave: List[int] = []
+        for tick in sorted(k for k in self.due if k <= t):
+            wave.extend(self.due.pop(tick))
+        if not wave:
+            return
+        for i in wave:
+            self.attempts[i] += 1
+            if self.attempts[i] > self.MAX_ATTEMPTS:
+                raise AssertionError(
+                    f"storm client {i} not served after "
+                    f"{self.MAX_ATTEMPTS} attempts — unbounded queueing")
+            if self.server is not None:
+                self._issue_inproc(i, t)
+            else:
+                self._issue_proc(i, t)
+
+    def _bump(self, name: str, by: int = 1) -> None:
+        """Verdict accounting: in-proc verdicts are deterministic and
+        land in the swarm counters (the replay-identity surface);
+        out-of-proc verdicts depend on remote wall-clock admission and
+        land ONLY in the identity-excluded ``storm`` report — a request
+        timeout under load must never flip ``replay_identical``."""
+        if self.server is not None:
+            self.swarm.counters.bump(name, by)
+        else:
+            self.remote[name] = self.remote.get(name, 0) + by
+
+    def _count(self, name: str) -> int:
+        if self.server is not None:
+            return self.swarm.counters.get(name)
+        return self.remote.get(name, 0)
+
+    def _retry(self, i: int, t: int, after_ticks: int) -> None:
+        self.due.setdefault(t + max(1, after_ticks), []).append(i)
+        self._bump("swarm.storm_retries")
+
+    def _serve(self, i: int, t: int, out: dict) -> None:
+        """Record one successful catchup answer and verify it.  The
+        served ``(handle, seq)`` is integrity-checked (a readable
+        summary at a seq the durable log actually holds), but the
+        client's consumption CURSOR is deliberately untouched: admission
+        verdicts differ between a shedding run and its never-shed
+        oracle twin, and any cursor influence would shift the client's
+        later fire schedule and ref_seqs — forking the logs the oracle
+        methodology pins byte-identical.  Sheds and degrades cost
+        LATENCY (recorded here in virtual ticks), never state; the
+        cohort drains columnar at ``catchup_rate`` either way — the
+        "sampled real folds + columnar-modeled remainder" split.  (The
+        100k matrix CAUGHT the cursor-jump variant of this harness:
+        divergent served seqs leaked into op ref_seqs and the sampled
+        digests split from the oracle.)"""
+        swarm = self.swarm
+        doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
+        served = out["docs"].get(doc_id)
+        if served is not None:
+            handle, seq = served
+            if int(seq) > int(swarm.head_arr[swarm.doc_of[i]]):
+                raise AssertionError(
+                    f"catchup served {doc_id} at seq {seq} beyond the "
+                    f"durable head {int(swarm.head_arr[swarm.doc_of[i]])}")
+            if self.server is not None:
+                # In-proc: the handle must resolve in the shared store —
+                # a degraded serve hands out a REAL stored summary, not
+                # a fabrication.
+                swarm.service.storage.read(handle)
+        lane = out.get("lane", "fold")
+        self._bump({
+            "warm": "swarm.storm_warm",
+            "fold": "swarm.storm_folds",
+            "degraded": "swarm.storm_degraded",
+        }.get(lane, "swarm.storm_folds"))
+        self._bump("swarm.storm_served")
+        self.latencies.append(t - self.start_tick[i])
+
+    def _issue_inproc(self, i: int, t: int) -> None:
+        swarm = self.swarm
+        doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
+        try:
+            out = self.server._dispatch(self._session, "catchup",
+                                        {"docs": [doc_id]})
+        except NackError as exc:
+            # Load-derived pacing honored in virtual ticks — the shed
+            # client waits the server's own hold, never less.
+            self._bump("swarm.storm_shed")
+            ticks = int(round(float(exc.retry_after)
+                              / swarm.spec.storm_tick_seconds))
+            self._retry(i, t, ticks)
+            return
+        except OSError:
+            # Injected catchup.fail (FaultError ⊂ OSError): the fold
+            # died after admission — slot released, single-flight
+            # waiters woken by the finally-abandon; the caller retries.
+            self._bump("swarm.storm_fold_errors")
+            self._retry(i, t, 1)
+            return
+        self._serve(i, t, out)
+
+    def _issue_proc(self, i: int, t: int) -> None:
+        from ..drivers.network_driver import RpcError
+
+        swarm = self.swarm
+        doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
+        try:
+            out = swarm.service.rpc.request("catchup", {"docs": [doc_id]})
+        except NackError as exc:
+            self._bump("swarm.storm_shed")
+            ticks = int(round(float(exc.retry_after)
+                              / swarm.spec.storm_tick_seconds))
+            self._retry(i, t, ticks)
+            return
+        except (RpcError, OSError) as exc:
+            self._bump("swarm.storm_fold_errors")
+            self.remote[f"error:{type(exc).__name__}"] = \
+                self.remote.get(f"error:{type(exc).__name__}", 0) + 1
+            self._retry(i, t, 1)
+            return
+        self._serve(i, t, out)
+
+    # -- reporting -------------------------------------------------------------
+
+    def _tier_stats(self):
+        if self.server is None:
+            return None
+        catchup = self.server._catchup
+        if catchup is None:
+            return None
+        return {
+            "cache": (catchup.cache.stats()
+                      if catchup.cache is not None else None),
+            "delta_cache": (catchup.delta_cache.stats()
+                            if catchup.delta_cache is not None else None),
+            "pack_cache": (catchup._pack_cache.stats()
+                           if catchup._pack_cache is not None else None),
+            "device_cache": (catchup.device_cache.stats()
+                             if catchup.device_cache is not None
+                             else None),
+        }
+
+    def phase_mark(self, key: str) -> None:
+        """Cumulative tier-cache snapshot at one phase boundary — the
+        per-phase hit-rate record the storm bench reads (diff adjacent
+        snapshots for a phase's own traffic)."""
+        self.phase_tiers[key] = self._tier_stats()
+
+    def summary(self) -> Dict[str, object]:
+        lat = sorted(self.latencies)
+        folds = self._count("swarm.storm_folds")
+        shed = self._count("swarm.storm_shed")
+        degraded = self._count("swarm.storm_degraded")
+        lane_total = folds + shed + degraded
+        out: Dict[str, object] = {
+            "mode": "proc" if self.server is None else "inproc",
+            "requests": self.swarm.counters.get("swarm.storm_requests"),
+            "served": self._count("swarm.storm_served"),
+            "warm": self._count("swarm.storm_warm"),
+            "folds": folds,
+            "shed": shed,
+            "degraded": degraded,
+            "retries": self._count("swarm.storm_retries"),
+            "fold_errors": self._count("swarm.storm_fold_errors"),
+            "shed_rate": (round(shed / lane_total, 4)
+                          if lane_total else None),
+            "latency_p50_ticks": float(percentile(lat, 0.50)),
+            "latency_p99_ticks": float(percentile(lat, 0.99)),
+            "latency_samples": len(lat),
+            "tiers": self._tier_stats(),
+            "phase_tiers": self.phase_tiers,
+        }
+        if self.server is not None:
+            out["admission"] = self.server.admission.snapshot()
+            out["admission_control"] = \
+                self.server.admission_control.snapshot()
+        else:
+            out["remote"] = dict(sorted(self.remote.items()))
+        return out
+
+
 class ClientSwarm:
     """The columnar client population plus the real service it drives.
 
@@ -423,6 +792,12 @@ class ClientSwarm:
             "swarm.elections",
             "swarm.catchup_completions", "swarm.delivery_samples",
             "swarm.frames", "swarm.sink_fences", "swarm.kills",
+            # catch-up storm (ISSUE 15): deterministic for in-proc runs,
+            # hence part of the replay-identity surface
+            "swarm.storm_requests", "swarm.storm_served",
+            "swarm.storm_warm", "swarm.storm_folds", "swarm.storm_shed",
+            "swarm.storm_degraded", "swarm.storm_retries",
+            "swarm.storm_fold_errors",
         )
         # -- columnar per-client state (the whole point) ----------------
         idx = np.arange(n, dtype=np.int64)
@@ -548,6 +923,8 @@ class ClientSwarm:
                            for d, doc_id in enumerate(self.doc_ids)}
         #: ingress-stage wall/byte accounting (outside replay identity)
         self.ingress = IngressMeter()
+        #: catch-up storm driver (ISSUE 15; None unless spec.storm)
+        self._storm = _CatchupStorm(self) if spec.storm else None
 
     # -- setup -----------------------------------------------------------------
 
@@ -1039,6 +1416,10 @@ class ClientSwarm:
                 dark = np.flatnonzero(self.state == _DARK)
                 self.state[dark] = _CATCHUP
                 self.catchup_start[dark] = t
+                if self._storm is not None:
+                    # THE storm: the whole re-entry herd forms at once —
+                    # its elected real callers all fire next tick.
+                    self._storm.enlist(t + 1, dark)
         elif phase.kind == "laggards":
             if t == phase_start and phase.frac > 0:
                 h = _hash_clients(self.spec.seed, 31 + phase_start, idx)
@@ -1061,6 +1442,10 @@ class ClientSwarm:
                                     & (self.lag_end == t))
             self.state[ending] = _CATCHUP
             self.catchup_start[ending] = t
+            if self._storm is not None and ending.size:
+                # Staggered re-entries storm too — smaller waves that
+                # keep the fold lane warm between herd spikes.
+                self._storm.enlist(t + 1, ending)
 
     # -- the run ---------------------------------------------------------------
 
@@ -1078,11 +1463,15 @@ class ClientSwarm:
                 self._connect_due(t)
                 self._tick_ingress(t)
                 self._drive_faults(t)
+                if self._storm is not None:
+                    self._storm.step(t)
                 self._consume(t)
                 self._sample_delivery(t)
                 t += 1
             phase_counters[f"{p_i}:{phase.kind}"] = \
                 self.counters.delta(since)
+            if self._storm is not None:
+                self._storm.phase_mark(f"{p_i}:{phase.kind}")
         # Quiescence: land any deferred JOIN cohorts and batches
         # (fault-free tail), then drain every client to the head.
         for _round in range(8):
@@ -1104,8 +1493,14 @@ class ClientSwarm:
                 self.state[catching] == _CATCHUP,
                 self.catchup_start[catching], t)
             self.state[catching] = _CATCHUP
-        while int(np.count_nonzero(self.state == _CATCHUP)):
+        while int(np.count_nonzero(self.state == _CATCHUP)) \
+                or (self._storm is not None and self._storm.pending()):
             t += 1
+            if self._storm is not None:
+                # Paced retries land beyond the scripted phases: keep
+                # serving until the whole storm drained (bounded by the
+                # driver's MAX_ATTEMPTS guard — zero unbounded queueing).
+                self._storm.step(t)
             self._consume(t)
             self._sample_delivery(t)
         self._consume(t, final=True)
@@ -1175,6 +1570,8 @@ class ClientSwarm:
             shard_stats=self._shard_stats(per_doc_head),
             fold_tier=(self._fold_probe()
                        if self.spec.fold_probe else {}),
+            storm=(self._storm.summary()
+                   if self._storm is not None else {}),
         )
 
     def _fold_probe(self) -> Dict[str, object]:
@@ -1279,6 +1676,10 @@ def oracle_spec(spec: ScenarioSpec, result: SwarmResult) -> ScenarioSpec:
         plan=None,
         dir=None,
         out_of_proc=False,
+        # The storm twin is the NEVER-SHED oracle (ISSUE 15): unlimited
+        # admission, no modeled fold hold — every shed/degraded client
+        # of the real run must converge byte-identically to it.
+        storm_never_shed=True,
         scripted_defers=tuple(result.defers),
         scripted_join_defers=tuple(result.join_defers),
     )

@@ -700,12 +700,22 @@ def test_fenced_mid_burst_client_converges_without_host_polling():
 
 
 def test_catchup_admission_sheds_overload_with_typed_nack(monkeypatch):
+    from fluidframework_tpu.utils.telemetry import (ConfigProvider,
+                                                    MonitoringContext)
+
     service = LocalOrderingService()
-    server = OrderingServer(service, catchup_max_inflight=1)
+    # Result cache off: every request takes the FOLD lane (the warm
+    # priority lane would otherwise serve this test's empty doc set
+    # without ever consulting admission — pinned separately in
+    # tests/test_catchup_storm.py).
+    server = OrderingServer(
+        service, catchup_max_inflight=1,
+        mc=MonitoringContext(config=ConfigProvider(
+            {"Catchup.Cache": "off"})))
     entered = threading.Event()
     release = threading.Event()
 
-    def slow_catchup(self, session, params):
+    def slow_catchup(self, session, params, **kw):
         entered.set()
         assert release.wait(timeout=30)
         return {"docs": {}}

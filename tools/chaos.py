@@ -101,6 +101,71 @@ PLANS = ("mixed", "append-storm", "kill-quake", "laggard-town")
 #: against REAL shard-host processes (SIGKILL, per-shard logs, adoption).
 PROC_PLANS = ("kill-quake-proc",)
 
+#: handled by the fluidscale storm runner (ISSUE 15): a catch-up herd
+#: through the REAL fold tier with the ``catchup.slow``/``catchup.fail``
+#: seams armed — shed, degraded-mode, and fold-crash recovery must all
+#: converge byte-identically to the never-shed oracle.
+STORM_PLANS = ("fold-squeeze",)
+
+
+def run_fold_squeeze(seeds: int) -> dict:
+    """The catchup-storm scenario as a chaos plan: herd joins hammer the
+    adaptive-admission fold lane (slots deliberately scarce), a slow
+    fold stretches the measured cost, a fold crash exercises the
+    single-flight abandon + retry — and every seed must converge
+    byte-identically to its never-shed single-shard oracle with full
+    fault coverage and the admission counters balancing exactly."""
+    from fluidframework_tpu.testing.scenarios import (
+        build_scenario, oracle_spec, run_swarm)
+
+    survived = 0
+    ops = 0
+    fault_totals: dict = {}
+    failures: list = []
+    storm_totals = {"shed": 0, "degraded": 0, "folds": 0, "warm": 0,
+                    "retries": 0, "fold_errors": 0}
+    for seed in range(seeds):
+        spec = build_scenario("catchup-storm", seed=seed, clients=1200,
+                              docs=8, shards=4)
+        chaos = run_swarm(spec)
+        oracle = run_swarm(oracle_spec(spec, chaos))
+        admission = chaos.storm.get("admission") or {}
+        balanced = (admission.get("catchup.requests", 0)
+                    == admission.get("catchup.admitted", 0)
+                    + admission.get("catchup.shed", 0)
+                    + admission.get("catchup.degraded", 0))
+        covered = all(
+            chaos.fault_counts.get(f"{p.site}:{p.kind}", 0) > 0
+            for p in spec.plan.points)
+        ok = (chaos.sampled_digests == oracle.sampled_digests
+              and chaos.per_doc_head == oracle.per_doc_head
+              and chaos.storm.get("served") == chaos.storm.get("requests")
+              and balanced and covered)
+        if ok:
+            survived += 1
+        else:
+            failures.append({
+                "seed": seed,
+                "digest_match":
+                    chaos.sampled_digests == oracle.sampled_digests,
+                "head_match": chaos.per_doc_head == oracle.per_doc_head,
+                "balanced": balanced,
+                "covered": covered,
+            })
+        ops += chaos.sequenced_ops
+        for key in storm_totals:
+            storm_totals[key] += int(chaos.storm.get(key) or 0)
+        for k, v in sorted(chaos.fault_counts.items()):
+            fault_totals[k] = fault_totals.get(k, 0) + v
+    return {
+        "scenarios": seeds,
+        "survived": survived,
+        "failures": failures,
+        "sequenced_ops": ops,
+        "storm": storm_totals,
+        "fault_counts": fault_totals,
+    }
+
 
 def run_proc_quake(seeds: int) -> dict:
     """The kill-quake plan's process variant (ISSUE 12): a steady-typing
@@ -302,7 +367,8 @@ def tcp_smoke() -> dict:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         description="run named fault plans against the serving stack")
-    parser.add_argument("--plan", choices=PLANS + PROC_PLANS + ("all",),
+    parser.add_argument("--plan",
+                        choices=PLANS + PROC_PLANS + STORM_PLANS + ("all",),
                         default="all")
     parser.add_argument("--plan-file", default=None,
                         help="run a custom JSON fault plan instead of "
@@ -337,6 +403,14 @@ def main(argv=None) -> None:
                 print(f"{name}: {result['survived']}/"
                       f"{result['scenarios']} survived (process kills: "
                       f"{result['fault_counts']})", file=sys.stderr)
+                continue
+            if name in STORM_PLANS:
+                result = run_fold_squeeze(args.seeds)
+                result["wall_sec"] = round(time.time() - plan_t0, 3)
+                report["plans"][name] = result
+                print(f"{name}: {result['survived']}/"
+                      f"{result['scenarios']} survived (storm: "
+                      f"{result['storm']})", file=sys.stderr)
                 continue
             result = run_plan(name, args.seeds, workdir,
                               plan_file=args.plan_file)
