@@ -178,7 +178,8 @@ class _ResidentEntry:
     needed to match and extend it."""
 
     __slots__ = ("tokens", "n_ops", "first_seq", "last_seq", "t_rows",
-                 "sig", "gen", "state", "ops", "base", "aux", "nbytes")
+                 "sig", "gen", "state", "ops", "base", "aux", "nbytes",
+                 "pinned", "spilled")
 
     def __init__(self, tokens, n_ops, first_seq, last_seq, t_rows, sig,
                  gen, state, ops, base, aux=None):
@@ -194,6 +195,14 @@ class _ResidentEntry:
         self.base = base                # device per-doc aux tree
         self.aux = aux                  # family host bookkeeping (counts)
         self.nbytes = _dev_nbytes(state, ops, base)
+        #: resident-state tier (round 16): a pinned entry is exempt from
+        #: the LRU sweep — the streaming fold keeps its working set live
+        #: across fold calls.  Over the pin budget the oldest pinned
+        #: entry SPILLS to host numpy copies (spilled=True): device HBM
+        #: freed, the next acquire re-uploads from the host copy instead
+        #: of repacking — a lost win, never corruption.
+        self.pinned = False
+        self.spilled = False
 
 
 def _lineage_gen(meta: dict) -> Optional[int]:
@@ -329,19 +338,27 @@ class DevicePackCache:
     ``device_ops`` selects the family (default: merge-tree)."""
 
     def __init__(self, max_bytes: int = 192 << 20, sharding=None,
-                 device_ops=None) -> None:
+                 device_ops=None, pin_max_bytes: int = 64 << 20) -> None:
         self.max_bytes = int(max_bytes)
+        #: device-byte budget for the PINNED tier (resident doc state of
+        #: the streaming fold).  Separate from ``max_bytes`` so a wide
+        #: pinned working set cannot starve the ordinary LRU tier, and
+        #: vice versa.
+        self.pin_max_bytes = int(pin_max_bytes)
         self._fam = device_ops if device_ops is not None \
             else MergeTreeDeviceOps()
         self._lock = threading.Lock()
         # tokens -> _ResidentEntry (insertion order = LRU order)
         self._entries: dict = {}  # guarded-by: _lock
-        self._bytes = 0  # guarded-by: _lock
+        self._bytes = 0       # device bytes of unspilled entries
+        self._host_bytes = 0  # host bytes of spilled entries
+        self._pinned_bytes = 0  # device bytes of pinned, unspilled entries
         self._last_epoch = None  # guarded-by: _lock
         self._sharding = sharding
         self.counters = CounterSet(
             "served", "spliced", "misses", "bypass", "inserts",
             "evictions", "invalidations", "bytes_saved",
+            "pins", "unpins", "spills", "unspills",
         )  # guarded-by: _lock (CounterSet is not internally synchronized)
 
     # -- placement -------------------------------------------------------------
@@ -358,6 +375,8 @@ class DevicePackCache:
             dropped = len(self._entries)
             self._entries.clear()
             self._bytes = 0
+            self._host_bytes = 0
+            self._pinned_bytes = 0
             self.counters.bump("evictions", dropped)
 
     @staticmethod
@@ -375,6 +394,120 @@ class DevicePackCache:
             return None
         return jax.tree.map(lambda leaf: cls.put(leaf, sharding), tree)
 
+    # -- the pinned resident-state tier (round 16) -----------------------------
+
+    def _pool_sub(self, entry: _ResidentEntry) -> None:  # holds-lock
+        if entry.spilled:
+            self._host_bytes -= entry.nbytes
+        else:
+            self._bytes -= entry.nbytes
+            if entry.pinned:
+                self._pinned_bytes -= entry.nbytes
+
+    def _pool_add(self, entry: _ResidentEntry) -> None:  # holds-lock
+        if entry.spilled:
+            self._host_bytes += entry.nbytes
+        else:
+            self._bytes += entry.nbytes
+            if entry.pinned:
+                self._pinned_bytes += entry.nbytes
+
+    def _spill_locked(self, entry: _ResidentEntry) -> None:
+        """Move an entry's buffers to host numpy copies (device HBM
+        freed by refcount once the caller's references die).  Holds
+        _lock; MUST run on the device-interaction thread (d2h)."""
+        self._pool_sub(entry)
+        entry.state = jax.tree.map(np.asarray, entry.state) \
+            if entry.state is not None else None
+        entry.ops = jax.tree.map(np.asarray, entry.ops)
+        entry.base = jax.tree.map(np.asarray, entry.base) \
+            if entry.base is not None else None
+        entry.spilled = True
+        self._pool_add(entry)
+        self.counters.bump("spills")
+
+    def _enforce_pin_budget(self, keep) -> None:  # holds-lock: _lock
+        """Spill oldest pinned entries until the pinned tier fits its
+        device-byte budget; ``keep`` (a tokens key) is spilled LAST —
+        it is the entry the caller is actively serving."""
+        while self._pinned_bytes > self.pin_max_bytes:
+            victim = next(
+                (k for k, e in self._entries.items()
+                 if e.pinned and not e.spilled and k != keep), None)
+            if victim is None:
+                victim = keep if keep in self._entries else None
+                if victim is None or self._entries[victim].spilled:
+                    break
+            self._spill_locked(self._entries[victim])
+
+    def pin(self, tokens) -> bool:
+        """Mark the chunk's resident entry as pinned doc state: exempt
+        from the LRU sweep, budgeted by ``pin_max_bytes`` with
+        spill-to-host (oldest-pinned-first) when the pinned set grows
+        past it.  Returns False when no entry exists for ``tokens``.
+        MUST be called from the device-interaction thread (a budget
+        overflow spills — a d2h copy)."""
+        with self._lock:
+            entry = self._entries.get(tokens)
+            if entry is None:
+                return False
+            if not entry.pinned:
+                entry.pinned = True
+                if not entry.spilled:
+                    self._pinned_bytes += entry.nbytes
+                self.counters.bump("pins")
+                self._enforce_pin_budget(tokens)
+            return True
+
+    def unpin(self, tokens) -> bool:
+        """Return a pinned entry to ordinary LRU life (a spilled one
+        stays spilled until its next acquire re-uploads it)."""
+        with self._lock:
+            entry = self._entries.get(tokens)
+            if entry is None or not entry.pinned:
+                return False
+            entry.pinned = False
+            if not entry.spilled:
+                self._pinned_bytes -= entry.nbytes
+            self.counters.bump("unpins")
+            return True
+
+    def _restore_spilled(self, entry: _ResidentEntry, sharding) -> int:
+        """Re-upload a spilled entry's host copies (the spill's other
+        half).  Returns the h2d bytes.  Caller thread = device thread;
+        the lock is NOT held across the uploads (they are slow) — the
+        entry object is private to the acquiring thread by the tier's
+        single-device-thread contract."""
+        entry.state = self.put_tree(entry.state, sharding)
+        entry.ops = self.put_tree(entry.ops, sharding)
+        entry.base = self.put_tree(entry.base, sharding)
+        with self._lock:
+            if self._entries.get(entry.tokens) is entry:
+                self._host_bytes -= entry.nbytes
+                entry.spilled = False
+                self._bytes += entry.nbytes
+                if entry.pinned:
+                    self._pinned_bytes += entry.nbytes
+                    self._enforce_pin_budget(entry.tokens)
+                self._sweep_unpinned(keep=entry.tokens)
+            else:
+                entry.spilled = False
+            self.counters.bump("unspills")
+        return entry.nbytes
+
+    def _sweep_unpinned(self, keep=None) -> None:  # holds-lock: _lock
+        """Evict oldest UNPINNED entries until the device pool fits —
+        the LRU sweep of the cache tier; the pinned tier never evicts
+        (it spills instead, on its own budget)."""
+        while self._bytes > self.max_bytes:
+            victim = next(
+                (k for k, e in self._entries.items()
+                 if not e.pinned and not e.spilled and k != keep), None)
+            if victim is None:
+                break
+            self._pool_sub(self._entries.pop(victim))
+            self.counters.bump("evictions")
+
     # -- introspection ---------------------------------------------------------
 
     def __len__(self) -> int:
@@ -386,11 +519,15 @@ class DevicePackCache:
             out = self.counters.snapshot()
             out["entries"] = len(self._entries)
             out["bytes"] = self._bytes
+            out["pinned_entries"] = sum(
+                1 for e in self._entries.values() if e.pinned)
+            out["pinned_bytes"] = self._pinned_bytes
+            out["spilled_bytes"] = self._host_bytes
         return out
 
     # -- the dispatch-side handshake -------------------------------------------
 
-    def acquire(self, state, ops, meta: dict):
+    def acquire(self, state, ops, meta: dict, pin: bool = False):
         """Device-resident ``(state, ops, aux, h2d_bytes)`` for a packed
         chunk about to dispatch: the resident buffers on an exact hit
         (zero upload), a donated suffix splice on a lineage-proven
@@ -399,7 +536,12 @@ class DevicePackCache:
         arrays unchanged (``aux=None`` — the dispatcher derives it as
         before); ``h2d_bytes`` is what this call actually put on the
         link.  MUST be called from the single device-interaction thread
-        (the pipeline's dispatch leg / the mesh fold)."""
+        (the pipeline's dispatch leg / the mesh fold).
+
+        ``pin=True`` (the streaming fold) additionally pins the served
+        entry into the resident-state tier — see :meth:`pin`.  A spilled
+        pinned entry that matches is restored by re-uploading its host
+        copies (cheaper than a repack; counted in ``h2d_bytes``)."""
         docs = meta["docs"]
         tokens = tuple(d.cache_token for d in docs)
         if any(t is None for t in tokens) or self._fam.bypass(docs):
@@ -411,33 +553,42 @@ class DevicePackCache:
         with self._lock:
             entry = self._entries.get(tokens)
             sharding = self._sharding
-        if entry is not None and entry.sig != sig:
+        if entry is not None and entry.sig != sig and not entry.spilled:
             self._fam.migrate(self, tokens, entry, sig, docs)
         if entry is not None and entry.sig == sig:
             kind = self.match(entry, docs)
+            restored = 0
+            if kind is not None and entry.spilled:
+                restored = self._restore_spilled(entry, sharding)
             if kind == "exact":
                 with self._lock:
                     self._touch(tokens)
                     self.counters.bump("served")
-                    self.counters.bump("bytes_saved", full_bytes)
+                    self.counters.bump("bytes_saved",
+                                       max(0, full_bytes - restored))
                 gen = _lineage_gen(meta)
                 if gen is not None:
                     # Content is equal either way; tracking the freshest
                     # tier-2 generation keeps future suffix lineage
                     # checks matching.
                     entry.gen = gen
-                return entry.state, entry.ops, entry.base, 0
+                if pin:
+                    self.pin(tokens)
+                return entry.state, entry.ops, entry.base, restored
             if kind == "suffix" and entry.gen is not None \
                     and _lineage_parent(meta) == entry.gen:
                 uploaded = self._fam.splice(self, entry, docs, state,
                                             ops, meta, sharding)
                 if uploaded is not None:
+                    uploaded += restored
                     self._refresh_windows(entry, docs, meta)
                     with self._lock:
                         self._touch(tokens)
                         self.counters.bump("spliced")
                         self.counters.bump("bytes_saved",
                                            max(0, full_bytes - uploaded))
+                    if pin:
+                        self.pin(tokens)
                     return entry.state, entry.ops, entry.base, uploaded
         # Miss / signature moved / unprovable lineage: full upload.
         with self._lock:
@@ -447,7 +598,7 @@ class DevicePackCache:
         aux_host = self._fam.aux(meta)
         base_dev = self.put_tree(aux_host, sharding)
         self._store(tokens, docs, sig, _lineage_gen(meta), state_dev,
-                    ops_dev, base_dev, ops, meta)
+                    ops_dev, base_dev, ops, meta, pin=pin)
         base_bytes = _np_nbytes(tuple(jax.tree.leaves(aux_host)))
         return state_dev, ops_dev, base_dev, full_bytes + base_bytes
 
@@ -491,19 +642,14 @@ class DevicePackCache:
         with self._lock:
             if self._entries.get(tokens) is not entry:
                 return
-            self._bytes += entry.nbytes - old_nbytes
-            while self._bytes > self.max_bytes \
-                    and len(self._entries) > 1:
-                oldest = next(iter(self._entries))
-                if oldest == tokens:
-                    self._touch(tokens)  # never evict the entry in hand
-                    continue
-                dropped = self._entries.pop(oldest)
-                self._bytes -= dropped.nbytes
-                self.counters.bump("evictions")
-            if self._bytes > self.max_bytes:
-                self._entries.pop(tokens)
-                self._bytes -= entry.nbytes
+            delta = entry.nbytes - old_nbytes
+            self._bytes += delta
+            if entry.pinned:
+                self._pinned_bytes += delta
+                self._enforce_pin_budget(tokens)
+            self._sweep_unpinned(keep=tokens)
+            if self._bytes > self.max_bytes and not entry.pinned:
+                self._pool_sub(self._entries.pop(tokens))
                 self.counters.bump("evictions")
 
     def _touch(self, tokens) -> None:  # holds-lock: _lock
@@ -512,7 +658,7 @@ class DevicePackCache:
             self._entries[tokens] = entry
 
     def _store(self, tokens, docs, sig, gen, state_dev, ops_dev, base_dev,
-               host_ops, meta: dict) -> None:
+               host_ops, meta: dict, pin: bool = False) -> None:
         n_ops, first_seq, last_seq = [], [], []
         for doc in docs:
             n, first, last = _doc_window(doc)
@@ -526,18 +672,23 @@ class DevicePackCache:
         with self._lock:
             old = self._entries.pop(tokens, None)
             if old is not None:
-                self._bytes -= old.nbytes
+                self._pool_sub(old)
+                # A re-store inherits the old entry's pin: the pin names
+                # the DOC's resident state, not one encoding of it.
+                entry.pinned = old.pinned
+            entry.pinned = entry.pinned or pin
             if entry.nbytes > self.max_bytes:
                 self.counters.bump("evictions")
                 return
             self._entries[tokens] = entry
             self._bytes += entry.nbytes
+            if entry.pinned:
+                self._pinned_bytes += entry.nbytes
+                if pin and (old is None or not old.pinned):
+                    self.counters.bump("pins")
+                self._enforce_pin_budget(tokens)
             self.counters.bump("inserts")
-            while self._bytes > self.max_bytes and self._entries:
-                oldest = next(iter(self._entries))
-                dropped = self._entries.pop(oldest)
-                self._bytes -= dropped.nbytes
-                self.counters.bump("evictions")
+            self._sweep_unpinned(keep=tokens)
 
     # -- epoch invalidation ----------------------------------------------------
 
@@ -553,7 +704,8 @@ class DevicePackCache:
             stale = [key for key in self._entries
                      if any(tok[0] != current_epoch for tok in key)]
             for key in stale:
-                dropped = self._entries.pop(key)
-                self._bytes -= dropped.nbytes
+                # Pins do not survive an epoch flip: the pinned state
+                # was derived under the dead storage generation.
+                self._pool_sub(self._entries.pop(key))
                 self.counters.bump("invalidations")
         return len(stale)

@@ -548,6 +548,7 @@ def pipelined_family_replay(
     pack_cache: Optional[PackCache] = None,
     delta_cache=None,
     device_cache=None,
+    pin_resident: bool = False,
 ):
     """Canonical summaries for ``docs`` in the given order, through the
     generic four-tier pipeline for any registered kernel family.
@@ -575,7 +576,10 @@ def pipelined_family_replay(
     with ZERO h2d pack bytes, a suffix hit uploads only the new rows
     through a donated in-place splice, and any mismatch falls back to
     the full upload — which without the tier is also the only route (and
-    is what ``h2d_bytes`` then counts)."""
+    is what ``h2d_bytes`` then counts).  ``pin_resident=True`` (the
+    streaming fold) pins every chunk this call serves into the device
+    cache's resident-state tier — exempt from LRU, spill-to-host over
+    its own byte budget (see ``DevicePackCache.pin``)."""
 
     # Seed HERE, not in the fold: a batch that routes entirely to
     # fallback never reaches _pipelined_fold, and the schema contract
@@ -587,7 +591,7 @@ def pipelined_family_replay(
         return _pipelined_fold(
             family, batch, chunk_docs, pack_threads, extract_threads,
             fetch_depth, schedule, stats, stage, packed_out, pack_cache,
-            delta_cache, device_cache,
+            delta_cache, device_cache, pin_resident,
         )
 
     return partition_replay(
@@ -610,6 +614,7 @@ def pipelined_mergetree_replay(
     pack_cache: Optional[PackCache] = None,
     delta_cache=None,
     device_cache=None,
+    pin_resident: bool = False,
 ):
     """The merge-tree instance of :func:`pipelined_family_replay` — the
     original round-5 entry point, signature unchanged."""
@@ -620,6 +625,7 @@ def pipelined_mergetree_replay(
         schedule=schedule, stats=stats, stage=stage,
         packed_out=packed_out, pack_cache=pack_cache,
         delta_cache=delta_cache, device_cache=device_cache,
+        pin_resident=pin_resident,
     )
 
 
@@ -695,7 +701,7 @@ def seed_stage(stage: Optional[dict]) -> None:
 def _pipelined_fold(family, batch, chunk_docs, pack_threads,
                     extract_threads, fetch_depth, schedule, stats, stage,
                     packed_out, pack_cache=None, delta_cache=None,
-                    device_cache=None):
+                    device_cache=None, pin_resident=False):
     order = family.order(batch, schedule)
     sched = [batch[i] for i in order]
     starts = list(range(0, len(sched), chunk_docs))
@@ -846,7 +852,8 @@ def _pipelined_fold(family, batch, chunk_docs, pack_threads,
                 if device_cache is not None:
                     t0 = perf_counter()
                     state, ops, base_dev, up_bytes = \
-                        device_cache.acquire(state, ops, meta)
+                        device_cache.acquire(state, ops, meta,
+                                             pin=pin_resident)
                     _bump(stage, "upload", t0)
                     _count_h2d(stage, up_bytes)
                 else:

@@ -105,6 +105,14 @@ class Sequencer:
         self._session: List[Optional[str]] = []
         self._free: List[int] = []
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
+        #: commit WATCHERS (round 16, the streaming fold's cadence feed):
+        #: fired with the new head seq after a stamp (or columnar
+        #: segment) has fully committed — durable gate accepted, every
+        #: subscriber delivered.  Deliberately NOT subscribers: they
+        #: never see messages (nothing to box) and are invisible to
+        #: ``has_subscribers_besides``, so watching a document does not
+        #: knock its client OP columns off the columnar fast path.
+        self._watchers: List[Callable[[int], None]] = []
         self._log: List[SequencedMessage] = []
         self._clock = 0
         # Delivery queue: stamping is allowed *during* a broadcast (e.g. the
@@ -317,6 +325,8 @@ class Sequencer:
             self._recompute_min_seq()
             raise
         self._recompute_min_seq()
+        if self._watchers:
+            self._notify_commit()
         return True
 
     def disconnect(self, client_id: str) -> None:
@@ -498,6 +508,8 @@ class Sequencer:
                 raise
             raise BatchAbortedError(0, [], err) from err
         self._recompute_min_seq()
+        if self._watchers:
+            self._notify_commit()
         return segment
 
     def _submit_one(self, op: RawOperation,
@@ -575,6 +587,24 @@ class Sequencer:
     def unsubscribe(self, fn: Callable[[SequencedMessage], None]) -> None:
         if fn in self._subscribers:
             self._subscribers.remove(fn)
+
+    def watch_commits(self, fn: Callable[[int], None]) -> None:
+        """Register a commit watcher: ``fn(head_seq)`` after each stamp
+        or columnar segment fully commits.  Watchers are not
+        subscribers — they see no messages, cannot veto, and do not
+        affect :meth:`has_subscribers_besides` (the columnar fast path
+        stays on).  A watcher that raises propagates to the submitter
+        AFTER the commit (the message is already durable and
+        broadcast); keep watchers non-throwing."""
+        self._watchers.append(fn)
+
+    def unwatch_commits(self, fn: Callable[[int], None]) -> None:
+        if fn in self._watchers:
+            self._watchers.remove(fn)
+
+    def _notify_commit(self) -> None:
+        for fn in list(self._watchers):
+            fn(self._seq)
 
     def is_connected(self, client_id: str) -> bool:
         """Quorum membership probe (reap/monitoring surfaces)."""
@@ -745,4 +775,6 @@ class Sequencer:
                         raise
             finally:
                 self._delivering = False
+            if self._watchers:
+                self._notify_commit()
         return msg

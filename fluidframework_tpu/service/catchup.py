@@ -267,6 +267,9 @@ class CatchupService:
         self.device_docs = 0  # guarded-by: _serial
         self.cpu_docs = 0  # guarded-by: _serial
         self.host_channels = 0  # guarded-by: _serial (host-side channel folds)
+        #: whether the CURRENT fold pass pins its folded device chunks
+        #: into the tier-2.5 resident-state tier (streaming fold only).
+        self._pin_resident = False  # guarded-by: _serial
 
     def invalidate_epoch(self, epoch: str) -> None:
         """ONE epoch sweep over every epoch-keyed cache tier this
@@ -312,6 +315,8 @@ class CatchupService:
         doc_ids: Optional[Sequence[str]] = None,
         upload: bool = True,
         join_timeout: Optional[float] = None,
+        stream_lag: Optional[int] = None,
+        stream_docs: Optional[list] = None,
     ) -> Tuple[Dict[str, Tuple[str, int]], bool]:
         """The tier-0/1 WARM pass alone: ``(results, complete)`` where
         ``complete`` means every requested document was served without
@@ -326,11 +331,25 @@ class CatchupService:
         wedged leader turns joiners into fold-lane requests — where
         admission sheds with pacing — instead of parking them on
         executor threads.  ``({}, False)`` when the result cache is
-        disabled."""
+        disabled.
+
+        ``stream_lag`` (round 16, set by the server when a streaming
+        fold is attached) widens the no-new-ops fast path into the
+        STREAMING-HEAD lane: a document whose durable head is within
+        ``stream_lag`` ops of its newest summary serves that summary at
+        its ref_seq — the client gap-repairs the bounded tail from the
+        op log, exactly the reference's summary+tail contract — instead
+        of falling to the fold lane.  The bound is the fold cadence, so
+        with the streaming fold healthy EVERY doc qualifies and the
+        warm lane hit rate goes to ~1.0.  Docs served laggy are
+        appended to ``stream_docs`` (when given) so the server can
+        label the lane."""
         if self.cache is None:
             return {}, False
         return self._serve_cached(doc_ids, upload,
-                                  join_timeout=join_timeout)
+                                  join_timeout=join_timeout,
+                                  stream_lag=stream_lag,
+                                  stream_docs=stream_docs)
 
     def catch_up(
         self,
@@ -338,6 +357,7 @@ class CatchupService:
         upload: bool = True,
         stats: Optional[dict] = None,
         prefetched: Optional[Dict[str, Tuple[str, int]]] = None,
+        pin_resident: bool = False,
     ) -> Dict[str, Tuple[str, int]]:
         """Fold each document's tail; returns {doc_id: (handle, seq)}.
         Documents with no new ops keep their current summary handle.
@@ -348,7 +368,9 @@ class CatchupService:
         caller's OWN :meth:`catch_up_cached` pass already served (the
         server's warm lane): the internal cached pass is skipped so those
         documents' metadata scans — and their cache hit counts — never
-        run twice.
+        run twice.  ``pin_resident`` (the streaming fold) pins the folded
+        chunks' device buffers into the tier-2.5 resident-state tier so
+        the NEXT micro-batch splices onto them instead of re-uploading.
 
         With the ``Catchup.ProfileDir`` config gate set (or
         ``FLUID_TPU_CATCHUP_PROFILEDIR``), each bulk fold is wrapped in a
@@ -386,6 +408,7 @@ class CatchupService:
             prefetched = served
         profile_dir = self.mc.config.raw("Catchup.ProfileDir")
         with CatchupService._serial:
+            self._pin_resident = pin_resident
             tracer = (
                 jax_profiler_trace(str(profile_dir))
                 if profile_dir else contextlib.nullcontext()
@@ -440,7 +463,9 @@ class CatchupService:
         return fold.handle, seq
 
     def _serve_cached(self, doc_ids, upload: bool,
-                      join_timeout: Optional[float] = None):
+                      join_timeout: Optional[float] = None,
+                      stream_lag: Optional[int] = None,
+                      stream_docs: Optional[list] = None):
         """As much of the request as tier 1 can serve: ``(results,
         complete)`` where ``complete`` means every document was served
         and the caller can skip the fold path entirely.  Runs WITHOUT
@@ -466,6 +491,16 @@ class CatchupService:
             head = self.service.oplog.head(doc_id)
             if head <= ref_seq:
                 results[doc_id] = (handle, ref_seq)
+                continue
+            if stream_lag is not None and head - ref_seq <= stream_lag:
+                # Streaming-head serve: the summary trails the durable
+                # head by at most the fold cadence — hand it out at its
+                # ref_seq and let the client replay the bounded tail
+                # (summary + tail, the reference contract).  No fold, no
+                # admission, no device work.
+                results[doc_id] = (handle, ref_seq)
+                if stream_docs is not None:
+                    stream_docs.append(doc_id)
                 continue
             fold = self.cache.join(
                 self._cache_key_at(doc_id, handle, ref_seq, head),
@@ -811,6 +846,7 @@ class CatchupService:
                     pack_cache=self._pack_cache,
                     delta_cache=self.delta_cache,
                     device_cache=self.device_cache,
+                    pin_resident=self._pin_resident,
                 ),
                 MAP_TYPE: functools.partial(
                     replay_map_batch, stats=self.pipeline_stats),
@@ -823,6 +859,7 @@ class CatchupService:
                     pack_cache=self.tree_pack_cache,
                     delta_cache=self.delta_cache,
                     device_cache=self.tree_device_cache,
+                    pin_resident=self._pin_resident,
                 ),
             }
         fb_before = self.pipeline_stats.get("fallback_docs", 0)

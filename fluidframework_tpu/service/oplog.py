@@ -35,6 +35,23 @@ from ..protocol.wire import (ColumnSegment, decode_sequenced_message,
 from ..utils.jsonl import iter_jsonl_tolerant, repair_jsonl_tail
 
 
+class TruncatedRangeError(OSError):
+    """A ranged read asked for seqs below the document's truncation
+    floor: the log no longer holds them.  Callers that can re-anchor on
+    a summary at or above ``floor`` should; anything else is a bug —
+    truncation only ever cuts below the newest durable summary AND the
+    sequencer's minimum sequence number, so no live client's gap repair
+    can land here."""
+
+    def __init__(self, doc_id: str, from_seq: int, floor: int) -> None:
+        super().__init__(
+            f"doc {doc_id!r}: range from_seq={from_seq} is below the "
+            f"truncation floor {floor}")
+        self.doc_id = doc_id
+        self.from_seq = from_seq
+        self.floor = floor
+
+
 def shard_log_path(base_dir: str, shard_id: str) -> str:
     """The canonical per-shard durable log location of the out-of-process
     tier (fluidproc): every shard host writes its OWN log file under the
@@ -66,6 +83,18 @@ class OpLog:
         if read_only and path is None:
             raise ValueError("read_only needs a file-backed log")
         self._docs: Dict[str, List[SequencedMessage]] = {}
+        #: summary-anchored truncation floor per doc: seqs <= floor have
+        #: been sealed and dropped; reads from below raise
+        #: :class:`TruncatedRangeError`.  0 = never truncated.
+        self._floors: Dict[str, int] = {}
+        #: orderer checkpoint persisted with each truncation marker so
+        #: recovery of a truncated doc restores from it instead of
+        #: replaying from seq 1 (which the log can no longer serve).
+        self._trunc_ckpts: Dict[str, dict] = {}
+        #: lifetime truncation counters (for stats surfaces)
+        self.truncated_msgs = 0
+        self.truncations = 0
+        self.bytes_reclaimed = 0
         self._path = path
         self._autoflush = autoflush
         self._faults = faults
@@ -83,7 +112,20 @@ class OpLog:
             # would merge onto the partial line.
             repair_jsonl_tail(path)
             for rec in iter_jsonl_tolerant(path):
+                trunc = rec.get("truncate")
+                if trunc is not None:
+                    # Truncation marker: everything at or below ``below``
+                    # is sealed.  In an uncompacted log (crash between
+                    # seal and drop) the marker FOLLOWS the old records,
+                    # so applying it here drops them exactly as the
+                    # interrupted truncation would have; in a compacted
+                    # log it leads and the drop is a no-op.
+                    self._apply_marker(rec["doc"], int(trunc["below"]),
+                                       trunc.get("checkpoint"))
+                    continue
                 msg = decode_sequenced_message(rec["msg"])
+                if msg.seq <= self._floors.get(rec["doc"], 0):
+                    continue  # pre-marker replay of a sealed record
                 log = self._docs.setdefault(rec["doc"], [])
                 if log and msg.seq <= log[-1].seq:
                     if msg.seq == log[-1].seq:
@@ -110,6 +152,8 @@ class OpLog:
 
     def append(self, doc_id: str, msg: SequencedMessage) -> None:
         self._check_writable()
+        if msg.seq <= self._floors.get(doc_id, 0):
+            return  # sealed below the truncation floor: a replay no-op
         log = self._docs.setdefault(doc_id, [])
         if log and msg.seq <= entry_last_seq(log[-1]):
             return  # exactly-once: replays after crash-resume are idempotent
@@ -174,6 +218,8 @@ class OpLog:
         n = len(segment)
         if n == 0:
             return
+        if segment.last_seq <= self._floors.get(doc_id, 0):
+            return  # wholly below the truncation floor: a replay no-op
         log = self._docs.setdefault(doc_id, [])
         if self._faults is not None or (
                 log and segment.start_seq <= entry_last_seq(log[-1])):
@@ -298,15 +344,177 @@ class OpLog:
             self._file.close()
             self._file = None
 
+    # -- summary-anchored truncation -------------------------------------------
+
+    def floor(self, doc_id: str) -> int:
+        """The document's truncation floor: highest seq sealed and
+        dropped (0 if never truncated).  Reads must start at or above
+        it; ``get(doc, from_seq=floor)`` is the exact boundary read."""
+        return self._floors.get(doc_id, 0)
+
+    def truncation_checkpoint(self, doc_id: str) -> Optional[dict]:
+        """The orderer checkpoint persisted with the newest truncation
+        marker, or None.  Recovery of a truncated doc restores from this
+        instead of full replay (the sealed prefix is gone)."""
+        return self._trunc_ckpts.get(doc_id)
+
+    def _apply_marker(self, doc_id: str, below: int,
+                      checkpoint: Optional[dict]) -> int:
+        """Apply a truncation floor to the in-memory view: raise the
+        floor, remember the checkpoint, drop entries wholly at or below
+        the cut.  A columnar segment straddling the cut stays whole —
+        the floor still guards reads into its sealed prefix."""
+        if below <= self._floors.get(doc_id, 0):
+            return 0
+        self._floors[doc_id] = below
+        if checkpoint is not None:
+            self._trunc_ckpts[doc_id] = checkpoint
+        log = self._docs.get(doc_id)
+        if not log:
+            return 0
+        kept = []
+        dropped = 0
+        for entry in log:
+            if entry_last_seq(entry) <= below:
+                dropped += (len(entry)
+                            if isinstance(entry, ColumnSegment) else 1)
+            else:
+                kept.append(entry)
+        self._docs[doc_id] = kept
+        return dropped
+
+    def truncate(self, doc_id: str, below_seq: int,
+                 checkpoint: Optional[dict] = None) -> int:
+        """Summary-anchored truncation: seal and drop every record with
+        ``seq <= below_seq``.  Returns the number of messages dropped.
+
+        The CALLER owns the safety argument — ``below_seq`` must be at
+        or under both the newest durable summary's ref_seq (so catch-up
+        can always re-anchor) and the sequencer's minimum sequence
+        number (so no live client's gap repair lands below the cut);
+        see ``service.streamfold``.  ``checkpoint`` (an orderer
+        checkpoint) rides in the durable marker so a later recovery can
+        restore without the sealed prefix.
+
+        Crash discipline mirrors the PR 12 migration points: the
+        ``oplog.truncate.seal`` site fires BEFORE the marker is durable
+        (a crash here leaves the log byte-identical — nothing happened);
+        the marker line is then appended and fsynced (the commit point);
+        ``oplog.truncate.drop`` fires AFTER the marker but BEFORE
+        physical compaction (a crash here reopens to the same floor —
+        the marker re-applies the drop — with the dead bytes reclaimed
+        by the next successful truncation's rewrite)."""
+        self._check_writable()
+        below_seq = min(below_seq, self.head(doc_id))
+        if below_seq <= self._floors.get(doc_id, 0):
+            return 0
+        fault = (self._faults.fire("oplog.truncate.seal", doc=doc_id)
+                 if self._faults is not None else None)
+        if fault is not None:
+            from ..testing.faults import FaultError
+
+            raise FaultError("oplog.truncate.seal", fault.kind, doc_id)
+        if self._file is not None:
+            rec = {"doc": doc_id,
+                   "truncate": {"below": below_seq,
+                                "checkpoint": checkpoint}}
+            self._file.write(canonical_json(rec).decode("utf-8") + "\n")
+            self.flush()  # the marker IS the commit point: fsync it
+        dropped = self._apply_marker(doc_id, below_seq, checkpoint)
+        self.truncations += 1
+        self.truncated_msgs += dropped
+        fault = (self._faults.fire("oplog.truncate.drop", doc=doc_id)
+                 if self._faults is not None else None)
+        if fault is not None:
+            from ..testing.faults import FaultError
+
+            raise FaultError("oplog.truncate.drop", fault.kind, doc_id)
+        if self._file is not None:
+            self._compact()
+        return dropped
+
+    def adopt_floor(self, doc_id: str, below: int,
+                    checkpoint: Optional[dict] = None) -> None:
+        """Import-side floor adoption (migration/failover of a TRUNCATED
+        document): persist the source log's truncation marker into THIS
+        log verbatim.  Unlike :meth:`truncate` there is no head clamp
+        and no crash-point choreography — the sealed prefix never
+        crossed the wire, so there is nothing here to seal or drop;
+        the marker just records that seqs at or below ``below`` are
+        vouched for by the summary anchor, and carries the recovery
+        checkpoint along."""
+        self._check_writable()
+        if below <= self._floors.get(doc_id, 0):
+            return
+        if self._file is not None:
+            rec = {"doc": doc_id,
+                   "truncate": {"below": below, "checkpoint": checkpoint}}
+            self._file.write(canonical_json(rec).decode("utf-8") + "\n")
+            self.flush()
+        self._apply_marker(doc_id, below, checkpoint)
+
+    def _compact(self) -> None:
+        """Physically drop sealed bytes: rewrite the whole file from the
+        in-memory view (markers first so a reopen raises each doc's
+        floor before its surviving records), fsync the replacement, then
+        atomically swap it in and reopen the append handle.  Atomicity
+        rides ``os.replace`` — a crash mid-rewrite leaves the original
+        intact and the tmp file as garbage."""
+        before = os.path.getsize(self._path) if os.path.exists(
+            self._path) else 0
+        tmp = self._path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as out:
+            for doc_id in sorted(set(self._docs) | set(self._floors)):
+                floor = self._floors.get(doc_id, 0)
+                if floor:
+                    rec = {"doc": doc_id,
+                           "truncate": {
+                               "below": floor,
+                               "checkpoint":
+                                   self._trunc_ckpts.get(doc_id)}}
+                    out.write(canonical_json(rec).decode("utf-8") + "\n")
+                for entry in self._docs.get(doc_id, []):
+                    if isinstance(entry, ColumnSegment):
+                        for j in range(len(entry)):
+                            rec = {"doc": doc_id,
+                                   "msg": entry.wire_dict(j)}
+                            out.write(canonical_json(rec)
+                                      .decode("utf-8") + "\n")
+                    else:
+                        rec = {"doc": doc_id,
+                               "msg": encode_sequenced_message(entry)}
+                        out.write(canonical_json(rec)
+                                  .decode("utf-8") + "\n")
+            out.flush()
+            os.fsync(out.fileno())
+        if self._file is not None:
+            self._file.close()
+        os.replace(tmp, self._path)
+        self._file = open(self._path, "a", encoding="utf-8")
+        try:  # best-effort directory fsync so the rename is durable
+            dfd = os.open(os.path.dirname(self._path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        self.bytes_reclaimed += max(
+            0, before - os.path.getsize(self._path))
+
     # -- read side (catch-up) --------------------------------------------------
 
     def doc_ids(self) -> List[str]:
         return sorted(self._docs)
 
     def head(self, doc_id: str) -> int:
-        """Highest sequenced seq for the document (0 if none)."""
+        """Highest sequenced seq for the document (0 if none).  A
+        truncated-then-idle doc reports its floor: the sealed history
+        still happened even though its bytes are gone."""
         log = self._docs.get(doc_id)
-        return entry_last_seq(log[-1]) if log else 0
+        if log:
+            return entry_last_seq(log[-1])
+        return self._floors.get(doc_id, 0)
 
     def get(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
@@ -315,7 +523,16 @@ class OpLog:
         (the loader's catch-up fetch; half-open so ``from_seq`` is 'the seq
         my summary already covers').  Columnar segments materialize their
         in-range rows on the fly — readers always see plain
-        :class:`SequencedMessage` objects."""
+        :class:`SequencedMessage` objects.
+
+        Raises :class:`TruncatedRangeError` when ``from_seq`` is below
+        the truncation floor: the sealed prefix is gone and serving a
+        silently-gapped tail would corrupt the reader.  The boundary
+        read ``from_seq == floor`` is legal (half-open range — the
+        floor seq itself is never returned)."""
+        floor = self._floors.get(doc_id, 0)
+        if from_seq < floor:
+            raise TruncatedRangeError(doc_id, from_seq, floor)
         log = self._docs.get(doc_id, [])
         out = []
         for entry in log:
@@ -343,13 +560,19 @@ class OpLog:
         """True iff the document's seqs are exactly 1..head with no gap
         or duplicate — O(entries), not O(messages): columnar segments
         are contiguous by construction (seqs are an arange), so only
-        their boundaries need checking."""
-        prev = 0
+        their boundaries need checking.  A truncated doc is contiguous
+        from its floor: the sealed prefix is vouched for by the marker's
+        summary anchor, not re-checked."""
+        floor = self._floors.get(doc_id, 0)
+        prev = floor
         for entry in self._docs.get(doc_id, []):
             if isinstance(entry, ColumnSegment):
                 if len(entry) == 0:
                     continue
-                if entry.start_seq != prev + 1:
+                # A segment straddling the truncation cut is kept whole;
+                # only its live suffix (> floor) counts for contiguity.
+                start = max(entry.start_seq, floor + 1)
+                if start != prev + 1:
                     return False
                 prev = entry.last_seq
             else:

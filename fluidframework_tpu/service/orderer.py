@@ -320,9 +320,22 @@ class DocumentOrderer:
         for ``disconnect``-ing ones that never return, or the MSN stays
         pinned at their last ref_seq."""
         checkpoint_seq = checkpoint["sequencer"]["seq"]
+        floor = oplog.floor(doc_id)
+        if checkpoint_seq < floor:
+            # The checkpoint predates a truncation cut: the log can no
+            # longer back-fill it.  The truncation marker carries a
+            # checkpoint taken at the cut — restore from that instead
+            # (absent one, the ranged read below raises loudly rather
+            # than silently resuming over a gap).
+            trunc = oplog.truncation_checkpoint(doc_id)
+            if trunc is not None:
+                checkpoint = trunc
+                checkpoint_seq = checkpoint["sequencer"]["seq"]
+        from_seq = floor if floor <= checkpoint_seq else 0
         sequencer = Sequencer.restore(
             checkpoint["sequencer"],
-            log=oplog.get(doc_id, to_seq=checkpoint_seq),
+            log=oplog.get(doc_id, from_seq=from_seq,
+                          to_seq=checkpoint_seq),
         )
         orderer = DocumentOrderer(doc_id, oplog, storage, sequencer=sequencer)
         orderer.scribe.restore(checkpoint["scribe"])
@@ -335,7 +348,15 @@ class DocumentOrderer:
     def recover(
         doc_id: str, oplog: OpLog, storage: SummaryStorage
     ) -> "DocumentOrderer":
-        """No checkpoint at all: rebuild everything from the durable log."""
+        """No host checkpoint at all: rebuild everything from the durable
+        log.  A TRUNCATED log cannot replay from seq 1 — its sealed
+        prefix is gone — so recovery pivots to the checkpoint the
+        truncation marker persisted at the cut (restore + tail replay),
+        which carries the JOIN/LEAVE quorum and dedup floors the dropped
+        records once established."""
+        trunc = oplog.truncation_checkpoint(doc_id)
+        if trunc is not None:
+            return DocumentOrderer.restore(doc_id, oplog, storage, trunc)
         orderer = DocumentOrderer(doc_id, oplog, storage)
         for msg in oplog.get(doc_id):
             orderer.sequencer.replay(msg)
@@ -493,6 +514,12 @@ class LocalOrderingService:
         #: that mutate these maps concurrently with event-loop dispatches
         #: (ADVICE r3) — GIL atomicity alone is not a contract.
         self.state_lock = threading.RLock()
+        #: optional ``fn(doc_id, head_seq)`` fired after every committed
+        #: stamp/segment on any document (the streaming fold's dirty-doc
+        #: feed).  Installed via :meth:`set_commit_hook`; rides the
+        #: sequencer's WATCHER list, never its subscriber list, so it
+        #: cannot knock documents off the columnar fast path.
+        self.commit_hook = None  # guarded-by: state_lock (installation)
 
     def fence_all(self) -> List[str]:
         """Shard failover: refuse new orderers, then fence every live one.
@@ -508,6 +535,40 @@ class LocalOrderingService:
             orderer.fence()
         return [doc_id for doc_id, _ in orderers]
 
+    def set_commit_hook(self, fn) -> None:
+        """Install (or clear) the service-wide commit hook and wire it
+        onto every LIVE orderer; later-created/recovered/adopted orderers
+        are wired at install time.  One hook at a time — the streaming
+        fold is the intended single consumer."""
+        with self.state_lock:
+            self.commit_hook = fn
+            orderers = sorted(self._orderers.items())
+        if fn is not None:
+            for doc_id, orderer in orderers:
+                self._wire_commit_hook(doc_id, orderer)
+
+    def _wire_commit_hook(self, doc_id: str,
+                          orderer: DocumentOrderer) -> None:
+        # The watcher reads ``self.commit_hook`` at FIRE time (not wire
+        # time) so clearing the hook actually detaches delivery, and it
+        # is wired at most once per orderer so attach/detach/attach does
+        # not fan a single commit out twice.
+        with self.state_lock:
+            armed = self.commit_hook is not None
+        if not armed:
+            return
+        if getattr(orderer, "_commit_hook_wired", False):
+            return
+        orderer._commit_hook_wired = True
+        orderer.sequencer.watch_commits(
+            lambda head, _d=doc_id: self._fire_commit_hook(_d, head))
+
+    def _fire_commit_hook(self, doc_id: str, head: int) -> None:
+        with self.state_lock:  # snapshot only; fn runs lock-free
+            fn = self.commit_hook
+        if fn is not None:
+            fn(doc_id, head)
+
     def create_document(self, doc_id: str) -> DocumentEndpoint:
         with self.state_lock:
             if self._fenced:
@@ -517,6 +578,7 @@ class LocalOrderingService:
             self._orderers[doc_id] = DocumentOrderer(
                 doc_id, self.oplog, self.storage, throttle=self.throttle
             )
+            self._wire_commit_hook(doc_id, self._orderers[doc_id])
             return DocumentEndpoint(self._orderers[doc_id])
 
     def has_document(self, doc_id: str) -> bool:
@@ -552,6 +614,9 @@ class LocalOrderingService:
         with self.state_lock:
             fenced = self._fenced
             installed = self._orderers.setdefault(doc_id, orderer)
+        if installed is orderer:
+            self._wire_commit_hook(doc_id, installed)
+        with self.state_lock:
             flight = self._recoveries.pop(doc_id, None)
         if fenced:
             installed.fence()
@@ -620,6 +685,8 @@ class LocalOrderingService:
         with self.state_lock:
             fenced = self._fenced
             installed = self._orderers.setdefault(doc_id, orderer)
+        if installed is orderer:
+            self._wire_commit_hook(doc_id, installed)
         if fenced:
             installed.fence()
         return installed

@@ -444,7 +444,20 @@ class OrderingServer:
         #: never entered the fold lane at all.
         self.admission = LockedCounterSet(
             "catchup.requests", "catchup.admitted", "catchup.shed",
-            "catchup.degraded", "catchup.degraded_docs", "catchup.warm")
+            "catchup.degraded", "catchup.degraded_docs", "catchup.warm",
+            "catchup.stream")
+        #: streaming fold (ISSUE 16): when the ``Catchup.Stream`` gate is
+        #: on, a sequencer-attached :class:`~.streamfold.StreamFoldService`
+        #: folds committed micro-batches continuously (pinned device
+        #: state, summary-anchored oplog truncation) and catch-up serves
+        #: the STREAMING HEAD lane — summaries at most one cadence behind
+        #: the durable head, no fold, no admission.
+        self.stream_enabled = str(
+            cfg.raw("Catchup.Stream") or "off"
+        ).strip().lower() in ("on", "true", "1")
+        self.stream_cadence = cfg.get_int("Catchup.StreamCadence", 8)
+        self.stream_retention = cfg.get_int("Catchup.StreamRetention", 64)
+        self.streamfold = None  # guarded-by: _catchup_init (lazy)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None
         # lazy CatchupService (the "catchup" method); executor threads
@@ -592,7 +605,26 @@ class OrderingServer:
             msg = service.endpoint(params["doc"]).submit(
                 decode_raw_operation(params["op"])
             )
+            if self.stream_enabled:
+                # Streaming cadence: the commit watcher recorded the new
+                # head; fold it once the unfolded span reaches the
+                # cadence.  Synchronous and cadence-gated — almost every
+                # call is a no-op dict check, and a due round folds one
+                # micro-batch, not a cold tail.
+                streamfold = self._ensure_streamfold()
+                if streamfold is not None:
+                    streamfold.poll()
             return encode_sequenced_message(msg) if msg is not None else None
+        if method == "stream_poll":
+            # Control-plane poke for the streaming fold (tests, the
+            # swarm tick, operators): one poll round now; force=True
+            # folds every pending doc regardless of cadence.
+            streamfold = self._ensure_streamfold()
+            if streamfold is None:
+                return None
+            folded = streamfold.poll(force=bool(params.get("force")))
+            return {"folded": {d: [h, s] for d, (h, s) in folded.items()},
+                    "stats": streamfold.stats()}
         if method == "update_ref_seq":
             service.endpoint(params["doc"]).update_ref_seq(
                 params["client"], params["ref_seq"]
@@ -669,6 +701,8 @@ class OrderingServer:
         per-shard identity and log heads)."""
         service = self.service
         docs = service.doc_ids()
+        with self._catchup_init:
+            streamfold = self.streamfold
         return {
             "docs": len(docs),
             "ops": sum(service.oplog.head(d) for d in docs),
@@ -677,6 +711,11 @@ class OrderingServer:
             # live controller state (inflight leases, measured fold-cost
             # EMA, shed streak) next to the monotonic counters
             "admissionControl": self.admission_control.snapshot(),
+            # streaming fold health (None while the gate is off): poll/
+            # fold/publish counters, truncation totals, summary lag
+            # high-water in sequence numbers.
+            "stream": (streamfold.stats()
+                       if streamfold is not None else None),
         }
 
     def _track_dispatch(self, session: _ClientSession, method: str,
@@ -727,6 +766,36 @@ class OrderingServer:
             # immutable-once-set, the attribute slot is not).
             return self._catchup
 
+    def _ensure_streamfold(self):
+        """Lazy streaming-fold service (gate: ``Catchup.Stream``).
+        Returns None when streaming is off."""
+        if not self.stream_enabled:
+            return None
+        catchup = self._ensure_catchup()
+        with self._catchup_init:
+            if self.streamfold is None:
+                from .streamfold import StreamFoldService
+
+                self.streamfold = StreamFoldService(
+                    self.service, catchup,
+                    cadence_ops=self.stream_cadence,
+                    retention_floor=self.stream_retention,
+                    faults=self.faults,
+                ).attach()
+            return self.streamfold
+
+    def enable_streaming(self, cadence_ops: Optional[int] = None,
+                         retention_floor: Optional[int] = None):
+        """Turn the streaming fold on programmatically (tests and the
+        swarm harness; production uses the ``Catchup.Stream`` gate).
+        Returns the attached :class:`~.streamfold.StreamFoldService`."""
+        if cadence_ops is not None:
+            self.stream_cadence = int(cadence_ops)
+        if retention_floor is not None:
+            self.stream_retention = int(retention_floor)
+        self.stream_enabled = True
+        return self._ensure_streamfold()
+
     def _catchup_docs(self, session: _ClientSession, params: dict):
         """(resolved doc ids, tenant prefix) for one catchup request."""
         doc_ids = params.get("docs")
@@ -769,13 +838,28 @@ class OrderingServer:
         # every tier of every kernel family (round 14).
         catchup.invalidate_epoch(self.service.storage.epoch)
         doc_ids, prefix = self._catchup_docs(session, params)
+        # Streaming head (ISSUE 16): with the streaming fold attached,
+        # a summary within one fold cadence of the durable head is
+        # final enough — serve it at its ref_seq (the client replays
+        # the bounded tail) instead of folding the last few ops.
+        streamfold = self._ensure_streamfold()
+        stream_docs: list = []
+        stream_lag = (streamfold.cadence_ops
+                      if streamfold is not None else None)
         served, complete = catchup.catch_up_cached(
-            doc_ids, join_timeout=self.warm_join_timeout)
+            doc_ids, join_timeout=self.warm_join_timeout,
+            stream_lag=stream_lag, stream_docs=stream_docs)
         if complete:
-            self.admission.bump("catchup.warm")
+            if stream_docs:
+                self.admission.bump("catchup.stream")
+                lane = "stream"
+            else:
+                self.admission.bump("catchup.warm")
+                lane = "warm"
             return self._catchup_response(
                 session, catchup, prefix, doc_ids, served,
-                self._zero_fold_stats(), lane="warm")
+                self._zero_fold_stats(), lane=lane,
+                stream=stream_docs)
         self.admission.bump("catchup.requests")
         verdict, grant = self.admission_control.admit()
         if verdict != "admit":
@@ -795,7 +879,8 @@ class OrderingServer:
             # never re-scans (or re-counts hits for) those documents.
             return self._catchup_rpc(session, params, catchup=catchup,
                                      doc_ids=doc_ids, prefix=prefix,
-                                     prefetched=served)
+                                     prefetched=served,
+                                     stream=stream_docs)
         finally:
             self.admission_control.release(
                 grant, hold=self.catchup_hold_seconds)
@@ -855,7 +940,7 @@ class OrderingServer:
 
     def _catchup_rpc(self, session: _ClientSession, params: dict,
                      catchup=None, doc_ids=None, prefix=None,
-                     prefetched=None):
+                     prefetched=None, stream=()):
         """The catchup FOLD body, run under an admission lease.
 
         The north-star maintenance op in the deployed server shape:
@@ -887,11 +972,13 @@ class OrderingServer:
         results = catchup.catch_up(doc_ids, stats=stats,
                                    prefetched=prefetched)
         return self._catchup_response(session, catchup, prefix, doc_ids,
-                                      results, stats, lane="fold")
+                                      results, stats, lane="fold",
+                                      stream=stream)
 
     def _catchup_response(self, session: _ClientSession, catchup,
                           prefix: str, doc_ids, results: dict,
-                          stats: dict, lane: str, degraded=()):
+                          stats: dict, lane: str, degraded=(),
+                          stream=()):
         """ONE response shape for every catchup lane."""
         service = self.service
         out = {}
@@ -913,6 +1000,10 @@ class OrderingServer:
             # that a tail replay is coming via gap repair).
             "lane": lane,
             "degraded": sorted(d[len(prefix):] for d in degraded),
+            # Documents answered from the STREAMING HEAD: a summary at
+            # most one fold cadence behind the durable head, served at
+            # its ref_seq with the client replaying the bounded tail.
+            "stream": sorted(d[len(prefix):] for d in stream),
             "deviceDocs": stats.get("deviceDocs", 0),
             "cpuDocs": stats.get("cpuDocs", 0),
             # Per-channel split inside device-routed documents:

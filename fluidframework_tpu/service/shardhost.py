@@ -231,22 +231,33 @@ class ShardHost:
         """Migration step 2 (source): the document's full durable span,
         codec-encoded — plus the latest summary commit handle (the
         summary OBJECTS never move: the store is shared and
-        content-addressed)."""
+        content-addressed).  A TRUNCATED document exports its live
+        suffix (the sealed prefix is gone by construction) plus the
+        floor and the marker's recovery checkpoint, so the importer
+        reconstructs the same guarded view."""
+        floor = self.oplog.floor(doc_id)
         return {
             "records": [encode_sequenced_message(m)
-                        for m in self.oplog.get(doc_id)],
+                        for m in self.oplog.get(doc_id, from_seq=floor)],
             "head": self.oplog.head(doc_id),
             "summary": self.storage.head(doc_id),
+            "floor": floor,
+            "trunc_checkpoint": self.oplog.truncation_checkpoint(doc_id),
         }
 
     def import_doc(self, doc_id: str, records: List[dict],
-                   checkpoint: Optional[dict] = None) -> dict:
+                   checkpoint: Optional[dict] = None,
+                   floor: int = 0,
+                   trunc_checkpoint: Optional[dict] = None) -> dict:
         """Migration step 3 (target): append the span into THIS shard's
         log (idempotent — seq-deduped, so a retried import after a crash
         mid-transfer lands exactly once), fsync it, then install the
         orderer restored from the frozen checkpoint.  Without a
         checkpoint (failover adoption), the orderer recovers by full log
-        replay instead."""
+        replay instead.  ``floor``/``trunc_checkpoint`` carry a
+        truncated source's sealed boundary: the marker is adopted into
+        this log so reads below the floor keep failing loudly and a
+        later recovery still has its checkpoint."""
         self._retired.discard(doc_id)
         # The previous owner appended this doc's summary-commit chain to
         # the shared store from ITS process; merge those records into
@@ -257,6 +268,8 @@ class ShardHost:
             for rec in records:
                 self.oplog.append(doc_id, decode_sequenced_message(rec))
         self.oplog.flush()
+        if floor > 0:
+            self.oplog.adopt_floor(doc_id, int(floor), trunc_checkpoint)
         if checkpoint is not None:
             self.service.adopt_orderer(
                 doc_id,
@@ -311,13 +324,17 @@ class ShardHost:
             else:
                 peer = OpLog()  # peer never wrote: empty view
             self._peer_logs[from_shard] = peer
-        records = [encode_sequenced_message(m) for m in peer.get(doc_id)]
-        if not records:
+        peer_floor = peer.floor(doc_id)
+        records = [encode_sequenced_message(m)
+                   for m in peer.get(doc_id, from_seq=peer_floor)]
+        if not records and peer_floor == 0:
             self.storage.refresh_doc(doc_id)
             if self.storage.head(doc_id) is None \
                     and self.oplog.head(doc_id) == 0:
                 return {"head": 0, "nothing": True}
-        return self.import_doc(doc_id, records, checkpoint=None)
+        return self.import_doc(
+            doc_id, records, checkpoint=None, floor=peer_floor,
+            trunc_checkpoint=peer.truncation_checkpoint(doc_id))
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -347,13 +364,15 @@ class ShardHostServer(OrderingServer):
             "log_contiguous": lambda s, p: (
                 host.contiguous(list(p["docs"])) if "docs" in p
                 else host.log_contiguous(p["doc"])),
-            "submit_mixed": lambda s, p: host.submit_mixed_wire(p),
+            "submit_mixed": lambda s, p: self._submit_mixed(p),
             "connect_many": lambda s, p: host.connect_many_wire(p),
             "bump_epoch": lambda s, p: host.bump_epoch(p["token"]),
             "freeze_doc": lambda s, p: host.freeze_doc(p["doc"]),
             "export_doc": lambda s, p: host.export_doc(p["doc"]),
             "import_doc": lambda s, p: host.import_doc(
-                p["doc"], p.get("records") or [], p.get("checkpoint")),
+                p["doc"], p.get("records") or [], p.get("checkpoint"),
+                floor=int(p.get("floor") or 0),
+                trunc_checkpoint=p.get("trunc_checkpoint")),
             "thaw_doc": lambda s, p: host.thaw_doc(p["doc"]),
             "retire_doc": lambda s, p: host.retire_doc(p["doc"]),
             "adopt_doc": lambda s, p: host.adopt_doc(
@@ -361,9 +380,26 @@ class ShardHostServer(OrderingServer):
         })
         self.drain_exempt = {"ping", "stats", "shard_info"}
 
+    def _submit_mixed(self, params: dict) -> Dict[str, dict]:
+        """Batched ingress + streaming cadence: the group commit lands
+        first (batch closed, bytes durable), THEN a due streaming round
+        folds — never inside the commit's ``oplog.batch()``, the
+        truncation marker needs a real flush for its commit point."""
+        out = self.shard.submit_mixed_wire(params)
+        if self.stream_enabled:
+            streamfold = self._ensure_streamfold()
+            if streamfold is not None:
+                streamfold.poll()
+        return out
+
     def _shard_stats(self) -> dict:
         out = self.shard.stats()
         out["admission"] = self.admission.snapshot()
+        out["stream"] = (self.streamfold.stats()
+                         if self.streamfold is not None else None)
+        out["truncations"] = self.shard.oplog.truncations
+        out["truncated_msgs"] = self.shard.oplog.truncated_msgs
+        out["log_bytes_reclaimed"] = self.shard.oplog.bytes_reclaimed
         return out
 
     def _dispatch(self, session, method: str, params: dict):
@@ -392,6 +428,15 @@ def main(argv=None) -> None:
     parser.add_argument("--fault-plan", default=None,
                         help="optional faultline plan JSON arming this "
                              "host's oplog/storage seams (chaos runs)")
+    parser.add_argument("--stream", action="store_true",
+                        help="attach the streaming fold (ISSUE 16): fold "
+                             "committed micro-batches continuously and "
+                             "truncate the per-shard log below durable "
+                             "summaries")
+    parser.add_argument("--stream-cadence", type=int, default=None,
+                        help="fold once a doc has this many unfolded ops")
+    parser.add_argument("--stream-retention", type=int, default=None,
+                        help="never truncate the newest N ops")
     args = parser.parse_args(argv)
 
     faults = None
@@ -418,6 +463,9 @@ def main(argv=None) -> None:
     # catchup.slow / session.write), not just the durable tier's.
     server = ShardHostServer(host, tcp_host=args.host, port=args.port,
                              faults=faults)
+    if args.stream:
+        server.enable_streaming(cadence_ops=args.stream_cadence,
+                                retention_floor=args.stream_retention)
 
     async def _run():
         await server.start()

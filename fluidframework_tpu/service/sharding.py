@@ -227,6 +227,13 @@ class ShardedOrderingService:
     def shard_service(self, shard_id: str) -> LocalOrderingService:
         return self._shards[shard_id]
 
+    def set_commit_hook(self, fn) -> None:
+        """Fan the commit watcher out to every shard (streaming fold,
+        ISSUE 16): whichever shard owns a document — now or after a
+        failover re-own — its sequencer feeds the same hook."""
+        for sid in sorted(self._shards):
+            self._shards[sid].set_commit_hook(fn)
+
     def _owner(self, doc_id: str) -> LocalOrderingService:
         return self._shards[self.router.owner(doc_id)]
 

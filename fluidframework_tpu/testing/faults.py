@@ -90,6 +90,21 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # feeds — slows deterministically.
     "catchup.fail": ("fail",),
     "catchup.slow": ("delay",),
+    # Streaming fold tier (round 16): ``stream.stall`` makes the
+    # streaming service skip a whole poll round (the dirty docs stay
+    # pending and the NEXT catch-up takes the ordinary cold-fold path —
+    # the degradation under test), ``stream.crash`` raises out of the
+    # per-doc fold mid-round (the service must swallow it, count it,
+    # and leave the doc foldable later).  Log truncation crash points
+    # mirror PR 12's migration style: ``oplog.truncate.seal`` fires
+    # BEFORE the truncation marker is durable (a crash here leaves the
+    # log byte-identical), ``oplog.truncate.drop`` fires AFTER the
+    # marker is durable but BEFORE physical compaction (a crash here
+    # must reopen to the same floor with the old bytes still present).
+    "stream.stall": ("stall",),
+    "stream.crash": ("fail",),
+    "oplog.truncate.seal": ("fail",),
+    "oplog.truncate.drop": ("fail",),
 }
 
 #: sites matched by occurrence count (the seam calls ``fire``); the rest

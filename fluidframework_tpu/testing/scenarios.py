@@ -229,10 +229,41 @@ class ScenarioSpec:
     #: admission and zero modeled hold — the never-shed twin every
     #: shed/degraded client must converge byte-identically to
     storm_never_shed: bool = False
+    #: streaming fold (ISSUE 16): attach a
+    #: :class:`~..service.streamfold.StreamFoldService` to the storm's
+    #: in-proc server — committed micro-batches fold once per tick at
+    #: ``stream_cadence``, summaries publish to the streaming-head
+    #: index, and the oplog truncates behind the newest durable summary
+    #: (``stream_retention`` hot-tail floor).  Herd re-entries then
+    #: serve from the ``stream`` lane instead of cold folds.
+    stream: bool = False
+    stream_cadence: int = 8
+    stream_retention: int = 64
+    #: fail-loud floor on the real-caller election (ISSUE 16 satellite):
+    #: a gate that needs at least this many REAL catch-up callers per
+    #: document must declare it here — asking for more than
+    #: ``storm_clients_per_doc`` admits is a spec error, not a silently
+    #: clipped sample.
+    storm_min_cohort: int = 0
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
             raise ValueError("need at least one client per document")
+        if self.storm_min_cohort > self.storm_clients_per_doc:
+            raise ValueError(
+                f"{self.name!r} asks for storm_min_cohort="
+                f"{self.storm_min_cohort} real catch-up callers per doc "
+                f"but storm_clients_per_doc={self.storm_clients_per_doc} "
+                f"silently bounds the election — raise the bound or "
+                f"lower the gate")
+        if self.stream and not self.storm:
+            raise ValueError(
+                f"{self.name!r}: stream=True rides the storm's in-proc "
+                f"server — set storm=True")
+        if self.stream and self.out_of_proc:
+            raise ValueError(
+                f"{self.name!r}: streaming scenarios run in-proc (shard "
+                f"host processes take --stream directly)")
         if self.docs < 1 or self.shards < 1:
             raise ValueError(f"bad docs/shards on {self.name!r}")
         if self.out_of_proc and self.plan is not None:
@@ -536,6 +567,12 @@ class _CatchupStorm:
         self._session = _StormSession()
         self.clock = None
         self.server = None
+        self.streamfold = None
+        #: cohort members the storm_clients_per_doc bound clipped out of
+        #: the real-caller election (they stay columnar-modeled) — the
+        #: PR 15 silent bound, surfaced (ISSUE 16 satellite)
+        self.elected = 0
+        self.clipped = 0
         if not spec.out_of_proc:
             from ..service.server import OrderingServer
             from ..utils.telemetry import ConfigProvider, MonitoringContext
@@ -552,6 +589,13 @@ class _CatchupStorm:
             if not spec.storm_never_shed:
                 self.server.catchup_hold_seconds = (
                     spec.storm_fold_ticks * spec.storm_tick_seconds)
+            if spec.stream:
+                # Streaming fold rides the SAME server the storm drives:
+                # the commit hook attaches to the swarm's real service,
+                # and step() polls once per virtual tick.
+                self.streamfold = self.server.enable_streaming(
+                    cadence_ops=spec.stream_cadence,
+                    retention_floor=spec.stream_retention)
 
     # -- scheduling ------------------------------------------------------------
 
@@ -574,7 +618,10 @@ class _CatchupStorm:
         ends = np.concatenate([cuts, [members.size]])
         chosen: List[int] = []
         for s, e in zip(starts.tolist(), ends.tolist()):
-            chosen.extend(int(i) for i in members[s:min(e, s + k)])
+            take = min(e, s + k)
+            chosen.extend(int(i) for i in members[s:take])
+            self.clipped += e - take
+        self.elected += len(chosen)
         spread = max(1, int(self.swarm.spec.storm_spread_ticks))
         jitter = _hash_clients(self.swarm.spec.seed, 41,
                                np.asarray(chosen, dtype=np.int64))
@@ -595,6 +642,12 @@ class _CatchupStorm:
             # One swarm tick of storm time: previously-held fold leases
             # age toward expiry on the admission controller's clock.
             self.clock.sleep(self.swarm.spec.storm_tick_seconds)
+        if self.streamfold is not None:
+            # One streaming round per virtual tick.  step() runs after
+            # the tick's ingress group commit closed, so the truncation
+            # marker's flush commit point is real — poll() must never
+            # run inside an open oplog.batch().
+            self.streamfold.poll()
         # Everything due AT OR BEFORE t: the run loop skips storm steps
         # across the phase→quiescence boundary (those ticks advance ``t``
         # without a step), and an entry stranded at a skipped tick would
@@ -670,6 +723,7 @@ class _CatchupStorm:
             "warm": "swarm.storm_warm",
             "fold": "swarm.storm_folds",
             "degraded": "swarm.storm_degraded",
+            "stream": "swarm.storm_stream",
         }.get(lane, "swarm.storm_folds"))
         self._bump("swarm.storm_served")
         self.latencies.append(t - self.start_tick[i])
@@ -753,8 +807,16 @@ class _CatchupStorm:
         out: Dict[str, object] = {
             "mode": "proc" if self.server is None else "inproc",
             "requests": self.swarm.counters.get("swarm.storm_requests"),
+            # The real-caller election bound, surfaced: gates sampling
+            # "real folds" must read the bound they sampled under, and
+            # how many cohort members it clipped to columnar modeling.
+            "clients_per_doc_bound":
+                self.swarm.spec.storm_clients_per_doc,
+            "elected": self.elected,
+            "cohort_clipped": self.clipped,
             "served": self._count("swarm.storm_served"),
             "warm": self._count("swarm.storm_warm"),
+            "stream": self._count("swarm.storm_stream"),
             "folds": folds,
             "shed": shed,
             "degraded": degraded,
@@ -774,6 +836,8 @@ class _CatchupStorm:
                 self.server.admission_control.snapshot()
         else:
             out["remote"] = dict(sorted(self.remote.items()))
+        if self.streamfold is not None:
+            out["streamfold"] = self.streamfold.stats()
         return out
 
 
@@ -798,6 +862,10 @@ class ClientSwarm:
             "swarm.storm_warm", "swarm.storm_folds", "swarm.storm_shed",
             "swarm.storm_degraded", "swarm.storm_retries",
             "swarm.storm_fold_errors",
+            # streaming-head serves (ISSUE 16): a catch-up answered from
+            # the continuously-published summary index — no fold, no
+            # admission
+            "swarm.storm_stream",
         )
         # -- columnar per-client state (the whole point) ----------------
         idx = np.arange(n, dtype=np.int64)

@@ -1,0 +1,273 @@
+"""Summary-anchored log truncation safety (ISSUE 16, satellite 3).
+
+Kill-9-style crash coverage at BOTH truncation fault points — seal
+(before the marker is durable) and drop (after the marker, before
+compaction) — with reopen recovery asserted byte-identical against an
+untruncated oracle, plus the gap-repair boundary contract and recovery
+of a truncated document from its marker checkpoint.
+"""
+
+import os
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.protocol.wire import encode_sequenced_message
+from fluidframework_tpu.protocol.summary import SummaryStorage
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service import LocalOrderingService, OpLog
+from fluidframework_tpu.service.catchup import CatchupService
+from fluidframework_tpu.service.oplog import TruncatedRangeError
+from fluidframework_tpu.testing.faults import (
+    FaultError, FaultInjector, FaultPlan, FaultPoint,
+)
+
+
+def op(client, client_seq, ref_seq=0, contents=None):
+    return RawOperation(
+        client_id=client, client_seq=client_seq, ref_seq=ref_seq,
+        type=MessageType.OP, contents=contents or {"k": client_seq},
+    )
+
+
+def _fill(service, doc_id="doc", n=10, client="a"):
+    ep = service.create_document(doc_id) \
+        if not service.has_document(doc_id) else service.endpoint(doc_id)
+    if client not in ep._orderer.sequencer._slots:
+        ep.connect(client)
+    for i in range(1, n + 1):
+        ep.submit(op(client, i, ref_seq=ep.head_seq))
+    return ep
+
+
+def _records(oplog, doc_id="doc"):
+    """The full byte-identity view of one doc's surviving records."""
+    floor = oplog.floor(doc_id)
+    return [encode_sequenced_message(m)
+            for m in oplog.get(doc_id, from_seq=floor)]
+
+
+# -- the floor contract (in-memory) ------------------------------------------
+
+
+def test_truncate_drops_prefix_and_guards_reads():
+    service = LocalOrderingService()
+    _fill(service, n=10)  # head 11: JOIN + 10 ops
+    log = service.oplog
+    dropped = log.truncate("doc", 6)
+    assert dropped == 6
+    assert log.floor("doc") == 6
+    assert log.head("doc") == 11
+    # Exact-boundary gap repair is legal (half-open: floor excluded).
+    assert [m.seq for m in log.get("doc", from_seq=6)] == [7, 8, 9, 10, 11]
+    with pytest.raises(TruncatedRangeError):
+        log.get("doc", from_seq=5)
+    assert log.is_contiguous("doc")
+    # Re-truncating at/below the floor is a no-op, not a corruption.
+    assert log.truncate("doc", 6) == 0
+    assert log.truncate("doc", 3) == 0
+
+
+def test_truncate_clamps_to_head_and_empty_log_head_is_floor():
+    service = LocalOrderingService()
+    _fill(service, n=4)  # head 5
+    log = service.oplog
+    assert log.truncate("doc", 99) == 5  # clamped: everything sealed
+    assert log.floor("doc") == 5
+    assert log.head("doc") == 5  # empty log answers its floor
+    assert log.get("doc", from_seq=5) == []
+
+
+# -- crash at the SEAL point (before the marker is durable) ------------------
+
+
+def test_seal_crash_reopens_byte_identical_to_untruncated_oracle(tmp_path):
+    path = str(tmp_path / "ops.jsonl")
+    plan = FaultPlan(seed=0, points=(
+        FaultPoint("oplog.truncate.seal", "fail", at=1),))
+    log = OpLog(path, autoflush=True, faults=FaultInjector(plan))
+    service = LocalOrderingService(oplog=log)
+    _fill(service, n=10)
+    oracle = _records(log)  # the untruncated truth, pre-crash
+    with pytest.raises(FaultError):
+        log.truncate("doc", 6, checkpoint=service._orderers["doc"].checkpoint())
+    # Crashed BEFORE the marker hit the file: nothing sealed.
+    assert log.floor("doc") == 0
+    log.close()  # kill -9: reopen from bytes alone
+    reopened = OpLog(path)
+    assert reopened.floor("doc") == 0
+    assert reopened.truncation_checkpoint("doc") is None
+    assert _records(reopened) == oracle
+    assert reopened.is_contiguous("doc")
+
+
+# -- crash at the DROP point (marker durable, compaction lost) ---------------
+
+
+def test_drop_crash_marker_is_durable_and_reopen_converges(tmp_path):
+    path = str(tmp_path / "ops.jsonl")
+    plan = FaultPlan(seed=0, points=(
+        FaultPoint("oplog.truncate.drop", "fail", at=1),))
+    log = OpLog(path, autoflush=True, faults=FaultInjector(plan))
+    service = LocalOrderingService(oplog=log)
+    _fill(service, n=10)
+    oracle_tail = [encode_sequenced_message(m)
+                   for m in log.get("doc", from_seq=6)]
+    bytes_before = os.path.getsize(path)
+    with pytest.raises(FaultError):
+        log.truncate("doc", 6, checkpoint=service._orderers["doc"].checkpoint())
+    # The marker IS the commit point: the floor applied even though the
+    # compaction never ran (dead bytes linger until the next rewrite).
+    assert log.floor("doc") == 6
+    log.close()
+    reopened = OpLog(path)
+    assert reopened.floor("doc") == 6
+    assert reopened.truncation_checkpoint("doc") is not None
+    assert _records(reopened) == oracle_tail
+    with pytest.raises(TruncatedRangeError):
+        reopened.get("doc", from_seq=5)
+    # A clean truncation on the reopened log compacts the file: the
+    # sealed prefix's dead bytes are finally reclaimed.
+    reopened.truncate("doc", 8)
+    assert os.path.getsize(path) < bytes_before
+    assert reopened.bytes_reclaimed > 0
+    assert [m.seq for m in reopened.get("doc", from_seq=8)] == [9, 10, 11]
+
+
+def test_both_crash_points_then_clean_retry_is_exactly_once(tmp_path):
+    # seal-crash, retry drop-crashes, retry succeeds: the floor moves
+    # once, the drop count is exact, no record is dropped twice.
+    path = str(tmp_path / "ops.jsonl")
+    plan = FaultPlan(seed=0, points=(
+        FaultPoint("oplog.truncate.seal", "fail", at=1),
+        FaultPoint("oplog.truncate.drop", "fail", at=1),))
+    injector = FaultInjector(plan)
+    log = OpLog(path, autoflush=True, faults=injector)
+    service = LocalOrderingService(oplog=log)
+    _fill(service, n=10)
+    with pytest.raises(FaultError):
+        log.truncate("doc", 6)
+    assert log.floor("doc") == 0
+    with pytest.raises(FaultError):
+        log.truncate("doc", 6)
+    assert log.floor("doc") == 6  # marker durable on attempt 2
+    assert log.truncate("doc", 6) == 0  # already sealed: no-op
+    assert log.truncate("doc", 7) == 1  # one more record, exactly once
+    assert log.truncated_msgs == 7
+    assert not injector.unfired()
+
+
+# -- gap repair at exactly the truncation boundary ---------------------------
+
+
+def test_gap_repair_at_exact_boundary_after_reopen(tmp_path):
+    path = str(tmp_path / "ops.jsonl")
+    log = OpLog(path, autoflush=True)
+    service = LocalOrderingService(oplog=log)
+    _fill(service, n=10)
+    log.truncate("doc", 6, checkpoint=service._orderers["doc"].checkpoint())
+    log.close()
+    reopened = OpLog(path)
+    # A client whose last-seen seq IS the floor repairs its gap fine...
+    assert [m.seq for m in reopened.get("doc", from_seq=6)][:2] == [7, 8]
+    # ...one seq older and the log refuses loudly (re-anchor on the
+    # summary instead of silently serving a hole).
+    with pytest.raises(TruncatedRangeError) as exc:
+        reopened.get("doc", from_seq=5)
+    assert "floor" in str(exc.value)
+
+
+# -- recovery of a truncated document ----------------------------------------
+
+
+def test_truncated_doc_recovers_from_marker_checkpoint(tmp_path):
+    """Full replay is impossible below the floor — recovery must restore
+    the sequencer from the truncation marker's checkpoint and resume
+    stamping contiguously."""
+    path = str(tmp_path / "ops.jsonl")
+    log = OpLog(path, autoflush=True)
+    service = LocalOrderingService(oplog=log)
+    ep = _fill(service, n=10)  # head 11
+    log.truncate("doc", 6, checkpoint=service._orderers["doc"].checkpoint())
+    log.close()
+
+    service2 = LocalOrderingService(oplog=OpLog(path))
+    assert service2.has_document("doc")
+    ep2 = service2.endpoint("doc")
+    assert ep2.head_seq == 11
+    # Dedup floor survived the truncation: a replayed old client_seq is
+    # rejected, the next fresh one stamps head+1.
+    assert ep2.submit(op("a", 10, ref_seq=11)) is None
+    msg = ep2.submit(op("a", 11, ref_seq=11))
+    assert msg is not None and msg.seq == 12
+    assert service2.oplog.is_contiguous("doc")
+    assert ep.head_seq == 11  # the dead incarnation stayed at 11
+
+
+def test_truncated_catchup_converges_with_untruncated_oracle(tmp_path):
+    """End to end: summary + truncated tail folds to the same bytes as
+    the oracle that never truncated."""
+    def seeded(oplog):
+        storage = SummaryStorage()
+        rt = ContainerRuntime()
+        rt.create_datastore("ds").create_channel("sequence-tpu", "text")
+        storage.upload("doc", rt.summarize(), 0)
+        service = LocalOrderingService(oplog=oplog, storage=storage)
+        service.create_document("doc")
+        ep = service.endpoint("doc")
+        ep.connect("c")
+        for i in range(1, 13):
+            ep.submit(RawOperation(
+                client_id="c", client_seq=i, ref_seq=ep.head_seq,
+                type=MessageType.OP,
+                contents={"type": "groupedBatch", "ops": [
+                    {"ds": "ds", "channel": "text", "clientSeq": i,
+                     "contents": {"kind": "insert", "pos": 0,
+                                  "text": "x"}}]}))
+        return service
+
+    truncated = seeded(OpLog(str(tmp_path / "t.jsonl"), autoflush=True))
+    oracle = seeded(OpLog(str(tmp_path / "o.jsonl"), autoflush=True))
+    # Publish a mid-stream summary, then cut behind it.
+    mid = CatchupService(truncated, mesh=None).catch_up(
+        ["doc"], upload=True)
+    _handle, ref = mid["doc"]
+    truncated.oplog.truncate("doc", ref - 4,
+                             checkpoint=truncated._orderers["doc"].checkpoint())
+    assert truncated.oplog.floor("doc") > 0
+    got = CatchupService(truncated, mesh=None).catch_up(
+        ["doc"], upload=False)
+    want = CatchupService(oracle, mesh=None).catch_up(
+        ["doc"], upload=False)
+    # upload=False returns (content digest, ref_seq): byte identity.
+    assert got["doc"] == want["doc"]
+
+
+# -- import-side floor adoption ----------------------------------------------
+
+
+def test_adopt_floor_carries_truncation_across_migration(tmp_path):
+    src = OpLog(str(tmp_path / "src.jsonl"), autoflush=True)
+    service = LocalOrderingService(oplog=src)
+    _fill(service, n=10)
+    ckpt = service._orderers["doc"].checkpoint()
+    src.truncate("doc", 6, checkpoint=ckpt)
+
+    dst = OpLog(str(tmp_path / "dst.jsonl"), autoflush=True)
+    # Migration: adopt the source's floor FIRST (truncate() would clamp
+    # to the empty destination's head 0), then replay the tail.
+    dst.adopt_floor("doc", src.floor("doc"),
+                    src.truncation_checkpoint("doc"))
+    for m in src.get("doc", from_seq=src.floor("doc")):
+        dst.append("doc", m)
+    assert dst.floor("doc") == 6
+    assert dst.head("doc") == 11
+    assert _records(dst) == _records(src)
+    with pytest.raises(TruncatedRangeError):
+        dst.get("doc", from_seq=5)
+    dst.close()
+    # The adopted marker is durable: a reopen still refuses sealed reads
+    # and still knows the recovery checkpoint.
+    reopened = OpLog(str(tmp_path / "dst.jsonl"))
+    assert reopened.floor("doc") == 6
+    assert reopened.truncation_checkpoint("doc") is not None

@@ -374,3 +374,42 @@ def test_narrow_upload_shrinks_op_stream(packed_chunk, monkeypatch):
     assert narrow <= wide * 0.6, (
         f"narrow upload only {wide - narrow} of {wide} bytes saved"
     )
+
+
+def test_streamfold_gate_collapses_cold_folds(tmp_path):
+    """The streaming-fold gate (ISSUE 16) end to end at test scale: the
+    same catch-up storm with the sequencer-attached streaming fold ON
+    must serve its herd joins from the streaming head / warm tiers
+    (≥95%), collapse the cold folds the OFF run pays, bound the summary
+    lag by the fold cadence, and leave the oplog file strictly smaller
+    after summary-anchored truncation — all byte-identical to the OFF
+    run.  Runs the real ``tools.loadgen --stream`` entrypoint so the
+    JSON artifact contract is covered too."""
+    import json
+
+    from tools import loadgen
+
+    out = tmp_path / "stream.json"
+    rc = loadgen.main([
+        "--stream", "--clients", "96", "--docs", "4", "--shards", "2",
+        "--seed", "3", "--out", str(out),
+    ])
+    report = json.loads(out.read_text())
+    stream = report["stream"]
+    assert rc == 0 and stream["passed"], stream
+    assert stream["converged_identical"], (
+        "streaming on vs off diverged — the fold must be byte-identical"
+    )
+    assert stream["stream_serve_rate"] >= stream["gate_serve_rate"]
+    assert stream["cold_folds_on"] < stream["cold_folds_off"], (
+        f"streaming did not collapse cold folds: "
+        f"{stream['cold_folds_on']} vs {stream['cold_folds_off']}"
+    )
+    assert stream["stream_summary_lag_max_seqs"] \
+        <= stream["stream_lag_gate_seqs"]
+    assert stream["truncated_msgs"] > 0
+    assert 0 < stream["oplog_bytes_on"] \
+        < stream["oplog_bytes_untruncated_on"], (
+        "summary-anchored truncation did not shrink the durable log"
+    )
+    assert stream["oplog_bytes_reclaimed"] > 0

@@ -1,0 +1,378 @@
+"""Streaming fold (ISSUE 16): sequencer-attached incremental
+summarization with device-resident doc state.
+
+Covers the StreamFoldService poll loop (cadence, publish, stall/crash
+seams), the StreamHeadIndex publication map, the server's streaming-head
+catch-up lane, the pinned resident-state tier of DevicePackCache, the
+scenario-spec fail-loud validation for the real-caller election bound,
+and on-vs-off byte identity of the folded summaries.
+"""
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.catchup import CatchupService
+from fluidframework_tpu.service.catchup_cache import StreamHeadIndex
+from fluidframework_tpu.service.orderer import LocalOrderingService
+from fluidframework_tpu.service.server import OrderingServer
+from fluidframework_tpu.service.streamfold import StreamFoldService
+from fluidframework_tpu.testing.faults import (
+    FaultInjector, FaultPlan, FaultPoint,
+)
+
+
+def _seed_tree():
+    rt = ContainerRuntime()
+    rt.create_datastore("ds").create_channel("sequence-tpu", "text")
+    return rt.summarize()
+
+
+def _service_with_docs(n_docs=2, oplog=None):
+    service = LocalOrderingService(oplog=oplog)
+    tree = _seed_tree()
+    ids = []
+    for i in range(n_docs):
+        doc_id = f"sf-{i:02d}"
+        service.storage.upload(doc_id, tree, 0)
+        service.create_document(doc_id)
+        ids.append(doc_id)
+    return service, ids
+
+
+def _type(service, doc_id, n, client="c1"):
+    """Submit n single-char inserts through the real endpoint."""
+    ep = service.endpoint(doc_id)
+    if client not in ep._orderer.sequencer._slots:
+        ep.connect(client)  # the JOIN takes one sequence number
+    ref = service.oplog.head(doc_id)
+    start = 0
+    for msg in service.oplog.get(doc_id):
+        if msg.client_id == client:
+            start = max(start, msg.client_seq)
+    for i in range(n):
+        msg = ep.submit(RawOperation(
+            client_id=client, client_seq=start + i + 1, ref_seq=ref,
+            type=MessageType.OP,
+            contents={"type": "groupedBatch", "ops": [
+                {"ds": "ds", "channel": "text",
+                 "clientSeq": start + i + 1,
+                 "contents": {"kind": "insert", "pos": 0, "text": "a"}}]},
+        ))
+        ref = msg.seq
+    return ref
+
+
+# -- StreamHeadIndex ---------------------------------------------------------
+
+
+def test_head_index_publish_is_monotone_and_epoch_pinned():
+    idx = StreamHeadIndex()
+    assert idx.publish("d", "h1", 10, "e1")
+    assert idx.get("d", "e1") == ("h1", 10)
+    # Stale ref_seq never regresses the published head.
+    assert not idx.publish("d", "h0", 5, "e1")
+    assert idx.get("d", "e1") == ("h1", 10)
+    assert idx.counters.get("regressions") == 1
+    # A different epoch sweeps the map: old entries are unservable.
+    assert idx.get("d", "e2") is None
+    assert idx.publish("d", "h2", 12, "e2")
+    assert idx.get("d", "e2") == ("h2", 12)
+    assert len(idx) == 1
+
+
+def test_head_index_lag_high_water():
+    idx = StreamHeadIndex()
+    idx.publish("d", "h1", 10, "e")
+    assert idx.observe_lag("d", 14) == 4
+    assert idx.observe_lag("d", 11) == 1
+    assert idx.stats()["lag_max"] == 4
+    # Never-published doc: the whole head is lag.
+    assert idx.observe_lag("x", 7) == 7
+
+
+# -- the streaming poll loop -------------------------------------------------
+
+
+def test_poll_folds_at_cadence_and_publishes():
+    service, (d0, d1) = _service_with_docs()
+    catchup = CatchupService(service, mesh=None)
+    sf = StreamFoldService(service, catchup, cadence_ops=4,
+                           retention_floor=64, truncate=False).attach()
+    _type(service, d0, 5)
+    _type(service, d1, 2)  # below cadence: stays pending
+    assert sf.due() == [d0]
+    results = sf.poll()
+    assert set(results) == {d0}
+    handle, ref_seq = results[d0]
+    assert ref_seq == service.oplog.head(d0)
+    # Published through the index AND durable in the store.
+    assert sf.head_index.get(d0, service.storage.epoch) == (handle, ref_seq)
+    assert service.storage.read(handle) is not None
+    assert sf.counters["publishes"] == 1
+    assert sf.counters["ops_folded"] >= 5
+    # force folds the sub-cadence doc too.
+    assert set(sf.poll(force=True)) == {d1}
+    # Nothing pending → an empty round.
+    assert sf.poll(force=True) == {}
+    assert sf.stats()["pending_docs"] == 0
+
+
+def test_commit_hook_records_without_folding():
+    service, (d0, _d1) = _service_with_docs()
+    catchup = CatchupService(service, mesh=None)
+    sf = StreamFoldService(service, catchup, cadence_ops=2,
+                           truncate=False).attach()
+    _type(service, d0, 3)
+    # The hook only RECORDED: no fold happened during stamping.
+    assert sf.counters["folds"] == 0
+    assert sf.stats()["pending_docs"] == 1
+    sf.detach()
+    _type(service, d0, 2)
+    # Detached: commits after detach are invisible.
+    assert sf.due(force=True) == [d0]
+    heads = dict(sf._pending)
+    assert heads[d0] == 4  # JOIN + 3 ops; the 2 post-detach ops unseen
+
+
+def test_stall_skips_round_and_crash_aborts_mid_selection():
+    plan = FaultPlan(seed=0, points=(
+        FaultPoint("stream.stall", "stall", at=1),
+        FaultPoint("stream.crash", "fail", at=1),
+    ))
+    faults = FaultInjector(plan)
+    service, (d0, d1) = _service_with_docs()
+    catchup = CatchupService(service, mesh=None)
+    sf = StreamFoldService(service, catchup, cadence_ops=2,
+                           truncate=False, faults=faults).attach()
+    _type(service, d0, 3)
+    _type(service, d1, 3)
+    # Round 1 stalls whole: nothing folds, both docs stay pending.
+    assert sf.poll() == {}
+    assert sf.counters["stalls"] == 1
+    assert sf.stats()["pending_docs"] == 2
+    # Round 2 crashes mid-selection on the FIRST doc: the round dies,
+    # both docs survive to fold next round (swallowed + counted).
+    assert sf.poll() == {}
+    assert sf.counters["crashes"] == 1
+    assert sf.stats()["pending_docs"] == 2
+    # Round 3 is clean: both fold, byte-identical to a cold fold.
+    results = sf.poll()
+    assert set(results) == {d0, d1}
+    assert not faults.unfired()
+
+
+def test_streaming_matches_cold_fold_byte_identically():
+    # Twin corpora: one folds continuously via streaming, the other cold
+    # at the end — same bytes (the SAME CatchupService fold either way).
+    stream_svc, (sd,) = _service_with_docs(n_docs=1)
+    cold_svc, (cd,) = _service_with_docs(n_docs=1)
+    catchup = CatchupService(stream_svc, mesh=None)
+    sf = StreamFoldService(stream_svc, catchup, cadence_ops=4,
+                           truncate=False).attach()
+    for _ in range(4):
+        _type(stream_svc, sd, 4)
+        sf.poll()
+    _type(cold_svc, cd, 16)
+    cold = CatchupService(cold_svc, mesh=None).catch_up([cd], upload=False)
+    handle, ref_seq = sf.head_index.get(sd, stream_svc.storage.epoch)
+    assert ref_seq == 17 and cold[cd][1] == 17  # JOIN + 16 ops each
+    # upload=False hands back the fold's content digest, not a store
+    # handle — exactly the byte-identity token we want to compare.
+    assert stream_svc.storage.read(handle).digest() == cold[cd][0]
+
+
+# -- summary-anchored truncation via the poll loop ---------------------------
+
+
+def test_poll_truncates_behind_summary_with_retention_floor():
+    service, (d0,) = _service_with_docs(n_docs=1)
+    catchup = CatchupService(service, mesh=None)
+    sf = StreamFoldService(service, catchup, cadence_ops=4,
+                           retention_floor=4).attach()
+    _type(service, d0, 16)  # head 17: JOIN + 16 ops
+    results = sf.poll()
+    assert results[d0][1] == 17
+    # cut = min(summary ref 17, MSN, head 17 − retention 4 = 13)
+    floor = service.oplog.floor(d0)
+    assert 0 < floor <= 13
+    assert sf.counters["truncations"] == 1
+    assert sf.counters["truncated_msgs"] == floor
+    # Boundary gap-repair read stays legal; below raises.
+    tail = service.oplog.get(d0, from_seq=floor)
+    assert [m.seq for m in tail] == list(range(floor + 1, 18))
+    from fluidframework_tpu.service.oplog import TruncatedRangeError
+    with pytest.raises(TruncatedRangeError):
+        service.oplog.get(d0, from_seq=floor - 1)
+    # The truncated doc still catches up byte-identically (summary+tail).
+    again = CatchupService(service, mesh=None).catch_up([d0], upload=False)
+    assert again[d0][1] == 17
+
+
+# -- the server's streaming-head lane ----------------------------------------
+
+
+class _Session:
+    client_id = "storm"
+    authenticated = True
+    tenant = None
+
+
+def test_server_stream_lane_serves_published_head():
+    service, (d0,) = _service_with_docs(n_docs=1)
+    server = OrderingServer(service)
+    sf = server.enable_streaming(cadence_ops=4, retention_floor=64)
+    _type(service, d0, 8)  # head 9: JOIN + 8 ops
+    folded = server._dispatch(_Session(), "stream_poll", {})
+    assert folded["folded"][d0][1] == 9
+    # Two more ops — within the stream lag: served from the streaming
+    # head with NO fold, lane marked, admission counter bumped.
+    _type(service, d0, 2)
+    before = server.admission.get("catchup.stream")
+    out = server._dispatch(_Session(), "catchup", {"docs": [d0]})
+    assert out["lane"] == "stream"
+    assert out["stream"] == [d0]
+    assert out["docs"][d0][1] == 9  # the published ref_seq, tail repairs
+    assert server.admission.get("catchup.stream") == before + 1
+    # The served handle resolves and the tail read is available.
+    handle, ref_seq = out["docs"][d0]
+    assert service.storage.read(handle) is not None
+    assert [m.seq for m in service.oplog.get(d0, from_seq=ref_seq)] \
+        == [10, 11]
+    assert sf.stats()["head_publishes"] >= 1
+
+
+def test_server_stream_lane_degrades_when_lag_exceeds_cadence():
+    service, (d0,) = _service_with_docs(n_docs=1)
+    server = OrderingServer(service)
+    server.enable_streaming(cadence_ops=4, retention_floor=64)
+    _type(service, d0, 8)  # head 9: JOIN + 8 ops
+    server._dispatch(_Session(), "stream_poll", {})
+    # The summary ages: 6 > cadence unfolded ops — the stream lane must
+    # NOT serve a stale head; the request falls through to the ordinary
+    # fold path and answers at the true head.
+    _type(service, d0, 6)
+    out = server._dispatch(_Session(), "catchup", {"docs": [d0]})
+    assert out["lane"] != "stream"
+    assert out["docs"][d0][1] == 15
+
+
+# -- pinned resident-state tier (DevicePackCache) ----------------------------
+
+
+def _pack_chunk(i, ops=6):
+    import bench
+    from fluidframework_tpu.ops.mergetree_kernel import pack_mergetree_batch
+
+    docs = [bench.synth_doc(i * 16 + j, ops) for j in range(2)]
+    for j, doc in enumerate(docs):
+        # Synthetic identity tokens (bench docs have none and would
+        # bypass the cache): same shape as the real (epoch, channel,
+        # ref, head) tuples.
+        doc.cache_token = ("e0", f"chunk{i}-doc{j}", 0, ops)
+        doc.binary_ops = None
+    state, packed_ops, meta = pack_mergetree_batch(docs)
+    return state, packed_ops, meta
+
+
+def test_device_cache_pin_survives_lru_sweep():
+    from fluidframework_tpu.ops.device_cache import DevicePackCache
+
+    cache = DevicePackCache(max_bytes=192 << 20, pin_max_bytes=64 << 20)
+    state, ops, meta = _pack_chunk(0)
+    cache.acquire(state, ops, meta, pin=True)
+    one_entry = cache.stats()["bytes"]
+    assert cache.stats()["pinned_entries"] == 1
+    # Shrink the device budget so two entries cannot coexist: the LRU
+    # sweep may only take UNPINNED entries — the pinned one survives
+    # even over-budget.
+    cache.max_bytes = one_entry + 1
+    state2, ops2, meta2 = _pack_chunk(1)
+    cache.acquire(state2, ops2, meta2)
+    stats = cache.stats()
+    assert stats["pinned_entries"] == 1
+    assert any(e.pinned for e in cache._entries.values())
+    # Control: with the first entry unpinned, the same pressure sweeps
+    # it out.
+    ctrl = DevicePackCache(max_bytes=one_entry + 1,
+                           pin_max_bytes=64 << 20)
+    ctrl.acquire(state, ops, meta)
+    ctrl.acquire(state2, ops2, meta2)
+    assert ctrl.stats()["evictions"] >= 1
+
+
+def test_device_cache_pin_budget_spills_to_host_and_restores():
+    from fluidframework_tpu.ops.device_cache import DevicePackCache
+
+    cache = DevicePackCache(max_bytes=192 << 20, pin_max_bytes=1)
+    state, ops, meta = _pack_chunk(2)
+    cache.acquire(state, ops, meta, pin=True)
+    # Pin budget is 1 byte: the pinned entry spills to host copies.
+    stats = cache.stats()
+    assert stats["spills"] >= 1
+    assert stats["pinned_bytes"] == 0
+    assert stats["spilled_bytes"] > 0
+    # Re-acquire restores the spilled entry (h2d) and serves it.
+    cache.pin_max_bytes = 64 << 20
+    cache.acquire(state, ops, meta, pin=True)
+    assert cache.stats()["unspills"] >= 1
+
+
+def test_device_cache_unpin_returns_entry_to_lru():
+    from fluidframework_tpu.ops.device_cache import DevicePackCache
+
+    cache = DevicePackCache(max_bytes=192 << 20, pin_max_bytes=64 << 20)
+    state, ops, meta = _pack_chunk(3)
+    cache.acquire(state, ops, meta, pin=True)
+    tokens = next(iter(cache._entries))
+    assert cache.unpin(tokens)
+    assert cache.stats()["pinned_entries"] == 0
+    assert not cache.unpin(tokens)  # already unpinned
+    assert not cache.pin(("nope",))  # unknown tokens
+
+
+# -- scenario-spec fail-loud validation (the PR 15 debt satellite) -----------
+
+
+def test_spec_rejects_gate_beyond_real_caller_bound():
+    from fluidframework_tpu.testing.scenarios import build_scenario
+    import dataclasses
+
+    spec = build_scenario("catchup-storm", seed=0, clients=64, docs=4,
+                          shards=1)
+    with pytest.raises(ValueError, match="silently bounds the election"):
+        dataclasses.replace(spec, storm_min_cohort=8,
+                            storm_clients_per_doc=4)
+    # Declaring a floor the bound admits is fine.
+    ok = dataclasses.replace(spec, storm_min_cohort=4)
+    assert ok.storm_min_cohort == 4
+
+
+def test_spec_rejects_stream_without_storm_server():
+    from fluidframework_tpu.testing.scenarios import build_scenario
+    import dataclasses
+
+    spec = build_scenario("steady-typing", seed=0, clients=64, docs=4,
+                          shards=1)
+    with pytest.raises(ValueError, match="storm=True"):
+        dataclasses.replace(spec, stream=True)
+
+
+def test_truncation_never_cuts_above_msn():
+    # A connected client pinned at an old ref_seq holds MSN down: the
+    # cut must stay at/below MSN so the client's gap repair still finds
+    # its records.
+    service, (d0,) = _service_with_docs(n_docs=1)
+    ep = service.endpoint(d0)
+    ep.connect("slow")
+    _type(service, d0, 16, client="typer")
+    ep.update_ref_seq("slow", 3)
+    catchup = CatchupService(service, mesh=None)
+    sf = StreamFoldService(service, catchup, cadence_ops=4,
+                           retention_floor=0).attach()
+    sf.note_doc(d0)
+    sf.poll(force=True)
+    msn = ep._orderer.sequencer.min_seq
+    assert service.oplog.floor(d0) <= msn
+    # The slow client's repair from its own ref view still reads.
+    assert service.oplog.get(d0, from_seq=msn) is not None

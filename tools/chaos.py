@@ -105,7 +105,7 @@ PROC_PLANS = ("kill-quake-proc",)
 #: through the REAL fold tier with the ``catchup.slow``/``catchup.fail``
 #: seams armed — shed, degraded-mode, and fold-crash recovery must all
 #: converge byte-identically to the never-shed oracle.
-STORM_PLANS = ("fold-squeeze",)
+STORM_PLANS = ("fold-squeeze", "stream-squeeze")
 
 
 def run_fold_squeeze(seeds: int) -> dict:
@@ -163,6 +163,86 @@ def run_fold_squeeze(seeds: int) -> dict:
         "failures": failures,
         "sequenced_ops": ops,
         "storm": storm_totals,
+        "fault_counts": fault_totals,
+    }
+
+
+def run_stream_squeeze(seeds: int) -> dict:
+    """The catchup-storm scenario with the STREAMING fold attached
+    (ISSUE 16) and its chaos seams armed: a stall window parked over the
+    herd re-entry makes the published summaries age past the stream lag
+    — those catch-ups must DEGRADE to the ordinary cold-fold path,
+    deterministically, with the downgrade visible in the lane counters —
+    and a poll-round crash mid-selection must be swallowed, counted, and
+    leave the unpicked documents foldable next round.  Every seed must
+    converge byte-identically to its never-shed oracle twin, the
+    streaming lane must carry serves outside the stall window, and the
+    truncation totals must show the log really shrank behind the
+    continuously-published summaries."""
+    import dataclasses
+
+    from fluidframework_tpu.testing.scenarios import (
+        build_scenario, oracle_spec, run_swarm)
+
+    survived = 0
+    ops = 0
+    fault_totals: dict = {}
+    failures: list = []
+    storm_totals = {"stream": 0, "warm": 0, "folds": 0, "shed": 0,
+                    "degraded": 0, "retries": 0, "fold_errors": 0}
+    stream_totals = {"stalls": 0, "crashes": 0, "truncations": 0,
+                     "truncated_msgs": 0}
+    for seed in range(seeds):
+        spec = build_scenario("catchup-storm", seed=seed, clients=1200,
+                              docs=8, shards=4)
+        # The streaming seams arm ON TOP of the storm's own
+        # catchup.slow/catchup.fail.  Polls run once per tick, so
+        # stall-occurrence ≈ tick: the 8-round window starts just
+        # before the herd cohort's jittered arrivals (herd phase ends
+        # around tick 88) — the downgrade happens while stormers land.
+        plan = FaultPlan(seed=seed, points=spec.plan.points + (
+            FaultPoint("stream.stall", "stall", at=85, count=8),
+            FaultPoint("stream.crash", "fail", at=40),
+        ))
+        spec = dataclasses.replace(spec, stream=True, plan=plan)
+        chaos = run_swarm(spec)
+        oracle = run_swarm(oracle_spec(spec, chaos))
+        covered = all(
+            chaos.fault_counts.get(f"{p.site}:{p.kind}", 0) > 0
+            for p in spec.plan.points)
+        sf = chaos.storm.get("streamfold") or {}
+        ok = (chaos.sampled_digests == oracle.sampled_digests
+              and chaos.per_doc_head == oracle.per_doc_head
+              and chaos.storm.get("served") == chaos.storm.get("requests")
+              and covered
+              and sf.get("stalls", 0) > 0
+              and sf.get("crashes", 0) > 0
+              and sf.get("truncations", 0) > 0)
+        if ok:
+            survived += 1
+        else:
+            failures.append({
+                "seed": seed,
+                "digest_match":
+                    chaos.sampled_digests == oracle.sampled_digests,
+                "head_match": chaos.per_doc_head == oracle.per_doc_head,
+                "covered": covered,
+                "streamfold": sf,
+            })
+        ops += chaos.sequenced_ops
+        for key in storm_totals:
+            storm_totals[key] += int(chaos.storm.get(key) or 0)
+        for key in stream_totals:
+            stream_totals[key] += int(sf.get(key) or 0)
+        for k, v in sorted(chaos.fault_counts.items()):
+            fault_totals[k] = fault_totals.get(k, 0) + v
+    return {
+        "scenarios": seeds,
+        "survived": survived,
+        "failures": failures,
+        "sequenced_ops": ops,
+        "storm": storm_totals,
+        "streamfold": stream_totals,
         "fault_counts": fault_totals,
     }
 
@@ -405,7 +485,9 @@ def main(argv=None) -> None:
                       f"{result['fault_counts']})", file=sys.stderr)
                 continue
             if name in STORM_PLANS:
-                result = run_fold_squeeze(args.seeds)
+                runner = (run_stream_squeeze if name == "stream-squeeze"
+                          else run_fold_squeeze)
+                result = runner(args.seeds)
                 result["wall_sec"] = round(time.time() - plan_t0, 3)
                 report["plans"][name] = result
                 print(f"{name}: {result['survived']}/"

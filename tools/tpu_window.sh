@@ -43,6 +43,11 @@ while true; do
     run_one sdt FF_NO_PALLAS_CANARY=1 python bench.py && MAIN_OK=1
     run_one legacy FF_NO_PALLAS_CANARY=1 BENCH_E2E_PIPELINE=legacy python bench.py && MAIN_OK=1
     run_one configs FF_NO_PALLAS_CANARY=1 python tools/bench_configs.py && MAIN_OK=1
+    # Streaming-fold counters under the real backend (ISSUE 16): the
+    # catchup-storm gate with the sequencer-attached streaming fold on
+    # vs off — steady fold rate, lag, lanes, truncation bytes (loadgen
+    # --stream prints the JSON document to stdout).
+    run_one streamfold FF_NO_PALLAS_CANARY=1 python -m tools.loadgen --stream --clients 1200 --docs 8 --shards 4 --seed 16 && MAIN_OK=1
     if [ "${#KEEP[@]}" -gt 0 ]; then
       log "committing ${#KEEP[@]} artifact(s): ${KEEP[*]}"
       git add -- "${KEEP[@]}" && \
