@@ -126,7 +126,7 @@ class FileSummaryStorage(SummaryStorage):
             f.write(self.epoch)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp_path, self._epoch_path)
+        os.replace(tmp_path, self._epoch_path)  # commit-point: epoch publish
         # fsync the DIRECTORY too: the rename itself must be durable,
         # or a crash could lose the epoch file and a reopen would mint
         # a new generation for a store whose data survived.
@@ -204,7 +204,7 @@ class FileSummaryStorage(SummaryStorage):
                 "doc": commit.doc_id, "handle": commit.tree,
                 "refSeq": commit.ref_seq, "parent": commit.parent,
                 "message": commit.message,
-            })
+            })  # commit-point: summary commit record
             # Deliberately NOT refreshing the scan memo here: the file
             # size now also covers bytes OTHER processes appended since
             # our last scan, and marking those as seen would make the
@@ -218,7 +218,7 @@ class FileSummaryStorage(SummaryStorage):
             super().create_ref(doc_id, name, commit_digest)
             _append_jsonl(self._refs_path,
                           {"doc": doc_id, "ref": name,
-                           "commit": commit_digest})
+                           "commit": commit_digest})  # commit-point: ref pin record
 
     def _store(self, node: Union[SummaryTree, SummaryBlob]) -> str:
         digest = super()._store(node)
@@ -234,7 +234,9 @@ class FileSummaryStorage(SummaryStorage):
                 self._faulted_store(fault, tmp, node)
             with open(tmp, "wb") as f:
                 f.write(_serialize_node(node))
-            os.replace(tmp, path)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # commit-point: summary object publish
         return digest
 
     def _faulted_store(self, fault, tmp: str,

@@ -148,7 +148,14 @@ def _build_library() -> Optional[str]:
              "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, lib_path)  # atomic under concurrent builders
+        # g++ wrote the artifact through its own descriptors: reopen and
+        # fsync before publishing, or a crash can install a torn .so.
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, lib_path)  # commit-point: native library publish
     except (OSError, subprocess.SubprocessError):
         return None
     finally:

@@ -92,17 +92,17 @@ class Sequencer:
 
     def __init__(self, start_seq: int = INITIAL_SEQ,
                  throttle=None) -> None:
-        self._seq = start_seq
-        self._min_seq = start_seq
+        self._seq = start_seq  # durable-shadow: stamp counter
+        self._min_seq = start_seq  # durable-shadow: collaboration-window floor
         #: optional policy: callable(client_id) -> retry-after seconds when
         #: this submit should be NACKed (throttling), else None.
         self.throttle = throttle
         self.nacks_issued = 0
         # -- columnar quorum state (client_id -> slot into the arrays) --
-        self._slots: Dict[str, int] = {}
-        self._ref = np.empty(0, dtype=np.int64)
-        self._floor = np.empty(0, dtype=np.int64)
-        self._session: List[Optional[str]] = []
+        self._slots: Dict[str, int] = {}  # durable-shadow: quorum membership
+        self._ref = np.empty(0, dtype=np.int64)  # durable-shadow: ref seqs
+        self._floor = np.empty(0, dtype=np.int64)  # durable-shadow: dedup floors
+        self._session: List[Optional[str]] = []  # durable-shadow: session tokens
         self._free: List[int] = []
         self._subscribers: List[Callable[[SequencedMessage], None]] = []
         #: commit WATCHERS (round 16, the streaming fold's cadence feed):
@@ -113,8 +113,8 @@ class Sequencer:
         #: ``has_subscribers_besides``, so watching a document does not
         #: knock its client OP columns off the columnar fast path.
         self._watchers: List[Callable[[int], None]] = []
-        self._log: List[SequencedMessage] = []
-        self._clock = 0
+        self._log: List[SequencedMessage] = []  # durable-shadow: stamped log
+        self._clock = 0  # durable-shadow: logical timestamp
         # Delivery queue: stamping is allowed *during* a broadcast (e.g. the
         # scribe acks a summary from inside its subscription callback), but
         # delivery must stay in total order — re-entrant stamps are queued
@@ -202,7 +202,7 @@ class Sequencer:
                 ref_seq=self._seq,
                 type_=MessageType.JOIN,
                 contents={"clientId": client_id},
-            )
+            )  # unwinds: _slots
         except BaseException:
             # A JOIN whose durable append failed (unwound) must not
             # leave the client in the quorum: the retry's connect would
@@ -242,7 +242,7 @@ class Sequencer:
                         type_=MessageType.JOIN,
                         contents={"clientId": client_id},
                         recompute_msn=False,
-                    )
+                    )  # unwinds: _slots
                 except BaseException:
                     # Same unwind discipline as connect(): an un-stamped
                     # JOIN must not leave the client in the quorum.
@@ -306,7 +306,7 @@ class Sequencer:
         segment = JoinColumnSegment(tuple(client_ids), start,
                                     self._min_seq, clock0)
         try:
-            gate(segment)
+            gate(segment)  # commit-point: columnar JOIN cohort; unwinds: _seq, _clock, _ref, _floor, _slots, _session
         except ColumnAppendError as err:
             landed = err.landed
             self._seq = start - 1 + landed
@@ -345,7 +345,7 @@ class Sequencer:
                 ref_seq=self._seq,
                 type_=MessageType.LEAVE,
                 contents={"clientId": client_id},
-            )
+            )  # unwinds: _slots
         except BaseException:
             # Same unwind discipline as connect: an un-stamped LEAVE must
             # leave the quorum membership (and its MSN contribution)
@@ -480,7 +480,7 @@ class Sequencer:
         segment = OpColumnSegment(batch, kept_rows, start,
                                   self._min_seq, clock0)
         try:
-            gate(segment)
+            gate(segment)  # commit-point: columnar OP segment; unwinds: _seq, _clock, _floor, _ref
         except ColumnAppendError as err:
             landed = err.landed
             self._seq = start - 1 + landed
@@ -546,7 +546,7 @@ class Sequencer:
                 type_=op.type,
                 contents=op.contents,
                 recompute_msn=recompute_msn,
-            )
+            )  # unwinds: _floor, _ref
         except BaseException:
             # A failed stamp that UNWOUND (durable append refused the
             # message — see _stamp's rollback) must also restore the
@@ -740,7 +740,7 @@ class Sequencer:
                     delivered_to = 0
                     try:
                         for fn in list(self._subscribers):
-                            fn(queued)
+                            fn(queued)  # commit-point: durable gate rides first; unwinds: _seq, _clock, _log
                             delivered_to += 1
                     except BaseException:
                         # The FIRST subscriber is the durability gate

@@ -32,6 +32,7 @@ from ..protocol.summary import SummaryTree, canonical_json
 from ..runtime.container import ContainerRuntime
 from ..runtime.op_pipeline import decode_stream
 from ..runtime.registry import ChannelRegistry, default_registry
+from . import gates
 from .orderer import LocalOrderingService
 
 def jax_profiler_trace(log_dir: str):
@@ -153,7 +154,7 @@ class CatchupService:
     #: died without reaching its finally (killed executor thread, OOM)
     #: must not hang followers forever.  Configurable via the
     #: ``Catchup.JoinTimeout`` gate; folds themselves are unaffected.
-    JOIN_TIMEOUT = 60.0
+    JOIN_TIMEOUT = float(gates.default("Catchup.JoinTimeout"))
 
     def __init__(
         self,
@@ -182,25 +183,26 @@ class CatchupService:
         from ..ops.pipeline import PackCache
         from .catchup_cache import CatchupResultCache, DeltaExportCache
 
-        def _gated(value, gate_key, bytes_key, default_bytes, ctor):
+        def _gated(value, gate_key, bytes_key, ctor):
+            # Defaults come from the gates registry — the single source
+            # the FL-DUR-GATE drift check pins call sites against.
             if value != "default":
                 return value
-            gate = str(self.mc.config.raw(gate_key) or "on").strip().lower()
-            if gate in ("off", "false", "0"):
+            if not gates.is_on(self.mc.config, gate_key):
                 return None
-            return ctor(self.mc.config.get_int(bytes_key, default_bytes))
+            return ctor(gates.get_int(self.mc.config, bytes_key))
 
         self.cache = _gated(cache, "Catchup.Cache", "Catchup.CacheBytes",
-                            256 << 20, CatchupResultCache)
+                            CatchupResultCache)
         self._pack_cache = _gated(pack_cache, "Catchup.PackCache",
-                                  "Catchup.PackCacheBytes", 192 << 20,
+                                  "Catchup.PackCacheBytes",
                                   PackCache)
         # Tier 0 (ISSUE 6): digest-gated delta download — summaries stay
         # device-resident; only changed documents' export rows cross the
         # d2h link on a warm catch-up.  Gate Catchup.DeltaDownload
         # (default ON) / Catchup.DeltaCacheBytes.
         self.delta_cache = _gated(delta_cache, "Catchup.DeltaDownload",
-                                   "Catchup.DeltaCacheBytes", 256 << 20,
+                                   "Catchup.DeltaCacheBytes",
                                    DeltaExportCache)
         # Tier 2.5 (ISSUE 13): device-resident pack buffers — the upload
         # mirror of tier 0.  Packed chunk arrays stay in device memory
@@ -211,7 +213,7 @@ class CatchupService:
         from ..ops.device_cache import DevicePackCache
 
         self.device_cache = _gated(device_cache, "Catchup.DeviceResident",
-                                    "Catchup.DeviceCacheBytes", 192 << 20,
+                                    "Catchup.DeviceCacheBytes",
                                     DevicePackCache)
         # The SECOND kernel family (ISSUE 14): tree channels ride the
         # same four-tier pipeline.  Tier 0/1 are family-agnostic and
@@ -227,26 +229,22 @@ class CatchupService:
         # tree planes exactly like the merge-tree ones).
         self.tree_pack_cache = (
             tree_pack_cache(
-                self.mc.config.get_int("Catchup.PackCacheBytes",
-                                       192 << 20))
+                gates.get_int(self.mc.config, "Catchup.PackCacheBytes"))
             if isinstance(self._pack_cache, PackCache) else None)
         self.tree_device_cache = (
             tree_device_cache(
-                self.mc.config.get_int("Catchup.DeviceCacheBytes",
-                                       192 << 20))
+                gates.get_int(self.mc.config, "Catchup.DeviceCacheBytes"))
             if isinstance(self.device_cache, DevicePackCache) else None)
         #: kernel channels that fell back to the oracle path (ISSUE 14
         #: satellite: hostChannels alone could not distinguish a
         #: non-kernel channel from a kernel channel that fell back).
         self.fallback_channels = 0  # guarded-by: _serial
-        raw_timeout = self.mc.config.raw("Catchup.JoinTimeout")
-        try:
-            # Explicit None check: a configured 0 means "never wait on a
-            # leader, always fold" and must not fall back to the default.
-            self.join_timeout = self.JOIN_TIMEOUT if raw_timeout is None \
-                else float(raw_timeout)
-        except (TypeError, ValueError):
-            self.join_timeout = self.JOIN_TIMEOUT
+        # Tolerant parse, explicit-None default: a configured 0 means
+        # "never wait on a leader, always fold" and must not fall back
+        # to the default.
+        self.join_timeout = gates.get_float(
+            self.mc.config, "Catchup.JoinTimeout",
+            fallback=self.JOIN_TIMEOUT)
         #: busy-seconds per pipeline stage (pack/upload/dispatch/
         #: device_wait/download/extract, plus the h2d_bytes/d2h_bytes
         #: integer counters) and device/fallback doc counts, accumulated
@@ -295,10 +293,7 @@ class CatchupService:
         if not self._mesh_resolved:
             self._mesh_resolved = True
             self._mesh = None
-            gate = str(
-                self.mc.config.raw("Catchup.Mesh") or "auto"
-            ).strip().lower()
-            if gate not in ("off", "false", "0"):
+            if gates.is_on(self.mc.config, "Catchup.Mesh"):
                 import jax
 
                 from ..parallel.shard import doc_mesh
@@ -406,7 +401,7 @@ class CatchupService:
             # fold pass so their metadata scan (latest + tail + digest)
             # and hit counting never run twice.
             prefetched = served
-        profile_dir = self.mc.config.raw("Catchup.ProfileDir")
+        profile_dir = gates.raw(self.mc.config, "Catchup.ProfileDir")
         with CatchupService._serial:
             self._pin_resident = pin_resident
             tracer = (

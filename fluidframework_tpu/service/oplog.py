@@ -82,7 +82,7 @@ class OpLog:
                  read_only: bool = False) -> None:
         if read_only and path is None:
             raise ValueError("read_only needs a file-backed log")
-        self._docs: Dict[str, List[SequencedMessage]] = {}
+        self._docs: Dict[str, List[SequencedMessage]] = {}  # durable-shadow: log view
         #: summary-anchored truncation floor per doc: seqs <= floor have
         #: been sealed and dropped; reads from below raise
         #: :class:`TruncatedRangeError`.  0 = never truncated.
@@ -103,7 +103,7 @@ class OpLog:
         #: ONE flush at outermost batch exit (group commit)
         self._batch_depth = 0
         self._batch_dirty = False
-        self._file: Optional[io.TextIOWrapper] = None
+        self._file: Optional[io.TextIOWrapper] = None  # durable-handle: single-record
         if path is not None:
             # The op log is the highest-write-rate file in the store: a
             # crash mid-append is likeliest here.  Repair the torn tail
@@ -173,7 +173,7 @@ class OpLog:
             if fault is not None and fault.kind == "torn":
                 self._torn_append(log, line, fault)
             try:
-                self._file.write(line)
+                self._file.write(line)  # commit-point: op record; unwinds: _docs
                 if self._autoflush:
                     if self._batch_depth:
                         # Group commit (batched ingress): defer the fsync
@@ -246,7 +246,7 @@ class OpLog:
         landed = 0
         try:
             for line in lines:
-                self._file.write(line)
+                self._file.write(line)  # commit-point: columnar op records; unwinds: _docs
                 landed += 1
             if self._autoflush:
                 if self._batch_depth:
@@ -419,7 +419,7 @@ class OpLog:
                    "truncate": {"below": below_seq,
                                 "checkpoint": checkpoint}}
             self._file.write(canonical_json(rec).decode("utf-8") + "\n")
-            self.flush()  # the marker IS the commit point: fsync it
+            self.flush()  # commit-point: truncation marker fsync
         dropped = self._apply_marker(doc_id, below_seq, checkpoint)
         self.truncations += 1
         self.truncated_msgs += dropped
@@ -450,7 +450,7 @@ class OpLog:
             rec = {"doc": doc_id,
                    "truncate": {"below": below, "checkpoint": checkpoint}}
             self._file.write(canonical_json(rec).decode("utf-8") + "\n")
-            self.flush()
+            self.flush()  # commit-point: adopted truncation marker
         self._apply_marker(doc_id, below, checkpoint)
 
     def _compact(self) -> None:

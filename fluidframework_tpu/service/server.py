@@ -42,6 +42,7 @@ from ..protocol.summary import tree_from_obj, tree_to_obj
 from ..protocol.wire import (LEN as _LEN, MAX_FRAME, WIRE_VERSION,
                              decode_raw_operation,
                              encode_sequenced_message, frame_bytes)
+from . import gates
 from .broadcaster import Broadcaster
 from .orderer import LocalOrderingService
 
@@ -391,13 +392,6 @@ class OrderingServer:
         self.mc = mc if mc is not None else MonitoringContext()
         cfg = self.mc.config
 
-        def _cfg_float(key: str, default: float) -> float:
-            raw = cfg.raw(key)
-            try:
-                return default if raw is None else float(raw)
-            except (TypeError, ValueError):
-                return default
-
         #: injected clock for every admission/pacing decision —
         #: time.monotonic in production, a VirtualClock (whose reads and
         #: ``sleep`` advance virtual time) in deterministic harnesses.
@@ -409,30 +403,29 @@ class OrderingServer:
         #: queue depth (clients catch up from the durable op log
         #: instead), or — under SUSTAINED overload — served DEGRADED
         #: from the stored summary at an older ref_seq.
-        self.catchup_max_inflight = cfg.get_int(
-            "Catchup.MaxInflight", int(catchup_max_inflight))
+        self.catchup_max_inflight = gates.get_int(
+            cfg, "Catchup.MaxInflight",
+            fallback=int(catchup_max_inflight))
         self.admission_control = AdmissionController(
             self.catchup_max_inflight, clock=self.clock,
-            retry_floor=_cfg_float("Catchup.ShedRetryFloor", 0.05),
-            retry_cap=_cfg_float("Catchup.ShedRetryCap", 5.0),
-            degrade_after=cfg.get_int("Catchup.DegradeAfter", 2))
+            retry_floor=gates.get_float(cfg, "Catchup.ShedRetryFloor"),
+            retry_cap=gates.get_float(cfg, "Catchup.ShedRetryCap"),
+            degrade_after=gates.get_int(cfg, "Catchup.DegradeAfter"))
         #: Catchup.DegradedServe gate (default ON): under sustained
         #: overload serve the tier-1 stored summary at an older ref_seq
         #: — the client replays the durable tail via normal gap repair —
         #: instead of pure shedding.
-        self.degraded_serve = str(
-            cfg.raw("Catchup.DegradedServe") or "on"
-        ).strip().lower() not in ("off", "false", "0")
+        self.degraded_serve = gates.is_on(cfg, "Catchup.DegradedServe")
         #: retry_after on the ``shuttingDown`` drain nack
         #: (Server.DrainRetryAfter gate; was a hardcoded 0.5).
-        self.drain_retry_after = _cfg_float("Server.DrainRetryAfter", 0.5)
+        self.drain_retry_after = gates.get_float(cfg, "Server.DrainRetryAfter")
         #: bound on the warm lane's single-flight join
         #: (Catchup.WarmJoinTimeout): a wedged leader must turn joiners
         #: into FOLD-LANE requests — where admission sheds with pacing —
         #: after seconds, not park them on executor threads for the full
         #: crashed-leader JoinTimeout (60 s).
-        self.warm_join_timeout = _cfg_float("Catchup.WarmJoinTimeout",
-                                            5.0)
+        self.warm_join_timeout = gates.get_float(cfg,
+                                                 "Catchup.WarmJoinTimeout")
         #: modeled fold duration: extra clock seconds an admission lease
         #: stays occupied AFTER the synchronous fold returns.  0 in
         #: production; the deterministic storm harness sets it so
@@ -452,11 +445,9 @@ class OrderingServer:
         #: state, summary-anchored oplog truncation) and catch-up serves
         #: the STREAMING HEAD lane — summaries at most one cadence behind
         #: the durable head, no fold, no admission.
-        self.stream_enabled = str(
-            cfg.raw("Catchup.Stream") or "off"
-        ).strip().lower() in ("on", "true", "1")
-        self.stream_cadence = cfg.get_int("Catchup.StreamCadence", 8)
-        self.stream_retention = cfg.get_int("Catchup.StreamRetention", 64)
+        self.stream_enabled = gates.is_on(cfg, "Catchup.Stream")
+        self.stream_cadence = gates.get_int(cfg, "Catchup.StreamCadence")
+        self.stream_retention = gates.get_int(cfg, "Catchup.StreamRetention")
         self.streamfold = None  # guarded-by: _catchup_init (lazy)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.base_events.Server] = None

@@ -267,7 +267,7 @@ class ShardHost:
         with self.oplog.batch():
             for rec in records:
                 self.oplog.append(doc_id, decode_sequenced_message(rec))
-        self.oplog.flush()
+        self.oplog.flush()  # commit-point: imported span fsync
         if floor > 0:
             self.oplog.adopt_floor(doc_id, int(floor), trunc_checkpoint)
         if checkpoint is not None:

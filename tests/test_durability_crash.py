@@ -1,0 +1,123 @@
+"""Crash-consistency sweeps for the temp-write→publish paths.
+
+The static side of this contract lives in fluidlint's durability family
+(FL-DUR-RENAME / FL-DUR-COMMIT); these tests are the dynamic half: an
+ALICE-style sweep that simulates a crash at EVERY byte offset of the
+summary-object publish, plus ordering regressions for the two fsync
+fixes the analyzer found (file_driver._store published without fsync;
+native_pack._build_library published g++'s artifact without reopening
+and fsyncing it).
+"""
+
+import os
+
+import pytest
+
+from fluidframework_tpu.drivers.file_driver import FileSummaryStorage
+from fluidframework_tpu.ops import native_pack
+from fluidframework_tpu.protocol.summary import SummaryTree
+
+
+def _tree() -> SummaryTree:
+    tree = SummaryTree()
+    tree.add_blob("payload", b"durability sweep payload")
+    sub = tree.add_tree("sub")
+    sub.add_blob("x", b"nested blob")
+    return tree
+
+
+def test_summary_publish_crash_sweep_every_offset(tmp_path):
+    """Simulate a crash after every byte of the tmp write, before the
+    rename: the torn tmp must never be visible to reads, must be swept
+    on reopen, and a re-upload must heal the handle byte-identically."""
+    ref_root = str(tmp_path / "ref")
+    handle = FileSummaryStorage(ref_root).upload("d", _tree(), 1)
+    data = open(os.path.join(ref_root, "objects", handle), "rb").read()
+    assert data, "reference object is empty — sweep would be vacuous"
+    for offset in range(len(data) + 1):
+        root = str(tmp_path / f"at{offset:04d}")
+        FileSummaryStorage(root)  # lay down the store skeleton
+        objects = os.path.join(root, "objects")
+        torn = os.path.join(objects, f"{handle}.tmp.999.1")
+        with open(torn, "wb") as f:
+            f.write(data[:offset])
+        reopened = FileSummaryStorage(root)
+        # swept, invisible, unreadable — the handle simply doesn't exist
+        assert not [n for n in os.listdir(objects) if ".tmp." in n], offset
+        assert reopened.head("d") is None, offset
+        with pytest.raises(KeyError):
+            reopened.read(handle)
+        # the retry heals: same content-addressed handle, readable tree
+        assert reopened.upload("d", _tree(), 1) == handle, offset
+        assert reopened.read(handle).digest() == handle, offset
+
+
+def test_store_fsyncs_before_publish(tmp_path, monkeypatch):
+    """Regression for the FL-DUR-RENAME true positive: every os.replace
+    that publishes a summary object must be preceded by an os.fsync of
+    the tmp bytes (a crash straight after the rename must not be able to
+    publish an empty or torn object)."""
+    storage = FileSummaryStorage(str(tmp_path / "store"))
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def rec_fsync(fd):
+        events.append(("fsync", None))
+        real_fsync(fd)
+
+    def rec_replace(src, dst):
+        events.append(("replace", src))
+        real_replace(src, dst)
+
+    monkeypatch.setattr(os, "fsync", rec_fsync)
+    monkeypatch.setattr(os, "replace", rec_replace)
+    storage.upload("d", _tree(), 1)
+    publishes = [i for i, (kind, src) in enumerate(events)
+                 if kind == "replace" and ".tmp." in str(src)]
+    assert publishes, "upload published no object — recording broke"
+    prev = -1
+    for i in publishes:
+        assert any(kind == "fsync" for kind, _ in events[prev + 1:i]), (
+            f"object publish at event {i} had no fsync since the "
+            f"previous publish: {events[:i + 1]}")
+        prev = i
+
+
+def test_native_pack_fsyncs_artifact_before_publish(tmp_path, monkeypatch):
+    """Regression for the second FL-DUR-RENAME true positive: g++ writes
+    the .so through its own descriptors, so _build_library must reopen
+    and fsync the artifact before the publishing rename."""
+    native = tmp_path / "native"
+    native.mkdir()
+    src = native / "oppack.cpp"
+    src.write_text("// fake source\n")
+    monkeypatch.setattr(native_pack, "_REPO_ROOT", str(tmp_path))
+    monkeypatch.setattr(native_pack, "_SRC", str(src))
+
+    def fake_gxx(cmd, **kwargs):
+        out = cmd[cmd.index("-o") + 1]
+        with open(out, "wb") as f:
+            f.write(b"\x7fELF fake shared object")
+
+    monkeypatch.setattr(native_pack.subprocess, "run", fake_gxx)
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+
+    def rec_fsync(fd):
+        events.append(("fsync", None))
+        real_fsync(fd)
+
+    def rec_replace(src_path, dst):
+        events.append(("replace", src_path))
+        real_replace(src_path, dst)
+
+    monkeypatch.setattr(os, "fsync", rec_fsync)
+    monkeypatch.setattr(os, "replace", rec_replace)
+    lib = native_pack._build_library()
+    assert lib is not None and os.path.exists(lib)
+    kinds = [kind for kind, _ in events]
+    assert "replace" in kinds, "library was never published"
+    publish = kinds.index("replace")
+    assert ".tmp" in str(events[publish][1])
+    assert "fsync" in kinds[:publish], (
+        f"artifact published without an fsync first: {events}")

@@ -439,6 +439,86 @@ MODULE_RULE_FIXTURES = {
         """,
         SERVICE,
     ),
+    "FL-DUR-RENAME": (
+        """
+        import os
+        def publish(tmp, path):
+            with open(tmp, "wb") as f:
+                f.write(b"data")
+            os.replace(tmp, path)
+        """,
+        """
+        import os
+        def publish(tmp, path):
+            with open(tmp, "wb") as f:
+                f.write(b"data")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        """,
+        SERVICE,
+    ),
+    "FL-DUR-COMMIT": (
+        """
+        class Log:
+            def append(self, msg, client):
+                client.ack(msg)
+                self._file.write(msg)  # commit-point: op record
+        """,
+        """
+        class Log:
+            def append(self, msg, client):
+                self._file.write(msg)  # commit-point: op record
+                client.ack(msg)
+        """,
+        SERVICE,
+    ),
+    "FL-DUR-UNWIND": (
+        """
+        class Seq:
+            def __init__(self):
+                self._seq = 0  # durable-shadow: stamp counter
+            def stamp(self, msg):
+                self._seq += 1
+                self._log.write(msg)  # unwinds: _seq
+        """,
+        """
+        class Seq:
+            def __init__(self):
+                self._seq = 0  # durable-shadow: stamp counter
+            def stamp(self, msg):
+                self._seq += 1
+                try:
+                    self._log.write(msg)  # unwinds: _seq
+                except Exception:
+                    self._seq -= 1
+                    raise
+        """,
+        SERVICE,
+    ),
+    "FL-DUR-TORN": (
+        """
+        import os
+        class Log:
+            def __init__(self, path):
+                self._file = open(path, "wb")  # durable-handle: single-record
+            def append(self, head, body):
+                self._file.write(head)
+                self._file.write(body)
+                os.fsync(self._file.fileno())
+        """,
+        """
+        import os
+        class Log:
+            def __init__(self, path):
+                self._file = open(path, "wb")  # durable-handle: single-record
+            def append(self, head, body):
+                self._file.write(head + body)
+                self._file.flush()
+                os.fsync(self._file.fileno())
+        """,
+        SERVICE,
+    ),
 }
 
 
@@ -2014,6 +2094,227 @@ def test_double_close_lock_wrapped_guard_accepted():
             self._writer.close()
     """
     assert findings_for(src, SERVICE, "FL-LEAK-DOUBLE-CLOSE") == []
+
+
+# -- fluiddur behavior details -----------------------------------------------
+
+
+def test_dur_rename_flags_os_rename_and_unflushed_fsync():
+    src = """
+    import os
+    def publish(tmp_path, path, f):
+        f.write(b"data")
+        os.fsync(f.fileno())
+        os.rename(tmp_path, path)
+    """
+    msgs = [f.message for f in findings_for(src, SERVICE, "FL-DUR-RENAME")]
+    assert any("use os.replace()" in m for m in msgs), msgs
+    assert any("without a preceding .flush()" in m for m in msgs), msgs
+
+
+def test_dur_rename_tmpness_through_local_assignment():
+    # the publish source is tmp-ish only via the local name it was
+    # assigned from — the rule must chase one level of assignment
+    src = """
+    import os
+    def publish(base, path):
+        staging = base + ".tmp"
+        os.replace(staging, path)
+    """
+    hits = findings_for(src, SERVICE, "FL-DUR-RENAME")
+    assert len(hits) == 1 and "no os.fsync()" in hits[0].message
+
+
+def test_dur_commit_annotation_requires_a_call():
+    src = """
+    class Log:
+        def append(self, msg):
+            pending = True  # commit-point: op record
+            self._file.write(msg)
+    """
+    hits = findings_for(src, SERVICE, "FL-DUR-COMMIT")
+    assert len(hits) == 1 and "no call" in hits[0].message
+
+
+def test_dur_commit_names_the_label():
+    src = """
+    class Log:
+        def append(self, msg, client):
+            client.broadcast(msg)
+            self._file.write(msg)  # commit-point: op record
+    """
+    hits = findings_for(src, SERVICE, "FL-DUR-COMMIT")
+    assert len(hits) == 1
+    assert "broadcast" in hits[0].message
+    assert "op record" in hits[0].message
+
+
+def test_dur_unwind_unknown_attribute_is_flagged():
+    src = """
+    class Seq:
+        def __init__(self):
+            self._seq = 0  # durable-shadow: stamp counter
+        def stamp(self, msg):
+            try:
+                self._log.write(msg)  # unwinds: _sqe
+            except Exception:
+                raise
+    """
+    hits = findings_for(src, SERVICE, "FL-DUR-UNWIND")
+    assert len(hits) == 1 and "_sqe" in hits[0].message
+    assert "not declared" in hits[0].message
+
+
+def test_dur_unwind_bare_commit_point_needs_pairing():
+    src = """
+    class Seq:
+        def __init__(self):
+            self._seq = 0  # durable-shadow: stamp counter
+        def stamp(self, msg):
+            self._seq += 1
+            self._log.write(msg)  # commit-point: stamp record
+    """
+    hits = findings_for(src, SERVICE, "FL-DUR-UNWIND")
+    assert len(hits) == 1
+    assert "no '# unwinds:' pairing" in hits[0].message
+
+
+def test_dur_unwind_restores_through_alias_and_helper():
+    # the two real restore shapes: a subscript store through a local
+    # alias of the shadow attr, and a one-level same-class helper call
+    src = """
+    class Seq:
+        def __init__(self):
+            self._docs = {}  # durable-shadow: log view
+            self._slots = {}  # durable-shadow: membership
+        def _drop(self, cid):
+            self._slots = {}
+        def stamp(self, cid, msg):
+            log = self._docs.setdefault(cid, [])
+            log.append(msg)
+            self._slots[cid] = 1
+            try:
+                self._file.write(msg)  # unwinds: _docs, _slots
+            except Exception:
+                log.pop()
+                self._drop(cid)
+                raise
+    """
+    assert findings_for(src, SERVICE, "FL-DUR-UNWIND") == []
+    # drop the helper call: _slots is no longer restored
+    broken = src.replace("                self._drop(cid)\n", "")
+    hits = findings_for(broken, SERVICE, "FL-DUR-UNWIND")
+    assert len(hits) == 1 and "'_slots'" in hits[0].message
+
+
+def test_dur_torn_same_class_fsync_helper_is_an_fsync_point():
+    src = """
+    import os
+    class Log:
+        def __init__(self, path):
+            self._file = open(path, "wb")  # durable-handle: single-record
+        def flush(self):
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        def append(self, head, body):
+            self._file.write(head)
+            self.flush()
+            self._file.write(body)
+            self.flush()
+    """
+    assert findings_for(src, SERVICE, "FL-DUR-TORN") == []
+    broken = src.replace("            self.flush()\n"
+                         "            self._file.write(body)",
+                         "            self._file.write(body)")
+    hits = findings_for(broken, SERVICE, "FL-DUR-TORN")
+    assert len(hits) == 1 and "torn record" in hits[0].message
+
+
+# -- project rule: FL-DUR-SEAM -----------------------------------------------
+
+
+def _write_seam_tree(root, faults_body, service_body):
+    pkg = root / "fluidframework_tpu"
+    (pkg / "testing").mkdir(parents=True)
+    (pkg / "service").mkdir()
+    (pkg / "testing" / "faults.py").write_text(textwrap.dedent(faults_body))
+    (pkg / "service" / "x.py").write_text(textwrap.dedent(service_body))
+
+
+def test_dur_seam_positive(tmp_path):
+    _write_seam_tree(tmp_path, """
+        SITES = {
+            "shard.kill": "kill a shard host",
+            "oplog.lost": "drop an oplog append",
+        }
+        SCHEDULED_SITES = ("shard.kill", "client.stall")
+    """, """
+        def hurt(faults):
+            faults.fire("shard.kill")
+            faults.fire("proc.vanish")
+    """)
+    msgs = {f.message for f in analyze(tmp_path) if f.rule == "FL-DUR-SEAM"}
+    assert any("'proc.vanish' is fired here but not registered" in m
+               for m in msgs), msgs
+    assert any("'oplog.lost' is armed nowhere" in m for m in msgs), msgs
+    assert any("'client.stall' is not a SITES key" in m for m in msgs), msgs
+
+
+def test_dur_seam_negative(tmp_path):
+    _write_seam_tree(tmp_path, """
+        SITES = {
+            "shard.kill": "kill a shard host",
+            "oplog.lost": "drop an oplog append",
+        }
+        SCHEDULED_SITES = ("shard.kill",)
+    """, """
+        def hurt(faults):
+            faults.fire("oplog.lost")
+            for site in ("shard.kill",):
+                faults.due(site)
+    """)
+    assert [f for f in analyze(tmp_path) if f.rule == "FL-DUR-SEAM"] == []
+
+
+# -- project rule: FL-DUR-GATE -----------------------------------------------
+
+
+def _write_gate_tree(root, gates_body, service_body):
+    pkg = root / "fluidframework_tpu" / "service"
+    pkg.mkdir(parents=True)
+    (pkg / "gates.py").write_text(textwrap.dedent(gates_body))
+    (pkg / "x.py").write_text(textwrap.dedent(service_body))
+
+
+def test_dur_gate_positive(tmp_path):
+    _write_gate_tree(tmp_path, """
+        GATES = {
+            "Catchup.Cache": "on",
+            "Catchup.Ghost": 1,
+        }
+    """, """
+        def read(config):
+            config.get_str("Catchup.Cache", "on")
+            config.get_int("Server.Unknown", 1)
+    """)
+    msgs = {f.message for f in analyze(tmp_path) if f.rule == "FL-DUR-GATE"}
+    assert any("'Server.Unknown' is read here but not registered" in m
+               for m in msgs), msgs
+    assert any("'Catchup.Ghost' is never read" in m for m in msgs), msgs
+
+
+def test_dur_gate_negative(tmp_path):
+    _write_gate_tree(tmp_path, """
+        GATES = {
+            "Catchup.Cache": "on",
+            "Server.DrainRetryAfter": 0.5,
+        }
+    """, """
+        def read(config):
+            config.get_str("Catchup.Cache", "on")
+            config.get_float("Server.DrainRetryAfter", 0.5)
+    """)
+    assert [f for f in analyze(tmp_path) if f.rule == "FL-DUR-GATE"] == []
 
 
 # -- registry meta-coverage ----------------------------------------------------

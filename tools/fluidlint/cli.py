@@ -47,10 +47,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="machine-readable output (alias for "
                              "--format json)")
     parser.add_argument("--rules", default=None, metavar="FAMILY",
-                        help="comma-separated rule ids or family prefixes "
-                             "to run (e.g. 'FL-RACE' or "
-                             "'FL-DET-CLOCK,FL-TRACE'); baseline entries "
-                             "for other rules are ignored, not stale")
+                        help="comma-separated rule ids, rule-id prefixes, "
+                             "or family names to run (e.g. 'FL-RACE', "
+                             "'FL-DET-CLOCK,FL-TRACE', or 'dur' for the "
+                             "durability family); baseline entries for "
+                             "other rules are ignored, not stale")
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument("--check-baseline", action="store_true",
                         help="baseline hygiene only: fail when an entry "
@@ -68,8 +69,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     rules = all_rules()
     if args.rules:
         families = [f.strip() for f in args.rules.split(",") if f.strip()]
+        # A selector matches a rule id exactly, a rule-id prefix, or the
+        # rule's family name ('dur' selects every rules_durability rule).
         rules = {name: rule for name, rule in rules.items()
-                 if any(name == f or name.startswith(f) for f in families)}
+                 if any(name == f or name.startswith(f)
+                        or rule_family(rule).startswith(f.lower())
+                        for f in families)}
         if not rules:
             print(f"error: --rules {args.rules!r} selects no known rule "
                   "(see --list-rules)", file=sys.stderr)

@@ -1,0 +1,736 @@
+"""fluiddur — durability-ordering & crash-consistency rules.
+
+The serving tier's durability story is a set of ORDERINGS: a temp file
+is flushed and fsynced before the rename that publishes it; nothing
+externally visible (an ack, a broadcast) happens before the durable
+write that commits the operation; in-memory state that shadows durable
+state (sequence counters, dedup floors) is unwound when the durable
+write fails; a single logical record is one ``.write()`` between fsync
+points.  Every one of those orderings was previously enforced only by
+the crash-sweep tests someone remembered to write — ALICE-style
+application-level crash-consistency checking shows these bugs are
+systematic and statically findable, so this family makes the orderings
+checked invariants.
+
+Annotation conventions (trailing comments, like ``guarded-by``):
+
+``# commit-point: <label>``
+    On the statement whose durable write commits an operation.  Calls
+    with externally-visible effects (broadcast/ack/notify/...) reachable
+    on a path BEFORE the commit point are FL-DUR-COMMIT findings — a
+    broadcast cannot be un-broadcast when the write fails.
+
+``# durable-shadow: <note>``
+    On an attribute assignment declaring in-memory state that shadows
+    durable state.  FL-DUR-UNWIND tracks mutations of these attributes.
+
+``# unwinds: a, b``
+    On a fallible durable-write call that is reached after shadow
+    mutations: the enclosing ``try``'s handlers must restore every named
+    attribute (directly, through a local alias, or through one same-class
+    method call — the sequencer's ``_drop``-style restore).
+
+``# durable-handle: single-record``
+    On the assignment binding a durable file handle attribute: within
+    any one method, at most one ``.write()`` call site may touch the
+    handle between fsync points (FL-DUR-TORN).
+
+Known limits (documented in the README): file handles reached through
+local aliases are invisible to TORN; a write and its fsync split across
+two functions (other than a one-level ``self.flush()``-style helper) are
+invisible to RENAME/TORN; shadow mutations hidden inside callee methods
+are invisible to UNWIND (the caller's annotation is the contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .core import (Finding, ModuleContext, ProjectContext, ProjectRule,
+                   Rule, register)
+from .rules_concurrency import _owner_phrase, _walk_pruned as _fn_walk
+from .rules_lifecycle import _dotted, _exit_paths_for, _functions
+
+COMMIT_RE = re.compile(r"commit-point:\s*(\S.*)")
+SHADOW_RE = re.compile(r"durable-shadow\b")
+UNWINDS_RE = re.compile(r"unwinds:\s*([A-Za-z_][\w, ]*)")
+HANDLE_RE = re.compile(r"durable-handle:\s*single-record")
+
+#: terminal call names whose effect escapes the process (or the caller's
+#: ability to roll back): flagged before a commit point.
+VISIBLE_EFFECTS = frozenset({
+    "broadcast", "deliver", "publish", "notify", "notify_all",
+    "_notify_commit", "ack", "nack", "respond", "reply", "emit",
+    "send", "sendall", "send_frame", "write_frame",
+    "set_result", "set_exception",
+})
+
+#: method names that mutate their receiver in place.
+MUTATORS = frozenset({
+    "append", "extend", "add", "update", "insert", "setdefault",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+})
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _stmts(fn: ast.AST) -> Iterator[ast.stmt]:
+    """Every statement of ``fn`` in lexical order, nested defs pruned."""
+    out = [n for n in _fn_walk(fn) if isinstance(n, ast.stmt) and n is not fn]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return iter(out)
+
+
+def _calls(fn: ast.AST) -> List[ast.Call]:
+    out = [n for n in _fn_walk(fn) if isinstance(n, ast.Call)]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' for a ``self.X`` attribute expression, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _target_attr(target: ast.AST) -> Optional[str]:
+    """'X' when an assignment target is ``self.X`` or ``self.X[...]``."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    return _self_attr(target)
+
+
+def _classes(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def _methods(cls: ast.ClassDef) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- FL-DUR-RENAME ------------------------------------------------------------
+
+
+def _tmpish(text: str) -> bool:
+    low = text.lower()
+    return "tmp" in low or ".compact" in low or "temp" in low
+
+
+@register
+class DurRenameRule(Rule):
+    """Temp-write → publish must fsync the artifact before the rename,
+    and the rename must be ``os.replace`` (atomic-overwrite)."""
+
+    name = "FL-DUR-RENAME"
+    severity = "error"
+    description = ("temp-write→publish paths must flush()+os.fsync() the "
+                   "artifact before an os.replace (never os.rename)")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            yield from self._check_fn(m, fn)
+
+    def _check_fn(self, m: ModuleContext, fn) -> Iterator[Finding]:
+        calls = _calls(fn)
+        resolved = [(c, m.imports.resolve(c.func)) for c in calls]
+        fsync_lines = [c.lineno for c, r in resolved if r == "os.fsync"]
+        # local Name -> assigned-expression text (for tmp-ness lookup)
+        assigns: Dict[str, str] = {}
+        for st in _stmts(fn):
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                assigns[st.targets[0].id] = ast.unparse(st.value)
+        for call, qual in resolved:
+            if qual == "os.rename":
+                yield m.finding(self, call, (
+                    f"os.rename() {_owner_phrase(fn.name)}: use os.replace() "
+                    f"— rename is not atomic-overwrite on all platforms"))
+            if qual != "os.replace" or not call.args:
+                continue
+            src = call.args[0]
+            src_text = ast.unparse(src)
+            tmp = _tmpish(src_text)
+            if isinstance(src, ast.Name) and not tmp:
+                tmp = _tmpish(assigns.get(src.id, ""))
+            if not tmp:
+                continue
+            if not any(line < call.lineno for line in fsync_lines):
+                yield m.finding(self, call, (
+                    f"os.replace() {_owner_phrase(fn.name)} publishes temp "
+                    f"artifact '{src_text}' with no os.fsync() before the "
+                    f"rename — a crash can publish an empty or torn file"))
+        # fsync on a buffered handle written in this function must be
+        # preceded by .flush() — fsync of an unflushed handle syncs
+        # nothing.
+        writes_by_recv: Dict[str, List[int]] = {}
+        flush_by_recv: Dict[str, List[int]] = {}
+        for call in calls:
+            if isinstance(call.func, ast.Attribute):
+                recv = _dotted(call.func.value)
+                if recv is None:
+                    continue
+                if call.func.attr == "write":
+                    writes_by_recv.setdefault(recv, []).append(call.lineno)
+                elif call.func.attr == "flush":
+                    flush_by_recv.setdefault(recv, []).append(call.lineno)
+        for call, qual in resolved:
+            if qual != "os.fsync" or not call.args:
+                continue
+            arg = call.args[0]
+            if not (isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "fileno"):
+                continue
+            recv = _dotted(arg.func.value)
+            if recv is None or recv not in writes_by_recv:
+                continue
+            if not any(line <= call.lineno
+                       for line in flush_by_recv.get(recv, [])):
+                yield m.finding(self, call, (
+                    f"os.fsync() on '{recv}' {_owner_phrase(fn.name)} "
+                    f"without a preceding .flush() — buffered bytes are "
+                    f"not on disk when the fsync returns"))
+
+
+# -- FL-DUR-COMMIT ------------------------------------------------------------
+
+
+@register
+class DurCommitRule(Rule):
+    """Nothing externally visible before the annotated commit point."""
+
+    name = "FL-DUR-COMMIT"
+    severity = "error"
+    description = ("no ack/broadcast/notify reachable on a path before the "
+                   "'# commit-point:' durable write that commits the op")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            yield from self._check_fn(m, fn)
+
+    def _check_fn(self, m: ModuleContext, fn) -> Iterator[Finding]:
+        commit_calls: List[ast.Call] = []
+        labels: Dict[int, str] = {}
+        for st in _stmts(fn):
+            match = COMMIT_RE.search(m.stmt_comment(st))
+            if not match:
+                continue
+            in_stmt = [n for n in ast.walk(st) if isinstance(n, ast.Call)]
+            if not in_stmt:
+                yield m.finding(self, st, (
+                    f"'# commit-point:' annotation {_owner_phrase(fn.name)} "
+                    f"on a statement with no call — the commit point must "
+                    f"be the durable write itself"))
+                continue
+            commit_calls.extend(in_stmt)
+            for c in in_stmt:
+                labels[id(c)] = match.group(1).strip()
+        if not commit_calls:
+            return
+        commit_ids = {id(c) for c in commit_calls}
+        paths = _exit_paths_for(m, fn)
+        flagged: Set[int] = set()
+        if paths is None:
+            # budget exceeded: lexical fallback
+            first = min(c.lineno for c in commit_calls)
+            for call in _calls(fn):
+                name = _terminal(call.func)
+                if name in VISIBLE_EFFECTS and call.lineno < first \
+                        and id(call) not in flagged:
+                    flagged.add(id(call))
+                    yield m.finding(self, call, (
+                        f"'{name}()' {_owner_phrase(fn.name)} precedes the "
+                        f"commit point — visible before the op is durable"))
+            return
+        for path in paths:
+            idx = next((i for i, ev in enumerate(path.events)
+                        if id(ev.node) in commit_ids), None)
+            if idx is None:
+                continue
+            label = labels.get(id(path.events[idx].node), "")
+            for ev in path.events[:idx]:
+                if ev.kind != "call" or id(ev.node) in commit_ids:
+                    continue
+                name = _terminal(ev.node.func) \
+                    if isinstance(ev.node, ast.Call) else None
+                if name in VISIBLE_EFFECTS and id(ev.node) not in flagged:
+                    flagged.add(id(ev.node))
+                    yield m.finding(self, ev.node, (
+                        f"'{name}()' {_owner_phrase(fn.name)} is reachable "
+                        f"before commit point '{label}' — the effect is "
+                        f"visible before the op is durable"))
+
+
+# -- FL-DUR-UNWIND ------------------------------------------------------------
+
+
+def _method_restores(method, shadow: Set[str]) -> Set[str]:
+    """Shadow attrs a method restores lexically (assign / augassign /
+    subscript-assign / mutator call on ``self.X``)."""
+    out: Set[str] = set()
+    for node in _fn_walk(method):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _target_attr(t)
+                if attr in shadow:
+                    out.add(attr)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr in shadow:
+                out.add(attr)
+    return out
+
+
+@register
+class DurUnwindRule(Rule):
+    """Shadow state mutated before a fallible durable write must be
+    restored by the write's exception handlers (the un-stamp
+    discipline, generalized)."""
+
+    name = "FL-DUR-UNWIND"
+    severity = "error"
+    description = ("'# durable-shadow:' state mutated before a durable "
+                   "write needs an '# unwinds:' pairing whose try handlers "
+                   "restore it on every exception exit")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for cls in _classes(m.tree):
+            yield from self._check_class(m, cls)
+
+    def _check_class(self, m: ModuleContext, cls) -> Iterator[Finding]:
+        shadow: Set[str] = set()
+        for method in _methods(cls):
+            for st in _stmts(method):
+                if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if not SHADOW_RE.search(m.stmt_comment(st)):
+                    continue
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for t in targets:
+                    attr = _target_attr(t)
+                    if attr:
+                        shadow.add(attr)
+        methods = list(_methods(cls))
+        restores_of: Dict[str, Set[str]] = {
+            meth.name: _method_restores(meth, shadow) for meth in methods}
+        for method in methods:
+            yield from self._check_method(m, cls, method, shadow,
+                                          restores_of)
+
+    def _aliases(self, method, shadow: Set[str]) -> Dict[str, str]:
+        """local name -> shadow attr it aliases (``log = self._docs...``)."""
+        out: Dict[str, str] = {}
+        for st in _fn_walk(method):
+            if not (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)):
+                continue
+            for node in ast.walk(st.value):
+                attr = _self_attr(node)
+                if attr in shadow:
+                    out[st.targets[0].id] = attr
+                    break
+        return out
+
+    def _mutations(self, method, shadow: Set[str],
+                   aliases: Dict[str, str]) -> List[Tuple[int, str, ast.AST]]:
+        """(line, attr, node) for every lexical mutation of shadow state
+        in ``method``, through ``self.X`` or a local alias."""
+        def _hit(target: ast.AST) -> Optional[str]:
+            attr = _target_attr(target)
+            if attr in shadow:
+                return attr
+            # ``log[-1] = ...`` through a local alias mutates the attr;
+            # rebinding the alias name itself does not.
+            if isinstance(target, ast.Subscript) \
+                    and isinstance(target.value, ast.Name):
+                return aliases.get(target.value.id)
+            return None
+
+        out: List[Tuple[int, str, ast.AST]] = []
+        for node in _fn_walk(method):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _hit(t)
+                    if attr:
+                        out.append((node.lineno, attr, node))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATORS:
+                recv = node.func.value
+                attr = _self_attr(recv)
+                if attr is None and isinstance(recv, ast.Name):
+                    attr = aliases.get(recv.id)
+                if attr in shadow:
+                    out.append((node.lineno, attr, node))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def _handler_restores(self, handler, shadow: Set[str],
+                          aliases: Dict[str, str],
+                          restores_of: Dict[str, Set[str]]) -> Set[str]:
+        out: Set[str] = set()
+        for node in _fn_walk(handler):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _target_attr(t)
+                    if attr is None and isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name):
+                        attr = aliases.get(t.value.id)
+                    if attr in shadow:
+                        out.add(attr)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    if node.func.attr in MUTATORS:
+                        attr = _self_attr(recv)
+                        if attr is None and isinstance(recv, ast.Name):
+                            attr = aliases.get(recv.id)
+                        if attr in shadow:
+                            out.add(attr)
+                    # one-level interprocedural: self._drop(...)-style
+                    # same-class restore helpers
+                    if isinstance(recv, ast.Name) and recv.id == "self":
+                        out |= restores_of.get(node.func.attr, set())
+        return out
+
+    def _check_method(self, m: ModuleContext, cls, method,
+                      shadow: Set[str],
+                      restores_of: Dict[str, Set[str]]) -> Iterator[Finding]:
+        aliases = self._aliases(method, shadow)
+        mutations = self._mutations(method, shadow, aliases)
+        # mutations inside except handlers ARE the restores; don't count
+        # them as pre-commit advances.
+        handler_lines: Set[int] = set()
+        for node in _fn_walk(method):
+            if isinstance(node, ast.ExceptHandler):
+                for sub in ast.walk(node):
+                    if hasattr(sub, "lineno"):
+                        handler_lines.add(sub.lineno)
+        mutations = [mu for mu in mutations if mu[0] not in handler_lines]
+
+        tries = [n for n in _fn_walk(method) if isinstance(n, ast.Try)]
+
+        def enclosing_try(call_line: int) -> Optional[ast.Try]:
+            best = None
+            for t in tries:
+                if t.lineno <= call_line <= (t.end_lineno or t.lineno) \
+                        and t.handlers:
+                    if best is None or t.lineno > best.lineno:
+                        best = t
+            return best
+
+        for st in _stmts(method):
+            comment = m.stmt_comment(st)
+            unwinds = UNWINDS_RE.search(comment)
+            is_commit = COMMIT_RE.search(comment) is not None
+            if not unwinds and not is_commit:
+                continue
+            names = [n.strip() for n in unwinds.group(1).split(",")
+                     if n.strip()] if unwinds else []
+            for name in names:
+                if name not in shadow:
+                    yield m.finding(self, st, (
+                        f"'# unwinds: {name}' {_owner_phrase(method.name)} "
+                        f"names an attribute not declared "
+                        f"'# durable-shadow:' on {cls.name}"))
+            names = [n for n in names if n in shadow]
+            pre = {attr for line, attr, _ in mutations if line < st.lineno}
+            if not names:
+                # bare commit point: any shadow advance before it is an
+                # unpaired mutation
+                if is_commit and pre:
+                    yield m.finding(self, st, (
+                        f"shadow state {sorted(pre)} mutated before the "
+                        f"commit point {_owner_phrase(method.name)} with "
+                        f"no '# unwinds:' pairing — a failed durable "
+                        f"write leaves memory ahead of disk"))
+                continue
+            uncovered = pre - set(names)
+            if is_commit and uncovered:
+                yield m.finding(self, st, (
+                    f"shadow state {sorted(uncovered)} mutated before the "
+                    f"commit point {_owner_phrase(method.name)} is not in "
+                    f"its '# unwinds:' list"))
+            t = enclosing_try(st.lineno)
+            if t is None:
+                yield m.finding(self, st, (
+                    f"durable write annotated '# unwinds: "
+                    f"{', '.join(names)}' {_owner_phrase(method.name)} is "
+                    f"not inside a try with exception handlers — nothing "
+                    f"restores the shadow state on failure"))
+                continue
+            restored: Set[str] = set()
+            for handler in t.handlers:
+                restored |= self._handler_restores(handler, shadow,
+                                                  aliases, restores_of)
+            for name in names:
+                if name not in restored:
+                    yield m.finding(self, st, (
+                        f"exception handlers around the durable write "
+                        f"{_owner_phrase(method.name)} do not restore "
+                        f"'# unwinds:' attribute '{name}'"))
+
+
+# -- FL-DUR-TORN --------------------------------------------------------------
+
+
+@register
+class DurTornRule(Rule):
+    """At most one ``.write()`` call site on a single-record durable
+    handle between fsync points (torn-write exposure)."""
+
+    name = "FL-DUR-TORN"
+    severity = "error"
+    description = ("more than one .write() on a '# durable-handle: "
+                   "single-record' file handle between fsync points is "
+                   "torn-write exposure")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for cls in _classes(m.tree):
+            yield from self._check_class(m, cls)
+
+    def _check_class(self, m: ModuleContext, cls) -> Iterator[Finding]:
+        handles: Set[str] = set()
+        for method in _methods(cls):
+            for st in _stmts(method):
+                if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if not HANDLE_RE.search(m.stmt_comment(st)):
+                    continue
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for t in targets:
+                    attr = _target_attr(t)
+                    if attr:
+                        handles.add(attr)
+        if not handles:
+            return
+        methods = list(_methods(cls))
+        # same-class methods that fsync a handle count as fsync points
+        # (OpLog.flush() style); one level only.
+        fsyncers: Dict[str, Set[str]] = {h: set() for h in handles}
+        for method in methods:
+            for call in _calls(method):
+                if not (m.imports.resolve(call.func) == "os.fsync"
+                        and call.args):
+                    continue
+                arg = call.args[0]
+                if isinstance(arg, ast.Call) \
+                        and isinstance(arg.func, ast.Attribute) \
+                        and arg.func.attr == "fileno":
+                    attr = _self_attr(arg.func.value)
+                    if attr in handles:
+                        fsyncers[attr].add(method.name)
+        for method in methods:
+            yield from self._check_method(m, method, handles, fsyncers)
+
+    def _check_method(self, m: ModuleContext, method, handles: Set[str],
+                      fsyncers: Dict[str, Set[str]]) -> Iterator[Finding]:
+        pending: Dict[str, Optional[ast.Call]] = {h: None for h in handles}
+        for call in _calls(method):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            recv_attr = _self_attr(call.func.value)
+            if call.func.attr == "write" and recv_attr in handles:
+                prev = pending[recv_attr]
+                if prev is not None and prev is not call:
+                    yield m.finding(self, call, (
+                        f"second .write() on single-record handle "
+                        f"'self.{recv_attr}' {_owner_phrase(method.name)} "
+                        f"before an fsync point — a crash between the "
+                        f"writes leaves a torn record"))
+                pending[recv_attr] = call
+                continue
+            # fsync points: os.fsync(self.X.fileno()) or a same-class
+            # helper known to fsync the handle (self.flush()).
+            if m.imports.resolve(call.func) == "os.fsync" and call.args:
+                arg = call.args[0]
+                if isinstance(arg, ast.Call) \
+                        and isinstance(arg.func, ast.Attribute) \
+                        and arg.func.attr == "fileno":
+                    attr = _self_attr(arg.func.value)
+                    if attr in handles:
+                        pending[attr] = None
+                continue
+            if isinstance(call.func.value, ast.Name) \
+                    and call.func.value.id == "self":
+                for h in handles:
+                    if call.func.attr in fsyncers[h]:
+                        pending[h] = None
+
+
+# -- FL-DUR-SEAM --------------------------------------------------------------
+
+
+FAULTS_MODULE = "fluidframework_tpu/testing/faults.py"
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _registered_sites(tree: ast.Module) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(SITES key -> line, SCHEDULED_SITES entry -> line)."""
+    sites: Dict[str, int] = {}
+    scheduled: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        value = node.value
+        if "SITES" in names and isinstance(value, ast.Dict):
+            for key in value.keys:
+                lit = _const_str(key)
+                if lit is not None:
+                    sites[lit] = key.lineno
+        elif "SCHEDULED_SITES" in names \
+                and isinstance(value, (ast.Tuple, ast.List)):
+            for el in value.elts:
+                lit = _const_str(el)
+                if lit is not None:
+                    scheduled[lit] = el.lineno
+    return sites, scheduled
+
+
+@register
+class DurSeamRule(ProjectRule):
+    """Fault-seam registry drift: every registered site is armed
+    somewhere, every armed site is registered."""
+
+    name = "FL-DUR-SEAM"
+    severity = "error"
+    description = ("every testing/faults.py SITES entry must be armed by a "
+                   "fire()/due()/schedule literal somewhere in the package, "
+                   "and every fired site literal must be registered")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        tree = project.parse(FAULTS_MODULE)
+        if tree is None:
+            return
+        sites, scheduled = _registered_sites(tree)
+        armed: Set[str] = set()
+        fired: List[Tuple[str, str, int]] = []
+        for rel in project.glob("fluidframework_tpu/**/*.py"):
+            if rel == FAULTS_MODULE or "__pycache__" in rel:
+                continue
+            mod = project.parse(rel)
+            if mod is None:
+                continue
+            for node in ast.walk(mod):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("fire", "due") \
+                        and node.args:
+                    lit = _const_str(node.args[0])
+                    if lit is not None:
+                        fired.append((lit, rel, node.lineno))
+                lit = _const_str(node)
+                if lit in sites:
+                    armed.add(lit)
+        for lit, rel, line in fired:
+            if lit not in sites:
+                yield self.project_finding(rel, line, (
+                    f"fault site '{lit}' is fired here but not registered "
+                    f"in testing/faults.py SITES — invisible to the fault "
+                    f"matrix"))
+        for site, line in sorted(sites.items()):
+            if site not in armed:
+                yield self.project_finding(FAULTS_MODULE, line, (
+                    f"registered fault site '{site}' is armed nowhere in "
+                    f"the package — hollow fault coverage"))
+        for site, line in sorted(scheduled.items()):
+            if site not in sites:
+                yield self.project_finding(FAULTS_MODULE, line, (
+                    f"SCHEDULED_SITES entry '{site}' is not a SITES key"))
+
+
+# -- FL-DUR-GATE --------------------------------------------------------------
+
+
+GATES_MODULE = "fluidframework_tpu/service/gates.py"
+GATE_LIT_RE = re.compile(r"^(Catchup|Server)\.[A-Za-z][A-Za-z0-9_]*$")
+
+
+def _registered_gates(tree: ast.Module) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if "GATES" in names and isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                lit = _const_str(key)
+                if lit is not None:
+                    out[lit] = key.lineno
+    return out
+
+
+@register
+class DurGateRule(ProjectRule):
+    """Gate-registry drift: every ``Catchup.*``/``Server.*`` literal in
+    the package must be a registered gate, and every registered gate
+    must be read somewhere."""
+
+    name = "FL-DUR-GATE"
+    severity = "error"
+    description = ("every Catchup.*/Server.* gate literal must be in "
+                   "service/gates.py GATES, and every registered gate must "
+                   "be read somewhere in the package")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        tree = project.parse(GATES_MODULE)
+        if tree is None:
+            return
+        registered = _registered_gates(tree)
+        used: Set[str] = set()
+        for rel in project.glob("fluidframework_tpu/**/*.py"):
+            if rel == GATES_MODULE or "__pycache__" in rel:
+                continue
+            mod = project.parse(rel)
+            if mod is None:
+                continue
+            for node in ast.walk(mod):
+                lit = _const_str(node)
+                if lit is None or not GATE_LIT_RE.match(lit):
+                    continue
+                if lit in registered:
+                    used.add(lit)
+                else:
+                    yield self.project_finding(rel, node.lineno, (
+                        f"gate '{lit}' is read here but not registered in "
+                        f"service/gates.py GATES — defaults drift silently"))
+        for key, line in sorted(registered.items()):
+            if key not in used:
+                yield self.project_finding(GATES_MODULE, line, (
+                    f"registered gate '{key}' is never read anywhere in "
+                    f"the package — dead configuration"))
