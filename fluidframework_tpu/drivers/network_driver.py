@@ -382,7 +382,8 @@ class _RpcClient:
             if nack is not None:
                 raise NackError(nack.get("reason", "nacked"),
                                 retry_after=nack.get("retryAfter", 0.0),
-                                code=nack.get("code", "throttled"))
+                                code=nack.get("code", "throttled"),
+                                admission=nack.get("admission"))
             if frame.get("code") == "epochMismatch":
                 # Dead generation: unpin and drop EVERY cache riding this
                 # connection before anyone can retry unpinned against the
@@ -482,6 +483,214 @@ class _RpcClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class _ReconnectingRpc:
+    """A live :class:`_RpcClient` plus everything needed to STAND UP ITS
+    REPLACEMENT — registered event handlers, tapped documents, the epoch
+    pin, epoch-invalidation listeners — so a transport swap (dead door
+    socket, relocated document) rebuilds the session without the caller
+    losing its subscriptions.  Subclasses decide WHEN to swap and WHERE
+    to dial; this base keeps the replay state and exposes the exact
+    surface :class:`NetworkConnection` / :class:`_RemoteStorage` consume
+    (``request``/``on``/``off``/``add_epoch_listener``/``epoch``/
+    ``close``)."""
+
+    def __init__(self, timeout: float = 30.0, mc=None, faults=None,
+                 retry=None, rng=None) -> None:
+        self._timeout = timeout
+        self._mc = mc
+        self._faults = faults
+        self._retry_policy = retry
+        self._rng = rng
+        self._client: Optional[_RpcClient] = None
+        #: replayed onto every replacement transport
+        self._handlers: List[tuple] = []
+        self._taps: set = set()
+        self._epoch_refs: List["weakref.WeakMethod"] = []
+        #: transport swaps taken (test/bench pin: the drill went >= 1)
+        self.failovers = 0
+
+    def _dial(self, addr) -> _RpcClient:
+        return _RpcClient(addr[0], addr[1], timeout=self._timeout,
+                          mc=self._mc, faults=self._faults,
+                          retry=self._retry_policy, rng=self._rng)
+
+    def _adopt(self, client: _RpcClient) -> None:
+        """Install a replacement transport: carry the epoch pin (the
+        storage generation is store-wide, not per-socket), replay event
+        handlers and epoch listeners, then re-establish every tap the
+        old session held — the server side of a tap died with the old
+        socket, so a client that does not re-subscribe goes silently
+        deaf (the exact failure the demotion kick exists to prevent)."""
+        old = self._client
+        if old is not None:
+            client.epoch = old.epoch
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._client = client
+        for event, doc_id, fn in list(self._handlers):
+            client.on(event, doc_id, fn)
+        for ref in self._epoch_refs:
+            if ref() is not None:
+                client.add_epoch_listener(ref)
+        for doc_id in sorted(self._taps):
+            client.request("subscribe_doc", {"doc": doc_id})
+
+    # -- the _RpcClient surface ------------------------------------------------
+
+    def request(self, method: str, params: dict,
+                timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def _note_tap(self, method: str, params: dict) -> None:
+        if method == "subscribe_doc" and params.get("doc"):
+            self._taps.add(params["doc"])
+
+    def on(self, event: str, doc_id: str, fn) -> None:
+        self._handlers.append((event, doc_id, fn))
+        if self._client is not None:
+            self._client.on(event, doc_id, fn)
+
+    def off(self, event: str, doc_id: str, fn) -> None:
+        entry = (event, doc_id, fn)
+        if entry in self._handlers:
+            self._handlers.remove(entry)
+        if self._client is not None:
+            self._client.off(event, doc_id, fn)
+
+    def add_epoch_listener(self, ref: "weakref.WeakMethod") -> None:
+        self._epoch_refs.append(ref)
+        if self._client is not None:
+            self._client.add_epoch_listener(ref)
+
+    @property
+    def epoch(self) -> Optional[str]:
+        return self._client.epoch if self._client is not None else None
+
+    @epoch.setter
+    def epoch(self, value: Optional[str]) -> None:
+        if self._client is not None:
+            self._client.epoch = value
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+
+
+class DoorFailoverRpc(_ReconnectingRpc):
+    """The replica-list front-door transport (ISSUE 18): one live door
+    socket, a list of door addresses, and dead-socket rotation.  Only
+    :class:`ConnectionLostError` rotates — it is the one failure that
+    can never heal in place (the socket under us is GONE, which is
+    exactly what a replica SIGKILL looks like from the client).  Typed
+    service refusals (nack / fence / wrongShard) and in-place-retryable
+    transport noise stay with the active door."""
+
+    def __init__(self, addrs: List[tuple], **kwargs) -> None:
+        super().__init__(**kwargs)
+        if not addrs:
+            raise ValueError("need at least one door address")
+        self._addrs = [tuple(a) for a in addrs]
+        self._at = 0
+        self._connect_initial()
+
+    def _connect_initial(self) -> None:
+        last: Optional[BaseException] = None
+        for idx, addr in enumerate(self._addrs):
+            try:
+                self._client = self._dial(addr)
+                self._at = idx
+                return
+            except OSError as exc:
+                last = exc
+        raise ConnectionLostError(f"no door reachable: {last}")
+
+    def _rotate(self) -> bool:
+        for step in range(1, len(self._addrs) + 1):
+            idx = (self._at + step) % len(self._addrs)
+            try:
+                client = self._dial(self._addrs[idx])
+            except OSError:
+                continue
+            self._at = idx
+            self._adopt(client)
+            self.failovers += 1
+            return True
+        return False
+
+    def request(self, method: str, params: dict,
+                timeout: Optional[float] = None):
+        last: Optional[BaseException] = None
+        for _attempt in range(len(self._addrs) + 1):
+            try:
+                result = self._client.request(method, params,
+                                              timeout=timeout)
+            except ConnectionLostError as exc:
+                last = exc
+                if not self._rotate():
+                    break
+                continue
+            self._note_tap(method, params)
+            return result
+        raise last
+
+
+class DirectShardRpc(_ReconnectingRpc):
+    """The direct-to-shard DATA path for one document (ISSUE 18): the
+    front door answers ``locate`` (control plane), the client dials the
+    owning shardhost itself, and every doc-scoped RPC — submits, deltas,
+    taps, summaries — skips the relay hop entirely.  Placement is a
+    LEASE, not a fact: on ``wrongShard`` (live migration), ``fence``
+    (failover recovery), or the shard socket dying, the client
+    re-resolves through the door and retries against the new owner —
+    bounded hops, because a route that never settles is an outage, not
+    a redirect loop."""
+
+    MAX_HOPS = 4
+
+    def __init__(self, door, doc_id: str, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self._door = door
+        self.doc_id = doc_id
+        self.shard: Optional[str] = None
+
+    def _resolve(self) -> None:
+        where = self._door.request("locate", {"doc": self.doc_id})
+        addr = (where["host"], where["port"])
+        self.shard = where["shard"]
+        client = self._dial(addr)
+        had = self._client is not None
+        self._adopt(client)
+        if had:
+            self.failovers += 1
+
+    def request(self, method: str, params: dict,
+                timeout: Optional[float] = None):
+        last: Optional[BaseException] = None
+        for _hop in range(self.MAX_HOPS):
+            if self._client is None:
+                self._resolve()
+            try:
+                result = self._client.request(method, params,
+                                              timeout=timeout)
+            except (ShardFencedError, ConnectionLostError) as exc:
+                # DocRelocatedError ⊂ ShardFencedError: stale placement.
+                # ConnectionLost: the shard process died under us.  Both
+                # recover the same way — ask the door who owns the
+                # document NOW (its failover machinery re-homes orphans
+                # on route resolution) and retry there.
+                last = exc
+                try:
+                    self._resolve()
+                except (RpcError, OSError) as resolve_exc:
+                    last = resolve_exc
+                continue
+            self._note_tap(method, params)
+            return result
+        raise last
 
 
 class NetworkConnection:
@@ -746,9 +955,26 @@ class NetworkDocumentServiceFactory:
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
                  timeout: float = 30.0, tenant: Optional[str] = None,
                  secret: Optional[str] = None, mc=None, faults=None,
-                 retry=None, retry_rng=None) -> None:
-        self._rpc = _RpcClient(host, port, timeout=timeout, mc=mc,
-                               faults=faults, retry=retry, rng=retry_rng)
+                 retry=None, retry_rng=None,
+                 replicas: Optional[List[tuple]] = None,
+                 direct: bool = False) -> None:
+        """``replicas`` (ISSUE 18): additional front-door ``(host,
+        port)`` addresses over the same shard fleet — a dead door socket
+        fails over to the next reachable one, taps re-established.
+        ``direct`` routes every DOC-scoped call straight to the owning
+        shardhost (resolved via the door's ``locate``), demoting the
+        door to control plane: creation, discovery, placement."""
+        self._transport_kw = dict(timeout=timeout, mc=mc, faults=faults,
+                                  retry=retry, rng=retry_rng)
+        addrs = [(host, port)] + [tuple(a) for a in (replicas or ())]
+        if len(addrs) > 1:
+            self._rpc = DoorFailoverRpc(addrs, **self._transport_kw)
+        else:
+            self._rpc = _RpcClient(host, port, timeout=timeout, mc=mc,
+                                   faults=faults, retry=retry,
+                                   rng=retry_rng)
+        self.direct = bool(direct)
+        self._direct_rpcs: Dict[str, DirectShardRpc] = {}
         self._connections: Dict[str, NetworkConnection] = {}
         if tenant is not None:
             # Riddler capability: authenticate the connection before any
@@ -760,10 +986,21 @@ class NetworkDocumentServiceFactory:
                 self._rpc.close()  # no factory object escapes to close()
                 raise
 
+    def _doc_rpc(self, doc_id: str):
+        """The transport DOC-scoped traffic rides: the door itself, or
+        (direct mode) a per-document connection to the owning shard."""
+        if not self.direct:
+            return self._rpc
+        rpc = self._direct_rpcs.get(doc_id)
+        if rpc is None:
+            rpc = DirectShardRpc(self._rpc, doc_id, **self._transport_kw)
+            self._direct_rpcs[doc_id] = rpc
+        return rpc
+
     def _connection(self, doc_id: str) -> NetworkConnection:
         conn = self._connections.get(doc_id)
         if conn is None:
-            conn = NetworkConnection(self._rpc, doc_id)
+            conn = NetworkConnection(self._doc_rpc(doc_id), doc_id)
             self._connections[doc_id] = conn
         return conn
 
@@ -786,8 +1023,15 @@ class NetworkDocumentServiceFactory:
             doc_id,
             connection=conn,
             delta_storage=_RemoteDeltaStorage(conn),
-            storage=_RemoteStorage(self._rpc, doc_id),
+            storage=_RemoteStorage(self._doc_rpc(doc_id), doc_id),
         )
 
     def close(self) -> None:
+        # getattr: tests assemble partial factories via __new__ to probe
+        # the unauthenticated path — close() still has to work there.
+        for rpc in getattr(self, "_direct_rpcs", {}).values():
+            try:
+                rpc.close()
+            except OSError:
+                pass
         self._rpc.close()

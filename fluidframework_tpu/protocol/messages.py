@@ -60,13 +60,18 @@ class NackError(ConnectionError):
     """
 
     def __init__(self, reason: str, retry_after: float = 0.0,
-                 code: str = "throttled") -> None:
+                 code: str = "throttled", admission=None) -> None:
         super().__init__(reason)
         self.reason = reason
         self.retry_after = retry_after
         #: "throttled" (resend the same bytes later) or "staleView" (the
         #: encoded view is unresolvable: rebase + resubmit via reconnect)
         self.code = code
+        #: optional AdmissionController snapshot at shed time (ISSUE 18):
+        #: rides the wire so an out-of-proc harness can pin that a
+        #: verdict's pacing derived from the shard's REPORTED fold-cost
+        #: EMA — replay-identical state — not from wall clock.
+        self.admission = admission
 
 
 class ShardFencedError(ConnectionError):

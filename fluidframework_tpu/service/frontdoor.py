@@ -10,6 +10,17 @@ over a per-shard RPC connection; broadcast events are relayed
 serialize-once (one ``frame_bytes`` per event for all subscribed client
 sessions).
 
+Connection layer (ISSUE 18): a single-threaded :mod:`~.framepump`
+event loop owns every client socket — accept, reads, and budget-aware
+writes — and decoded frames dispatch to a small worker pool (responses
+match by ``re`` id, so per-connection pipelining is safe).  Connection
+count is a benchmarked axis (``tools/loadgen.py --connections``), not a
+thread-count ceiling.  N doors can front one shard fleet: replicas run
+``spawn="attach"`` against the primary's ``shard_addrs()`` and agree on
+placement purely through the deterministic rendezvous router — shared
+assignment state is ZERO, and each replica taps shard broadcasts over
+its own RPC connection.
+
 Control plane (all topology mutations run on ONE supervisor thread — the
 actor discipline that keeps failover and migration serialized without
 holding a lock across an RPC round-trip):
@@ -45,15 +56,14 @@ the SIGKILL-before-adopt rule is what makes the distinction irrelevant).
 
 from __future__ import annotations
 
-import json
 import os
 import queue
-import socket
+import signal as _signal
 import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -62,10 +72,10 @@ from ..drivers.network_driver import (RpcError, RpcTimeoutError,
                                       RpcTransportError, _RpcClient)
 from ..protocol.messages import (DocRelocatedError, NackError,
                                  ShardFencedError)
-from ..protocol.wire import (LEN as _LEN, MAX_FRAME, WIRE_VERSION,
-                             decode_column_batch, encode_column_batch,
-                             frame_bytes)
+from ..protocol.wire import (WIRE_VERSION, decode_column_batch,
+                             encode_column_batch, frame_bytes)
 from ..utils.telemetry import LockedCounterSet, MonitoringContext
+from .framepump import FramePump, PumpConnection
 from .sharding import ShardRouter, fence_token, rendezvous_score
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -103,6 +113,10 @@ class ShardHandle:
         self.shard_id = shard_id
         self.addr: Tuple[str, int] = ("", 0)
         self.rpc: Optional[_RpcClient] = None
+        #: the shard process pid (from ``shard_info``): what lets a
+        #: NON-owning front door (a replica attached to another door's
+        #: shards) still honor SIGKILL-is-the-fence on failover.
+        self.pid: Optional[int] = None
 
     def connect(self, mc=None, timeout: float = 30.0) -> None:
         self.rpc = _RpcClient(self.addr[0], self.addr[1], timeout=timeout,
@@ -146,12 +160,14 @@ class ProcShard(ShardHandle):
     """A real ``python -m fluidframework_tpu.service.shardhost`` process."""
 
     def __init__(self, shard_id: str, base_dir: str,
-                 fault_plan_path: Optional[str] = None) -> None:
+                 fault_plan_path: Optional[str] = None,
+                 extra_args: Tuple[str, ...] = ()) -> None:
         super().__init__(shard_id)
         cmd = [sys.executable, "-m", "fluidframework_tpu.service.shardhost",
                "--shard-id", shard_id, "--dir", base_dir, "--port", "0"]
         if fault_plan_path:
             cmd += ["--fault-plan", fault_plan_path]
+        cmd += list(extra_args)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         self.proc = subprocess.Popen(
             cmd, cwd=_REPO_ROOT, env=env, stdout=subprocess.PIPE,
@@ -206,8 +222,6 @@ class ProcShard(ShardHandle):
             pass
 
     def hang(self) -> None:
-        import signal as _signal
-
         if self.proc.poll() is None:
             self.proc.send_signal(_signal.SIGSTOP)
 
@@ -230,12 +244,16 @@ class ThreadShard(ShardHandle):
     REAL signal semantics (mid-anything SIGKILL, SIGSTOP hangs, SIGTERM
     seal) are exercised by the ``ProcShard`` tests and benches."""
 
-    def __init__(self, shard_id: str, base_dir: str) -> None:
-        from .shardhost import ShardHost, ShardHostServer
+    def __init__(self, shard_id: str, base_dir: str,
+                 extra_args: Tuple[str, ...] = ()) -> None:
+        from .shardhost import ShardHost, ShardHostServer, apply_shard_flags
 
         super().__init__(shard_id)
         self.host_obj = ShardHost(shard_id, base_dir)
         self.server = ShardHostServer(self.host_obj, port=0)
+        # Same tuning vocabulary as the process CLI (and re-applied the
+        # same way on a failover respawn).
+        apply_shard_flags(self.server, extra_args)
         self.server.start_in_thread()
         self.addr = ("127.0.0.1", self.server.port)
         self._dead = False
@@ -286,128 +304,50 @@ class ThreadShard(ShardHandle):
         self.host_obj.seal()
 
 
-class _FrontSession:
-    """One client connection's server-side state on the front door.
+class ExternShard(ShardHandle):
+    """Attach-mode handle (ISSUE 18): a shard-host process OWNED BY
+    ANOTHER front door (the primary), addressed over TCP.  N shared-
+    nothing replicas supervise the same shard fleet through these —
+    they agree on doc→shard placement purely through the deterministic
+    rendezvous router, with zero shared assignment state.
 
-    Broadcast relay frames do NOT write to the socket inline: they
-    queue under a per-client byte budget and a lazily-started writer
-    thread drains them (ISSUE 15).  Before this, ``_relay_event`` ran a
-    blocking ``sendall`` per subscribed session on the shard-RPC
-    dispatcher thread — ONE stalled reader blocked every other client's
-    events and its kernel-plus-process buffering grew unboundedly.  Now
-    a stalled reader saturates ITS OWN queue (bounded by
-    ``relay_budget``) and the front door demotes it — the existing
-    broadcaster demotion contract, applied at the relay hop."""
+    Ownership split: ``terminate`` is a NO-OP (a replica closing must
+    never tear down shards the primary still serves), but ``kill`` is
+    REAL — it SIGKILLs by pid (``shard_info`` reports it; same-machine
+    deployment).  SIGKILL-is-the-fence must hold no matter which
+    replica runs a failover: adopting a merely-unreachable shard's
+    documents without killing it would let the old process wake up and
+    extend a re-owned log."""
 
-    def __init__(self, sock: socket.socket,
-                 relay_budget: int = 4 << 20) -> None:
-        self.sock = sock
-        self._write_lock = threading.Lock()
-        self.subscribed: Set[str] = set()
-        self.closed = False
-        self.relay_budget = int(relay_budget)
-        #: a Condition so the writer thread sleeps until a frame arrives
-        #: (or close()) instead of idle-polling for the session lifetime
-        self._relay_lock = threading.Condition()
-        self._relay_q: "deque[bytes]" = deque()  # guarded-by: _relay_lock
-        self._relay_bytes = 0  # guarded-by: _relay_lock
-        #: lazily started on the first relayed frame — sessions that
-        #: never subscribe (the 10⁴-connection shape) cost no thread.
-        self._relay_thread: Optional[threading.Thread] = None  # guarded-by: _relay_lock
+    def __init__(self, shard_id: str, addr: Tuple[str, int]) -> None:
+        super().__init__(shard_id)
+        self.addr = (addr[0], int(addr[1]))
 
-    def write(self, obj: dict) -> None:
-        self.write_bytes(frame_bytes(obj))
+    def alive(self) -> bool:
+        # No child handle to poll: liveness is observable only over the
+        # wire.  The heartbeat model already accepts that ambiguity —
+        # kill-before-adopt is what makes slow-vs-dead irrelevant.
+        return self.ping()
 
-    def write_bytes(self, data: bytes) -> None:
-        if self.closed:
+    def kill(self) -> None:
+        if self.pid is None:
             return
         try:
-            with self._write_lock:
-                self.sock.sendall(data)
-        except OSError:
-            self.closed = True
+            os.kill(self.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass  # already gone (the owner may have reaped it)
 
-    # -- bounded broadcast relay (per-client flow control) ---------------------
-
-    def relay(self, data: bytes) -> bool:
-        """Bounded enqueue of one broadcast frame: False = the budget
-        is exhausted (a stalled or slow reader) and the caller demotes
-        this session — the broadcaster's sink contract at this hop.
-        A frame larger than the whole budget is still accepted into an
-        EMPTY queue (charged in flight): otherwise one oversized event
-        would demote every subscriber — idle fast readers included — on
-        every occurrence, forever.  Memory stays bounded by
-        ``max(relay_budget, one frame)``."""
-        if self.closed:
-            return True  # tearing down: drop silently, like the server sink
-        with self._relay_lock:
-            if self._relay_bytes > 0 \
-                    and self._relay_bytes + len(data) > self.relay_budget:
-                return False
-            self._enqueue_locked(data)
-        return True
-
-    def relay_priority(self, data: bytes) -> None:
-        """Budget-exempt, queue-jumping enqueue for CONTROL frames
-        (demoted / fence): bounded by construction — at most one per
-        (doc, event) — and they must reach a saturated client
-        PROMPTLY, not behind its whole data backlog (the demotion
-        notice IS the recovery trigger the driver's re-subscribe
-        rides; receivers dedup any stale data frames that drain after
-        it by seq watermark)."""
-        if self.closed:
+    def hang(self) -> None:
+        if self.pid is None:
             return
-        with self._relay_lock:
-            self._enqueue_locked(data, front=True)
-
-    def _enqueue_locked(self, data: bytes, front: bool = False) -> None:
-        if front:
-            self._relay_q.appendleft(data)
-        else:
-            self._relay_q.append(data)
-        self._relay_bytes += len(data)
-        self._relay_lock.notify()
-        if self._relay_thread is None:
-            self._relay_thread = threading.Thread(target=self._relay_loop,
-                                                  daemon=True)
-            self._relay_thread.start()
-
-    def relay_pending(self) -> int:
-        with self._relay_lock:
-            return self._relay_bytes
-
-    def _relay_loop(self) -> None:
-        while True:
-            with self._relay_lock:
-                while not self._relay_q and not self.closed:
-                    # bounded wait: re-checks closed even if a racing
-                    # close() slipped between the notify and this wait
-                    self._relay_lock.wait(timeout=0.5)
-                if not self._relay_q and self.closed:
-                    return
-                data = self._relay_q.popleft()
-            # Send OUTSIDE the queue lock (the socket may block on a
-            # slow reader for arbitrarily long); the frame stays
-            # budget-charged (``_relay_bytes``) until the kernel
-            # accepted it, so in-flight bytes count against the budget.
-            self.write_bytes(data)
-            with self._relay_lock:
-                self._relay_bytes -= len(data)
-
-    def close(self) -> None:
-        if self.closed:
-            return
-        self.closed = True
-        with self._relay_lock:
-            self._relay_lock.notify_all()  # wake the writer to exit
         try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
+            os.kill(self.pid, _signal.SIGSTOP)
+        except (ProcessLookupError, PermissionError):
             pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+
+    def terminate(self, timeout: float = 15.0) -> None:
+        """Not ours to stop: the owning front door drains-and-seals its
+        own children on ITS close."""
 
 
 class FrontDoor:
@@ -427,14 +367,30 @@ class FrontDoor:
                  hang_detect_ticks: int = 2, mc=None,
                  shard_fault_plan_path: Optional[str] = None,
                  request_timeout: float = 30.0,
-                 relay_budget: int = 4 << 20) -> None:
-        if spawn not in ("proc", "thread"):
+                 relay_budget: int = 4 << 20,
+                 attach_addrs: Optional[Dict[str, Tuple[str, int]]] = None,
+                 shard_args: Optional[List[str]] = None,
+                 dispatch_workers: int = 8) -> None:
+        if spawn not in ("proc", "thread", "attach"):
             raise ValueError(f"unknown spawn backend {spawn!r}")
-        ids = (list(shard_ids) if shard_ids is not None
-               else [f"shard{i:02d}" for i in range(n_shards)])
+        if spawn == "attach":
+            if not attach_addrs:
+                raise ValueError("attach spawn requires attach_addrs")
+            ids = (list(shard_ids) if shard_ids is not None
+                   else sorted(attach_addrs))
+        else:
+            ids = (list(shard_ids) if shard_ids is not None
+                   else [f"shard{i:02d}" for i in range(n_shards)])
         self.base_dir = base_dir
         os.makedirs(base_dir, exist_ok=True)
         self.spawn_mode = spawn
+        self._attach_addrs = dict(attach_addrs or {})
+        #: extra tuning args applied to every spawned shard — CLI args
+        #: for proc spawns, the same vocabulary via
+        #: ``shardhost.apply_shard_flags`` for thread spawns (e.g. the
+        #: wire-clock admission flags a deterministic out-of-proc storm
+        #: needs); ignored for attach spawns (not ours to configure).
+        self.shard_args: Tuple[str, ...] = tuple(shard_args or ())
         self.host = host
         self.port = port
         self.router = ShardRouter(ids)
@@ -466,7 +422,7 @@ class FrontDoor:
         self._overrides: Dict[str, str] = {}  # guarded-by: _route_lock
         self._orphans: Dict[str, str] = {}  # guarded-by: _route_lock
         self._docs: Set[str] = set()  # guarded-by: _route_lock
-        self._subs: Dict[str, List[_FrontSession]] = {}  # guarded-by: _route_lock
+        self._subs: Dict[str, List[PumpConnection]] = {}  # guarded-by: _route_lock
         self._tap_registered: Set[Tuple[str, str]] = set()  # guarded-by: _route_lock
         #: migration audit trail: (doc, source shard, target shard)
         self.migrations: List[Tuple[str, str, str]] = []  # guarded-by: _route_lock
@@ -477,10 +433,17 @@ class FrontDoor:
         self._stopping = threading.Event()
         self._jobs: "queue.Queue[Optional[_Job]]" = queue.Queue()
         self._supervisor: Optional[threading.Thread] = None
-        self._accept_thread: Optional[threading.Thread] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
-        self._lsock: Optional[socket.socket] = None
-        self._sessions: List[_FrontSession] = []  # guarded-by: _route_lock
+        #: the event-loop connection layer (ISSUE 18): ONE thread owns
+        #: accept + reads + budget-aware writes for every client socket;
+        #: decoded frames dispatch to the worker pool below (a shard RPC
+        #: must never run on the loop — it would stall every connection).
+        self._pump: Optional[FramePump] = None
+        self._dispatch: Optional[ThreadPoolExecutor] = None
+        self.dispatch_workers = int(dispatch_workers)
+        #: set by :meth:`kill` (replica-death drills): this door went
+        #: down ABRUPTLY — no drain, no seal, shards left running.
+        self.killed = False
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -504,20 +467,18 @@ class FrontDoor:
                 except (OSError, RuntimeError):
                     pass
             raise
-        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind((self.host, self.port))
-        self._lsock.listen(128)
-        # Closing a listening socket does NOT wake a blocked accept() on
-        # Linux; a bounded accept timeout lets the loop observe shutdown.
-        self._lsock.settimeout(0.5)
-        self.port = self._lsock.getsockname()[1]
+        self._dispatch = ThreadPoolExecutor(
+            max_workers=self.dispatch_workers,
+            thread_name_prefix="fd-dispatch")
+        self._pump = FramePump(self.host, self.port, self._on_frame,
+                               on_close=self._drop_session,
+                               relay_budget=self.relay_budget,
+                               mc=self._mc)
+        self._pump.start()
+        self.port = self._pump.port
         self._supervisor = threading.Thread(target=self._supervisor_loop,
                                             daemon=True)
         self._supervisor.start()
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
-        self._accept_thread.start()
         if self._heartbeat_interval is not None:
             self._heartbeat_thread = threading.Thread(
                 target=self._heartbeat_loop, daemon=True)
@@ -528,11 +489,19 @@ class FrontDoor:
         if self.spawn_mode == "proc":
             handle: ShardHandle = ProcShard(
                 shard_id, self.base_dir,
-                fault_plan_path=self._shard_fault_plan_path)
+                fault_plan_path=self._shard_fault_plan_path,
+                extra_args=self.shard_args)
+        elif self.spawn_mode == "attach":
+            if shard_id not in self._attach_addrs:
+                raise RpcTransportError(
+                    f"attach replica has no address for {shard_id!r}")
+            handle = ExternShard(shard_id, self._attach_addrs[shard_id])
         else:
-            handle = ThreadShard(shard_id, self.base_dir)
+            handle = ThreadShard(shard_id, self.base_dir,
+                                 extra_args=self.shard_args)
         handle.connect(mc=self._mc, timeout=self.request_timeout)
         info = handle.request("shard_info", {})
+        handle.pid = info.get("pid")
         if self.epoch is None:
             self.epoch = info["epoch"]
         return handle
@@ -550,18 +519,17 @@ class FrontDoor:
             self._docs.update(seen)
 
     def close(self) -> None:
+        """Graceful stop: connections down, workers drained, every OWNED
+        shard drain-and-sealed (``ExternShard.terminate`` is a no-op —
+        attach replicas never tear down the primary's fleet)."""
         self._stopping.set()
-        if self._lsock is not None:
-            try:
-                self._lsock.close()
-            except OSError:
-                pass
+        if self._pump is not None:
+            self._pump.close()
+        if self._dispatch is not None:
+            self._dispatch.shutdown(wait=False)
         self._jobs.put(None)
         with self._route_lock:
             handles = list(self._shards.values())
-            sessions = list(self._sessions)
-        for session in sessions:
-            session.close()
         for handle in handles:
             handle.close()
             try:
@@ -572,8 +540,29 @@ class FrontDoor:
                     "shard": handle.shard_id, "error": str(exc)})
         if self._supervisor is not None:
             self._supervisor.join(timeout=10)
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=10)
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=10)
+
+    def kill(self) -> None:
+        """Abrupt death (replica drills): every client socket drops with
+        NOTHING flushed — from the wire this is indistinguishable from a
+        SIGKILLed replica process, which is the point.  Shard processes
+        are NOT touched (a replica does not own them; for a primary this
+        models the supervisor dying while its children keep serving —
+        callers that own shards must still reap them)."""
+        self.killed = True
+        self._stopping.set()
+        if self._pump is not None:
+            self._pump.close()
+        if self._dispatch is not None:
+            self._dispatch.shutdown(wait=False, cancel_futures=True)
+        self._jobs.put(None)
+        with self._route_lock:
+            handles = list(self._shards.values())
+        for handle in handles:
+            handle.close()  # the RPC socket only, never the process
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=10)
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=10)
 
@@ -680,59 +669,39 @@ class FrontDoor:
                 self._control(lambda s=sid: self._check_shard(s))
         raise last
 
-    # -- client-facing server --------------------------------------------------
+    # -- client-facing server (the pump feeds these) ---------------------------
 
-    def _accept_loop(self) -> None:
-        while not self._stopping.is_set():
-            try:
-                conn, _addr = self._lsock.accept()
-            except socket.timeout:
-                continue  # periodic shutdown check
-            except OSError:
-                return  # listener closed (shutdown)
-            session = _FrontSession(conn, relay_budget=self.relay_budget)
-            with self._route_lock:
-                self._sessions.append(session)
-            thread = threading.Thread(target=self._serve_client,
-                                      args=(session,), daemon=True)
-            thread.start()
-
-    def _serve_client(self, session: _FrontSession) -> None:
-        rfile = session.sock.makefile("rb")
+    def _on_frame(self, session: PumpConnection, frame: dict) -> None:
+        # on-loop: runs on the pump thread for EVERY decoded frame — the
+        # only permissible work here is handing off to the worker pool
+        # (a shard RPC on the loop would stall every connection).
+        dispatch = self._dispatch
+        if dispatch is None or self._stopping.is_set():
+            return
         try:
-            while True:
-                header = rfile.read(_LEN.size)
-                if header is None or len(header) != _LEN.size:
-                    return
-                (length,) = _LEN.unpack(header)
-                if length > MAX_FRAME:
-                    return
-                payload = rfile.read(length)
-                if payload is None or len(payload) != length:
-                    return
-                frame = json.loads(payload)
-                session.write(self._respond(session, frame))
-        except (OSError, ValueError) as exc:
+            dispatch.submit(self._serve_frame, session, frame)
+        except RuntimeError:
+            pass  # pool shut down mid-teardown: the socket is dying too
+
+    def _serve_frame(self, session: PumpConnection, frame: dict) -> None:
+        """Worker-pool entry: serve one request, write the response back
+        through the pump.  Responses may interleave across requests of
+        one connection — the wire contract matches replies by ``re`` id,
+        so per-connection pipelining is free concurrency, not a bug."""
+        try:
+            session.send_obj(self._respond(session, frame))
+        except Exception as exc:  # a response writer must never die mute
             self._mc.logger.send({"eventName": "clientSessionError",
                                   "error": str(exc)})
-        finally:
-            try:
-                rfile.close()
-            except OSError:
-                pass
-            self._drop_session(session)
-            session.close()
 
-    def _drop_session(self, session: _FrontSession) -> None:
+    def _drop_session(self, session: PumpConnection) -> None:
         with self._route_lock:
-            if session in self._sessions:
-                self._sessions.remove(session)
             for doc_id in session.subscribed:
                 subs = self._subs.get(doc_id)
                 if subs and session in subs:
                     subs.remove(session)
 
-    def _respond(self, session: _FrontSession, frame: dict) -> dict:
+    def _respond(self, session: PumpConnection, frame: dict) -> dict:
         rid = frame.get("id")
         if frame.get("v", 1) > WIRE_VERSION:
             return {"v": WIRE_VERSION, "re": rid, "ok": False,
@@ -744,10 +713,12 @@ class FrontDoor:
             return {"v": WIRE_VERSION, "re": rid, "ok": True,
                     "result": result}
         except NackError as nack:
+            body = {"retryAfter": nack.retry_after,
+                    "reason": nack.reason, "code": nack.code}
+            if nack.admission is not None:
+                body["admission"] = nack.admission
             return {"v": WIRE_VERSION, "re": rid, "ok": False,
-                    "error": nack.reason,
-                    "nack": {"retryAfter": nack.retry_after,
-                             "reason": nack.reason, "code": nack.code}}
+                    "error": nack.reason, "nack": body}
         except DocRelocatedError as dr:
             return {"v": WIRE_VERSION, "re": rid, "ok": False,
                     "error": str(dr), "code": "wrongShard",
@@ -768,7 +739,7 @@ class FrontDoor:
             return {"v": WIRE_VERSION, "re": rid, "ok": False,
                     "error": str(exc)}
 
-    def _handle_method(self, session: _FrontSession, method: str,
+    def _handle_method(self, session: PumpConnection, method: str,
                        params: dict):
         if method == "ping":
             return "pong"
@@ -903,14 +874,17 @@ class FrontDoor:
 
     # -- broadcast relay -------------------------------------------------------
 
-    def _subscribe(self, session: _FrontSession, params: dict) -> int:
+    def _subscribe(self, session: PumpConnection, params: dict) -> int:
         doc_id = params["doc"]
         head = self._ensure_tap(doc_id)
         with self._route_lock:
             subs = self._subs.setdefault(doc_id, [])
             if session not in subs:
                 subs.append(session)
-        session.subscribed.add(doc_id)
+            # Under the lock: _drop_session and _demote_relay iterate /
+            # mutate this set cross-thread, and pool dispatch means even
+            # one connection's own subscribes run on arbitrary workers.
+            session.subscribed.add(doc_id)
         return head
 
     def _ensure_tap(self, doc_id: str) -> int:
@@ -941,7 +915,7 @@ class FrontDoor:
             if not session.relay(data):
                 self._demote_relay(session, doc_id)
 
-    def _demote_relay(self, session: _FrontSession, doc_id: str) -> None:
+    def _demote_relay(self, session: PumpConnection, doc_id: str) -> None:
         """Per-client relay flow control tripped (ISSUE 15): remove the
         laggard session from this document's fan-out and tell it once —
         the client driver re-subscribes and gap-repairs from durable
@@ -954,10 +928,9 @@ class FrontDoor:
             if subs is None or session not in subs:
                 return  # already demoted by a racing relay fan-out
             subs.remove(session)
-            # Under the lock: _drop_session iterates session.subscribed
-            # while holding it, and this is the one cross-thread writer
-            # (every other touch happens on the session's own serve
-            # thread).
+            # Under the lock, like every touch of session.subscribed
+            # (_subscribe adds, _drop_session iterates — all
+            # cross-thread once frames dispatch to a pool).
             session.subscribed.discard(doc_id)
         self.counters.bump("fd.relay_demotions")
         session.relay_priority(frame_bytes(
@@ -1466,12 +1439,23 @@ class FrontDoor:
         with self._route_lock:
             return sorted(self._docs)
 
+    def shard_addrs(self) -> Dict[str, Tuple[str, int]]:
+        """(host, port) per live shard — what an attach replica needs to
+        supervise the same fleet (``FrontDoor(spawn="attach",
+        attach_addrs=primary.shard_addrs())``)."""
+        with self._route_lock:
+            dead = set(self.router.dead())
+            return {sid: handle.addr
+                    for sid, handle in sorted(self._shards.items())
+                    if sid not in dead}
+
     def stats(self) -> dict:
         with self._route_lock:
             handles = sorted(self._shards.items())
             migrations = list(self.migrations)
             fences = self.fences
-            sessions = list(self._sessions)
+        pump = self._pump
+        sessions = pump.connections() if pump is not None else []
         shards = {}
         for sid, handle in handles:
             if sid in self.router.dead() or not handle.alive():
@@ -1512,7 +1496,28 @@ class FrontDoor:
                                      for s in sessions),
                 "budget_per_session": self.relay_budget,
             },
+            # connection-layer health (the event-loop pump)
+            "pump": {
+                "accepted": pump.accepted if pump is not None else 0,
+                "dropped": pump.dropped if pump is not None else 0,
+                "open": len(sessions),
+            },
         }
+
+
+def _raise_nofile_limit() -> None:
+    """Best-effort: lift the soft fd limit to the hard cap.  The
+    connection-scale gate (tools/loadgen.py --connections) needs every
+    fd the container will give one process; the HARD cap is a kernel/
+    container fact this process cannot raise, so the bench records it
+    honestly instead."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ImportError, ValueError, OSError):
+        pass
 
 
 def main(argv=None) -> None:
@@ -1528,15 +1533,28 @@ def main(argv=None) -> None:
     parser.add_argument("--heartbeat", type=float, default=1.0,
                         help="heartbeat interval in seconds (death "
                              "detection); 0 disables")
+    parser.add_argument("--spawn", choices=("proc", "thread"),
+                        default="proc",
+                        help="shard backend: real processes, or "
+                             "in-process servers (connection-scale "
+                             "benches measure ONE process this way)")
+    parser.add_argument("--relay-budget", type=int, default=4 << 20,
+                        help="per-client broadcast relay byte budget")
+    parser.add_argument("--shard-arg", action="append", default=[],
+                        help="extra CLI arg forwarded to every spawned "
+                             "shard-host process (repeatable)")
     args = parser.parse_args(argv)
+    _raise_nofile_limit()
     door = FrontDoor(
-        args.dir, n_shards=args.shards, spawn="proc", host=args.host,
+        args.dir, n_shards=args.shards, spawn=args.spawn, host=args.host,
         port=args.port,
         heartbeat_interval=args.heartbeat if args.heartbeat > 0 else None,
+        relay_budget=args.relay_budget,
+        shard_args=args.shard_arg,
     )
     door.start()
     print(f"frontdoor listening on {door.host}:{door.port} "
-          f"shards={door.router.alive()}", flush=True)
+          f"shards={door.router.alive()} pid={os.getpid()}", flush=True)
     try:
         while True:
             time.sleep(3600)

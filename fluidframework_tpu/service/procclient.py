@@ -10,6 +10,16 @@ ride the existing ``upload_summary`` RPC.  The front door object runs
 in-process (it IS the harness's supervisor); only the shards are real
 processes.
 
+Replica HA (ISSUE 18): the adapter takes an optional list of REPLICA
+doors fronting the same shard fleet.  The data path pins to the newest
+replica; when its socket dies (a replica SIGKILL drops every connection
+with nothing flushed) the adapter rotates to the next live door and
+resends — safe for every route it carries, because submits dedup by
+(client, client_seq) server-side and reads are idempotent.  Control
+calls (``tick``, ``router``, ``stats``) stay direct object calls on the
+PRIMARY door: the fault-plan driver is the harness's supervisor, not a
+wire client.
+
 The adapter deliberately implements the NARROW surface the swarm
 consumes — ``endpoint(doc).connect_many/connect_columns``,
 ``submit_mixed``, ``oplog.head/batch/is_contiguous``, ``storage.upload``,
@@ -24,7 +34,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..drivers.network_driver import _RpcClient
+from ..drivers.network_driver import (RpcTimeoutError, RpcTransportError,
+                                      _RpcClient)
 from ..protocol.summary import tree_to_obj
 from ..protocol.wire import ColumnBatch, encode_column_batch, \
     encode_raw_operation
@@ -36,19 +47,19 @@ class ProcEndpoint:
     """Per-document ingress facade over the front door (JOIN cohorts;
     per-op routes ride the network driver, not this adapter)."""
 
-    def __init__(self, rpc: _RpcClient, doc_id: str) -> None:
-        self._rpc = rpc
+    def __init__(self, client: "ProcServiceClient", doc_id: str) -> None:
+        self._client = client
         self.doc_id = doc_id
 
     def connect_many(self, client_ids: List[str],
                      session: Optional[str] = None) -> None:
-        self._rpc.request("connect_many", {
+        self._client.request("connect_many", {
             "doc": self.doc_id, "clients": list(client_ids),
             "session": session, "columnar": False})
 
     def connect_columns(self, client_ids: List[str],
                         session: Optional[str] = None) -> None:
-        self._rpc.request("connect_many", {
+        self._client.request("connect_many", {
             "doc": self.doc_id, "clients": list(client_ids),
             "session": session, "columnar": True})
 
@@ -65,8 +76,8 @@ class _ProcLogView:
         return self._client.heads([doc_id])[doc_id]
 
     def is_contiguous(self, doc_id: str) -> bool:
-        return bool(self._client.rpc.request("log_contiguous",
-                                             {"doc": doc_id}))
+        return bool(self._client.request("log_contiguous",
+                                         {"doc": doc_id}))
 
     def batch(self):
         return contextlib.nullcontext(self)
@@ -75,11 +86,11 @@ class _ProcLogView:
 class _ProcStorageView:
     """``service.storage.upload`` for the swarm's summary elections."""
 
-    def __init__(self, rpc: _RpcClient) -> None:
-        self._rpc = rpc
+    def __init__(self, client: "ProcServiceClient") -> None:
+        self._client = client
 
     def upload(self, doc_id: str, tree, ref_seq: int) -> str:
-        result = self._rpc.request("upload_summary", {
+        result = self._client.request("upload_summary", {
             "doc": doc_id, "summary": tree_to_obj(tree),
             "ref_seq": ref_seq})
         return result["handle"]
@@ -97,15 +108,70 @@ def _decode_outcome(wire: dict) -> SubmitOutcome:
 
 class ProcServiceClient:
     """The ordering-tier surface of a fluidproc deployment, for swarm
-    harnesses.  One RPC connection to the (in-process) front door; the
-    fault-plan ``tick`` and the router are direct object calls — the
-    supervisor is local even though every shard is a separate process."""
+    harnesses.  One RPC connection to an (in-process) front door — the
+    newest replica when replicas exist — with dead-door rotation; the
+    fault-plan ``tick`` and the router are direct object calls on the
+    primary — the supervisor is local even though every shard is a
+    separate process."""
 
-    def __init__(self, door: FrontDoor, timeout: float = 120.0) -> None:
+    def __init__(self, door: FrontDoor, timeout: float = 120.0,
+                 replicas: Optional[List[FrontDoor]] = None) -> None:
         self.door = door
-        self.rpc = _RpcClient("127.0.0.1", door.port, timeout=timeout)
+        self._timeout = float(timeout)
+        #: every door fronting the fleet, primary first; the data path
+        #: pins to the LAST (newest replica) so a replica-death drill
+        #: kills the door the traffic actually rides.
+        self.doors: List[FrontDoor] = [door] + list(replicas or [])
+        self._at = len(self.doors) - 1
+        self.rpc = _RpcClient("127.0.0.1", self.doors[self._at].port,
+                              timeout=self._timeout)
+        #: door rotations taken (the drill pins this went >= 1)
+        self.door_failovers = 0
         self.oplog = _ProcLogView(self)
-        self.storage = _ProcStorageView(self.rpc)
+        self.storage = _ProcStorageView(self)
+
+    def request(self, method: str, params: dict,
+                timeout: Optional[float] = None):
+        """One RPC with door failover: a dead socket (replica SIGKILL)
+        rotates to the next live door and resends.  Typed refusals
+        (nack / wrongShard / fence) pass through — they are the
+        SERVICE talking, not the transport dying; only transport-shaped
+        failures rotate.  Resends are safe on every adapter route:
+        submits dedup by (client, client_seq), everything else is a
+        read or an idempotent registration."""
+        last: Optional[BaseException] = None
+        for _attempt in range(len(self.doors) + 1):
+            try:
+                return self.rpc.request(method, params, timeout=timeout)
+            except (RpcTransportError, RpcTimeoutError) as exc:
+                last = exc
+                if not self._rotate_door():
+                    break
+        raise last
+
+    def _rotate_door(self) -> bool:
+        """Reconnect to the next door not known-dead (``killed`` is the
+        harness's own flag; a door killed out-of-band just fails its
+        connect and the rotation continues).  Returns False when every
+        candidate is exhausted."""
+        try:
+            self.rpc.close()
+        except OSError:
+            pass
+        for step in range(1, len(self.doors) + 1):
+            idx = (self._at - step) % len(self.doors)
+            candidate = self.doors[idx]
+            if candidate.killed:
+                continue
+            try:
+                self.rpc = _RpcClient("127.0.0.1", candidate.port,
+                                      timeout=self._timeout)
+            except OSError:
+                continue
+            self._at = idx
+            self.door_failovers += 1
+            return True
+        return False
 
     @property
     def router(self):
@@ -115,17 +181,17 @@ class ProcServiceClient:
         return self.door.tick(now)
 
     def endpoint(self, doc_id: str) -> ProcEndpoint:
-        return ProcEndpoint(self.rpc, doc_id)
+        return ProcEndpoint(self, doc_id)
 
     def heads(self, doc_ids: List[str]) -> Dict[str, int]:
         if not doc_ids:
             return {}
-        return self.rpc.request("heads", {"docs": list(doc_ids)})
+        return self.request("heads", {"docs": list(doc_ids)})
 
     def contiguous(self, doc_ids: List[str]) -> Dict[str, bool]:
         if not doc_ids:
             return {}
-        return self.rpc.request("log_contiguous", {"docs": list(doc_ids)})
+        return self.request("log_contiguous", {"docs": list(doc_ids)})
 
     def doc_ids(self) -> List[str]:
         return self.door.doc_ids()
@@ -152,7 +218,7 @@ class ProcServiceClient:
                 ranges[doc] = [s, e]
             payload["columns"] = encode_column_batch(batch)
             payload["doc_rows"] = ranges
-        out = self.rpc.request("submit_mixed", payload)
+        out = self.request("submit_mixed", payload)
         return {doc: _decode_outcome(w) for doc, w in out.items()}
 
     def submit_many(self, batches: Dict[str, list]

@@ -87,6 +87,17 @@ class AdmissionController:
       ``shed`` to ``degrade``: the server answers with the stored
       summary at an older ref_seq (see ``_degraded_serve``) instead of
       pure refusal.
+
+    **Wire-clock mode** (ISSUE 18, the storm-verdict replay debt): an
+    out-of-proc shard cannot share the harness's VirtualClock object,
+    so remote admission used to ride wall time — every verdict landed
+    OUTSIDE replay identity.  With ``virtual = True`` the controller
+    instead advances on clock values the CALLERS carry on the wire
+    (:meth:`observe`, monotone max): a deterministic driver that stamps
+    its virtual tick onto each catchup request makes every lease
+    expiry, backlog depth, and load-derived ``retry_after`` a pure
+    function of the request sequence — bit-identical on replay, process
+    boundary or not.
     """
 
     def __init__(self, max_inflight: int, clock=None,
@@ -111,6 +122,23 @@ class AdmissionController:
         #: sustained-overload signal and the queue-depth estimate (each
         #: consecutive shed implies another caller waiting out there).
         self._shed_streak = 0  # guarded-by: _lock
+        #: wire-clock mode: time advances only via observe() — see the
+        #: class doc.  Flipped post-ctor (a deployment flag, not config).
+        self.virtual = False
+        self._vnow = 0.0  # guarded-by: _lock
+
+    def observe(self, vnow: float) -> None:
+        """Wire-clock input: a caller reported ITS clock.  Monotone max
+        — requests may arrive reordered across connections, and time
+        never runs backwards."""
+        vnow = float(vnow)
+        with self._lock:
+            if vnow > self._vnow:
+                self._vnow = vnow
+
+    def _now_locked(self) -> float:
+        # holds-lock: _lock
+        return self._vnow if self.virtual else self._clock()
 
     def _purge_locked(self, now: float) -> None:
         expired = [token for token, lease in self._leases.items()
@@ -122,8 +150,8 @@ class AdmissionController:
         """One admission decision: ``("admit", token)`` — the caller
         runs its fold and MUST ``release(token)`` (try/finally) — or
         ``("shed" | "degrade", retry_after)`` under overload."""
-        now = self._clock()
         with self._lock:
+            now = self._now_locked()
             self._purge_locked(now)
             if len(self._leases) >= self.max_inflight:
                 self._shed_streak += 1
@@ -146,8 +174,8 @@ class AdmissionController:
         in the EMA the pacing derives from; with ``hold`` > 0 the lease
         keeps its slot until ``now + hold`` (purged lazily by later
         admits), else the slot frees immediately."""
-        now = self._clock()
         with self._lock:
+            now = self._now_locked()
             lease = self._leases.get(token)
             if lease is None:
                 return
@@ -160,12 +188,18 @@ class AdmissionController:
                 self._leases.pop(token)
 
     def snapshot(self) -> dict:
+        """Self-contained pacing record: everything a remote harness
+        needs to RE-DERIVE a shed verdict's retry_after (the clamp
+        bounds included), so out-of-proc storm pacing can be audited
+        against the snapshot the nack carried."""
         with self._lock:
             return {
                 "inflight": len(self._leases),
                 "max_inflight": self.max_inflight,
                 "cost_ema": round(self._cost_ema, 6),
                 "shed_streak": self._shed_streak,
+                "retry_floor": self.retry_floor,
+                "retry_cap": self.retry_cap,
             }
 
 
@@ -820,6 +854,13 @@ class OrderingServer:
         ``catchup.requests == admitted + shed + degraded``, with
         ``catchup.warm`` counting lane-1 serves outside that balance.
         """
+        # Wire-clock admission (ISSUE 18): a deterministic out-of-proc
+        # caller stamps its virtual tick onto the request; in virtual
+        # mode the controller advances ONLY on these, so every verdict
+        # below is a pure function of the request sequence.
+        vnow = params.get("vnow")
+        if vnow is not None and self.admission_control.virtual:
+            self.admission_control.observe(float(vnow))
         catchup = self._ensure_catchup()
         # Epoch-keyed invalidation (EpochTracker parity for the SERVER's
         # own fold caches): entries are keyed by the storage generation
@@ -863,7 +904,8 @@ class OrderingServer:
             self.admission.bump("catchup.shed")
             raise NackError(
                 "catch-up tier overloaded; backfill from deltas "
-                "or retry", retry_after=float(grant), code="overloaded")
+                "or retry", retry_after=float(grant), code="overloaded",
+                admission=self.admission_control.snapshot())
         self.admission.bump("catchup.admitted")
         try:
             # The warm pre-pass's partial serves ride along so the fold
@@ -1084,12 +1126,15 @@ class OrderingServer:
                                     "code": "shardFenced",
                                     "doc": sf.doc_id}
                     except NackError as nack:
+                        nack_body = {"retryAfter": nack.retry_after,
+                                     "reason": nack.reason,
+                                     "code": nack.code}
+                        if nack.admission is not None:
+                            nack_body["admission"] = nack.admission
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
                                     "ok": False, "error": nack.reason,
-                                    "nack": {"retryAfter": nack.retry_after,
-                                             "reason": nack.reason,
-                                             "code": nack.code}}
+                                    "nack": nack_body}
                     except Exception as exc:  # surfaced to the client
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),

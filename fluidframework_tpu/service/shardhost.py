@@ -410,6 +410,39 @@ class ShardHostServer(OrderingServer):
         return super()._dispatch(session, method, params)
 
 
+def apply_shard_flags(server, argv) -> None:
+    """Apply the tuning subset of the shardhost CLI to a LIVE server.
+
+    Shared by ``main()`` (real processes) and ``ThreadShard`` (in-thread
+    shards): both spawn modes take the same ``--shard-arg`` vocabulary,
+    and a failover RESPAWN re-applies it automatically — a restarted
+    storm shard comes back with the same wire-clock admission shape as
+    the one that died.  Deployment knobs, not config gates: post-ctor
+    attributes exactly like the in-proc harnesses set them (the gate
+    registry stays the single source of DEFAULTS; these override
+    per-process)."""
+    argv = list(argv)
+    i = 0
+    while i < len(argv):
+        flag = argv[i]
+        if flag == "--virtual-admission":
+            server.admission_control.virtual = True
+            i += 1
+            continue
+        if i + 1 >= len(argv):
+            raise ValueError(f"shard flag {flag!r} needs a value")
+        value = argv[i + 1]
+        if flag == "--catchup-hold":
+            server.catchup_hold_seconds = float(value)
+        elif flag == "--catchup-max-inflight":
+            server.admission_control.max_inflight = max(1, int(value))
+        elif flag == "--catchup-degrade-after":
+            server.admission_control.degrade_after = max(0, int(value))
+        else:
+            raise ValueError(f"unknown shard flag {flag!r}")
+        i += 2
+
+
 def main(argv=None) -> None:
     import argparse
     import asyncio
@@ -437,6 +470,21 @@ def main(argv=None) -> None:
                         help="fold once a doc has this many unfolded ops")
     parser.add_argument("--stream-retention", type=int, default=None,
                         help="never truncate the newest N ops")
+    parser.add_argument("--virtual-admission", action="store_true",
+                        help="wire-clock catchup admission (ISSUE 18): "
+                             "the controller's clock advances only on "
+                             "vnow values carried by catchup requests — "
+                             "deterministic out-of-proc storm verdicts")
+    parser.add_argument("--catchup-hold", type=float, default=None,
+                        help="modeled fold duration: extra clock seconds "
+                             "an admission lease occupies its slot after "
+                             "release (storm harness load model)")
+    parser.add_argument("--catchup-max-inflight", type=int, default=None,
+                        help="override the catchup fold lane's admission "
+                             "slot count")
+    parser.add_argument("--catchup-degrade-after", type=int, default=None,
+                        help="consecutive sheds before the verdict "
+                             "degrades to stored-summary serving")
     args = parser.parse_args(argv)
 
     faults = None
@@ -466,6 +514,18 @@ def main(argv=None) -> None:
     if args.stream:
         server.enable_streaming(cadence_ops=args.stream_cadence,
                                 retention_floor=args.stream_retention)
+    # One application point for both spawn modes: re-encode the parsed
+    # tuning flags and run them through the same helper ThreadShard uses.
+    flags: list = []
+    if args.virtual_admission:
+        flags.append("--virtual-admission")
+    if args.catchup_hold is not None:
+        flags += ["--catchup-hold", str(args.catchup_hold)]
+    if args.catchup_max_inflight is not None:
+        flags += ["--catchup-max-inflight", str(args.catchup_max_inflight)]
+    if args.catchup_degrade_after is not None:
+        flags += ["--catchup-degrade-after", str(args.catchup_degrade_after)]
+    apply_shard_flags(server, flags)
 
     async def _run():
         await server.start()

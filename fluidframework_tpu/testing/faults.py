@@ -80,6 +80,13 @@ SITES: Dict[str, Tuple[str, ...]] = {
     # "Deployment & migration").
     "proc.kill": ("kill",),
     "proc.hang": ("hang",),
+    # ``replica.kill`` (round 18) SIGKILLs a front-door REPLICA — the
+    # door itself, not a shard — at a scheduled tick: every client
+    # socket it held drops with nothing flushed, shards keep running,
+    # and the swarm's adapter must fail over to a surviving door.  The
+    # swarm executes this (the replica fleet is harness topology the
+    # primary's tick driver never sees).
+    "replica.kill": ("kill",),
     # Catch-up fold tier (round 15, the storm subsystem): fired by the
     # server's fold lane AFTER admission — ``catchup.fail`` raises out
     # of the fold (the single-flight finally-abandon, the admission
@@ -109,7 +116,8 @@ SITES: Dict[str, Tuple[str, ...]] = {
 
 #: sites matched by occurrence count (the seam calls ``fire``); the rest
 #: are schedule-driven (the harness calls ``due`` with the virtual tick).
-SCHEDULED_SITES = ("shard.kill", "client.stall", "proc.kill", "proc.hang")
+SCHEDULED_SITES = ("shard.kill", "client.stall", "proc.kill", "proc.hang",
+                   "replica.kill")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -198,8 +198,9 @@ class ScenarioSpec:
     #: cohort models consumption columnar so cost stays bounded.
     #: In-proc runs are replay bit-identical (admission runs off a
     #: VirtualClock); out-of-proc runs issue the real ``catchup`` RPC
-    #: through the front door (verdict detail lands in
-    #: ``SwarmResult.storm``, outside replay identity).
+    #: through the front door against WIRE-CLOCK shard admission
+    #: (ISSUE 18), so remote verdicts are bit-identical too — only
+    #: transport noise stays outside replay identity.
     storm: bool = False
     #: real catch-up callers elected per document per storm wave — the
     #: "sampled real folds" bound; the cohort remainder stays columnar
@@ -245,6 +246,21 @@ class ScenarioSpec:
     #: ``storm_clients_per_doc`` admits is a spec error, not a silently
     #: clipped sample.
     storm_min_cohort: int = 0
+    #: front-door replicas (ISSUE 18): out-of-proc runs stand up this
+    #: many front doors over ONE shard fleet — the primary spawns and
+    #: supervises the shards, every additional door ATTACHES to the same
+    #: addresses (shared-nothing: replicas agree on placement only
+    #: through the deterministic rendezvous router).  The swarm's data
+    #: path pins to the NEWEST replica, so a scheduled ``replica.kill``
+    #: SIGKILLs the door the traffic actually rides and the adapter must
+    #: fail over to a survivor.
+    replicas: int = 1
+    #: shard backend for out-of-proc runs: ``"proc"`` (real processes,
+    #: the default) or ``"thread"`` (in-process ShardHostServers behind
+    #: the same real TCP wire — no fork cost, which is what lets a
+    #: replica-death drill run in tier-1 time).  ``replica.kill`` works
+    #: under either; ``proc.kill``/``proc.hang`` need real processes.
+    proc_spawn: str = "proc"
 
     def __post_init__(self) -> None:
         if self.clients < self.docs:
@@ -266,14 +282,36 @@ class ScenarioSpec:
                 f"host processes take --stream directly)")
         if self.docs < 1 or self.shards < 1:
             raise ValueError(f"bad docs/shards on {self.name!r}")
+        if self.replicas < 1:
+            raise ValueError(f"bad replicas on {self.name!r}")
+        if self.replicas > 1 and not self.out_of_proc:
+            raise ValueError(
+                f"{self.name!r}: front-door replicas are an out-of-proc "
+                f"topology — set out_of_proc=True")
+        if self.proc_spawn not in ("proc", "thread"):
+            raise ValueError(
+                f"{self.name!r}: proc_spawn must be 'proc' or 'thread'")
         if self.out_of_proc and self.plan is not None:
-            allowed = {"proc.kill", "proc.hang", "shard.kill"}
+            allowed = {"proc.kill", "proc.hang", "shard.kill",
+                       "replica.kill"}
             bad = [p.label() for p in self.plan.points
                    if p.site not in allowed]
             if bad:
                 raise ValueError(
                     f"out-of-proc scenarios only execute scheduled "
                     f"process faults {sorted(allowed)}; plan has {bad}")
+            if self.replicas < 2 and any(p.site == "replica.kill"
+                                         for p in self.plan.points):
+                raise ValueError(
+                    f"{self.name!r}: replica.kill needs a survivor — "
+                    f"set replicas >= 2")
+            if self.proc_spawn == "thread" and any(
+                    p.site in ("proc.kill", "proc.hang")
+                    for p in self.plan.points):
+                raise ValueError(
+                    f"{self.name!r}: proc.kill/proc.hang SIGKILL/SIGSTOP "
+                    f"real processes — use proc_spawn='proc' (thread "
+                    f"shards take shard.kill)")
 
     @property
     def ticks(self) -> int:
@@ -318,6 +356,8 @@ class SwarmResult:
     join_defers: Tuple[tuple, ...]
     #: (tick, killed shard, docs re-owned) per executed failover
     kills: Tuple[tuple, ...]
+    #: (tick, door index) per executed front-door replica kill
+    replica_kills: Tuple[tuple, ...]
     per_doc_head: Dict[str, int]
     #: sampled doc -> final summary digest (real Loader load at the end)
     sampled_digests: Dict[str, str]
@@ -544,8 +584,13 @@ class _CatchupStorm:
     ticks) before retrying.
 
     **Out-of-proc**: issues the real ``catchup`` RPC through the front
-    door to the owning shard process.  Remote admission runs on wall
-    clock, so per-verdict detail lands only in the (identity-excluded)
+    door to the owning shard process.  The shard runs WIRE-CLOCK
+    admission (ISSUE 18): its controller advances only on the ``vnow``
+    each request carries, requests go out sequentially on one
+    connection, and the remote verdict sequence becomes the same pure
+    function of ``(seed, spec)`` as in-proc — verdict counters rejoin
+    the replay-identity surface, and only transport noise (timeouts,
+    dead sockets, their retries) stays in the identity-excluded
     ``SwarmResult.storm`` report.
     """
 
@@ -573,6 +618,14 @@ class _CatchupStorm:
         #: PR 15 silent bound, surfaced (ISSUE 16 satellite)
         self.elected = 0
         self.clipped = 0
+        #: out-of-proc storms run WIRE-CLOCK admission (ISSUE 18): the
+        #: shard's controller advances only on the vnow each catchup
+        #: request carries, the harness issues requests sequentially on
+        #: one connection, and the verdict sequence becomes a pure
+        #: function of request order — so verdict counters rejoin the
+        #: replay-identity surface.  Transport noise (timeouts, socket
+        #: errors and the retries they cause) stays identity-excluded.
+        self.wire_clock = spec.out_of_proc
         if not spec.out_of_proc:
             from ..service.server import OrderingServer
             from ..utils.telemetry import ConfigProvider, MonitoringContext
@@ -671,23 +724,36 @@ class _CatchupStorm:
 
     def _bump(self, name: str, by: int = 1) -> None:
         """Verdict accounting: in-proc verdicts are deterministic and
-        land in the swarm counters (the replay-identity surface);
-        out-of-proc verdicts depend on remote wall-clock admission and
-        land ONLY in the identity-excluded ``storm`` report — a request
-        timeout under load must never flip ``replay_identical``."""
-        if self.server is not None:
+        land in the swarm counters (the replay-identity surface).
+        Out-of-proc verdicts USED to be wall-clock shaped and rode only
+        the identity-excluded ``storm`` report; under wire-clock
+        admission (ISSUE 18) they are deterministic too and rejoin the
+        identity counters — only transport NOISE (fold errors and the
+        retries they cause, bumped explicitly into ``remote``) stays
+        excluded, because a request timeout under load must never flip
+        ``replay_identical``."""
+        if self.server is not None or self.wire_clock:
             self.swarm.counters.bump(name, by)
         else:
             self.remote[name] = self.remote.get(name, 0) + by
 
     def _count(self, name: str) -> int:
-        if self.server is not None:
+        if self.server is not None or self.wire_clock:
             return self.swarm.counters.get(name)
         return self.remote.get(name, 0)
 
-    def _retry(self, i: int, t: int, after_ticks: int) -> None:
+    def _noise(self, name: str, by: int = 1) -> None:
+        """Non-deterministic accounting (transport errors, their
+        retries): identity-excluded by construction."""
+        self.remote[name] = self.remote.get(name, 0) + by
+
+    def _retry(self, i: int, t: int, after_ticks: int,
+               noise: bool = False) -> None:
         self.due.setdefault(t + max(1, after_ticks), []).append(i)
-        self._bump("swarm.storm_retries")
+        if noise:
+            self._noise("swarm.storm_retries")
+        else:
+            self._bump("swarm.storm_retries")
 
     def _serve(self, i: int, t: int, out: dict) -> None:
         """Record one successful catchup answer and verify it.  The
@@ -752,23 +818,49 @@ class _CatchupStorm:
         self._serve(i, t, out)
 
     def _issue_proc(self, i: int, t: int) -> None:
+        """One REAL catchup RPC through the front door (with door
+        failover — a replica SIGKILL mid-storm rotates to a survivor and
+        resends).  The request carries the wire clock: ``vnow`` is the
+        storm's own virtual time, and the shard's virtual admission
+        controller advances on nothing else — same pacing model as the
+        in-proc storm, across a real process boundary."""
         from ..drivers.network_driver import RpcError
 
         swarm = self.swarm
         doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
         try:
-            out = swarm.service.rpc.request("catchup", {"docs": [doc_id]})
+            out = swarm.service.request("catchup", {
+                "docs": [doc_id],
+                "vnow": t * swarm.spec.storm_tick_seconds})
         except NackError as exc:
             self._bump("swarm.storm_shed")
-            ticks = int(round(float(exc.retry_after)
-                              / swarm.spec.storm_tick_seconds))
+            retry = float(exc.retry_after)
+            snap = getattr(exc, "admission", None)
+            if self.wire_clock and snap:
+                # ISSUE 18 satellite: the nack carries the shard's
+                # admission snapshot, and the pacing must RE-DERIVE from
+                # the reported fold-cost EMA — drift between the
+                # snapshot and the verdict's retry_after is a bug, not
+                # rounding (cost_ema ships rounded to 1e-6).
+                backlog = int(snap["inflight"]) + int(snap["shed_streak"])
+                derived = min(float(snap["retry_cap"]), max(
+                    float(snap["retry_floor"]),
+                    float(snap["cost_ema"]) * backlog
+                    / max(1, int(snap["max_inflight"]))))
+                if abs(derived - retry) > 1e-4:
+                    raise AssertionError(
+                        f"admission snapshot does not reproduce the "
+                        f"shed pacing: derived {derived!r} vs wire "
+                        f"retry_after {retry!r} ({snap!r})")
+                retry = derived
+            ticks = int(round(retry / swarm.spec.storm_tick_seconds))
             self._retry(i, t, ticks)
             return
         except (RpcError, OSError) as exc:
-            self._bump("swarm.storm_fold_errors")
-            self.remote[f"error:{type(exc).__name__}"] = \
-                self.remote.get(f"error:{type(exc).__name__}", 0) + 1
-            self._retry(i, t, 1)
+            # Transport noise: wall-clock shaped, identity-excluded.
+            self._noise("swarm.storm_fold_errors")
+            self._noise(f"error:{type(exc).__name__}")
+            self._retry(i, t, 1, noise=True)
             return
         self._serve(i, t, out)
 
@@ -806,6 +898,10 @@ class _CatchupStorm:
         lane_total = folds + shed + degraded
         out: Dict[str, object] = {
             "mode": "proc" if self.server is None else "inproc",
+            # Wire-clock storms (every out-of-proc storm now): verdict
+            # counters are deterministic and live in the swarm counters;
+            # ``remote`` below carries only transport noise.
+            "wire_clock": self.wire_clock,
             "requests": self.swarm.counters.get("swarm.storm_requests"),
             # The real-caller election bound, surfaced: gates sampling
             # "real folds" must read the bound they sampled under, and
@@ -820,8 +916,11 @@ class _CatchupStorm:
             "folds": folds,
             "shed": shed,
             "degraded": degraded,
-            "retries": self._count("swarm.storm_retries"),
-            "fold_errors": self._count("swarm.storm_fold_errors"),
+            "retries": (self._count("swarm.storm_retries")
+                        + self.remote.get("swarm.storm_retries", 0)),
+            "fold_errors": (self._count("swarm.storm_fold_errors")
+                            + self.remote.get("swarm.storm_fold_errors",
+                                              0)),
             "shed_rate": (round(shed / lane_total, 4)
                           if lane_total else None),
             "latency_p50_ticks": float(percentile(lat, 0.50)),
@@ -856,6 +955,7 @@ class ClientSwarm:
             "swarm.elections",
             "swarm.catchup_completions", "swarm.delivery_samples",
             "swarm.frames", "swarm.sink_fences", "swarm.kills",
+            "swarm.replica_kills",
             # catch-up storm (ISSUE 15): deterministic for in-proc runs,
             # hence part of the replay-identity surface
             "swarm.storm_requests", "swarm.storm_served",
@@ -917,6 +1017,11 @@ class ClientSwarm:
                          if spec.plan is not None else None)
         self._cluster = None
         self._tmpdir = None
+        #: attach-mode front doors over the primary's shard fleet
+        #: (``spec.replicas`` > 1); the data path pins to the last one.
+        self._replicas: list = []
+        #: scheduled replica kills executed: ``(tick, door_index)``
+        self.replica_kills: List[Tuple[int, int]] = []
         self._proc_taps: Dict[str, object] = {}
         self._proc_frames: Dict[str, set] = {}
         if spec.out_of_proc:
@@ -936,17 +1041,48 @@ class ClientSwarm:
                 self._tmpdir = _tempfile.mkdtemp(prefix="fluidproc-swarm-")
                 base = self._tmpdir
             _os.makedirs(base, exist_ok=True)
+            # Wire-clock admission (ISSUE 18): a storm's shards take the
+            # virtual controller so every catchup verdict is a pure
+            # function of request order + the vnow each request carries
+            # — out-of-proc verdicts rejoin the replay-identity surface.
+            shard_args: List[str] = []
+            if spec.storm:
+                max_inflight = (1 << 30 if spec.storm_never_shed
+                                else spec.storm_max_inflight)
+                shard_args += [
+                    "--virtual-admission",
+                    "--catchup-max-inflight", str(max_inflight),
+                    "--catchup-degrade-after",
+                    str(spec.storm_degrade_after)]
+                if not spec.storm_never_shed:
+                    shard_args += ["--catchup-hold",
+                                   str(spec.storm_fold_ticks
+                                       * spec.storm_tick_seconds)]
             self._cluster = FrontDoor(
                 _os.path.join(base, "proc"), n_shards=spec.shards,
-                spawn="proc", faults=self.injector,
+                spawn=spec.proc_spawn, faults=self.injector,
+                shard_args=shard_args,
                 request_timeout=5.0).start()
             try:
-                self.service = ProcServiceClient(self._cluster)
+                # Additional front doors ATTACH to the primary's shard
+                # fleet: shared-nothing replicas that agree on placement
+                # only through the rendezvous router.  The primary stays
+                # the supervisor (fault ticks, respawns); replicas never
+                # terminate shards that are not theirs to stop.
+                for _r in range(1, spec.replicas):
+                    self._replicas.append(FrontDoor(
+                        _os.path.join(base, "proc"), spawn="attach",
+                        attach_addrs=self._cluster.shard_addrs(),
+                        request_timeout=5.0).start())
+                self.service = ProcServiceClient(
+                    self._cluster, replicas=self._replicas)
                 self.factory = NetworkDocumentServiceFactory(
                     port=self._cluster.port)
             except BaseException:
                 # Construction failed AFTER the processes spawned: reap
                 # them, or every failed setup leaks a live shard fleet.
+                for door in self._replicas:
+                    door.close()
                 self._cluster.close()
                 raise
         else:
@@ -1389,6 +1525,22 @@ class ClientSwarm:
         driver — the router diff is the mode-independent kill record."""
         if self.injector is None:
             return
+        # Replica kills are the SWARM's to execute: the front-door fleet
+        # is harness topology the primary's tick driver knows nothing
+        # about.  SIGKILL semantics for an in-process door: kill() tears
+        # the pump down with nothing flushed — wire-indistinguishable
+        # from the process dying — and the newest LIVE replica is always
+        # the victim, because that is the door the data path pins to.
+        for point in self.injector.due("replica.kill", t):
+            victim = next(
+                (i for i in range(len(self._replicas) - 1, -1, -1)
+                 if not self._replicas[i].killed), None)
+            if victim is None:
+                self.injector.mark_unfired(point)
+                continue
+            self._replicas[victim].kill()
+            self.replica_kills.append((t, victim))
+            self.counters.bump("swarm.replica_kills")
         router = getattr(self.service, "router", None)
         tick = getattr(self.service, "tick", None)
         if router is None or tick is None:
@@ -1628,6 +1780,7 @@ class ClientSwarm:
             defers=tuple(self.defers),
             join_defers=tuple(self.join_defers),
             kills=tuple(self.kills),
+            replica_kills=tuple(self.replica_kills),
             per_doc_head=per_doc_head,
             sampled_digests=digests,
             fault_counts=(self.injector.snapshot()
@@ -1693,6 +1846,10 @@ class ClientSwarm:
             return {}
         return {
             "cluster": self.service.stats(),
+            "doors": 1 + len(self._replicas),
+            "door_failovers": self.service.door_failovers,
+            "replica_pumps": [door.stats().get("pump")
+                              for door in self._replicas],
             "tap_unique_frames": {doc: len(seen) for doc, seen
                                   in sorted(self._proc_frames.items())},
             "tap_heads": {doc: per_doc_head[doc]
@@ -1710,6 +1867,10 @@ class ClientSwarm:
         except OSError:
             pass
         self.service.close()
+        for door in self._replicas:
+            if not door.killed:
+                door.close()
+        self._replicas = []
         self._cluster.close()
         self._cluster = None
         if self._tmpdir is not None:
@@ -1744,6 +1905,7 @@ def oracle_spec(spec: ScenarioSpec, result: SwarmResult) -> ScenarioSpec:
         plan=None,
         dir=None,
         out_of_proc=False,
+        replicas=1,
         # The storm twin is the NEVER-SHED oracle (ISSUE 15): unlimited
         # admission, no modeled fold hold — every shed/degraded client
         # of the real run must converge byte-identically to it.

@@ -413,12 +413,18 @@ def test_storm_smoke_converges_balances_and_replays():
 # --- front-door relay flow control ---------------------------------------------
 
 
-class _RecordingSock:
+class _FakeSock:
+    """A socket double for PumpConnection: accepts every byte."""
+
     def __init__(self):
         self.sent = []
 
-    def sendall(self, data):
-        self.sent.append(bytes(data))
+    def getpeername(self):
+        return ("test", 0)
+
+    def send(self, view):
+        self.sent.append(bytes(view))
+        return len(view)
 
     def shutdown(self, how):
         pass
@@ -427,19 +433,16 @@ class _RecordingSock:
         pass
 
 
-class _BlockingSock(_RecordingSock):
-    """sendall blocks until the gate opens — a reader that stopped."""
+class _FakePump:
+    """Pump double: flushing is EXPLICIT (`drain(conn)`), which is the
+    event-loop model's laggard — a connection whose kernel buffer has
+    not accepted its bytes yet is simply one the loop has not drained."""
 
-    def __init__(self):
-        super().__init__()
-        self.gate = threading.Event()
-        self.blocked = threading.Event()
+    def mark_dirty(self, conn):
+        pass
 
-    def sendall(self, data):
-        if not self.gate.is_set():
-            self.blocked.set()
-            assert self.gate.wait(timeout=30)
-        super().sendall(data)
+    def drop(self, conn):
+        conn.close()
 
 
 def _frontdoor_shell(tmp_path, relay_budget):
@@ -452,58 +455,53 @@ def _frontdoor_shell(tmp_path, relay_budget):
 
 
 def test_relay_budget_demotes_laggard_without_collateral(tmp_path):
-    from fluidframework_tpu.service.frontdoor import _FrontSession
+    from fluidframework_tpu.service.framepump import PumpConnection
 
     fd = _frontdoor_shell(tmp_path, relay_budget=300)
+    pump = _FakePump()
     # the healthy reader gets a roomy budget (a burst may momentarily
-    # outpace its writer thread); the stalled one a tight 300 bytes
-    fast = _FrontSession(_RecordingSock(), relay_budget=1 << 20)
-    slow = _FrontSession(_BlockingSock(), relay_budget=300)
+    # outpace the loop's flush passes); the stalled one a tight 300 B
+    fast = PumpConnection(_FakeSock(), pump, relay_budget=1 << 20)
+    slow = PumpConnection(_FakeSock(), pump, relay_budget=300)
     for s in (fast, slow):
         s.subscribed.add("doc")
     fd._subs["doc"] = [fast, slow]
     frame = {"v": 1, "event": "op", "doc": "doc", "msg": {"pad": "x" * 80}}
     for _ in range(12):
-        fd._relay_event(frame)
-    assert slow.sock.blocked.wait(timeout=10)
+        fd._relay_event(frame)  # slow is never flushed: a stopped reader
     # the laggard was demoted from this doc's fan-out, once
     assert fd.counters.get("fd.relay_demotions") == 1
     assert slow not in fd._subs["doc"]
     assert fast in fd._subs["doc"]
     # its queued bytes stayed bounded: budget + the priority demote frame
-    assert slow.relay_pending() < 300 + 200
-    # the fast client saw every frame, unstalled by the laggard
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and len(fast.sock.sent) < 12:
-        time.sleep(0.01)
+    assert slow.pending_bytes() < 300 + 200
+    # the fast client sees every frame once the loop flushes it,
+    # unstalled by the laggard
+    assert fast.flush()
     assert len(fast.sock.sent) == 12
-    # wake the laggard: its queue drains and the DEMOTED notice arrives
-    slow.sock.gate.set()
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and slow.relay_pending() > 0:
-        time.sleep(0.01)
-    assert slow.relay_pending() == 0
-    assert any(b'"demoted"' in data for data in slow.sock.sent)
+    # the laggard's reader returns: its bounded queue drains and the
+    # DEMOTED notice arrives (first — it jumped the queue)
+    assert slow.flush()
+    assert slow.relay_pending() == 0 and slow.pending_bytes() == 0
+    assert b'"demoted"' in slow.sock.sent[0]
     fast.close()
     slow.close()
 
 
-def test_relay_priority_frames_bypass_budget(tmp_path):
-    from fluidframework_tpu.service.frontdoor import _FrontSession
+def test_relay_priority_frames_bypass_budget():
+    from fluidframework_tpu.service.framepump import PumpConnection
 
-    session = _FrontSession(_BlockingSock(), relay_budget=64)
-    assert session.relay(b"x" * 60)  # first frame: in flight, charged
-    assert session.sock.blocked.wait(timeout=10)
-    assert not session.relay(b"y" * 60)  # budget exhausted
-    session.relay_priority(b"z" * 60)  # control frame still enqueues
-    assert session.relay_pending() > 64
-    session.sock.gate.set()
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and session.relay_pending() > 0:
-        time.sleep(0.01)
-    assert session.relay_pending() == 0
-    assert b"z" * 60 in session.sock.sent
-    session.close()
+    conn = PumpConnection(_FakeSock(), _FakePump(), relay_budget=64)
+    assert conn.relay(b"x" * 60)  # first frame: queued, charged
+    assert not conn.relay(b"y" * 60)  # budget exhausted, un-drained
+    conn.relay_priority(b"z" * 60)  # control frame still enqueues
+    assert conn.pending_bytes() > 64
+    assert conn.relay_pending() == 60  # only relay() charges the budget
+    assert conn.flush()
+    assert conn.relay_pending() == 0 and conn.pending_bytes() == 0
+    # priority frame jumped the queue: z drained before x
+    assert conn.sock.sent == [b"z" * 60, b"x" * 60]
+    conn.close()
 
 
 def test_frontdoor_stats_roll_up_admission_and_relay(tmp_path):

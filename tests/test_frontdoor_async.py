@@ -1,0 +1,277 @@
+"""Async front door (ISSUE 18): event-loop frame pump, shared-nothing
+front-door replicas, and the direct-to-shard data path.
+
+Four layers of coverage:
+
+1. Pump mechanics: incremental ``[len][json]`` reassembly over arbitrary
+   chunk boundaries, a live event-loop echo round-trip with pipelined
+   frames, and the relay-budget accounting contract on a single
+   ``PumpConnection`` (oversized-frame-into-empty-queue acceptance,
+   over-budget rejection, budget-exempt priority frames).
+2. Replica-death drills against the REAL wire (thread-backend shards —
+   identical RPC and on-disk layout): a catch-up storm and a failover
+   drill each run through TWO front-door replicas with the
+   traffic-bearing one killed mid-run; clients fail over through the
+   survivor and both runs land byte-identical to the fault-free
+   single-replica oracle twin AND replay bit-identically — storm
+   verdicts included, because out-of-proc admission now rides the wire
+   clock (the shed Nack carries the admission snapshot the harness
+   re-derives ``retry_after`` from).
+3. The direct-to-shard path: clients resolve placement through the
+   door's ``locate`` and tap the owning shardhost itself — the door's
+   relay counter stays pinned at ZERO while every event arrives, and
+   the control plane fails over across doors independently.
+4. Direct clients ride a SHARD failover: the owner dies, the driver's
+   next call hits the fence, re-resolves through the door, and
+   continues against the adopting shard with the log contiguous.
+"""
+
+import dataclasses
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.network_driver import (
+    NetworkDocumentServiceFactory,
+)
+from fluidframework_tpu.protocol.messages import MessageType, RawOperation
+from fluidframework_tpu.protocol.wire import WIRE_VERSION, frame_bytes
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.framepump import (
+    FrameParser, FramePump, PumpConnection,
+)
+from fluidframework_tpu.service.frontdoor import FrontDoor
+from fluidframework_tpu.testing.faults import FaultPlan, FaultPoint
+from fluidframework_tpu.testing.scenarios import (
+    build_scenario, oracle_spec, run_swarm,
+)
+
+
+# -- 1. pump mechanics --------------------------------------------------------
+
+
+def test_frame_parser_reassembles_across_arbitrary_chunks():
+    frames = [b"a", b"bb" * 10, json.dumps({"k": 1}).encode()]
+    wire = b"".join(struct.pack(">I", len(f)) + f for f in frames)
+    parser = FrameParser()
+    out = []
+    for i in range(0, len(wire), 3):  # dribble in 3-byte chunks
+        out.extend(parser.feed(wire[i:i + 3]))
+    assert out == frames
+    # one chunk carrying many frames plus a tail kept for the next feed
+    parser = FrameParser()
+    out = parser.feed(wire + struct.pack(">I", 5) + b"xy")
+    assert out == frames
+    assert parser.feed(b"z" * 3) == [b"xyzzz"]
+
+
+def test_frame_parser_rejects_oversized_frame():
+    from fluidframework_tpu.protocol.wire import MAX_FRAME
+
+    parser = FrameParser()
+    with pytest.raises(ValueError):
+        parser.feed(struct.pack(">I", MAX_FRAME + 1))
+
+
+def test_frame_pump_echo_round_trip_pipelined():
+    """One event-loop thread owns accept + read + write: pipelined
+    requests on one socket all come back (matched by ``re``), and the
+    pump counts the accept."""
+    def echo(conn, frame):
+        conn.send_obj({"re": frame["id"], "echo": frame["params"]})
+
+    pump = FramePump("127.0.0.1", 0, echo).start()
+    try:
+        with socket.create_connection(("127.0.0.1", pump.port),
+                                      timeout=10) as sock:
+            for rid in range(8):  # pipelined: all writes before reads
+                sock.sendall(frame_bytes(
+                    {"v": WIRE_VERSION, "id": rid, "params": {"n": rid}}))
+            parser, got = FrameParser(), []
+            while len(got) < 8:
+                got.extend(json.loads(p) for p in parser.feed(
+                    sock.recv(64 << 10)))
+            assert sorted(f["re"] for f in got) == list(range(8))
+            assert all(f["echo"] == {"n": f["re"]} for f in got)
+        assert pump.accepted == 1
+    finally:
+        pump.close()
+
+
+def test_pump_connection_relay_budget_contract():
+    """The PR 15 relay contract on the pump's write buffers: a frame
+    larger than the whole budget is still accepted into an EMPTY queue
+    (serialize-once means huge snapshots must pass), the next frame over
+    budget is refused (caller demotes), and priority control frames are
+    budget-exempt."""
+    pump = FramePump("127.0.0.1", 0, lambda c, f: None)  # never started
+    a, b = socket.socketpair()
+    try:
+        conn = PumpConnection(a, pump, relay_budget=8)
+        assert conn.relay(b"x" * 64)          # oversized but queue empty
+        assert not conn.relay(b"y")           # over budget: demote me
+        conn.relay_priority(b"demoted!")      # control frames are exempt
+        assert conn.relay_pending() == 64     # priority bytes uncharged
+    finally:
+        a.close()
+        b.close()
+        pump.close()
+
+
+# -- 2. replica-death drills --------------------------------------------------
+
+
+def _replica_drill(name, tmp_path, extra_points=()):
+    spec = build_scenario(name, seed=7, clients=400, docs=8, shards=2)
+    total = sum(p.ticks for p in spec.phases)
+    plan = FaultPlan(seed=7, points=tuple(extra_points) + (
+        FaultPoint("replica.kill", "kill", at=total // 2),))
+    return dataclasses.replace(
+        spec, out_of_proc=True, proc_spawn="thread", replicas=2,
+        plan=plan, sample_every=4, dir=str(tmp_path / "swarm"))
+
+
+def test_replica_death_storm_drill_oracle_and_replay(tmp_path):
+    """Catch-up storm through two shared-nothing replicas, the
+    traffic-bearing one killed mid-run: the swarm fails over through
+    the survivor, converges byte-identical to the single-replica
+    oracle, and the whole run — storm shed/retry verdicts included —
+    replays bit-identically off the wire-clock admission snapshots."""
+    spec = _replica_drill("catchup-storm", tmp_path)
+    result = run_swarm(spec)
+    assert result.replica_kills, "replica kill never executed"
+    assert result.shard_stats["door_failovers"] >= 1
+    assert result.shard_stats["doors"] == 2
+    storm = result.storm
+    assert storm["wire_clock"] is True
+    assert storm["served"] == storm["requests"] > 0
+    # the verdict counters live in the IDENTITY surface now, not in a
+    # wall-clock-excluded remote bucket
+    assert "swarm.storm_shed" in result.counters
+    twin = run_swarm(oracle_spec(spec, result))
+    assert result.sampled_digests == twin.sampled_digests
+    assert result.per_doc_head == twin.per_doc_head
+    replay = run_swarm(dataclasses.replace(
+        spec, dir=str(tmp_path / "swarm2")))
+    assert replay.identity() == result.identity()
+
+
+def test_replica_death_failover_drill_with_shard_kill(tmp_path):
+    """The failover drill with BOTH faults live: a shard dies (epoch
+    fence + adoption from its log) and a front-door replica dies
+    (client-side door failover) in the same run — still byte-identical
+    to the fault-free single-shard, single-replica twin."""
+    spec = build_scenario("failover-drill", seed=7, clients=400, docs=8,
+                          shards=2)
+    shard_kills = tuple(p for p in spec.plan.points
+                        if p.site == "shard.kill")
+    assert shard_kills, "scenario lost its shard kill"
+    spec = _replica_drill("failover-drill", tmp_path,
+                          extra_points=shard_kills)
+    result = run_swarm(spec)
+    assert result.kills, "the shard kill never executed"
+    assert result.replica_kills, "the replica kill never executed"
+    twin = run_swarm(oracle_spec(spec, result))
+    assert result.sampled_digests == twin.sampled_digests
+    assert result.per_doc_head == twin.per_doc_head
+    replay = run_swarm(dataclasses.replace(
+        spec, dir=str(tmp_path / "swarm2")))
+    assert replay.identity() == result.identity()
+
+
+# -- 3 + 4. direct-to-shard ---------------------------------------------------
+
+
+def _op(client, i, contents=None):
+    return RawOperation(client_id=client, client_seq=i + 1, ref_seq=0,
+                        type=MessageType.OP,
+                        contents=contents or {"i": i})
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_direct_to_shard_pins_door_out_of_byte_path(tmp_path):
+    """A ``direct=True`` driver resolves placement via the door's
+    ``locate`` and taps the owning shardhost itself: every event
+    arrives, while BOTH doors' relay counter (``fd.events``) stays
+    pinned at zero — the door is control plane, not byte path.  Killing
+    the replica the control plane rides proves doors fail over
+    independently of the data path (storage reads ride the shard,
+    untouched)."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=2, spawn="thread",
+                     request_timeout=5.0).start()
+    rep = FrontDoor(str(tmp_path / "proc"), spawn="attach",
+                    attach_addrs=door.shard_addrs(),
+                    request_timeout=5.0).start()
+    try:
+        factory = NetworkDocumentServiceFactory(
+            port=rep.port, replicas=[("127.0.0.1", door.port)],
+            direct=True)
+        service = factory.create_document(
+            "d-1", ContainerRuntime().summarize())
+        endpoint = service.connection()
+        got = []
+        endpoint.subscribe(lambda m: got.append(m.seq))
+        endpoint.connect("c1")
+        for i in range(5):
+            endpoint.submit(_op("c1", i))
+        assert _wait(lambda: len(got) >= 6)  # 5 ops + the JOIN
+        assert door.counters.get("fd.events") == 0
+        assert rep.counters.get("fd.events") == 0
+        assert factory._direct_rpcs["d-1"].shard is not None
+        # control-plane door failover, data path untouched
+        rep.kill()
+        assert service.storage.latest()[0] is not None
+        assert factory._rpc.request("ping", {}) == "pong"
+        assert factory._rpc.failovers == 1
+        factory.close()
+    finally:
+        if not rep.killed:
+            rep.close()
+        door.close()
+
+
+def test_direct_client_rides_shard_failover_via_re_resolution(tmp_path):
+    """The owning shard dies mid-session: the direct client's next call
+    hits the fence/dead socket, re-resolves through the door, and lands
+    on the adopting shard — ops keep sequencing, the subscription tap is
+    re-established on the new owner, and the durable log stays
+    contiguous across the adoption."""
+    door = FrontDoor(str(tmp_path / "proc"), n_shards=2, spawn="thread",
+                     request_timeout=5.0).start()
+    try:
+        factory = NetworkDocumentServiceFactory(port=door.port,
+                                                direct=True)
+        service = factory.create_document(
+            "d-1", ContainerRuntime().summarize())
+        endpoint = service.connection()
+        got = []
+        endpoint.subscribe(lambda m: got.append(m.seq))
+        endpoint.connect("c1")
+        for i in range(3):
+            endpoint.submit(_op("c1", i))
+        assert _wait(lambda: len(got) >= 4)
+        owner = factory._direct_rpcs["d-1"].shard
+        assert owner is not None
+        door.fail_shard(owner)
+        # the next data-plane calls re-resolve and ride the adopter
+        for i in range(3, 6):
+            endpoint.submit(_op("c1", i))
+        assert _wait(lambda: len(got) >= 7), f"only {len(got)} events"
+        assert factory._direct_rpcs["d-1"].shard != owner
+        assert factory._direct_rpcs["d-1"].failovers >= 1
+        assert door.contiguous(["d-1"]) == {"d-1": True}
+        assert door.counters.get("fd.events") == 0
+        factory.close()
+    finally:
+        door.close()

@@ -894,6 +894,92 @@ def test_race_blocking_event_wait_under_lock():
     assert len(hits) == 1 and "stall()" in hits[0].message
 
 
+LOOP_PREAMBLE = """
+import selectors
+class Pump:
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+"""
+
+
+def test_race_blocking_on_loop_blocklist_call_no_lock_needed():
+    # a selector-constructing class is an event-loop class: a blocklist
+    # call in any of its methods fires with NO lock held — it stalls the
+    # loop, not a lock contender
+    src = LOOP_PREAMBLE + """
+    def on_frame(self, conn, frame):
+        return self.rpc.request("heads", {})
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and "on-loop" in hits[0].message \
+        and "on_frame()" in hits[0].message
+
+
+def test_race_blocking_on_loop_exemptions():
+    # the loop's own socket primitives (recv/accept) run non-blocking on
+    # the loop by construction; '# off-loop' methods run on other
+    # threads; a deferred lambda executes off-loop (that IS the fix);
+    # __init__ runs before the loop exists
+    src = LOOP_PREAMBLE + """
+        self.rpc.request("hello", {})
+    def service(self, key):
+        data = key.fileobj.recv(65536)
+        conn = self._lsock.accept()
+        return data, conn
+    def submit(self, pool, frame):
+        pool.defer(lambda: self.rpc.request("fold", frame))
+    def admin_stats(self):  # off-loop
+        return self.rpc.request("stats", {})
+"""
+    assert findings_for(src, SERVICE, "FL-RACE-BLOCKING") == []
+
+
+def test_race_blocking_on_loop_opt_in_marker():
+    # '# on-loop' opts a method in even in a class that never constructs
+    # a selector (e.g. a callback registered ON some other pump)
+    src = """
+import time
+class Handler:
+    def on_frame(self, conn, frame):  # on-loop
+        time.sleep(1)
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and "on-loop" in hits[0].message
+
+
+def test_race_blocking_on_loop_under_lock_single_finding():
+    # a call that is BOTH under a lock and on-loop yields one finding
+    # (the under-lock message wins), never a duplicate pair
+    src = """
+import selectors, threading
+class Pump:
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+    def on_frame(self, conn, frame):
+        with self._lock:
+            return self.rpc.request("heads", {})
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and "holding" in hits[0].message
+
+
+def test_race_blocking_event_wait_on_loop():
+    # Event.wait inside an on-loop callback stalls the loop even with no
+    # lock anywhere in sight
+    src = """
+import selectors, threading
+class Pump:
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self.ready = threading.Event()
+    def on_frame(self, conn, frame):
+        self.ready.wait(5)
+"""
+    hits = findings_for(src, SERVICE, "FL-RACE-BLOCKING")
+    assert len(hits) == 1 and "ready.wait" in hits[0].message
+
+
 def test_race_class_level_lock_spelled_via_class_name():
     # `with C._serial:` inside class C counts as acquiring C's own lock
     src = """
@@ -1551,6 +1637,25 @@ def test_pair_closer_on_every_branch_is_clean():
                 self.c.abandon(k)
     """
     assert findings_for(src, SERVICE, "FL-LEAK-PAIR") == []
+
+
+def test_pair_executor_shutdown_keywords_not_an_opener():
+    # shutdown->close is the SOCKET pair; Executor.shutdown(wait=...) is
+    # itself terminal (keyword args mark the executor signature) while a
+    # bare socket shutdown(how) still demands its close
+    good = """
+    class S:
+        def stop(self):
+            self.pool.shutdown(wait=False)
+    """
+    bad = """
+    import socket
+    class S:
+        def stop(self):
+            self.sock.shutdown(socket.SHUT_RDWR)
+    """
+    assert findings_for(good, SERVICE, "FL-LEAK-PAIR") == []
+    assert findings_for(bad, SERVICE, "FL-LEAK-PAIR")
 
 
 def test_pair_closer_on_one_branch_only_fires():

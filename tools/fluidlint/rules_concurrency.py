@@ -38,6 +38,20 @@ locked for inference.  Nested functions/lambdas defined under a ``with``
 run *later*, possibly on another thread — they are analyzed with an
 empty held set.
 
+The event-loop model (ISSUE 18)
+-------------------------------
+
+A class that constructs a ``selectors.*`` selector is a **loop class**:
+its methods run on the event-loop thread by default (opt out with an
+``# off-loop`` comment on the method header), and any method anywhere
+may opt in with ``# on-loop``.  Inside an on-loop method, a call from
+the blocking blocklist is a finding even with NO lock held — one
+blocking callback stalls every connection the loop owns.  The loop's
+own non-blocking socket primitives (``recv``/``accept``/``connect_ex``)
+are exempt: on the loop they are non-blocking by construction.  Nested
+functions and lambdas are excluded (they run deferred — handing work to
+a pool is exactly the prescribed fix).
+
 Known limits (document, don't pretend): the analysis is per class and
 per file — cross-object guarding (``self.service.state_lock`` protecting
 ``self.service.handle_tenants``) and inherited annotations are invisible,
@@ -88,6 +102,15 @@ BLOCKING_CALLS = {
     "catch_up",              # a whole bulk fold
     "urlopen", "sleep",
 }
+
+#: Blocklist calls EXEMPT inside on-loop methods: the loop's own socket
+#: primitives run against non-blocking sockets there by construction
+#: (they still count under a held lock — that check is about stalls of
+#: lock contenders, not of the loop).
+LOOP_EXEMPT_CALLS = {"recv", "accept", "connect_ex"}
+
+ON_LOOP_RE = re.compile(r"\bon-loop\b")
+OFF_LOOP_RE = re.compile(r"\boff-loop\b")
 
 #: attribute calls that mutate their receiver in place
 MUTATORS = {
@@ -148,6 +171,7 @@ class _ClassModel:
         self.bad_declarations: List[Tuple[ast.AST, str]] = []
         self.spawns_threads = False
         self.has_events = False
+        self.loop_class = False
         # Event names visible module-wide: `.wait()` on one of these
         # while a lock is held is a blocking call (Condition names are
         # NOT here — Condition.wait requires its lock held).
@@ -167,11 +191,17 @@ class _ClassModel:
             names = self._holds_declaration(fn, m)
             for lock in sorted((names or set()) - set(self.locks)):
                 self.bad_holds.append((fn, lock))
+        # an explicit '# on-loop' opt-in (a callback registered on some
+        # OTHER class's pump) makes the class worth walking even with no
+        # locks, threads, or selector of its own
+        has_loop_marker = any(self._loop_marker(fn, m) == "on"
+                              for fn in self.methods)
         self.thread_visible = bool(self.locks) or self.spawns_threads \
-            or self.has_events
+            or self.has_events or self.loop_class or has_loop_marker
         self.accesses: List[_Access] = []
         self.acquisitions: List[_LockEvent] = []
         self.blocking: List[_BlockingCall] = []
+        self.loop_blocking: List[_BlockingCall] = []
         # Methods that lock manually (bare lock.acquire()/release()):
         # the walker's held-set is lexical (`with` blocks + held-method
         # conventions) and cannot track imperative acquire flow, so these
@@ -200,6 +230,12 @@ class _ClassModel:
                 q = m.imports.resolve(node.func)
                 if q == THREAD_CTOR:
                     self.spawns_threads = True
+                elif q is not None and q.startswith("selectors."):
+                    # constructing a selector makes this an event-loop
+                    # class: its methods default to on-loop (see
+                    # on_loop()), and blocking calls there stall every
+                    # connection the loop serves
+                    self.loop_class = True
             if not isinstance(node, (ast.Assign, ast.AnnAssign)):
                 continue
             value = node.value
@@ -283,6 +319,34 @@ class _ClassModel:
                         if n.strip()}
         return None
 
+    def _loop_marker(self, fn: ast.FunctionDef, m: ModuleContext
+                     ) -> Optional[str]:
+        """'on' / 'off' from a ``# on-loop`` / ``# off-loop`` marker on
+        the method header (same placement contract as '# holds-lock':
+        trailing any header line or standing alone before the docstring),
+        or None when unmarked.  off wins: 'off-loop' contains no
+        'on-loop' match, but checking it first keeps the precedence
+        explicit."""
+        first_body = fn.body[0].lineno if fn.body else fn.lineno + 1
+        for line in range(fn.lineno, first_body):
+            comment = m.comments.get(line, "")
+            if OFF_LOOP_RE.search(comment):
+                return "off"
+            if ON_LOOP_RE.search(comment):
+                return "on"
+        return None
+
+    def on_loop(self, fn: ast.FunctionDef, m: ModuleContext) -> bool:
+        """Does this method's body run on the event-loop thread?  An
+        explicit marker always wins; otherwise every method of a
+        selector-constructing class is presumed on-loop except
+        constructors (they run on the spawning thread, before the loop
+        exists)."""
+        marker = self._loop_marker(fn, m)
+        if marker is not None:
+            return marker == "on"
+        return self.loop_class and fn.name not in _CTOR_EXEMPT
+
     def held_for(self, fn: ast.FunctionDef, m: ModuleContext
                  ) -> FrozenSet[str]:
         names = self._holds_declaration(fn, m)
@@ -323,6 +387,7 @@ class _ClassModel:
     def _walk_method(self, m: ModuleContext, fn: ast.FunctionDef) -> None:
         write_ids = self._write_ids(fn)
         base_held = self.held_for(fn, m)
+        on_loop = self.on_loop(fn, m)
 
         def visit(node: ast.AST, held: FrozenSet[str],
                   deferred: bool) -> None:
@@ -353,7 +418,8 @@ class _ClassModel:
                     visit(child, new_held, deferred)
                 return
             if isinstance(node, ast.Call):
-                self._classify_call(fn, node, held)
+                self._classify_call(fn, node, held,
+                                    on_loop=on_loop and not deferred)
             attr = _self_attr(node)
             if attr is not None and attr not in self.locks:
                 write = isinstance(node.ctx, (ast.Store, ast.Del)) \
@@ -367,7 +433,8 @@ class _ClassModel:
             visit(stmt, base_held, False)
 
     def _classify_call(self, fn: ast.FunctionDef, node: ast.Call,
-                       held: FrozenSet[str]) -> None:
+                       held: FrozenSet[str], on_loop: bool = False
+                       ) -> None:
         func = node.func
         name = None
         if isinstance(func, ast.Attribute):
@@ -382,14 +449,23 @@ class _ClassModel:
                 fn.name, lock if lock is not None else "<unknown>",
                 held, node))
             return
-        if name == "wait" and held and isinstance(func, ast.Attribute):
+        if name == "wait" and isinstance(func, ast.Attribute):
             recv = _terminal_name(func.value)
             if recv in self._module_events:
-                self.blocking.append(_BlockingCall(
-                    fn.name, f"{recv}.wait", held, node))
+                if held:
+                    self.blocking.append(_BlockingCall(
+                        fn.name, f"{recv}.wait", held, node))
+                if on_loop:
+                    self.loop_blocking.append(_BlockingCall(
+                        fn.name, f"{recv}.wait", held, node))
             return
-        if name in BLOCKING_CALLS and held:
-            self.blocking.append(_BlockingCall(fn.name, name, held, node))
+        if name in BLOCKING_CALLS:
+            if held:
+                self.blocking.append(_BlockingCall(
+                    fn.name, name, held, node))
+            if on_loop and name not in LOOP_EXEMPT_CALLS:
+                self.loop_blocking.append(_BlockingCall(
+                    fn.name, name, held, node))
 
     # -- guard relation --------------------------------------------------------
 
@@ -491,7 +567,8 @@ class BlockingUnderLockRule(Rule):
     description = (
         "blocking operation (nested acquire, Event.wait, RPC/fold/pack "
         "blocklist call) while holding a lock — stalls every thread "
-        "contending for it"
+        "contending for it — or inside an on-loop event-loop callback, "
+        "where it stalls every connection the loop serves"
     )
 
     def check(self, m: ModuleContext) -> Iterable[Finding]:
@@ -515,7 +592,9 @@ class BlockingUnderLockRule(Rule):
                         "restructure to one critical section or a fixed "
                         "lock order with 'with'",
                     )
+            flagged: Set[int] = set()
             for b in model.blocking:
+                flagged.add(id(b.node))
                 held = ", ".join(sorted(b.held))
                 yield m.finding(
                     self, b.node,
@@ -523,6 +602,18 @@ class BlockingUnderLockRule(Rule):
                     f"{model.name} while holding '{held}'; move the slow "
                     "work outside the critical section (copy state out, "
                     "drop the lock, then block)",
+                )
+            for b in model.loop_blocking:
+                if id(b.node) in flagged:
+                    continue  # under-lock finding already covers it
+                yield m.finding(
+                    self, b.node,
+                    f"blocking call '{b.name}' in on-loop method "
+                    f"{b.method}() of {model.name} — a blocking "
+                    "event-loop callback stalls EVERY connection on the "
+                    "loop; hand the work to a worker thread and write "
+                    "the reply back cross-thread, or mark the method "
+                    "'# off-loop' if it never runs on the loop thread",
                 )
 
 

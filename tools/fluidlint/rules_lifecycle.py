@@ -237,8 +237,16 @@ class ProtocolPairRule(Rule):
                     yield node, recv, closers
                     continue
             closers = PROTOCOL_PAIRS.get(node.func.attr)
-            if closers is not None:
-                yield node, recv, closers
+            if closers is None:
+                continue
+            if node.func.attr == "shutdown" and node.keywords:
+                # shutdown->close is SOCKET protocol (shutdown(how)
+                # takes a lone positional).  Keyword args mark the
+                # Executor.shutdown(wait=..., cancel_futures=...)
+                # signature, which IS the terminal call — there is no
+                # closer to demand.
+                continue
+            yield node, recv, closers
 
     def _check_fn(self, m: ModuleContext, fn) -> Iterator[Finding]:
         openers = list(self._openers(m, fn))

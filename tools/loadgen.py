@@ -18,7 +18,14 @@ telemetry counter bit-identically.
     python -m tools.loadgen --out-of-proc --clients 100000 \
         --replay-check --out BENCH_service_proc_cpu_r12.json  # round 12:
         # the REAL process tier (shard-host processes, per-shard logs,
-        # front-door routing; the drill SIGKILLs a live shard process)
+        # front-door routing; the drill SIGKILLs a real shard process)
+    python -m tools.loadgen --out-of-proc --replicas 2 --replay-check
+        # round 18: every scenario through TWO shared-nothing front-door
+        # replicas with the traffic-bearing one SIGKILLed mid-run
+    python -m tools.loadgen --connections 100000 \
+        --out BENCH_frontdoor_cpu_r18.json  # round 18: real TCP
+        # connection scale against ONE event-loop front-door process,
+        # RSS-tripwired per idle connection
 
 Emits ONE JSON document via the shared bench writer: per scenario —
 ops/sec (wall), p50/p99 delivery and catch-up latency in VIRTUAL ticks
@@ -88,6 +95,21 @@ STORM_GATE_P99_TICKS = 64.0
 #: baseline.
 STREAM_GATE_SERVE_RATE = 0.95
 STREAM_GATE_LAG_CADENCES = 4.0
+
+#: ISSUE 18 connection-scale gates (``--connections``).  The bench holds
+#: N REAL TCP connections against ONE front-door process and trips when:
+#: resident bytes per idle connection exceed the budget (a thread-per-
+#: connection regression shows up here first — one thread stack dwarfs
+#: a PumpConnection); the server's thread count scales with connections
+#: instead of staying a small constant; or a sampled connection stops
+#: answering ping.  The fd HEADROOM is what the two processes keep free
+#: for everything that is not a herd socket (listen socket, shard RPC
+#: connections, logs, stdio) — the achieved count is recorded honestly
+#: against the container's NON-RAISABLE hard fd limit (``env_capped``).
+CONN_FD_HEADROOM = 512
+CONN_RSS_BUDGET_BYTES = 16 * 1024
+CONN_MAX_SERVER_THREADS = 64
+CONN_PING_SAMPLES = 64
 
 
 def run_stream(seed: int, clients: int, docs: int, shards: int,
@@ -203,18 +225,286 @@ def run_stream(seed: int, clients: int, docs: int, shards: int,
     }
 
 
+def _proc_status(pid: int) -> dict:
+    """{rss_bytes, threads} for a live pid from ``/proc`` (Linux); empty
+    on platforms without procfs — the tripwire then records null and the
+    gate skips the memory leg honestly instead of guessing."""
+    out: dict = {}
+    try:
+        with open(f"/proc/{pid}/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    out["rss_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("Threads:"):
+                    out["threads"] = int(line.split()[1])
+    except OSError:
+        pass
+    return out
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _raw_rpc(sock, method: str, params: dict, rid: int = 1):
+    """One request/response round-trip on a raw herd socket, skipping any
+    interleaved event frames (replies match by ``re``)."""
+    import json
+    import struct
+
+    from fluidframework_tpu.protocol.wire import WIRE_VERSION, frame_bytes
+
+    length = struct.Struct(">I")
+    sock.sendall(frame_bytes({"v": WIRE_VERSION, "id": rid,
+                              "method": method, "params": params}))
+    while True:
+        (n,) = length.unpack(_recv_exact(sock, 4))
+        frame = json.loads(_recv_exact(sock, n))
+        if frame.get("re") == rid:
+            if not frame.get("ok"):
+                raise RuntimeError(frame.get("error"))
+            return frame.get("result")
+
+
+def run_connections(requested: int, relay_budget: int = 4096,
+                    ops: int = 256) -> dict:
+    """The ISSUE 18 connection-scale gate: hold ``requested`` REAL TCP
+    connections against ONE front-door process (event-loop frame pump,
+    in-process shard — the bench measures the CONNECTION layer, not the
+    process tier) and assert, concurrently:
+
+    - every sampled connection still answers ``ping`` (liveness under
+      load, ``CONN_PING_SAMPLES`` spread across the herd);
+    - resident bytes per idle connection stay under
+      ``CONN_RSS_BUDGET_BYTES`` (peak RSS over baseline / achieved);
+    - the server's thread count stays a small constant
+      (``CONN_MAX_SERVER_THREADS``) — the anti-thread-per-connection pin;
+    - steady-typing traffic flows end to end through a real driver
+      client while the herd is held; and
+    - the per-connection relay byte budget is ENFORCED: a deliberately
+      never-reading subscriber must be demoted (``fd.relay_demotions``
+      >= 1) instead of ballooning the relay queue.
+
+    The container's hard fd limit is not raisable from userspace, so the
+    achieved count is ``min(requested, hard - CONN_FD_HEADROOM)`` and the
+    report records ``env_capped`` honestly rather than silently passing a
+    smaller gate.
+    """
+    import resource
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from fluidframework_tpu.drivers.network_driver import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType, RawOperation,
+    )
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    target = min(requested, max(1, hard - CONN_FD_HEADROOM))
+    env_capped = target < requested
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = tempfile.mkdtemp(prefix="fluid-conns-")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.service.frontdoor",
+         "--dir", os.path.join(base, "door"), "--shards", "1",
+         "--spawn", "thread", "--port", "0", "--heartbeat", "0",
+         "--relay-budget", str(relay_budget)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=repo_root)
+    conns: list = []
+    extra_socks: list = []
+    factory = None
+    try:
+        host, port, pid = None, None, None
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            line = proc.stdout.readline()
+            if line == "" and proc.poll() is not None:
+                break
+            if "listening on" in line:
+                addr = line.split("listening on", 1)[1].split()[0]
+                host, _, port_s = addr.rpartition(":")
+                port = int(port_s)
+                pid = int(line.rsplit("pid=", 1)[1].split()[0])
+                break
+        if port is None:
+            raise RuntimeError("front door never reported listening")
+
+        # Steady-typing fixture BEFORE the baseline RSS read, so the RSS
+        # delta charges the herd sockets and nothing else: one real
+        # driver client (reads its events) + one raw subscriber that
+        # NEVER reads (the relay-budget demotion victim).
+        factory = NetworkDocumentServiceFactory(host=host, port=port)
+        service = factory.create_document(
+            "conn-doc", ContainerRuntime().summarize())
+        endpoint = service.connection()
+        delivered: list = []
+        endpoint.subscribe(lambda m: delivered.append(m.seq))
+        endpoint.connect("typist")
+        # SO_RCVBUF is clamped BEFORE connect (it fixes the negotiated
+        # TCP window): otherwise loopback autotuning absorbs megabytes
+        # into kernel buffers and the pump's own relay queue — the thing
+        # the budget meters — never grows at bench-sized volumes.
+        deadbeat = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        deadbeat.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+        deadbeat.settimeout(30)
+        deadbeat.connect((host, port))
+        extra_socks.append(deadbeat)
+        _raw_rpc(deadbeat, "subscribe_doc", {"doc": "conn-doc"})
+        # From here on the deadbeat is NEVER read: broadcast bytes pile
+        # into its pump-side write queue until the budget demotes it.
+        baseline = _proc_status(pid)
+
+        t0 = _time.time()
+        rss_peak = baseline.get("rss_bytes", 0)
+        for i in range(target):
+            for attempt in (1, 2, 3):
+                try:
+                    conns.append(
+                        socket.create_connection((host, port), timeout=30))
+                    break
+                except OSError:
+                    if attempt == 3:
+                        raise
+                    _time.sleep(0.2)  # accept burst backlog: brief, rare
+            if (i + 1) % 2048 == 0:
+                rss_peak = max(rss_peak, _proc_status(pid)
+                               .get("rss_bytes", 0))
+            if (i + 1) % 8192 == 0:
+                print(f"  connections: {i + 1}/{target}", file=sys.stderr)
+        connect_wall = _time.time() - t0
+
+        ping_ok = 0
+        stride = max(1, target // CONN_PING_SAMPLES)
+        sampled = list(range(0, target, stride))
+        for j in sampled:
+            if _raw_rpc(conns[j], "ping", {}) == "pong":
+                ping_ok += 1
+
+        # Traffic while the herd is held: real ops through the driver,
+        # events delivered back through the pump's relay path.  The
+        # never-reading subscriber receives the same broadcast bytes and
+        # must blow its relay budget → demotion, not unbounded queueing.
+        # Only bytes the pump cannot hand to the KERNEL count against
+        # the budget, and loopback autotuning absorbs megabytes before
+        # send() blocks — so the bench types until the demotion fires
+        # (budget enforced) or a hard byte ceiling proves it never does,
+        # rather than guessing this host's kernel buffer depth.
+        pad = "x" * 8192
+        ops_sent, demotions = 0, 0
+        stats: dict = {}
+        while ops_sent < ops or (not demotions and ops_sent < 2048):
+            endpoint.submit(RawOperation(
+                client_id="typist", client_seq=ops_sent + 1, ref_seq=0,
+                type=MessageType.OP,
+                contents={"i": ops_sent, "pad": pad}))
+            ops_sent += 1
+            if ops_sent % 64 == 0:
+                stats = _raw_rpc(conns[0], "stats", {})
+                demotions = stats["counters"].get("fd.relay_demotions", 0)
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            stats = _raw_rpc(conns[0], "stats", {})
+            demotions = stats["counters"].get("fd.relay_demotions", 0)
+            if len(delivered) >= ops_sent and demotions:
+                break
+            _time.sleep(0.1)
+        status = _proc_status(pid)
+        rss_peak = max(rss_peak, status.get("rss_bytes", 0))
+        rss_base = baseline.get("rss_bytes")
+        per_conn = (max(0, rss_peak - rss_base) / target
+                    if rss_base is not None else None)
+        threads = status.get("threads")
+        pump = stats.get("pump") or {}
+        passed = (
+            len(conns) == target
+            and ping_ok == len(sampled)
+            and pump.get("open", 0) >= target
+            and (per_conn is None or per_conn <= CONN_RSS_BUDGET_BYTES)
+            and (threads is None or threads <= CONN_MAX_SERVER_THREADS)
+            and len(delivered) >= ops_sent
+            and demotions >= 1
+        )
+        return {
+            "requested_connections": requested,
+            "achieved_connections": len(conns),
+            "fd_hard_limit": hard,
+            "fd_headroom": CONN_FD_HEADROOM,
+            "env_capped": env_capped,
+            "connect_wall_sec": round(connect_wall, 3),
+            "connects_per_sec": (round(target / connect_wall, 1)
+                                 if connect_wall > 0 else None),
+            "rss_baseline_bytes": rss_base,
+            "rss_peak_bytes": rss_peak,
+            "rss_per_conn_bytes": (round(per_conn, 1)
+                                   if per_conn is not None else None),
+            "rss_budget_per_conn_bytes": CONN_RSS_BUDGET_BYTES,
+            "server_threads": threads,
+            "server_threads_max": CONN_MAX_SERVER_THREADS,
+            "ping_sampled": len(sampled),
+            "ping_ok": ping_ok,
+            "ops_submitted": ops_sent,
+            "events_delivered": len(delivered),
+            "relay_budget_bytes": relay_budget,
+            "relay_demotions": demotions,
+            "pump": pump or None,
+            "passed": passed,
+        }
+    finally:
+        if factory is not None:
+            try:
+                factory.close()
+            except Exception:
+                pass
+        for sock in conns + extra_socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
             oracle: bool, replay_check: bool, columnar: bool = True,
             sample_every: int = 8, gate_override: float = None,
-            compare_boxed: bool = False, out_of_proc: bool = False) -> dict:
+            compare_boxed: bool = False, out_of_proc: bool = False,
+            replicas: int = 1) -> dict:
     spec = build_scenario(name, seed=seed, clients=clients, docs=docs,
                           shards=shards)
     if out_of_proc and name == "catchup-storm":
         # The catchup.* seams live inside the shard processes, which
         # scheduled-site validation rightly rejects from the harness
         # plan; the deterministic in-proc storm is the seam-coverage
-        # run — out of proc exercises the real RPC path instead.
-        spec = dataclasses.replace(spec, plan=None)
+        # run — out of proc exercises the real RPC path instead, and
+        # (ISSUE 18) WIDENS the real-call sample: connections are cheap
+        # behind the event-loop pump, so 4× the storming clients per doc
+        # actually cross the wire.
+        spec = dataclasses.replace(spec, plan=None,
+                                   storm_clients_per_doc=16)
     if out_of_proc and name == "failover-drill":
         # The drill's scheduled kill becomes a REAL process kill: same
         # tick, same victim selection, SIGKILL semantics.
@@ -225,6 +515,24 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
                 FaultPoint("proc.kill", "kill", at=p.at, doc=p.doc,
                            shard=p.shard)
                 for p in spec.plan.points if p.site == "shard.kill")))
+    if out_of_proc and replicas > 1:
+        # ISSUE 18 replica drill: run the scenario through N shared-
+        # nothing front-door replicas and SIGKILL the traffic-bearing
+        # one mid-run — client drivers fail over through the survivor
+        # and the single-replica oracle twin must still match
+        # byte-identically (the twin resets replicas=1 and drops the
+        # kill, so the verdict is the failover's, not the topology's).
+        from fluidframework_tpu.testing.faults import FaultPlan, FaultPoint
+
+        mid = max(1, sum(p.ticks for p in spec.phases) // 2)
+        points = tuple(spec.plan.points) if spec.plan is not None else ()
+        # out_of_proc rides along here (it is re-applied below): the
+        # replicas>1 spec validation rightly refuses an in-proc topology.
+        spec = dataclasses.replace(spec, replicas=replicas,
+                                   out_of_proc=True, plan=FaultPlan(
+                                       seed=seed, points=points + (
+                                           FaultPoint("replica.kill",
+                                                      "kill", at=mid),)))
     spec = dataclasses.replace(spec, columnar=columnar,
                                sample_every=sample_every,
                                out_of_proc=out_of_proc,
@@ -338,6 +646,7 @@ def run_one(name: str, seed: int, clients: int, docs: int, shards: int,
         "clients": result.clients,
         "docs": result.docs,
         "shards": result.shards,
+        "replicas": replicas if out_of_proc else 1,
         "ticks": result.ticks,
         "seed": seed,
         "sequenced_ops": result.sequenced_ops,
@@ -435,6 +744,19 @@ def main(argv=None) -> int:
                              "processes with per-shard durable logs behind "
                              "the routing front door (ISSUE 12); the "
                              "failover drill SIGKILLs a real shard process")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="front-door replicas for out-of-proc runs "
+                             "(ISSUE 18); with >= 2 the traffic-bearing "
+                             "replica is SIGKILLed mid-run and clients "
+                             "fail over through a survivor")
+    parser.add_argument("--connections", type=int, default=None,
+                        help="connection-scale gate (ISSUE 18): hold N "
+                             "REAL TCP connections against one event-loop "
+                             "front-door process under a per-connection "
+                             "RSS tripwire, with steady-typing traffic "
+                             "flowing and relay budgets enforced; the "
+                             "achieved count is capped by the container's "
+                             "hard fd limit and recorded honestly")
     parser.add_argument("--out", default=None,
                         help="write the JSON report here (default stdout)")
     args = parser.parse_args(argv)
@@ -443,6 +765,31 @@ def main(argv=None) -> int:
         for name, doc in scenario_docs().items():
             print(f"{name:16s} {doc}")
         return 0
+
+    if args.connections:
+        t0 = time.time()
+        result = run_connections(args.connections)
+        report = {
+            "bench": "frontdoor_connections",
+            "platform": "cpu",
+            "connections": result,
+            "wall_sec": round(time.time() - t0, 3),
+        }
+        print(
+            f"connections: {result['achieved_connections']}/"
+            f"{result['requested_connections']}"
+            f"{' (env fd cap)' if result['env_capped'] else ''} | "
+            f"{result['rss_per_conn_bytes']}B/conn rss "
+            f"(budget {result['rss_budget_per_conn_bytes']}) | "
+            f"threads {result['server_threads']} | ping "
+            f"{result['ping_ok']}/{result['ping_sampled']} | events "
+            f"{result['events_delivered']}/{result['ops_submitted']} | "
+            f"demotions {result['relay_demotions']} | "
+            f"{'PASS' if result['passed'] else 'FAIL'}",
+            file=sys.stderr,
+        )
+        write_bench_json(report, out=args.out)
+        return 0 if result["passed"] else 1
 
     if args.stream:
         t0 = time.time()
@@ -487,6 +834,7 @@ def main(argv=None) -> int:
         "columnar": not args.boxed,
         "sample_every": args.sample_every,
         "out_of_proc": args.out_of_proc,
+        "replicas": args.replicas if args.out_of_proc else 1,
         "scenarios": {},
     }
     for name in names:
@@ -497,7 +845,8 @@ def main(argv=None) -> int:
                          sample_every=args.sample_every,
                          gate_override=args.gate,
                          compare_boxed=args.compare_boxed,
-                         out_of_proc=args.out_of_proc)
+                         out_of_proc=args.out_of_proc,
+                         replicas=args.replicas)
         report["scenarios"][name] = result
         print(
             f"{name}: {result['sequenced_ops']} msgs @ "
