@@ -26,6 +26,7 @@ import threading
 import weakref
 from typing import Callable, Dict, List, Optional
 
+from ..protocol import errors as wire_errors
 from ..protocol.messages import (DocRelocatedError, NackError, RawOperation,
                                  SequencedMessage, ShardFencedError)
 from ..protocol.summary import SummaryTree, tree_from_obj, tree_to_obj
@@ -72,6 +73,21 @@ class EpochMismatchError(RpcError):
     def __init__(self, message: str, server_epoch: Optional[str]) -> None:
         super().__init__(message)
         self.server_epoch = server_epoch
+
+
+class UnknownWireCodeError(RpcError):
+    """The peer sent an error code outside the protocol/errors.py
+    registry: the two sides disagree about the failure vocabulary
+    (version skew, a corrupt frame, a buggy server).  A plain RpcError
+    subclass on purpose — pacing or resending against an UNKNOWN
+    contract is how retry budgets burn, so this is never retried; the
+    host must surface it."""
+
+    def __init__(self, channel: str, code: object) -> None:
+        super().__init__(
+            f"unregistered wire error code {code!r} on {channel} channel")
+        self.channel = channel
+        self.code = code
 
 
 class _RpcClient:
@@ -380,9 +396,20 @@ class _RpcClient:
         if not frame.get("ok"):
             nack = frame.get("nack")
             if nack is not None:
+                nack_code = nack.get("code")
+                if nack_code not in wire_errors.codes("nack"):
+                    # A nack whose pacing class we don't know: silently
+                    # defaulting to "throttled" would pace the retry
+                    # budget on garbage.  Loud, typed, never retried.
+                    self.retry_counters.bump("rpc.unknown_code")
+                    self._mc.logger.send({
+                        "eventName": "unknownWireCode",
+                        "channel": "nack", "code": repr(nack_code),
+                    })
+                    raise UnknownWireCodeError("nack", nack_code)
                 raise NackError(nack.get("reason", "nacked"),
                                 retry_after=nack.get("retryAfter", 0.0),
-                                code=nack.get("code", "throttled"),
+                                code=nack_code,
                                 admission=nack.get("admission"))
             if frame.get("code") == "epochMismatch":
                 # Dead generation: unpin and drop EVERY cache riding this
@@ -416,6 +443,24 @@ class _RpcClient:
                 # death, not a server rejection — queued ops survive.
                 raise ConnectionLostError(
                     frame.get("error", "connection lost"))
+            if frame.get("code") == "internal":
+                # Server-side catch-all: a handler fault framed typed.
+                # Deterministic rejection — plain RpcError, never
+                # retried, never mistaken for transport.
+                raise RpcError(
+                    frame.get("error", "internal server error"))
+            frame_code = frame.get("code")
+            if frame_code is not None \
+                    and not wire_errors.is_registered(frame_code):
+                # The server speaks a code this driver's registry does
+                # not: version skew or corruption.  Same loud path as an
+                # unknown nack code — never folded into a generic error.
+                self.retry_counters.bump("rpc.unknown_code")
+                self._mc.logger.send({
+                    "eventName": "unknownWireCode",
+                    "channel": "frame", "code": repr(frame_code),
+                })
+                raise UnknownWireCodeError("frame", frame_code)
             raise RpcError(frame.get("error", "unknown server error"))
         return frame.get("result")
 

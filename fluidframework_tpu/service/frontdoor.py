@@ -737,7 +737,7 @@ class FrontDoor:
             return out
         except Exception as exc:  # surfaced to the client, like the server
             return {"v": WIRE_VERSION, "re": rid, "ok": False,
-                    "error": str(exc)}
+                    "error": str(exc), "code": "internal"}
 
     def _handle_method(self, session: PumpConnection, method: str,
                        params: dict):

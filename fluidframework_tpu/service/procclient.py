@@ -36,6 +36,7 @@ import numpy as np
 
 from ..drivers.network_driver import (RpcTimeoutError, RpcTransportError,
                                       _RpcClient)
+from ..protocol import errors as wire_errors
 from ..protocol.summary import tree_to_obj
 from ..protocol.wire import ColumnBatch, encode_column_batch, \
     encode_raw_operation
@@ -101,7 +102,13 @@ def _decode_outcome(wire: dict) -> SubmitOutcome:
     if wire.get("error") is not None:
         # Typed-enough reconstruction: the swarm's recovery contract only
         # branches on "failed at all" (defer + whole-batch resubmit).
-        error = ConnectionError(f"[{wire.get('code')}] {wire['error']}")
+        # The code must still be a registered outcome-channel row
+        # (protocol/errors.py); taxonomy drift is stamped into the text
+        # instead of silently passing as a registered failure.
+        code = wire.get("code")
+        if not wire_errors.is_registered(code):
+            code = f"unregistered:{code}"
+        error = ConnectionError(f"[{code}] {wire['error']}")
     return SubmitOutcome(stamped=[], consumed=int(wire["consumed"]),
                          error=error, stamped_count=int(wire["stamped"]))
 

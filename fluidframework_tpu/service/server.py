@@ -61,6 +61,13 @@ class EpochMismatch(Exception):
         self.server_epoch = server_epoch
 
 
+#: fold-cost EMA seed (seconds): the controller's pacing estimate before
+#: any lease has released.  Module-level so a harness that mirrors the
+#: EMA from its own observations (the storm verdict's cost_ema
+#: cross-check, testing/scenarios.py) shares the exact seed.
+ADMISSION_COST_INIT = 0.25
+
+
 class AdmissionController:
     """Adaptive admission for the catch-up fold lane (ISSUE 15).
 
@@ -103,7 +110,7 @@ class AdmissionController:
     def __init__(self, max_inflight: int, clock=None,
                  retry_floor: float = 0.05, retry_cap: float = 5.0,
                  degrade_after: int = 2,
-                 cost_init: float = 0.25) -> None:
+                 cost_init: float = ADMISSION_COST_INIT) -> None:
         #: injected clock (seconds); time.monotonic in production,
         #: a VirtualClock in deterministic harnesses.
         self._clock = clock if clock is not None else time.monotonic
@@ -1136,9 +1143,15 @@ class OrderingServer:
                                     "ok": False, "error": nack.reason,
                                     "nack": nack_body}
                     except Exception as exc:  # surfaced to the client
+                        # Typed catch-all (protocol/errors.py "internal",
+                        # fatal): a handler fault is a deterministic
+                        # rejection — framed with a registered code so it
+                        # can never masquerade as transport and be
+                        # blindly resent.
                         response = {"v": WIRE_VERSION,
                                     "re": frame.get("id"),
-                                    "ok": False, "error": str(exc)}
+                                    "ok": False, "error": str(exc),
+                                    "code": "internal"}
                 session._write(response)
                 await writer.drain()
         finally:

@@ -62,6 +62,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..protocol import errors as wire_errors
 from ..protocol.messages import DocRelocatedError, ShardFencedError
 from ..protocol.wire import (decode_column_batch, decode_raw_operation,
                              decode_sequenced_message,
@@ -79,7 +80,10 @@ _RETIRE_EXEMPT = frozenset({"ping", "stats", "shard_info", "adopt_doc",
 
 def _outcome_wire(outcome: SubmitOutcome) -> dict:
     """One per-doc submit outcome as a wire dict (errors by code + text;
-    exception objects do not cross processes)."""
+    exception objects do not cross processes).  The classification IS
+    the outcome channel of the protocol/errors.py registry — every code
+    emitted here must be a registered row (FL-ERR-CODE pins the literals
+    statically; the assert pins the runtime)."""
     error = outcome.error
     if error is None:
         code = None
@@ -89,6 +93,7 @@ def _outcome_wire(outcome: SubmitOutcome) -> dict:
         code = "unknownDoc"
     else:
         code = "fault"
+    assert code is None or wire_errors.is_registered(code)
     return {
         "stamped": outcome.n_stamped(),
         "consumed": outcome.consumed,

@@ -618,6 +618,34 @@ class _CatchupStorm:
         #: PR 15 silent bound, surfaced (ISSUE 16 satellite)
         self.elected = 0
         self.clipped = 0
+        #: fold-cost EMA twin (ISSUE 19 satellite): the harness re-runs
+        #: the admission controller's cost arithmetic from ITS OWN
+        #: observations — in-proc the virtual-clock delta across the
+        #: dispatch plus the modeled hold, out-of-proc the modeled hold
+        #: alone (wire-clock admission admits and releases at the same
+        #: observed vnow) — and every shed nack's snapshot ``cost_ema``
+        #: must reproduce it, or the storm fails loudly: a server whose
+        #: pacing derives from costs the harness never observed is
+        #: lying or buggy.  Keyed per admission domain (the one in-proc
+        #: server, or the owning shard id out-of-proc — each shard
+        #: paces from its own controller).
+        from ..service.server import ADMISSION_COST_INIT
+        self.ema_twin: Dict[str, float] = {}
+        self.ema_checks = 0
+        self.ema_skips = 0
+        #: set when transport noise makes the twin unverifiable (a lost
+        #: request may still have folded server-side; a respawned shard
+        #: comes back with a RESET controller) — checks are then
+        #: SKIPPED and counted, never silently passed.
+        self.ema_taint: Optional[str] = None
+        self._cost_init = ADMISSION_COST_INIT
+        #: modeled fold duration (seconds of lease occupancy after the
+        #: synchronous fold returns) — the same value the in-proc server
+        #: gets on ``catchup_hold_seconds`` and the out-of-proc shards
+        #: get via ``--catchup-hold``.
+        self.fold_hold = (0.0 if spec.storm_never_shed
+                          else spec.storm_fold_ticks
+                          * spec.storm_tick_seconds)
         #: out-of-proc storms run WIRE-CLOCK admission (ISSUE 18): the
         #: shard's controller advances only on the vnow each catchup
         #: request carries, the harness issues requests sequentially on
@@ -747,6 +775,44 @@ class _CatchupStorm:
         retries): identity-excluded by construction."""
         self.remote[name] = self.remote.get(name, 0) + by
 
+    def _ema_observe(self, key: str, cost: float) -> None:
+        """One fold lease released: fold its observed cost into the
+        twin with the controller's own arithmetic (release(), EMA 1/2 —
+        including the cost>0 guard, so zero-cost releases leave the
+        twin untouched exactly as they leave the controller's EMA)."""
+        if cost > 0.0:
+            self.ema_twin[key] = (0.5 * self.ema_twin.get(
+                key, self._cost_init) + 0.5 * cost)
+
+    def _ema_check(self, key: str, snap) -> None:
+        """The storm-verdict tolerance gate (ISSUE 19 satellite): the
+        shed nack's snapshot ``cost_ema`` must reproduce the harness's
+        own observed fold costs.  In-proc the tolerance covers the
+        server's OWN clock reads between admit and release (each
+        VirtualClock read advances one tick the harness cannot see);
+        out-of-proc wire-clock admission is exact up to the snapshot's
+        1e-6 rounding.  Tainted runs (transport noise, respawns) skip
+        the check and COUNT the skip — never a silent pass."""
+        if not snap or "cost_ema" not in snap:
+            return
+        if self.server is None and self.ema_taint is None:
+            if self.swarm.counters.get("swarm.kills"):
+                self.ema_taint = "shard-kill (controller reset on respawn)"
+            elif getattr(self.swarm.service, "door_failovers", 0):
+                self.ema_taint = "door-failover (resend may have folded)"
+        if self.ema_taint is not None:
+            self.ema_skips += 1
+            return
+        twin = self.ema_twin.get(key, self._cost_init)
+        tol = 50 * self.clock.tick if self.clock is not None else 1e-5
+        self.ema_checks += 1
+        if abs(float(snap["cost_ema"]) - twin) > tol:
+            raise AssertionError(
+                f"admission snapshot cost_ema {snap['cost_ema']!r} does "
+                f"not reproduce the harness-observed fold-cost EMA "
+                f"{twin!r} for {key!r} (tolerance {tol!r}): the shed "
+                f"pacing derives from costs the harness never saw")
+
     def _retry(self, i: int, t: int, after_ticks: int,
                noise: bool = False) -> None:
         self.due.setdefault(t + max(1, after_ticks), []).append(i)
@@ -797,13 +863,19 @@ class _CatchupStorm:
     def _issue_inproc(self, i: int, t: int) -> None:
         swarm = self.swarm
         doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
+        # ``.now`` is the non-advancing read: the before/after pair must
+        # not itself tick the clock the server's admission reads from.
+        before = self.clock.now
         try:
             out = self.server._dispatch(self._session, "catchup",
                                         {"docs": [doc_id]})
         except NackError as exc:
             # Load-derived pacing honored in virtual ticks — the shed
-            # client waits the server's own hold, never less.
+            # client waits the server's own hold, never less.  The nack
+            # carries the controller snapshot; its cost_ema must match
+            # the harness's own fold-cost observations.
             self._bump("swarm.storm_shed")
+            self._ema_check("inproc", getattr(exc, "admission", None))
             ticks = int(round(float(exc.retry_after)
                               / swarm.spec.storm_tick_seconds))
             self._retry(i, t, ticks)
@@ -812,9 +884,21 @@ class _CatchupStorm:
             # Injected catchup.fail (FaultError ⊂ OSError): the fold
             # died after admission — slot released, single-flight
             # waiters woken by the finally-abandon; the caller retries.
+            # The finally released WITH the hold, so the failed fold's
+            # cost still landed in the pacing EMA — mirror it.
+            self._ema_observe("inproc",
+                              (self.clock.now - before) + self.fold_hold)
             self._bump("swarm.storm_fold_errors")
             self._retry(i, t, 1)
             return
+        if out.get("lane", "fold") == "fold":
+            # A real fold held a lease: its released cost (the virtual
+            # time the dispatch consumed — catchup.slow sleeps land
+            # here — plus the modeled hold) is what the controller's
+            # EMA folded in.  Warm/stream/degraded serves never took a
+            # lease and never touch the EMA.
+            self._ema_observe("inproc",
+                              (self.clock.now - before) + self.fold_hold)
         self._serve(i, t, out)
 
     def _issue_proc(self, i: int, t: int) -> None:
@@ -828,6 +912,7 @@ class _CatchupStorm:
 
         swarm = self.swarm
         doc_id = swarm.doc_ids[int(swarm.doc_of[i])]
+        shard = str(swarm.service.router.owner(doc_id))
         try:
             out = swarm.service.request("catchup", {
                 "docs": [doc_id],
@@ -853,15 +938,28 @@ class _CatchupStorm:
                         f"shed pacing: derived {derived!r} vs wire "
                         f"retry_after {retry!r} ({snap!r})")
                 retry = derived
+                # ISSUE 19 satellite: the reported cost_ema itself must
+                # reproduce the harness's own fold-cost observations
+                # for this shard's admission domain.
+                self._ema_check(shard, snap)
             ticks = int(round(retry / swarm.spec.storm_tick_seconds))
             self._retry(i, t, ticks)
             return
         except (RpcError, OSError) as exc:
-            # Transport noise: wall-clock shaped, identity-excluded.
+            # Transport noise: wall-clock shaped, identity-excluded —
+            # and it taints the EMA twin (the lost request may still
+            # have folded, and released, server-side).
+            if self.ema_taint is None:
+                self.ema_taint = f"transport:{type(exc).__name__}"
             self._noise("swarm.storm_fold_errors")
             self._noise(f"error:{type(exc).__name__}")
             self._retry(i, t, 1, noise=True)
             return
+        if out.get("lane", "fold") == "fold":
+            # Wire-clock admission admits and releases a sequential
+            # request at the SAME observed vnow: the lease's released
+            # cost is exactly the modeled hold.
+            self._ema_observe(shard, self.fold_hold)
         self._serve(i, t, out)
 
     # -- reporting -------------------------------------------------------------
@@ -928,6 +1026,22 @@ class _CatchupStorm:
             "latency_samples": len(lat),
             "tiers": self._tier_stats(),
             "phase_tiers": self.phase_tiers,
+        }
+        # ISSUE 19 satellite — the cost_ema cross-check is part of the
+        # storm VERDICT: a storm that shed must have audited (or
+        # explicitly skipped, taint recorded) at least one snapshot; a
+        # server that stops shipping auditable snapshots fails loudly
+        # instead of sailing through unchecked.
+        if shed and not (self.ema_checks + self.ema_skips):
+            raise AssertionError(
+                f"{shed} shed verdict(s) carried no auditable admission "
+                f"snapshot — the cost_ema cross-check never ran")
+        out["ema_crosscheck"] = {
+            "checks": self.ema_checks,
+            "skipped": self.ema_skips,
+            "tainted": self.ema_taint,
+            "twin": {k: round(v, 6)
+                     for k, v in sorted(self.ema_twin.items())},
         }
         if self.server is not None:
             out["admission"] = self.server.admission.snapshot()

@@ -1,0 +1,348 @@
+"""Round-trip conformance for the wire error-code registry (ISSUE 19).
+
+``protocol/errors.py`` declares every wire error code with the typed
+exception and retryability class it promises.  fluidlint's FL-ERR family
+pins the STATIC mirror (every produced literal is registered, every
+registered row is produced); this suite pins the RUNTIME contract: every
+registered code is PROVOKED against a real server — frame and nack codes
+over a real TCP ``OrderingServer``, outcome codes through the shardhost
+codec the out-of-process tier ships — and must surface driver-side as
+exactly the declared exception, with no in-place resend for the
+reconnect/fatal/nack-paced classes.
+
+Coverage is exhaustive by construction: ``PROVOKERS`` is asserted to key
+exactly the registry, so registering a new code without a provoker (or
+retiring one and leaving its provoker behind) fails this file before it
+ships.
+"""
+
+import builtins
+import json
+import socket
+import threading
+
+import pytest
+
+from fluidframework_tpu.drivers import network_driver as nd
+from fluidframework_tpu.protocol import errors as wire_errors
+from fluidframework_tpu.protocol import messages
+from fluidframework_tpu.protocol.wire import LEN, frame_bytes
+from fluidframework_tpu.service import retry as retry_mod
+from fluidframework_tpu.service.orderer import SubmitOutcome
+from fluidframework_tpu.service.procclient import _decode_outcome
+from fluidframework_tpu.service.retry import RetryPolicy
+from fluidframework_tpu.service.server import EpochMismatch, OrderingServer
+from fluidframework_tpu.service.shardhost import _outcome_wire
+from fluidframework_tpu.utils.telemetry import MonitoringContext
+
+
+def _real_exception(name):
+    """The live class a registry row names, wherever it is defined."""
+    for mod in (nd, messages, retry_mod):
+        cls = getattr(mod, name, None)
+        if cls is not None:
+            return cls
+    return getattr(builtins, name)
+
+
+class _CaptureLogger:
+    def __init__(self):
+        self.events = []
+
+    def send(self, event):
+        self.events.append(dict(event))
+
+
+# -- live provocation: frame + nack channels ----------------------------------
+
+
+def _provoke(code, handler_body=None, *, drain=False):
+    """Run one real TCP request that the server answers with ``code``.
+
+    Returns ``(exception, server_calls, client)``: the driver-side
+    exception, how many times the provoking handler actually ran (the
+    no-in-place-resend pin), and the client for counter/telemetry
+    asserts.  The client carries a live RetryPolicy so a code that
+    WOULD be blindly resent shows up as ``server_calls > 1``.
+    """
+    srv = OrderingServer(port=0)
+    calls = {"n": 0}
+
+    def handler(session, params):
+        calls["n"] += 1
+        return handler_body(session, params)
+
+    srv.extra_methods["provoke"] = handler
+    srv.start_in_thread()
+    if drain:
+        srv.draining = True
+    logger = _CaptureLogger()
+    rpc = nd._RpcClient(
+        "127.0.0.1", srv.port, timeout=10.0,
+        mc=MonitoringContext(logger=logger),
+        retry=RetryPolicy(max_attempts=4, base_delay=0.0, budget=1.0))
+    rpc._captured_events = logger.events
+    try:
+        with pytest.raises(Exception) as excinfo:
+            rpc.request("provoke", {})
+    finally:
+        rpc.close()
+    return excinfo.value, calls["n"], rpc
+
+
+def _raiser(make_exc):
+    return lambda session, params: (_ for _ in ()).throw(make_exc())
+
+
+def _kill_transport(session, params):
+    # Die without answering: the client's reader drains every waiter
+    # with the "connectionLost" frame — the one frame-channel code the
+    # DRIVER produces (network_driver reader loop), consumed by the same
+    # dispatch chain as server-produced codes.
+    session.writer.transport.abort()
+    return True
+
+
+def _frame_provoker(code, make_exc):
+    def run():
+        exc, calls, rpc = _provoke(code, _raiser(make_exc))
+        want = _real_exception(wire_errors.spec(code)["exception"])
+        assert type(exc) is want, (code, exc)
+        assert calls == 1, f"{code} was resent in place ({calls} calls)"
+        assert rpc.retry_counters.get("retry.retries") == 0
+        return exc
+    return run
+
+
+def _nack_provoker(code, *, drain=False):
+    def run():
+        body = (None if drain else
+                _raiser(lambda: messages.NackError(
+                    f"refused:{code}", retry_after=0.125, code=code)))
+        exc, calls, rpc = _provoke(
+            code, body or (lambda s, p: True), drain=drain)
+        want = _real_exception(wire_errors.spec(code)["exception"])
+        assert type(exc) is want, (code, exc)
+        assert exc.code == code
+        assert exc.retry_after > 0.0
+        if not drain:
+            assert calls == 1, f"{code} was resent in place"
+        assert rpc.retry_counters.get("retry.retries") == 0
+        return exc
+    return run
+
+
+# -- outcome channel: the shardhost codec round trip --------------------------
+
+
+def _outcome_provoker(code, make_error):
+    def run():
+        wire = _outcome_wire(SubmitOutcome(
+            stamped=[], consumed=1, error=make_error()))
+        assert wire["code"] == code
+        out = _decode_outcome(wire)
+        assert isinstance(out.error, ConnectionError)
+        assert f"[{code}]" in str(out.error)
+        return out.error
+    return run
+
+
+def _shard_dead_provoker():
+    # Produced by the FRONT DOOR (a dead shard's whole submit answers
+    # with this shape), decoded by the same adapter path.
+    wire = {"stamped": 0, "consumed": 0,
+            "error": "shard shard00 died mid-submit", "code": "shardDead"}
+    out = _decode_outcome(wire)
+    assert isinstance(out.error, ConnectionError)
+    assert "[shardDead]" in str(out.error)
+    return out.error
+
+
+PROVOKERS = {
+    # frame channel
+    "epochMismatch": _frame_provoker(
+        "epochMismatch", lambda: EpochMismatch("gen-a", "gen-b")),
+    "shardFenced": _frame_provoker(
+        "shardFenced",
+        lambda: messages.ShardFencedError("doc-1", "shard fenced")),
+    "wrongShard": _frame_provoker(
+        "wrongShard",
+        lambda: messages.DocRelocatedError("doc-1", "moved to shard01")),
+    "internal": _frame_provoker(
+        "internal", lambda: RuntimeError("handler fault")),
+    "connectionLost": lambda: _connection_lost_provoker(),
+    # nack channel
+    "throttled": _nack_provoker("throttled"),
+    "staleView": _nack_provoker("staleView"),
+    "overloaded": _nack_provoker("overloaded"),
+    # shuttingDown takes the REAL drain refusal in _dispatch, not a
+    # synthetic raise — the handler never runs.
+    "shuttingDown": _nack_provoker("shuttingDown", drain=True),
+    # outcome channel
+    "fenced": _outcome_provoker(
+        "fenced", lambda: messages.ShardFencedError("doc-1", "fenced")),
+    "unknownDoc": _outcome_provoker(
+        "unknownDoc", lambda: KeyError("no-such-doc")),
+    "fault": _outcome_provoker(
+        "fault", lambda: RuntimeError("append fault")),
+    "shardDead": _shard_dead_provoker,
+}
+
+
+def _connection_lost_provoker():
+    exc, calls, rpc = _provoke("connectionLost", _kill_transport)
+    assert type(exc) is nd.ConnectionLostError
+    assert calls == 1, "a dead socket must never be resent in place"
+    assert rpc.retry_counters.get("retry.retries") == 0
+    return exc
+
+
+def test_provokers_cover_exactly_the_registry():
+    assert set(PROVOKERS) == set(wire_errors.codes()), (
+        "every registered wire code needs a provoker (and every "
+        "provoker a registered code): %r"
+        % sorted(set(PROVOKERS) ^ set(wire_errors.codes())))
+
+
+@pytest.mark.parametrize("code", sorted(wire_errors.codes()))
+def test_registered_code_round_trips_as_declared(code):
+    """The registry row IS the runtime behavior: provoking the code
+    against a real server/codec surfaces the declared exception type,
+    and reconnect/fatal/nack-paced codes are never resent in place."""
+    PROVOKERS[code]()
+
+
+def test_exception_table_matches_real_hierarchy():
+    """Every EXCEPTIONS row names a live class, and the declared parent
+    chain is the class's real inheritance — the registry can never
+    describe a hierarchy the code does not have (FL-ERR-RETRY walks
+    these chains to find reconnect exceptions hiding under retried
+    bases)."""
+    for name, row in wire_errors.EXCEPTIONS.items():
+        cls = _real_exception(name)
+        assert isinstance(cls, type) and issubclass(cls, BaseException)
+        for ancestor in wire_errors.ancestors(name):
+            assert issubclass(cls, _real_exception(ancestor)), (
+                name, ancestor)
+    # the PR 9 regression, as a registry fact: ConnectionLostError's
+    # chain passes through the transport-retried base, which is exactly
+    # why every retry site must pin it in no_retry.
+    assert "RpcTransportError" in wire_errors.ancestors(
+        "ConnectionLostError")
+    assert wire_errors.exception_spec(
+        "ConnectionLostError")["retry"] == "reconnect"
+
+
+def test_outcome_decode_tags_unregistered_codes():
+    """Taxonomy drift on the outcome channel is stamped into the error
+    text, never silently passed off as a registered failure."""
+    out = _decode_outcome({"stamped": 0, "consumed": 0,
+                           "error": "who knows", "code": "mysteryOutcome"})
+    assert "[unregistered:mysteryOutcome]" in str(out.error)
+
+
+# -- unknown-code hardening (the nack.get("code", "throttled") bugfix) --------
+
+
+class _ScriptedPeer:
+    """A TCP peer speaking the frame protocol but answering every
+    request with ONE crafted frame — the version-skewed / corrupt server
+    the real OrderingServer can never be."""
+
+    def __init__(self, make_response):
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        self._make = make_response
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _recv_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _run(self):
+        conn, _ = self._srv.accept()
+        try:
+            while True:
+                hdr = self._recv_exact(conn, LEN.size)
+                if hdr is None:
+                    return
+                payload = self._recv_exact(conn, LEN.unpack(hdr)[0])
+                req = json.loads(payload)
+                conn.sendall(frame_bytes(self._make(req)))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+            self._srv.close()
+
+
+def _scripted_request(make_response):
+    peer = _ScriptedPeer(make_response)
+    logger = _CaptureLogger()
+    rpc = nd._RpcClient(
+        "127.0.0.1", peer.port, timeout=10.0,
+        mc=MonitoringContext(logger=logger),
+        retry=RetryPolicy(max_attempts=4, base_delay=0.0, budget=1.0))
+    try:
+        with pytest.raises(Exception) as excinfo:
+            rpc.request("anything", {})
+    finally:
+        rpc.close()
+    return excinfo.value, rpc, logger.events
+
+
+def test_nack_without_code_is_loud_typed_and_unretried():
+    """Regression for the silent ``nack.get("code", "throttled")``
+    default: a nack missing its code must NOT be paced as a throttle —
+    it raises the typed loud error, bumps the drift counter, emits
+    telemetry, and is never retried."""
+    exc, rpc, events = _scripted_request(lambda req: {
+        "v": 1, "re": req["id"], "ok": False, "error": "busy",
+        "nack": {"retryAfter": 0.5, "reason": "busy"}})
+    assert type(exc) is nd.UnknownWireCodeError
+    assert not isinstance(exc, messages.NackError)
+    assert exc.channel == "nack" and exc.code is None
+    assert rpc.retry_counters.get("rpc.unknown_code") == 1
+    assert rpc.retry_counters.get("retry.retries") == 0
+    assert any(e.get("eventName", "").endswith("unknownWireCode") for e in events)
+
+
+def test_nack_with_unregistered_code_is_loud_typed_and_unretried():
+    exc, rpc, events = _scripted_request(lambda req: {
+        "v": 1, "re": req["id"], "ok": False, "error": "busy",
+        "nack": {"retryAfter": 0.5, "reason": "busy",
+                 "code": "mysteryPacing"}})
+    assert type(exc) is nd.UnknownWireCodeError
+    assert exc.channel == "nack" and exc.code == "mysteryPacing"
+    assert rpc.retry_counters.get("rpc.unknown_code") == 1
+    assert rpc.retry_counters.get("retry.retries") == 0
+    assert any(e.get("eventName", "").endswith("unknownWireCode")
+               and e.get("channel") == "nack" for e in events)
+
+
+def test_frame_with_unregistered_code_is_loud_typed_and_unretried():
+    exc, rpc, events = _scripted_request(lambda req: {
+        "v": 1, "re": req["id"], "ok": False, "error": "??",
+        "code": "fluxCapacitor"})
+    assert type(exc) is nd.UnknownWireCodeError
+    assert exc.channel == "frame" and exc.code == "fluxCapacitor"
+    assert rpc.retry_counters.get("rpc.unknown_code") == 1
+    assert rpc.retry_counters.get("retry.retries") == 0
+    assert any(e.get("eventName", "").endswith("unknownWireCode")
+               and e.get("channel") == "frame" for e in events)
+
+
+def test_codeless_error_frame_still_raises_plain_rpc_error():
+    """A bare ``{"ok": false, "error": ...}`` frame (no code at all) is
+    legacy-compatible: plain RpcError, not the unknown-code path."""
+    exc, rpc, _ = _scripted_request(lambda req: {
+        "v": 1, "re": req["id"], "ok": False, "error": "plain refusal"})
+    assert type(exc) is nd.RpcError
+    assert rpc.retry_counters.get("rpc.unknown_code") == 0

@@ -190,6 +190,28 @@ def test_fluiddur_modules_lint_clean_with_zero_suppressions():
     assert offenders == [], "durability-annotated modules stay clean"
 
 
+def test_fluidfail_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 19 acceptance pin: the error-taxonomy registry and every
+    module the FL-ERR family audits — the five serving/driver modules
+    that produce or consume wire error codes — pass ALL module rules
+    with zero findings AND zero baseline entries.  The true positives
+    the family caught (untyped broad handlers on reply paths, the
+    ConnectionLostError retry hole) were FIXED, never baselined."""
+    new_modules = [
+        "fluidframework_tpu/protocol/errors.py",
+        "fluidframework_tpu/drivers/network_driver.py",
+        "fluidframework_tpu/service/server.py",
+        "fluidframework_tpu/service/frontdoor.py",
+        "fluidframework_tpu/service/shardhost.py",
+        "fluidframework_tpu/service/procclient.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "error-taxonomy modules stay suppression-free"
+
+
 def test_counter_names_asserted_in_tests_are_produced():
     """ISSUE 17 satellite: counter-name drift.  Every namespaced counter
     literal a test references (catchup.*, fd.*, retry.*, swarm.*) must
@@ -238,8 +260,8 @@ def test_counter_names_asserted_in_tests_are_produced():
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5) + donate (PR 13)
-    # + 6 fluiddur (PR 17)
-    assert len(rules) >= 28, sorted(rules)
+    # + 6 fluiddur (PR 17) + 5 fluidfail (PR 19)
+    assert len(rules) >= 33, sorted(rules)
     for name, rule in rules.items():
         assert rule.description, f"{name} has no description"
         assert rule.severity in ("error", "warning"), name
@@ -283,6 +305,20 @@ def test_cli_rules_family_filter(capsys):
     assert all("[durability/" in ln for ln in out.splitlines() if ln)
     assert main(["--rules", "nosuchfamily", "--list-rules"]) == 2
     capsys.readouterr()
+
+
+def test_cli_rules_err_family_filter(capsys):
+    """ISSUE 19: `--rules err` selects exactly the five-rule FL-ERR
+    family (the error-taxonomy analyzer runs standalone)."""
+    from tools.fluidlint.cli import main, rule_family
+
+    assert main(["--rules", "err", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    listed = {ln.split(" ", 1)[0] for ln in out.splitlines() if ln}
+    expected = {name for name, rule in all_rules().items()
+                if rule_family(rule) == "errors"}
+    assert listed == expected and len(expected) == 5, (listed, expected)
+    assert all("[errors/" in ln for ln in out.splitlines() if ln)
 
 
 def test_cli_rules_family_filter_scopes_analysis(tmp_path, capsys):
@@ -343,6 +379,87 @@ def test_cli_exit_code_on_findings(tmp_path, capsys):
         "import time\n\ndef hold():\n    return time.time()\n")
     assert main(["--root", str(tmp_path)]) == 1
     assert "FL-DET-CLOCK" in capsys.readouterr().out
+
+
+def _seeded_git_tree(tmp_path):
+    """A two-commit synthetic repo for --diff: ``stale.py`` carries a
+    pre-existing finding and never changes after commit one;
+    ``touched.py`` gains a finding in commit two; ``gone.py`` is deleted
+    in commit two; ``fresh.py`` is untracked working-tree state."""
+    import subprocess
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.email=t@test",
+             "-c", "user.name=t", *argv],
+            check=True, capture_output=True)
+
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    bad = "import time\n\ndef hold():\n    return time.time()\n"
+    (pkg / "stale.py").write_text(bad)
+    (pkg / "touched.py").write_text("def fine():\n    return 1\n")
+    (pkg / "gone.py").write_text("def bye():\n    return 2\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "one")
+    (pkg / "touched.py").write_text(bad)
+    git("rm", "-q", str(pkg / "gone.py"))
+    git("add", "-A")
+    git("commit", "-qm", "two")
+    (pkg / "fresh.py").write_text(bad)
+    return pkg
+
+
+def test_cli_diff_lints_only_changed_files(tmp_path, capsys):
+    """ISSUE 19 satellite: `--diff GIT_REF` analyzes exactly the
+    Python files changed since the ref (committed + working tree +
+    untracked, deletions dropped) and reports the same findings a full
+    run restricted to those files would — pre-existing findings in
+    unchanged files stay out of the report."""
+    import json
+
+    from tools.fluidlint.cli import main
+
+    _seeded_git_tree(tmp_path)
+    assert main(["--root", str(tmp_path), "--diff", "HEAD~1",
+                 "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in report["unsuppressed"]} == {
+        "fluidframework_tpu/loader/touched.py",
+        "fluidframework_tpu/loader/fresh.py"}
+    # identical findings contract: a full run restricted to the changed
+    # files (the documented equivalence) produces the same report
+    assert main(["--root", str(tmp_path),
+                 "fluidframework_tpu/loader/touched.py",
+                 "fluidframework_tpu/loader/fresh.py", "--json"]) == 1
+    explicit = json.loads(capsys.readouterr().out)
+    assert report["unsuppressed"] == explicit["unsuppressed"]
+    # the unchanged file's finding exists — only a FULL run surfaces it
+    assert main(["--root", str(tmp_path), "--json"]) == 1
+    full = json.loads(capsys.readouterr().out)
+    assert "fluidframework_tpu/loader/stale.py" in {
+        f["path"] for f in full["unsuppressed"]}
+
+
+def test_cli_diff_usage_and_git_errors(tmp_path, capsys):
+    """--diff composes with nothing that contradicts it: explicit paths
+    alongside it, an unknown ref, or a root outside any git repo are
+    usage errors (exit 2), never a vacuously-clean exit 0."""
+    from tools.fluidlint.cli import main
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _seeded_git_tree(repo)
+    assert main(["--root", str(repo), "--diff", "HEAD",
+                 "fluidframework_tpu/loader/touched.py"]) == 2
+    assert main(["--root", str(repo), "--diff", "no-such-ref"]) == 2
+    # a root outside ANY git repo (sibling of the seeded one, so git
+    # discovery cannot walk up into it)
+    bare = tmp_path / "not-a-repo"
+    (bare / "fluidframework_tpu").mkdir(parents=True)
+    assert main(["--root", str(bare), "--diff", "HEAD"]) == 2
+    capsys.readouterr()
 
 
 def test_cli_write_baseline_bootstraps_missing_file(tmp_path, capsys):

@@ -519,6 +519,47 @@ MODULE_RULE_FIXTURES = {
         """,
         SERVICE,
     ),
+    "FL-ERR-CROSS": (
+        """
+        class Session:
+            def respond(self, req):
+                out = self._dispatch(req)
+                return {"ok": True, "result": out}
+        """,
+        """
+        class Session:
+            def respond(self, req):
+                try:
+                    out = self._dispatch(req)
+                except Exception as exc:
+                    return {"ok": False, "code": "internal",
+                            "error": str(exc)}
+                return {"ok": True, "result": out}
+        """,
+        SERVICE,
+    ),
+    "FL-ERR-HANDLER": (
+        """
+        class Session:
+            def respond(self, session, req):
+                try:
+                    payload = self._build(req)
+                except Exception:
+                    payload = None
+                send_obj(session, payload)
+        """,
+        """
+        class Session:
+            def respond(self, session, req):
+                try:
+                    payload = self._build(req)
+                except Exception as exc:
+                    payload = {"ok": False, "code": "internal",
+                               "error": str(exc)}
+                send_obj(session, payload)
+        """,
+        SERVICE,
+    ),
 }
 
 
@@ -2420,6 +2461,135 @@ def test_dur_gate_negative(tmp_path):
             config.get_float("Server.DrainRetryAfter", 0.5)
     """)
     assert [f for f in analyze(tmp_path) if f.rule == "FL-DUR-GATE"] == []
+
+
+# -- project rules: FL-ERR-CODE / FL-ERR-RAISE / FL-ERR-RETRY ------------------
+
+
+def _write_err_tree(root, errors_body, service_body):
+    pkg = root / "fluidframework_tpu"
+    (pkg / "protocol").mkdir(parents=True)
+    (pkg / "service").mkdir()
+    (pkg / "protocol" / "errors.py").write_text(textwrap.dedent(errors_body))
+    (pkg / "service" / "x.py").write_text(textwrap.dedent(service_body))
+
+
+def test_err_code_positive(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {
+            "throttled": {"channel": "nack"},
+            "epochMismatch": {"channel": "frame"},
+            "ghostCode": {"channel": "frame"},
+        }
+        EXCEPTIONS = {}
+    """, """
+        def reply(err):
+            if err.code == "mystery":
+                return {"ok": False, "code": "freeLancer"}
+            return {"ok": False, "code": "epochMismatch"}
+    """)
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-CODE"}
+    assert any("'freeLancer' is produced here but not registered" in m
+               for m in msgs), msgs
+    assert any("'mystery' is handled here but not registered" in m
+               for m in msgs), msgs
+    assert any("'ghostCode' is produced nowhere" in m for m in msgs), msgs
+    assert any("'epochMismatch' is produced but never handled" in m
+               for m in msgs), msgs
+
+
+def test_err_code_negative(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {
+            "throttled": {"channel": "nack"},
+            "epochMismatch": {"channel": "frame"},
+        }
+        EXCEPTIONS = {}
+    """, """
+        def reply(err):
+            if err.code == "epochMismatch":
+                return {"ok": False, "code": "epochMismatch"}
+            return {"ok": False, "code": "throttled"}
+    """)
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-CODE"] == []
+
+
+def test_err_raise_positive(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {
+            "throttled": {"channel": "nack"},
+            "epochMismatch": {"channel": "frame"},
+        }
+        EXCEPTIONS = {}
+    """, """
+        def pace():
+            raise NackError("busy", code="fluxCapacitor")
+
+        def fence():
+            raise NackError("stale", code="epochMismatch")
+    """)
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-RAISE"}
+    assert any("free-string code 'fluxCapacitor'" in m for m in msgs), msgs
+    assert any("'epochMismatch', a frame-channel code" in m
+               for m in msgs), msgs
+
+
+def test_err_raise_negative(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {
+            "throttled": {"channel": "nack"},
+        }
+        EXCEPTIONS = {}
+    """, """
+        def pace():
+            raise NackError("busy", code="throttled")
+    """)
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-RAISE"] == []
+
+
+def test_err_retry_positive(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {}
+        EXCEPTIONS = {
+            "RpcTransportError": {"retry": "transport"},
+            "ConnectionLostError": {"retry": "reconnect",
+                                    "parent": "RpcTransportError"},
+        }
+    """, """
+        def call(policy, op):
+            return policy.run(
+                operation=op,
+                retry_on=(RpcTransportError, OSError),
+            )
+    """)
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-RETRY"}
+    assert any("reconnect-class exception 'ConnectionLostError'" in m
+               and "absent from no_retry" in m for m in msgs), msgs
+
+
+def test_err_retry_negative(tmp_path):
+    _write_err_tree(tmp_path, """
+        WIRE_ERRORS = {}
+        EXCEPTIONS = {
+            "RpcTransportError": {"retry": "transport"},
+            "ConnectionLostError": {"retry": "reconnect",
+                                    "parent": "RpcTransportError"},
+        }
+    """, """
+        def call(policy, op):
+            return policy.run(
+                operation=op,
+                retry_on=(RpcTransportError, OSError),
+                no_retry=(ConnectionLostError,),
+            )
+    """)
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-ERR-RETRY"] == []
 
 
 # -- registry meta-coverage ----------------------------------------------------

@@ -7,6 +7,7 @@ the ``@register`` decorator, then import that module here.
 from . import rules_concurrency  # noqa: F401
 from . import rules_determinism  # noqa: F401
 from . import rules_durability   # noqa: F401
+from . import rules_errors       # noqa: F401
 from . import rules_events       # noqa: F401
 from . import rules_lifecycle    # noqa: F401
 from . import rules_trace        # noqa: F401

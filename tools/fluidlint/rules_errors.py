@@ -1,0 +1,583 @@
+"""fluidfail — error-taxonomy & cross-process failure-propagation rules.
+
+The serving tier's failure vocabulary is a REGISTRY
+(``fluidframework_tpu/protocol/errors.py``): every wire error code is
+declared once with its channel (frame / nack / outcome), its typed
+exception, and its retryability class (transport / nack-paced /
+reconnect / fatal).  Yuan et al. (OSDI'14) found most catastrophic
+distributed-system failures start in trivially wrong error-handling
+code, and error-propagation bugs are systematically missable by review
+— so, like fluiddur did for durability orderings, this family turns the
+taxonomy into checked invariants:
+
+``FL-ERR-CODE``
+    Registry drift, both directions.  A ``"code"`` literal produced
+    anywhere in the package (response dict, ``code=`` keyword,
+    ``code = "..."`` assignment, a ``code`` parameter default) and every
+    code literal a consumer branches on must be a registered row; a
+    registered row must be produced somewhere, and a frame-channel row
+    must also be HANDLED somewhere (a driver-side dispatch branch) —
+    produced-but-never-handled is an untyped failure crossing the
+    process boundary.
+``FL-ERR-RETRY``
+    A reconnect- or fatal-class exception (per the registry's
+    ``EXCEPTIONS`` chains) that a ``RetryPolicy`` site's ``retry_on``
+    would catch must appear in that site's ``no_retry`` (or ride
+    ``on_fence`` for the ShardFencedError family).  The PR 9
+    ConnectionLostError budget-burn bug is this finding.
+``FL-ERR-CROSS``
+    In a reply-path function (one that builds ``"ok"``-keyed response
+    dicts or calls ``send_obj``), a dispatch call must be covered by a
+    broad ``except`` that frames a TYPED error response (a ``"code"``
+    key) — otherwise a handler fault crosses the process boundary
+    unframed and the client cannot classify it.
+``FL-ERR-HANDLER``
+    A broad ``except`` on a reply path must re-frame an error response,
+    report to a telemetry sink, or re-raise — a silent swallow leaves
+    the client waiting forever (FL-LEAK-SWALLOW extended to the reply
+    contract).
+``FL-ERR-RAISE``
+    Protocol errors constructed with free-string ``code=`` keywords not
+    in the registry (and ``NackError`` built with a code from another
+    channel).
+
+Known limits (documented in the README): codes built by string
+concatenation or variables are invisible to CODE/RAISE (the registry
+convention is literal codes at call sites); RETRY declines at sites
+whose ``retry_on``/``no_retry`` tuples are named aliases rather than
+inline tuples; CROSS identifies dispatch calls by name convention
+(``*dispatch*``, ``_handle*``, executor indirection passing a
+``*dispatch*`` callable) and reply paths by shape (``"ok"`` dicts /
+``send_obj``), so a renamed dispatcher leaves the rule's scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (Finding, ModuleContext, ProjectContext, ProjectRule,
+                   Rule, register)
+from .rules_concurrency import _walk_pruned as _fn_walk
+from .rules_durability import _const_str, _terminal
+from .rules_lifecycle import _dotted, _functions
+
+ERRORS_MODULE = "fluidframework_tpu/protocol/errors.py"
+
+#: retryability classes whose declared recovery is incompatible with an
+#: in-place resend — the ones FL-ERR-RETRY polices at retry sites.
+_NO_RESEND_CLASSES = ("reconnect", "fatal")
+
+_RETRY_PHRASE = {
+    "reconnect": "an in-place resend can never succeed (declared "
+                 "recovery: reconnect / re-resolve / rebase)",
+    "fatal": "retrying a deterministic rejection burns the budget",
+}
+
+
+# -- registry parsing (the FL-DUR-SEAM/GATE machinery) ------------------------
+
+
+def _top_dict(tree: ast.Module, name: str) -> Optional[ast.Dict]:
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if name in names and isinstance(node.value, ast.Dict):
+            return node.value
+    return None
+
+
+def _registered_codes(tree: ast.Module) -> Dict[str, Tuple[int, str]]:
+    """WIRE_ERRORS: code -> (line, channel)."""
+    out: Dict[str, Tuple[int, str]] = {}
+    d = _top_dict(tree, "WIRE_ERRORS")
+    if d is None:
+        return out
+    for key, val in zip(d.keys, d.values):
+        lit = _const_str(key)
+        if lit is None:
+            continue
+        channel = ""
+        if isinstance(val, ast.Dict):
+            for k2, v2 in zip(val.keys, val.values):
+                if _const_str(k2) == "channel":
+                    channel = _const_str(v2) or ""
+        out[lit] = (key.lineno, channel)
+    return out
+
+
+def _registered_exceptions(tree: ast.Module) -> Dict[str, dict]:
+    """EXCEPTIONS: name -> {"retry", "parent", "line"}."""
+    out: Dict[str, dict] = {}
+    d = _top_dict(tree, "EXCEPTIONS")
+    if d is None:
+        return out
+    for key, val in zip(d.keys, d.values):
+        lit = _const_str(key)
+        if lit is None or not isinstance(val, ast.Dict):
+            continue
+        row = {"retry": "", "parent": None, "line": key.lineno}
+        for k2, v2 in zip(val.keys, val.values):
+            k2lit = _const_str(k2)
+            if k2lit == "retry":
+                row["retry"] = _const_str(v2) or ""
+            elif k2lit == "parent":
+                row["parent"] = _const_str(v2)
+        out[lit] = row
+    return out
+
+
+def _chain(name: str, table: Dict[str, dict]) -> Set[str]:
+    """``name`` plus its registered ancestors (cycle-guarded)."""
+    seen = [name]
+    cur = table.get(name, {}).get("parent")
+    while cur is not None and cur in table and cur not in seen:
+        seen.append(cur)
+        cur = table[cur]["parent"]
+    return set(seen)
+
+
+# -- code-literal scanning ----------------------------------------------------
+
+
+def _is_code_target(t: ast.AST) -> bool:
+    if isinstance(t, ast.Name):
+        return t.id == "code"
+    if isinstance(t, ast.Subscript):
+        return _const_str(t.slice) == "code"
+    return False
+
+
+def _is_code_expr(e: ast.AST) -> bool:
+    if isinstance(e, ast.Name):
+        return e.id == "code" or e.id.endswith("_code")
+    if isinstance(e, ast.Attribute):
+        return e.attr == "code"
+    if isinstance(e, ast.Subscript):
+        return _const_str(e.slice) == "code"
+    if isinstance(e, ast.Call):
+        return (_terminal(e.func) == "get" and bool(e.args)
+                and _const_str(e.args[0]) == "code")
+    return False
+
+
+def _code_sites(tree: ast.Module
+                ) -> Tuple[List[Tuple[str, int, str]],
+                           List[Tuple[str, int]]]:
+    """(produced, consumed) code literals with lines.
+
+    Produced kinds: ``dict`` (``{"code": X}``), ``ctor``/``kw``
+    (``code=X`` keyword on an ``*Error`` / other callee), ``assign``
+    (``code = X`` / ``out["code"] = X``), ``default`` (a ``code``
+    parameter default — ``NackError.__init__``'s "throttled" ships on
+    the wire whenever the ctor is called bare).  Consumed: a string
+    literal compared against a code-shaped expression (``.code``,
+    ``["code"]``, ``.get("code")``, a ``*code`` name)."""
+    produced: List[Tuple[str, int, str]] = []
+    consumed: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if _const_str(k) == "code":
+                    lit = _const_str(v)
+                    if lit is not None:
+                        produced.append((lit, v.lineno, "dict"))
+        elif isinstance(node, ast.Call):
+            ctor = (_terminal(node.func) or "").endswith("Error")
+            for kw in node.keywords:
+                if kw.arg == "code":
+                    lit = _const_str(kw.value)
+                    if lit is not None:
+                        produced.append((lit, kw.value.lineno,
+                                         "ctor" if ctor else "kw"))
+        elif isinstance(node, ast.Assign):
+            lit = _const_str(node.value)
+            if lit is not None and any(_is_code_target(t)
+                                       for t in node.targets):
+                produced.append((lit, node.lineno, "assign"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            pos = list(a.posonlyargs) + list(a.args)
+            for arg, dflt in zip(pos[len(pos) - len(a.defaults):],
+                                 a.defaults):
+                if arg.arg == "code":
+                    lit = _const_str(dflt)
+                    if lit is not None:
+                        produced.append((lit, dflt.lineno, "default"))
+            for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+                if dflt is not None and arg.arg == "code":
+                    lit = _const_str(dflt)
+                    if lit is not None:
+                        produced.append((lit, dflt.lineno, "default"))
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(_is_code_expr(s) for s in sides):
+                for s in sides:
+                    lit = _const_str(s)
+                    if lit is not None:
+                        consumed.append((lit, s.lineno))
+    return produced, consumed
+
+
+# -- FL-ERR-CODE --------------------------------------------------------------
+
+
+@register
+class ErrCodeRule(ProjectRule):
+    """Wire-code registry drift, both directions."""
+
+    name = "FL-ERR-CODE"
+    severity = "error"
+    description = ("every produced/handled wire error-code literal must be "
+                   "a registered protocol/errors.py WIRE_ERRORS row, every "
+                   "row must be produced, and every frame-channel row must "
+                   "be handled driver-side")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        tree = project.parse(ERRORS_MODULE)
+        if tree is None:
+            return
+        registered = _registered_codes(tree)
+        produced_anywhere: Set[str] = set()
+        consumed_anywhere: Set[str] = set()
+        for rel in project.glob("fluidframework_tpu/**/*.py"):
+            if rel == ERRORS_MODULE or "__pycache__" in rel:
+                continue
+            mod = project.parse(rel)
+            if mod is None:
+                continue
+            produced, consumed = _code_sites(mod)
+            for lit, line, kind in produced:
+                produced_anywhere.add(lit)
+                # ctor sites with an unregistered code are FL-ERR-RAISE's
+                # finding — one defect, one rule
+                if lit not in registered and kind != "ctor":
+                    yield self.project_finding(rel, line, (
+                        f"wire code '{lit}' is produced here but not "
+                        f"registered in protocol/errors.py WIRE_ERRORS — "
+                        f"invisible to the error taxonomy"))
+            for lit, line in consumed:
+                consumed_anywhere.add(lit)
+                if lit not in registered:
+                    yield self.project_finding(rel, line, (
+                        f"wire code '{lit}' is handled here but not "
+                        f"registered in protocol/errors.py WIRE_ERRORS — "
+                        f"producer/consumer drift"))
+        for code, (line, channel) in sorted(registered.items()):
+            if code not in produced_anywhere:
+                yield self.project_finding(ERRORS_MODULE, line, (
+                    f"registered wire code '{code}' is produced nowhere in "
+                    f"the package — dead taxonomy row"))
+            elif channel == "frame" and code not in consumed_anywhere:
+                yield self.project_finding(ERRORS_MODULE, line, (
+                    f"frame code '{code}' is produced but never handled by "
+                    f"a driver-side dispatch branch — an untyped failure "
+                    f"crossing the process boundary"))
+
+
+# -- FL-ERR-RAISE -------------------------------------------------------------
+
+
+@register
+class ErrRaiseRule(ProjectRule):
+    """Typed errors built with free-string codes."""
+
+    name = "FL-ERR-RAISE"
+    severity = "error"
+    description = ("a protocol error constructed with a code= keyword must "
+                   "use a registered WIRE_ERRORS code, and NackError must "
+                   "carry a nack-channel code")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        tree = project.parse(ERRORS_MODULE)
+        if tree is None:
+            return
+        registered = _registered_codes(tree)
+        for rel in project.glob("fluidframework_tpu/**/*.py"):
+            if rel == ERRORS_MODULE or "__pycache__" in rel:
+                continue
+            mod = project.parse(rel)
+            if mod is None:
+                continue
+            for node in ast.walk(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                term = _terminal(node.func) or ""
+                if not term.endswith("Error"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "code":
+                        continue
+                    lit = _const_str(kw.value)
+                    if lit is None:
+                        continue
+                    if lit not in registered:
+                        yield self.project_finding(
+                            rel, kw.value.lineno, (
+                                f"{term} constructed with free-string code "
+                                f"'{lit}' — not a registered "
+                                f"protocol/errors.py WIRE_ERRORS row"))
+                    elif term == "NackError" \
+                            and registered[lit][1] != "nack":
+                        yield self.project_finding(
+                            rel, kw.value.lineno, (
+                                f"NackError constructed with '{lit}', a "
+                                f"{registered[lit][1]}-channel code — nacks "
+                                f"must carry nack-channel codes"))
+
+
+# -- FL-ERR-RETRY -------------------------------------------------------------
+
+
+def _tuple_names(expr: Optional[ast.AST]) -> Optional[Set[str]]:
+    """Terminal names of an inline exception tuple/list, or None when the
+    value is absent or not statically resolvable (a named alias)."""
+    if expr is None or not isinstance(expr, (ast.Tuple, ast.List)):
+        return None
+    out: Set[str] = set()
+    for el in expr.elts:
+        t = _terminal(el)
+        if t is not None:
+            out.add(t)
+    return out
+
+
+@register
+class ErrRetryRule(ProjectRule):
+    """Reconnect/fatal exceptions retried in place."""
+
+    name = "FL-ERR-RETRY"
+    severity = "error"
+    description = ("a reconnect- or fatal-class exception caught by a "
+                   "RetryPolicy site's retry_on must appear in its "
+                   "no_retry (or ride on_fence for the fence family)")
+
+    def check_project(self, project: ProjectContext) -> Iterable[Finding]:
+        tree = project.parse(ERRORS_MODULE)
+        if tree is None:
+            return
+        table = _registered_exceptions(tree)
+        need = sorted(n for n, row in table.items()
+                      if row["retry"] in _NO_RESEND_CLASSES)
+        for rel in project.glob("fluidframework_tpu/**/*.py"):
+            if rel == ERRORS_MODULE or "__pycache__" in rel:
+                continue
+            mod = project.parse(rel)
+            if mod is None:
+                continue
+            for node in ast.walk(mod):
+                if not isinstance(node, ast.Call) \
+                        or _terminal(node.func) != "run":
+                    continue
+                kws = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+                if "operation" not in kws:
+                    continue  # not a RetryPolicy.run site
+                retry_names = _tuple_names(kws.get("retry_on"))
+                if retry_names is None:
+                    continue  # default retry_on names no registry type
+                no_retry = _tuple_names(kws.get("no_retry")) or set()
+                fence = kws.get("on_fence")
+                has_fence = fence is not None and not (
+                    isinstance(fence, ast.Constant)
+                    and fence.value is None)
+                for exc_name in need:
+                    chain = _chain(exc_name, table)
+                    if not chain & retry_names:
+                        continue
+                    if chain & no_retry:
+                        continue
+                    if has_fence and "ShardFencedError" in chain:
+                        continue
+                    row = table[exc_name]
+                    yield self.project_finding(rel, node.lineno, (
+                        f"{row['retry']}-class exception '{exc_name}' is "
+                        f"caught by retry_on at this RetryPolicy site but "
+                        f"absent from no_retry — "
+                        f"{_RETRY_PHRASE[row['retry']]}"))
+
+
+# -- reply-path shape detection (FL-ERR-CROSS / FL-ERR-HANDLER) ---------------
+
+
+#: call terminals that push a frame back to a client.
+_REPLY_SENDERS = frozenset({"send_obj"})
+
+
+def _is_reply_fn(fn: ast.AST) -> bool:
+    """A function that frames responses: builds ``"ok"``-keyed dicts or
+    pushes frames via ``send_obj``."""
+    for node in _fn_walk(fn):
+        if isinstance(node, ast.Dict) \
+                and any(_const_str(k) == "ok" for k in node.keys):
+            return True
+        if isinstance(node, ast.Call) \
+                and _terminal(node.func) in _REPLY_SENDERS:
+            return True
+    return False
+
+
+def _dispatchish(call: ast.Call) -> bool:
+    term = _terminal(call.func) or ""
+    if "dispatch" in term or term.startswith("_handle") or term == "handle":
+        return True
+    # executor indirection: loop.run_in_executor(None, self._dispatch, ...)
+    for arg in call.args:
+        t = _terminal(arg)
+        if t is not None and "dispatch" in t:
+            return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    elts = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    return any(_terminal(el) in ("Exception", "BaseException")
+               for el in elts)
+
+
+def _broad_handler(try_node: ast.Try) -> Optional[ast.ExceptHandler]:
+    for h in try_node.handlers:
+        if _is_broad(h):
+            return h
+    return None
+
+
+def _frames_typed(handler: ast.ExceptHandler) -> bool:
+    """The handler builds a typed error response: a dict carrying both
+    ``"ok"`` and ``"code"``, or assigns a ``["code"]`` slot."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Dict):
+            keys = {_const_str(k) for k in node.keys}
+            if "ok" in keys and "code" in keys:
+                return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and _const_str(t.slice) == "code":
+                    return True
+    return False
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    """The handler re-frames, re-raises, or reports to telemetry."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Dict) \
+                and any(_const_str(k) == "ok" for k in node.keys):
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and _const_str(t.slice) == "code":
+                    return True
+        if isinstance(node, ast.Call):
+            term = _terminal(node.func)
+            if term in ("send_obj", "bump"):
+                return True
+            if term == "send":
+                recv = _dotted(node.func.value) \
+                    if isinstance(node.func, ast.Attribute) else None
+                if recv is not None and "logger" in recv:
+                    return True
+    return False
+
+
+# -- FL-ERR-CROSS -------------------------------------------------------------
+
+
+@register
+class ErrCrossRule(Rule):
+    """Dispatch faults must cross the boundary framed and typed."""
+
+    name = "FL-ERR-CROSS"
+    severity = "error"
+    description = ("in a reply-path function, a dispatch call must be "
+                   "covered by a broad except that frames a typed (coded) "
+                   "error response — otherwise handler faults cross the "
+                   "process boundary unframed")
+    scope = ("fluidframework_tpu/service/", "fluidframework_tpu/drivers/")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            if not _is_reply_fn(fn):
+                continue
+            yield from self._check_fn(m, fn)
+
+    def _check_fn(self, m: ModuleContext, fn) -> Iterable[Finding]:
+        hits: List[Tuple[ast.Call, Optional[ast.ExceptHandler]]] = []
+
+        def walk(node: ast.AST, cover) -> None:
+            if isinstance(node, ast.Call) and _dispatchish(node):
+                hits.append((node, cover[-1] if cover else None))
+            if isinstance(node, ast.Try):
+                bh = _broad_handler(node)
+                inner = cover + [bh] if bh is not None else cover
+                for st in node.body + node.orelse:
+                    walk(st, inner)
+                # a fault raised INSIDE a handler or finally is not
+                # re-caught by this try
+                for h in node.handlers:
+                    for st in h.body:
+                        walk(st, cover)
+                for st in node.finalbody:
+                    walk(st, cover)
+                return
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                walk(child, cover)
+
+        for st in fn.body:
+            walk(st, [])
+        for call, handler in hits:
+            if handler is None:
+                yield m.finding(self, call, (
+                    f"a fault can escape this dispatch call in "
+                    f"{fn.name}() unframed — no broad except frames a "
+                    f"typed error response for the waiting client"))
+            elif not _frames_typed(handler):
+                yield m.finding(self, call, (
+                    f"the broad except covering this dispatch call in "
+                    f"{fn.name}() frames no typed error response (no "
+                    f"'code') — an untyped failure crosses the process "
+                    f"boundary"))
+
+
+# -- FL-ERR-HANDLER -----------------------------------------------------------
+
+
+@register
+class ErrHandlerRule(Rule):
+    """Broad excepts on reply paths must not swallow silently."""
+
+    name = "FL-ERR-HANDLER"
+    severity = "error"
+    description = ("a broad except in a reply-path function must re-frame "
+                   "an error response, report to telemetry, or re-raise — "
+                   "a silent swallow leaves the client waiting forever")
+    scope = ("fluidframework_tpu/service/", "fluidframework_tpu/drivers/")
+
+    def check(self, m: ModuleContext) -> Iterable[Finding]:
+        for fn in _functions(m.tree):
+            if not _is_reply_fn(fn):
+                continue
+            for node in _fn_walk(fn):
+                if not isinstance(node, ast.Try):
+                    continue
+                for h in node.handlers:
+                    if not _is_broad(h):
+                        continue
+                    if _handler_reports(h):
+                        continue
+                    yield m.finding(self, h, (
+                        f"broad except on the reply path of {fn.name}() "
+                        f"neither re-frames an error response nor reports "
+                        f"to telemetry — a swallowed fault leaves the "
+                        f"client waiting forever"))
