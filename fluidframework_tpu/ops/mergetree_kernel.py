@@ -546,11 +546,11 @@ def _export_state(final: MTState, doc_base: Optional[jnp.ndarray] = None,
         # [D, 4] int32 output instead of a full S-column row — one less
         # row off the dominant fetch; widen_export stitches the canonical
         # misc row back host-side.
-        out = jnp.stack(rows, axis=1).astype(jnp.int16)
+        out = jnp.stack(rows, axis=1).astype(jnp.int16)  # bound: i16_ok
         return out, misc[:, :4]
     rows.append(misc)
     out = jnp.stack(rows, axis=1)
-    return out.astype(jnp.int16) if i16 else out
+    return out.astype(jnp.int16) if i16 else out  # bound: i16_ok
 
 
 def export_to_numpy(export):
@@ -711,7 +711,8 @@ def gather_export_rows(export, idx: np.ndarray):
                 padded = np.concatenate(
                     [rows, np.repeat(rows[-1:], pad)]) if pad else rows
                 dev_idx = jnp.asarray(padded, jnp.int32)
-            full = np.asarray(_take_docs(a, dev_idx))
+            dev = _take_docs(a, dev_idx)  # bucketed-by: next_bucket_fine
+            full = np.asarray(dev)
             moved += full.nbytes
             got = full[:m]
         out.append(got)

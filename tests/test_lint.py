@@ -212,6 +212,31 @@ def test_fluidfail_modules_lint_clean_with_zero_suppressions():
     assert offenders == [], "error-taxonomy modules stay suppression-free"
 
 
+def test_fluidshape_modules_lint_clean_with_zero_suppressions():
+    """ISSUE 20 acceptance pin: every module the kernel family audits —
+    the Pallas fold, both kernel families, the resident-buffer cache,
+    the pipeline, and the mesh twin — passes ALL module rules (all six
+    families) with zero findings AND zero baseline entries.  The true
+    positives the family caught (unannotated narrow casts in the export
+    path, the unroutable delta-fetch gather index) were annotated with
+    reviewed reasons, never baselined."""
+    new_modules = [
+        "fluidframework_tpu/ops/pallas_fold.py",
+        "fluidframework_tpu/ops/mergetree_kernel.py",
+        "fluidframework_tpu/ops/tree_kernel.py",
+        "fluidframework_tpu/ops/device_cache.py",
+        "fluidframework_tpu/ops/pipeline.py",
+        "fluidframework_tpu/ops/family.py",
+        "fluidframework_tpu/ops/interning.py",
+        "fluidframework_tpu/parallel/shard.py",
+    ]
+    findings = analyze(ROOT, relpaths=new_modules)
+    assert findings == [], [f.render() for f in findings]
+    entries = load_baseline(BASELINE) if BASELINE.is_file() else []
+    offenders = [e for e in entries if e.get("path") in new_modules]
+    assert offenders == [], "kernel-layer modules stay suppression-free"
+
+
 def test_counter_names_asserted_in_tests_are_produced():
     """ISSUE 17 satellite: counter-name drift.  Every namespaced counter
     literal a test references (catchup.*, fd.*, retry.*, swarm.*) must
@@ -260,8 +285,8 @@ def test_counter_names_asserted_in_tests_are_produced():
 def test_every_rule_registered_and_described():
     rules = all_rules()
     # 9 (PR 2) + 6 fluidrace (PR 4) + 6 fluidleak (PR 5) + donate (PR 13)
-    # + 6 fluiddur (PR 17) + 5 fluidfail (PR 19)
-    assert len(rules) >= 33, sorted(rules)
+    # + 6 fluiddur (PR 17) + 5 fluidfail (PR 19) + 5 fluidshape (PR 20)
+    assert len(rules) >= 38, sorted(rules)
     for name, rule in rules.items():
         assert rule.description, f"{name} has no description"
         assert rule.severity in ("error", "warning"), name
@@ -319,6 +344,21 @@ def test_cli_rules_err_family_filter(capsys):
                 if rule_family(rule) == "errors"}
     assert listed == expected and len(expected) == 5, (listed, expected)
     assert all("[errors/" in ln for ln in out.splitlines() if ln)
+
+
+def test_cli_rules_kern_family_filter(capsys):
+    """ISSUE 20: `--rules kern` selects exactly the five-rule FL-KERN
+    family (the kernel shape/dtype analyzer runs standalone — it is the
+    first gate of tools/tpu_preflight.py)."""
+    from tools.fluidlint.cli import main, rule_family
+
+    assert main(["--rules", "kern", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    listed = {ln.split(" ", 1)[0] for ln in out.splitlines() if ln}
+    expected = {name for name, rule in all_rules().items()
+                if rule_family(rule) == "kernel"}
+    assert listed == expected and len(expected) == 5, (listed, expected)
+    assert all("[kernel/" in ln for ln in out.splitlines() if ln)
 
 
 def test_cli_rules_family_filter_scopes_analysis(tmp_path, capsys):
@@ -460,6 +500,71 @@ def test_cli_diff_usage_and_git_errors(tmp_path, capsys):
     (bare / "fluidframework_tpu").mkdir(parents=True)
     assert main(["--root", str(bare), "--diff", "HEAD"]) == 2
     capsys.readouterr()
+
+
+def test_cli_sarif_writes_valid_report(tmp_path, capsys):
+    """ISSUE 20 satellite: `--sarif FILE` writes a SARIF 2.1.0 document
+    — registry as the tool driver, findings as results with
+    repo-relative locations — while the text output and the exit code
+    stay exactly what they were without it."""
+    import json
+
+    from tools.fluidlint.cli import main
+
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    sarif = tmp_path / "out.sarif"
+    assert main(["--root", str(tmp_path), "--sarif", str(sarif)]) == 1
+    assert "FL-DET-CLOCK" in capsys.readouterr().out
+    doc = json.loads(sarif.read_text())
+    assert doc["version"] == "2.1.0" and "2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "fluidlint"
+    ids = {r["id"] for r in driver["rules"]}
+    assert "FL-DET-CLOCK" in ids and "FL-KERN-BLOCK" in ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    (hit,) = run["results"]
+    assert hit["ruleId"] == "FL-DET-CLOCK" and hit["level"] == "error"
+    loc = hit["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "fluidframework_tpu/loader/bad.py"
+    assert loc["region"]["startLine"] >= 1
+    assert "suppressions" not in hit
+
+
+def test_cli_sarif_maps_reviewed_suppressions(tmp_path, capsys):
+    """A baselined finding still appears in the SARIF output, carrying
+    an ``external`` suppression whose justification is the reviewed
+    reason — CI diff annotation sees WHAT was reviewed away and why."""
+    import json
+
+    from tools.fluidlint.cli import main
+
+    pkg = tmp_path / "fluidframework_tpu" / "loader"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "import time\n\ndef hold():\n    return time.time()\n")
+    bp = tmp_path / "lint_baseline.json"
+    assert main(["--root", str(tmp_path),
+                 "--write-baseline", str(bp)]) == 0
+    doc = json.loads(bp.read_text())
+    for e in doc["suppressions"]:
+        e["reason"] = "reviewed: synthetic fixture"
+    bp.write_text(json.dumps(doc))
+    capsys.readouterr()
+    sarif = tmp_path / "out.sarif"
+    assert main(["--root", str(tmp_path), "--baseline", str(bp),
+                 "--sarif", str(sarif)]) == 0
+    capsys.readouterr()
+    run = json.loads(sarif.read_text())["runs"][0]
+    (hit,) = run["results"]
+    assert hit["ruleId"] == "FL-DET-CLOCK"
+    (sup,) = hit["suppressions"]
+    assert sup["kind"] == "external"
+    assert sup["justification"] == "reviewed: synthetic fixture"
 
 
 def test_cli_write_baseline_bootstraps_missing_file(tmp_path, capsys):

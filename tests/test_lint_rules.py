@@ -560,6 +560,76 @@ MODULE_RULE_FIXTURES = {
         """,
         SERVICE,
     ),
+    "FL-KERN-BLOCK": (
+        """
+        from jax.experimental import pallas as pl
+        def fold(x, D):
+            spec = pl.BlockSpec((D, 100), lambda d: (d, 0))
+            return spec
+        """,
+        """
+        from jax.experimental import pallas as pl
+        LANE = 128
+        def _round_up(n, mult):
+            return ((n + mult - 1) // mult) * mult
+        def fold(x, D):
+            Dp = _round_up(D, 8)
+            spec = pl.BlockSpec((Dp, LANE), lambda d: (d, 0))
+            return spec
+        """,
+        OPS,
+    ),
+    "FL-KERN-NARROW": (
+        """
+        import numpy as np
+        def pack(vals):
+            return np.asarray(vals).astype(np.int16)
+        """,
+        """
+        import numpy as np
+        I16_LIMIT = 32766
+        def pack(vals, meta):
+            if not meta.get("i16_ok"):
+                raise ValueError("values exceed the narrow bound")
+            return np.asarray(vals).astype(np.int16)
+        """,
+        OPS,
+    ),
+    "FL-KERN-BUCKET": (
+        """
+        import jax
+        @jax.jit
+        def _fold(x, n):
+            return x[:n]
+        def run(x, docs):
+            return _fold(x, len(docs))
+        """,
+        """
+        import jax
+        from .interning import next_bucket
+        @jax.jit
+        def _fold(x, n):
+            return x[:n]
+        def run(x, docs):
+            return _fold(x, next_bucket(len(docs)))
+        """,
+        OPS,
+    ),
+    "FL-KERN-PAD": (
+        """
+        import jax.numpy as jnp
+        def digest(x):
+            plane = jnp.pad(x, ((0, 3),))
+            return plane.sum()
+        """,
+        """
+        import jax.numpy as jnp
+        def digest(x, mask):
+            plane = jnp.pad(x, ((0, 3),))
+            return jnp.where(mask, plane, 0).sum()
+        """,
+        OPS,
+    ),
 }
 
 
@@ -2590,6 +2660,342 @@ def test_err_retry_negative(tmp_path):
     """)
     assert [f for f in analyze(tmp_path)
             if f.rule == "FL-ERR-RETRY"] == []
+
+
+# -- fluidshape: FL-KERN-BLOCK behavior ---------------------------------------
+
+
+def test_kern_block_annotation_accepts_unprovable_dim():
+    src = """
+    from jax.experimental import pallas as pl
+    def _round_up(n, mult):
+        return ((n + mult - 1) // mult) * mult
+    def fold(x, Sp):
+        return pl.BlockSpec((8, Sp), lambda d: (d, 0))  # block-rule: _round_up
+    """
+    assert findings_for(src, OPS, "FL-KERN-BLOCK") == []
+
+
+def test_kern_block_annotation_typo_is_a_finding():
+    # a typo'd '# block-rule:' must not silently exempt the dim
+    src = """
+    from jax.experimental import pallas as pl
+    def _round_up(n, mult):
+        return ((n + mult - 1) // mult) * mult
+    def fold(x, Sp):
+        return pl.BlockSpec((8, Sp), lambda d: (d, 0))  # block-rule: _round_upp
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BLOCK")
+    assert len(hits) == 2  # the bad annotation AND the unproven dim
+    assert any("no recognized rounding helper" in f.message for f in hits)
+
+
+def test_kern_block_proven_violation_fires_despite_annotation():
+    # annotations excuse what the rule cannot prove, never what it can
+    src = """
+    from jax.experimental import pallas as pl
+    def _round_up(n, mult):
+        return ((n + mult - 1) // mult) * mult
+    def fold(x):
+        return pl.BlockSpec((8, 100), lambda d: (d, 0))  # block-rule: _round_up
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BLOCK")
+    assert len(hits) == 1 and "literal 100" in hits[0].message
+
+
+def test_kern_block_tuple_helper_route_accepted():
+    # the pallas_fold shape: dims unpacked from a tuple-returning wrapper
+    # around the canonical round-up, consts aliased locally, grid algebra
+    # over rounded names
+    src = """
+    import jax
+    from jax.experimental import pallas as pl
+    DOC_BLOCK = 8
+    LANE = 128
+    def _round_up(n, mult):
+        return ((n + mult - 1) // mult) * mult
+    def _padded_dims(D, S):
+        return (_round_up(max(D, 1), DOC_BLOCK),
+                _round_up(max(S, 1), LANE))
+    def fold(kernel, x, D, S):
+        Dp, Sp = _padded_dims(D, S)
+        B = DOC_BLOCK
+        row = pl.BlockSpec((B, Sp), lambda d: (d, 0))
+        return pl.pallas_call(kernel, grid=(Dp // B,), in_specs=[row])
+    """
+    assert findings_for(src, OPS, "FL-KERN-BLOCK") == []
+
+
+def test_kern_block_wrong_position_rounding_fires():
+    # a dim rounded to the SUBLANE multiple used in the lane position is
+    # a proven violation — 8 does not divide 128
+    src = """
+    from jax.experimental import pallas as pl
+    DOC_BLOCK = 8
+    LANE = 128
+    def _round_up(n, mult):
+        return ((n + mult - 1) // mult) * mult
+    def _padded_dims(D, S):
+        return (_round_up(max(D, 1), DOC_BLOCK),
+                _round_up(max(S, 1), LANE))
+    def fold(x, D, S):
+        Dp, Sp = _padded_dims(D, S)
+        return pl.BlockSpec((8, Dp), lambda d: (d, 0))
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BLOCK")
+    assert len(hits) == 1
+    assert "rounded to multiples of 8, not of 128" in hits[0].message
+
+
+def test_kern_block_is_interpret_mode_blind():
+    # interpret=True accepts blocks Mosaic rejects — the r05 failure.
+    # The rule must fire regardless of the interpret kwarg.
+    src = """
+    from jax.experimental import pallas as pl
+    def fold(kernel, x, D):
+        return pl.pallas_call(kernel, grid=(D // 8,), interpret=True)
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BLOCK")
+    assert len(hits) == 1 and "grid extent" in hits[0].message
+
+
+# -- fluidshape: FL-KERN-NARROW behavior --------------------------------------
+
+
+def test_kern_narrow_bound_annotation_accepted():
+    src = """
+    import numpy as np
+    I16_LIMIT = 32766
+    def pack(vals):
+        return vals.astype(np.int16)  # bound: I16_LIMIT
+    """
+    assert findings_for(src, OPS, "FL-KERN-NARROW") == []
+
+
+def test_kern_narrow_bound_annotation_typo_is_a_finding():
+    src = """
+    import numpy as np
+    I16_LIMIT = 32766
+    def pack(vals):
+        return vals.astype(np.int16)  # bound: I16_LIMIT_TYPO
+    """
+    hits = findings_for(src, OPS, "FL-KERN-NARROW")
+    assert len(hits) == 1
+    assert "references no bound guard" in hits[0].message
+
+
+def test_kern_narrow_dtype_compare_is_a_guard():
+    # relayout of an ALREADY-narrow buffer narrows nothing
+    src = """
+    import numpy as np
+    def relayout(buf):
+        if buf.dtype != np.int16:
+            return None
+        return np.ascontiguousarray(buf, np.int16)
+    """
+    assert findings_for(src, OPS, "FL-KERN-NARROW") == []
+
+
+def test_kern_narrow_accumulation_on_narrow_lanes_fires():
+    src = """
+    import numpy as np
+    def total(vals):
+        packed = vals.astype(np.int16)
+        return packed.sum()
+    """
+    hits = findings_for(src, OPS, "FL-KERN-NARROW")
+    assert any("accumulating op on narrow lanes 'packed'" in f.message
+               for f in hits)
+
+
+def test_kern_narrow_iinfo_is_a_guard():
+    src = """
+    import numpy as np
+    def pack(vals):
+        info = np.iinfo(np.int16)
+        ok = vals.max() <= info.max
+        return vals.astype(np.int16) if ok else vals
+    """
+    assert findings_for(src, OPS, "FL-KERN-NARROW") == []
+
+
+# -- fluidshape: FL-KERN-BUCKET behavior --------------------------------------
+
+
+def test_kern_bucket_annotation_accepted():
+    src = """
+    import jax
+    @jax.jit
+    def _fold(x, n):
+        return x[:n]
+    def run(x, docs):
+        return _fold(x, len(docs))  # bucketed-by: next_bucket
+    """
+    assert findings_for(src, OPS, "FL-KERN-BUCKET") == []
+
+
+def test_kern_bucket_annotation_typo_is_a_finding():
+    src = """
+    import jax
+    @jax.jit
+    def _fold(x, n):
+        return x[:n]
+    def run(x, docs):
+        return _fold(x, len(docs))  # bucketed-by: next_bucket_typo
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BUCKET")
+    assert len(hits) == 2  # the bad annotation AND the unrouted shape
+    assert any("no recognized bucket or rounding helper" in f.message
+               for f in hits)
+
+
+def test_kern_bucket_taint_flows_through_names():
+    # D = len(docs) is dirty; rebinding through the ladder cleans it
+    src = """
+    import jax
+    from .interning import next_bucket
+    @jax.jit
+    def _fold(x, n):
+        return x[:n]
+    def dirty(x, docs):
+        D = len(docs)
+        return _fold(x, D)
+    def clean(x, docs):
+        D = next_bucket(len(docs))
+        return _fold(x, D)
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BUCKET")
+    assert len(hits) == 1 and "in dirty()" in hits[0].message
+
+
+def test_kern_bucket_jit_factory_calls_checked():
+    # the lru-cached factory idiom: factory(...)(args) reaches a jit
+    src = """
+    import jax
+    import functools
+    @functools.lru_cache(maxsize=8)
+    def _fold_fn(static):
+        return jax.jit(lambda x, n: x[:n])
+    def run(x, docs):
+        return _fold_fn(True)(x, len(docs))
+    """
+    hits = findings_for(src, OPS, "FL-KERN-BUCKET")
+    assert len(hits) == 1 and "_fold_fn" in hits[0].message
+
+
+# -- fluidshape: FL-KERN-PAD behavior -----------------------------------------
+
+
+def test_kern_pad_masked_by_annotation_accepted():
+    src = """
+    import jax.numpy as jnp
+    def digest(x, mask):
+        plane = jnp.pad(x, ((0, 3),))
+        return plane.sum()  # masked-by: mask
+    """
+    assert findings_for(src, OPS, "FL-KERN-PAD") == []
+
+
+def test_kern_pad_masked_by_typo_is_a_finding():
+    src = """
+    import jax.numpy as jnp
+    def digest(x, mask):
+        plane = jnp.pad(x, ((0, 3),))
+        return plane.sum()  # masked-by: maskk
+    """
+    hits = findings_for(src, OPS, "FL-KERN-PAD")
+    assert len(hits) == 2  # the bad annotation AND the unmasked reduce
+    assert any("no name" in f.message for f in hits)
+
+
+def test_kern_pad_mask_reassignment_clears():
+    src = """
+    import jax.numpy as jnp
+    def digest(x, mask):
+        plane = jnp.pad(x, ((0, 3),))
+        plane = jnp.where(mask, plane, 0)
+        return plane.sum()
+    """
+    assert findings_for(src, OPS, "FL-KERN-PAD") == []
+
+
+def test_kern_pad_inline_chain_fires():
+    src = """
+    import jax.numpy as jnp
+    def digest(x):
+        return jnp.pad(x, ((0, 3),)).sum()
+    """
+    hits = findings_for(src, OPS, "FL-KERN-PAD")
+    assert len(hits) == 1 and "reaches reduction 'sum'" in hits[0].message
+
+
+# -- project rule: FL-KERN-FAMILY ---------------------------------------------
+
+
+def _write_family_tree(root, pipeline_body, shard_body):
+    ops = root / "fluidframework_tpu" / "ops"
+    par = root / "fluidframework_tpu" / "parallel"
+    ops.mkdir(parents=True)
+    par.mkdir(parents=True)
+    (ops / "family.py").write_text(textwrap.dedent("""
+        from dataclasses import dataclass
+        @dataclass(frozen=True)
+        class KernelFamily:
+            name: str
+            pack: object
+            dispatch: object
+            make_pad: object = None
+            pad_token: object = None
+            dispatch_sharded: object = None
+    """))
+    (ops / "pipeline.py").write_text(textwrap.dedent(pipeline_body))
+    (par / "shard.py").write_text(textwrap.dedent(shard_body))
+
+
+def test_kern_family_positive(tmp_path):
+    _write_family_tree(tmp_path, """
+        from .family import KernelFamily
+        STAGE_KEYS = ("pack", "upload", "dispatch", "download", "extract")
+        def seed_stage(stage):
+            return stage
+        FAM = KernelFamily(
+            name="mt", pack=object(),
+            make_pad=None, pad_token=object(),
+            dispatch_sharded=object(), chunk_tag=object(),
+        )
+    """, """
+        def replay_sharded(stage):
+            return stage
+    """)
+    msgs = {f.message for f in analyze(tmp_path)
+            if f.rule == "FL-KERN-FAMILY"}
+    assert any("omits descriptor hook 'dispatch'" in m for m in msgs), msgs
+    assert any("unknown hook 'chunk_tag'" in m for m in msgs), msgs
+    assert any("mesh hook 'make_pad' is None" in m for m in msgs), msgs
+    assert any("diverges from the canonical stage schema" in m
+               for m in msgs), msgs
+    assert any("mesh twin never seeds" in m for m in msgs), msgs
+
+
+def test_kern_family_negative(tmp_path):
+    _write_family_tree(tmp_path, """
+        from .family import KernelFamily
+        STAGE_KEYS = ("pack", "upload", "dispatch", "device_wait",
+                      "download", "extract")
+        def seed_stage(stage):
+            return stage
+        FAM = KernelFamily(
+            name="mt", pack=object(), dispatch=object(),
+            make_pad=object(), pad_token=object(),
+            dispatch_sharded=object(),
+        )
+    """, """
+        from ..ops.pipeline import seed_stage
+        def replay_sharded(stage):
+            return seed_stage(stage)
+    """)
+    assert [f for f in analyze(tmp_path)
+            if f.rule == "FL-KERN-FAMILY"] == []
 
 
 # -- registry meta-coverage ----------------------------------------------------

@@ -265,3 +265,39 @@ def test_wire_soak_1k_docs_through_catchup_rpc(tmp_path):
     finally:
         srv.terminate()
         srv.wait(timeout=15)
+
+
+# --- TPU-window preflight gate -----------------------------------------------
+
+
+def test_tpu_preflight_exits_zero_on_cpu():
+    """The preflight must be green on CPU (interpret mode): it is the
+    gate that keeps a real TPU window from being burned on failures CPU
+    could already report (kernel lint, fold parity, bench schema)."""
+    import os
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "tpu_preflight.py")],
+        capture_output=True, text=True, cwd=str(root),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["preflight_ok"] is True
+    assert set(doc["gates"]) == {"kernel_lint", "mergetree_parity",
+                                 "tree_parity", "bench_schema"}
+    assert all(g["ok"] for g in doc["gates"].values())
+
+
+def test_tpu_window_runs_preflight_first():
+    """The window catcher's healthy block starts with the preflight —
+    before the pallas canary and every bench — and keeps probing on a
+    preflight failure instead of spending the window."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    src = (root / "tools" / "tpu_window.sh").read_text(encoding="utf-8")
+    assert "tools/tpu_preflight.py" in src
+    assert src.index("tpu_preflight.py") < src.index("pallas_probe.py")

@@ -319,3 +319,66 @@ def test_summarize_wider_min_seq_emits_limbo():
     )
     advanced.advance(5, 4)  # the purge actually runs
     assert advanced.summarize(min_seq=4).digest() == wide.digest()
+
+
+# -- hardware-rule regression net: the tree family gets the same Mosaic
+# block-rule pin + non-divisible-bucket parity coverage that
+# test_pallas_fold.py gives the merge-tree family. --
+
+
+def _fuzz_doc_input(seed, steps):
+    _factory, _trees, log, final_seq, final_msn = run_fuzz_doc(
+        seed, steps=steps)
+    return TreeDocInput(doc_id="tree", ops=log, final_seq=final_seq,
+                        final_msn=final_msn)
+
+
+def test_tree_buckets_satisfy_mosaic_block_rule():
+    """Mirror of test_pallas_fold.test_padded_block_dims_satisfy_mosaic_
+    rule for the tree family: every device-plane bucket the packer
+    derives (N and T from tree_buckets, C inside pack_tree_batch) is a
+    power-of-two ladder value at or above its floor — hence divisible
+    by the 8-row sublane unit — and covers the per-doc used-row counts
+    it was sized from (pads extend, never truncate)."""
+    from fluidframework_tpu.ops.tree_kernel import (
+        pack_tree_batch,
+        tree_buckets,
+    )
+
+    docs = [_fuzz_doc_input(1400 + i, steps)
+            for i, steps in enumerate((4, 25, 60, 110))]
+    for k in range(1, len(docs) + 1):
+        sub = docs[:k]
+        N, T = tree_buckets(sub)
+        state, edits, meta = pack_tree_batch(sub)
+        C = state.head.shape[1]
+        for bucket, floor in ((N, 16), (T, 16), (C, 8)):
+            assert bucket >= floor and bucket % 8 == 0
+            # Power-of-two ladder: a finite, stable set of jit shapes.
+            assert bucket & (bucket - 1) == 0, bucket
+        # The allocated planes use exactly the derived buckets ...
+        assert state.next.shape == (k, N)
+        assert edits.kind.shape == (k, T)
+        # ... and every used-row count fits inside its bucket.
+        assert int(meta["n_nodes"].max()) <= N
+        assert int(meta["n_cont"].max()) <= C
+        assert int(meta["t_rows"].max()) <= T
+
+
+def test_tree_parity_on_nondivisible_buckets():
+    """Mirror of test_pallas_fold.test_pallas_fold_parity_on_
+    nondivisible_buckets: full digest parity on a batch whose natural
+    buckets genuinely violate the (8, 128) lane rule — a doc count that
+    is not a multiple of 8 and node/edit buckets that are not multiples
+    of 128 — so pad rows must be provably inert, not accidentally
+    aligned away."""
+    from fluidframework_tpu.ops.tree_kernel import tree_buckets
+
+    docs = [_fuzz_doc_input(1500 + i, steps=18) for i in range(11)]
+    N, T = tree_buckets(docs)
+    assert len(docs) % 8 != 0, "D accidentally 8-aligned"
+    assert N % 128 != 0, f"N={N} accidentally 128-aligned"
+    assert T % 128 != 0, f"T={T} accidentally 128-aligned"
+    summaries = replay_tree_batch(docs)
+    for doc, device in zip(docs, summaries):
+        assert device.digest() == oracle_summary(doc).digest()

@@ -4,7 +4,7 @@
                               [--rules FAMILY[,FAMILY...]]
                               [--format text|json | --json] [--list-rules]
                               [--check-baseline] [--write-baseline FILE]
-                              [--diff GIT_REF] [paths ...]
+                              [--diff GIT_REF] [--sarif FILE] [paths ...]
 
 Exit codes: 0 clean, 1 unsuppressed findings / stale or invalid baseline /
 baseline hygiene failure, 2 usage error.
@@ -67,6 +67,56 @@ def rule_family(rule) -> str:
     return module.split("rules_", 1)[-1] if "rules_" in module else module
 
 
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_doc(rules, report, entries) -> dict:
+    """SARIF 2.1.0 document for one run: the selected rule registry as
+    the tool driver, every finding as a result (suppressed ones carry an
+    ``external`` suppression with the reviewed reason as justification),
+    locations as repo-relative uri + startLine."""
+    reasons = {(e.get("rule"), e.get("path"), e.get("message")):
+               e.get("reason", "") for e in entries}
+
+    def result(f, suppressed: bool) -> dict:
+        r = {
+            "ruleId": f.rule,
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        if suppressed:
+            r["suppressions"] = [{
+                "kind": "external",
+                "justification": reasons.get(f.suppression_key, ""),
+            }]
+        return r
+
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fluidlint",
+                "rules": [{
+                    "id": name,
+                    "shortDescription": {
+                        "text": " ".join(rules[name].description.split())},
+                    "defaultConfiguration": {"level": rules[name].severity},
+                } for name in sorted(rules)],
+            }},
+            "results": [result(f, False) for f in report.unsuppressed]
+            + [result(f, True) for f in report.suppressed],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.fluidlint",
@@ -105,6 +155,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "same findings contract as listing those "
                              "paths explicitly — module rules only, "
                              "project rules stay a full-run cost")
+    parser.add_argument("--sarif", metavar="FILE", default=None,
+                        help="also write the report as SARIF 2.1.0 (rule "
+                             "registry, finding locations, reviewed "
+                             "suppressions as external suppression "
+                             "objects) — output format and exit code "
+                             "are unchanged")
     args = parser.parse_args(argv)
     if args.json:
         args.format = "json"
@@ -224,6 +280,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     hygiene = baseline_rule_hygiene(all_entries)
     hygiene += baseline_function_hygiene(root, entries)
     clean = report.clean and not hygiene
+
+    if args.sarif:
+        pathlib.Path(args.sarif).write_text(
+            json.dumps(_sarif_doc(rules, report, entries), indent=2) + "\n",
+            encoding="utf-8")
 
     if args.format == "json":
         print(json.dumps({

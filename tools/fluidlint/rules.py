@@ -9,6 +9,7 @@ from . import rules_determinism  # noqa: F401
 from . import rules_durability   # noqa: F401
 from . import rules_errors       # noqa: F401
 from . import rules_events       # noqa: F401
+from . import rules_kernel       # noqa: F401
 from . import rules_lifecycle    # noqa: F401
 from . import rules_trace        # noqa: F401
 from . import rules_wire         # noqa: F401

@@ -32,6 +32,17 @@ while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     log "HEALTHY — starting measurement chain"
     pkill -f test_fuzz_nightly 2>/dev/null; pkill -f "pytest tests/" 2>/dev/null; sleep 2
+    # Preflight FIRST (ISSUE 20): kernel lint + interpret-mode parity +
+    # bench schema, all CPU-answerable — never spend the window
+    # discovering a failure CPU could have reported.  On failure keep
+    # probing: the next window may follow a fix.
+    if ! timeout 580 python tools/tpu_preflight.py \
+        > window_artifacts/preflight.json 2> window_artifacts/preflight.err; then
+      log "preflight FAILED — window not spent ($(head -c 160 window_artifacts/preflight.err))"
+      sleep 150
+      continue
+    fi
+    log "preflight ok $(head -c 120 window_artifacts/preflight.json)"
     KEEP=()
     MAIN_OK=0
     # Canary first (smallest, highest-information: the Mosaic compile),
